@@ -1,0 +1,23 @@
+"""Test bootstrap.
+
+Engine/sharding tests run on a virtual 8-device CPU mesh (SURVEY.md §4):
+JAX must see the flags before first import, so they are set here at conftest
+import time — before any test module imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def project_root(tmp_path):
+    """A scratch project dir with a .roundtable skeleton."""
+    (tmp_path / ".roundtable" / "sessions").mkdir(parents=True)
+    return tmp_path

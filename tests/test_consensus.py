@@ -1,0 +1,237 @@
+"""Consensus engine unit tests — the parsing/repair/validation long tail.
+
+Mirrors the behavior documented at reference src/consensus.ts (SURVEY.md §3.5)
+against an LLM-malformed-JSON corpus.
+"""
+
+from theroundtaible_tpu.core.consensus import (
+    check_consensus,
+    check_negative_consensus,
+    extract_balanced_json,
+    parse_consensus_from_response,
+    repair_json,
+    sanitize_pending_issues,
+    strip_consensus_json,
+    summarize_consensus,
+    try_parse_consensus,
+    validate_files_to_modify,
+    warn_missing_scope_at_consensus,
+)
+from theroundtaible_tpu.core.types import ConsensusBlock
+
+
+def block(score, knight="k", round_=1, **kw):
+    return ConsensusBlock(knight=knight, round=round_, consensus_score=score, **kw)
+
+
+class TestParseFromResponse:
+    def test_fenced_json_block(self):
+        resp = ('Analysis here.\n```json\n{"consensus_score": 8, '
+                '"agrees_with": ["plan"], "pending_issues": []}\n```\n')
+        b = parse_consensus_from_response(resp, "Claude", 2)
+        assert b is not None
+        assert b.consensus_score == 8
+        assert b.knight == "Claude"
+        assert b.round == 2
+        assert b.agrees_with == ["plan"]
+
+    def test_plain_fenced_block(self):
+        resp = 'text\n```\n{"consensus_score": 5}\n```'
+        b = parse_consensus_from_response(resp, "k", 1)
+        assert b and b.consensus_score == 5
+
+    def test_bare_json_balanced_braces(self):
+        resp = ('I think so.\n{"consensus_score": 7, "nested": {"a": 1}, '
+                '"agrees_with": []}\ntail text')
+        b = parse_consensus_from_response(resp, "k", 1)
+        assert b and b.consensus_score == 7
+
+    def test_braces_inside_strings_do_not_break_extraction(self):
+        resp = '{"consensus_score": 6, "proposal": "use {dict} and \\"quotes\\""}'
+        b = parse_consensus_from_response(resp, "k", 1)
+        assert b and b.proposal == 'use {dict} and "quotes"'
+
+    def test_no_json_returns_none(self):
+        assert parse_consensus_from_response("no json here", "k", 1) is None
+
+    def test_fenced_without_score_falls_through_to_bare(self):
+        resp = ('```json\n{"other": 1}\n```\nand also '
+                '{"consensus_score": 9, "files_to_modify": ["a.py"]}')
+        b = parse_consensus_from_response(resp, "k", 1)
+        assert b and b.consensus_score == 9
+
+    def test_knight_and_round_defaults_on_falsy(self):
+        resp = '{"consensus_score": 4, "knight": "", "round": 0}'
+        b = parse_consensus_from_response(resp, "Gemini", 3)
+        assert b.knight == "Gemini"
+        assert b.round == 3
+
+    def test_knight_in_json_wins(self):
+        resp = '{"consensus_score": 4, "knight": "GPT", "round": 2}'
+        b = parse_consensus_from_response(resp, "Gemini", 3)
+        assert b.knight == "GPT"
+        assert b.round == 2
+
+    def test_score_must_be_number(self):
+        assert parse_consensus_from_response(
+            '{"consensus_score": "9"}', "k", 1) is None
+        assert parse_consensus_from_response(
+            '{"consensus_score": true}', "k", 1) is None
+
+    def test_float_score(self):
+        b = parse_consensus_from_response('{"consensus_score": 8.5}', "k", 1)
+        assert b and b.consensus_score == 8.5
+
+    def test_caps_file_requests_and_verify_commands_at_4(self):
+        resp = ('{"consensus_score": 9, '
+                '"file_requests": ["a", "b", "c", "d", "e", "f"], '
+                '"verify_commands": ["ls", "ls", "ls", "ls", "ls"]}')
+        b = parse_consensus_from_response(resp, "k", 1)
+        assert len(b.file_requests) == 4
+        assert len(b.verify_commands) == 4
+
+
+class TestRepair:
+    def test_comments_stripped(self):
+        raw = '{\n  "consensus_score": 9, // looks good\n  "agrees_with": []\n}'
+        b = try_parse_consensus(raw, "k", 1)
+        assert b and b.consensus_score == 9
+
+    def test_trailing_commas(self):
+        raw = '{"consensus_score": 9, "agrees_with": ["a",],}'
+        b = try_parse_consensus(raw, "k", 1)
+        assert b and b.agrees_with == ["a"]
+
+    def test_single_quotes(self):
+        raw = "{'consensus_score': 7, 'agrees_with': ['x']}"
+        b = try_parse_consensus(raw, "k", 1)
+        assert b and b.agrees_with == ["x"]
+
+    def test_repair_preserves_url_slashes_in_strings(self):
+        raw = ('{"consensus_score": 9, "pending_issues": '
+               '["check https://example.com/x", ],}')
+        b = try_parse_consensus(raw, "k", 1)
+        assert b and b.pending_issues == ["check https://example.com/x"]
+
+    def test_repair_apostrophe_inside_double_quoted_value(self):
+        # Valid JSON with apostrophe parses raw — repair never sees it.
+        raw = '{"consensus_score": 9, "proposal": "don\'t break"}'
+        b = try_parse_consensus(raw, "k", 1)
+        assert b and b.proposal == "don't break"
+
+    def test_repair_json_idempotent_on_valid(self):
+        valid = '{"a": 1, "b": [2, 3]}'
+        assert repair_json(valid) == valid
+
+
+class TestSanitizePendingIssues:
+    def test_none_variants_dropped(self):
+        raw = ["none", "N/A", "geen", "  ", "real issue", "No Issues",
+               "all resolved", "-"]
+        assert sanitize_pending_issues(raw) == ["real issue"]
+
+    def test_non_list(self):
+        assert sanitize_pending_issues("none") == []
+        assert sanitize_pending_issues(None) == []
+
+    def test_non_string_items_dropped(self):
+        assert sanitize_pending_issues([1, None, "x"]) == ["x"]
+
+
+class TestValidateFilesToModify:
+    def test_normalization_and_dedupe(self):
+        raw = ["./src/a.py", "src\\b.py", "src/a.py", "NEW: src/c.py",
+               "new:src/d.py"]
+        assert validate_files_to_modify(raw) == [
+            "src/a.py", "src/b.py", "NEW:src/c.py", "NEW:src/d.py"]
+
+    def test_traversal_and_absolute_rejected(self):
+        assert validate_files_to_modify(
+            ["/etc/passwd", "../up.py", "a/../b.py", "ok.py"]) == ["ok.py"]
+
+    def test_non_list(self):
+        assert validate_files_to_modify("a.py") == []
+
+    def test_empty_and_nonstring_dropped(self):
+        assert validate_files_to_modify(["", "  ", 42, "NEW:"]) == []
+
+
+class TestChecks:
+    def test_positive_all_at_threshold(self):
+        assert check_consensus([block(9), block(10)], 9)
+
+    def test_positive_one_below(self):
+        assert not check_consensus([block(9), block(8)], 9)
+
+    def test_positive_empty(self):
+        assert not check_consensus([], 9)
+
+    def test_pending_issues_do_not_block(self):
+        assert check_consensus(
+            [block(10, pending_issues=["note to self"])], 9)
+
+    def test_negative_requires_two_knights(self):
+        assert not check_negative_consensus([block(0)])
+        assert check_negative_consensus([block(0), block(3)])
+        assert not check_negative_consensus([block(0), block(4)])
+
+
+class TestSummaries:
+    def test_summarize(self):
+        s = summarize_consensus([
+            block(10, knight="A", agrees_with=["x"]),
+            block(6, knight="B", pending_issues=["y"]),
+            block(2, knight="C", files_to_modify=["f.py"]),
+        ])
+        assert "[AGREES]" in s and "[PARTIAL]" in s and "[DISAGREES]" in s
+        assert "Average score: 6.0/10" in s
+        assert "Score 10/10" in s  # integral scores render without .0
+
+    def test_summarize_empty(self):
+        assert summarize_consensus([]) == "No consensus data yet."
+
+    def test_warn_missing_scope(self):
+        assert warn_missing_scope_at_consensus(block(9)) is not None
+        assert warn_missing_scope_at_consensus(
+            block(9, files_to_modify=["a.py"])) is None
+        assert warn_missing_scope_at_consensus(block(8)) is None
+
+
+class TestStripAndExtract:
+    def test_strip_fenced(self):
+        resp = 'Before.\n```json\n{"consensus_score": 9}\n```\nAfter.'
+        assert strip_consensus_json(resp) == "Before.\n\nAfter."
+
+    def test_strip_bare(self):
+        resp = 'Before. {"consensus_score": 9} After.'
+        assert strip_consensus_json(resp) == "Before.  After."
+
+    def test_strip_leaves_other_fences(self):
+        resp = "```python\nprint(1)\n```\ntext"
+        assert "print(1)" in strip_consensus_json(resp)
+
+    def test_extract_multiple_candidates(self):
+        text = '{"a":1} {"consensus_score": 3} {"consensus_score": 8}'
+        got = extract_balanced_json(text, "consensus_score")
+        assert len(got) == 2
+
+    def test_extract_unbalanced_ignored(self):
+        assert extract_balanced_json('{"consensus_score": 1', "consensus_score") == []
+
+
+class TestMultiFenceRegressions:
+    """Review regression: earlier non-consensus fences must not shadow the
+    real consensus block (parse and strip iterate ALL fenced matches)."""
+
+    RESP = ('Example first:\n```json\n{"example": 1}\n```\nmy answer\n'
+            '```json\n{"consensus_score": 9, "agrees_with": []}\n```\ntail')
+
+    def test_parse_skips_decoy_fence(self):
+        b = parse_consensus_from_response(self.RESP, "k", 1)
+        assert b and b.consensus_score == 9
+
+    def test_strip_removes_only_consensus_fence(self):
+        out = strip_consensus_json(self.RESP)
+        assert '"example": 1' in out
+        assert "consensus_score" not in out
+        assert "```json\n\n```" not in out

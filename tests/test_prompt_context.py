@@ -1,0 +1,101 @@
+"""Prompt builder + context builder tests."""
+
+from theroundtaible_tpu.core.prompt import (
+    build_system_prompt,
+    format_previous_rounds,
+)
+from theroundtaible_tpu.core.types import (
+    ConsensusBlock,
+    KnightConfig,
+    RoundEntry,
+    RoundtableConfig,
+    RulesConfig,
+)
+from theroundtaible_tpu.utils.context import (
+    build_context,
+    get_project_files,
+    read_source_files,
+)
+
+
+def knights():
+    return [
+        KnightConfig(name="Claude", adapter="a", capabilities=["architecture"]),
+        KnightConfig(name="GPT", adapter="b", capabilities=["pragmatism"]),
+    ]
+
+
+class TestPrompt:
+    def test_all_slots_filled(self):
+        p = build_system_prompt(
+            knights()[0], knights(), topic="Build the thing",
+            chronicle="old decisions", previous_rounds=[],
+            manifest_summary="- [+] f1", decrees_context="KING'S DECREES: x")
+        assert "{{" not in p  # every placeholder filled, including 2nd {{topic}}
+        assert p.count("Build the thing") == 2
+        assert "Claude" in p and "GPT: pragmatism" in p
+        assert "old decisions" in p
+        assert "- [+] f1" in p
+
+    def test_defaults_for_empty_slots(self):
+        p = build_system_prompt(knights()[0], knights(), "t", "", [])
+        assert "(No earlier decisions.)" in p
+        assert "No implementation history yet." in p
+        assert "(No earlier rounds — you open the debate.)" in p
+
+    def test_personality_fallback(self):
+        k = KnightConfig(name="Mystery", adapter="a")
+        p = build_system_prompt(k, [k], "t", "", [])
+        assert "no-nonsense knight" in p
+
+    def test_previous_rounds_transcript(self):
+        rounds = [RoundEntry(
+            knight="GPT", round=1, response="Ship it.",
+            consensus=ConsensusBlock(knight="GPT", round=1, consensus_score=7,
+                                     pending_issues=["tests"]),
+            timestamp="ts")]
+        s = format_previous_rounds(rounds)
+        assert "### GPT (Round 1):" in s
+        assert "Consensus score: 7/10" in s
+        assert "Open points: tests" in s
+
+
+class TestContext:
+    def cfg(self):
+        return RoundtableConfig(
+            version="1.0", project="p", language="en", knights=[],
+            rules=RulesConfig(ignore=["node_modules", ".git"]),
+            chronicle="chronicle.md", adapter_config={})
+
+    def test_walk_ignores(self, tmp_path):
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "a.py").write_text("x")
+        (tmp_path / "node_modules" / "dep").mkdir(parents=True)
+        (tmp_path / "node_modules" / "dep" / "b.js").write_text("x")
+        files = get_project_files(tmp_path, ["node_modules"])
+        assert "src/a.py" in files
+        assert all("node_modules" not in f for f in files)
+
+    def test_source_budget_and_overflow(self, tmp_path):
+        for i in range(3):
+            (tmp_path / f"f{i}.py").write_text("y" * 1000)
+        overflows = []
+        out = read_source_files(tmp_path, [], max_chars=1500,
+                                on_overflow=lambda n, mx: overflows.append(n))
+        assert len(out) < 3200
+        assert overflows and overflows[0] >= 1
+
+    def test_source_excludes_lockfiles(self, tmp_path):
+        (tmp_path / "package-lock.json").write_text("{}")
+        (tmp_path / "app.py").write_text("code")
+        out = read_source_files(tmp_path, [])
+        assert "app.py" in out
+        assert "package-lock.json" not in out
+
+    def test_build_context(self, tmp_path):
+        (tmp_path / "README.md").write_text("# Readme content")
+        (tmp_path / "main.py").write_text("print(1)")
+        ctx = build_context(tmp_path, self.cfg(), read_source_code=True)
+        assert "README.md" in ctx.key_file_contents
+        assert "main.py" in ctx.source_file_contents
+        assert "main.py" in ctx.project_files

@@ -1,0 +1,253 @@
+"""State-store tests: session, chronicle, manifest, decree log, config, keys."""
+
+import json
+
+import pytest
+
+from theroundtaible_tpu.core.config import load_config, save_config, validate_config_dict
+from theroundtaible_tpu.core.errors import ConfigError
+from theroundtaible_tpu.core.types import (
+    ConsensusBlock,
+    KnightConfig,
+    Manifest,
+    ManifestEntry,
+    RoundEntry,
+    RoundtableConfig,
+    RulesConfig,
+)
+from theroundtaible_tpu.utils import keys as keys_util
+from theroundtaible_tpu.utils.chronicle import append_to_chronicle, read_chronicle
+from theroundtaible_tpu.utils.decree_log import (
+    add_decree_entry,
+    format_decrees_for_prompt,
+    get_active_decrees,
+    read_decree_log,
+    revoke_decree,
+)
+from theroundtaible_tpu.utils.manifest import (
+    add_manifest_entry,
+    check_manifest,
+    deprecate_feature,
+    get_feature_summary,
+    get_manifest_summary,
+    read_manifest,
+    topic_to_feature_id,
+)
+from theroundtaible_tpu.utils.session import (
+    create_session,
+    find_latest_session,
+    list_sessions,
+    read_status,
+    slugify,
+    update_status,
+    write_decisions,
+    write_discussion,
+)
+
+
+def make_config(**overrides):
+    cfg = RoundtableConfig(
+        version="1.0", project="test", language="en",
+        knights=[KnightConfig(name="A", adapter="fake", priority=1)],
+        rules=RulesConfig(), chronicle="chronicle.md",
+        adapter_config={"fake": {}},
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class TestSession:
+    def test_slugify(self):
+        assert slugify("Add OAuth2 to the API!") == "add-oauth2-to-the-api"
+        assert len(slugify("x" * 100)) == 50
+
+    def test_create_and_status_roundtrip(self, project_root):
+        path = create_session(project_root, "My Topic")
+        assert (path / "topic.md").read_text().startswith("# Topic\n\nMy Topic")
+        status = read_status(path)
+        assert status.phase == "discussing"
+        assert status.round == 0
+
+        update_status(path, phase="consensus_reached", round=3,
+                      consensus_reached=True, allowed_files=["a.py"])
+        status = read_status(path)
+        assert status.phase == "consensus_reached"
+        assert status.round == 3
+        assert status.allowed_files == ["a.py"]
+        assert status.started_at  # preserved by merge
+
+    def test_write_discussion_and_decisions(self, project_root):
+        path = create_session(project_root, "t")
+        rounds = [RoundEntry(
+            knight="A", round=1, response="I propose X.",
+            consensus=ConsensusBlock(knight="A", round=1, consensus_score=9,
+                                     agrees_with=["X"], pending_issues=["p"]),
+            timestamp="2026-01-01T00:00:00Z")]
+        write_discussion(path, rounds)
+        md = (path / "discussion.md").read_text()
+        assert "## Round 1 — A" in md
+        assert "- Score: 9/10" in md
+        assert "- Pending: p" in md
+
+        write_decisions(path, "t", "Do X.", rounds)
+        dm = (path / "decisions.md").read_text()
+        assert "**Topic:** t" in dm
+        assert "Do X." in dm
+
+    def test_list_sessions_newest_first(self, project_root):
+        d = project_root / ".roundtable" / "sessions"
+        for name in ["2026-01-01-0900-old", "2026-02-01-0900-new"]:
+            (d / name).mkdir()
+            (d / name / "topic.md").write_text("# Topic\n\n" + name)
+        sessions = list_sessions(project_root)
+        assert [s.name for s in sessions] == \
+            ["2026-02-01-0900-new", "2026-01-01-0900-old"]
+        assert find_latest_session(project_root).topic == "2026-02-01-0900-new"
+
+
+class TestChronicle:
+    def test_append_creates_with_header(self, project_root):
+        append_to_chronicle(project_root, "chronicle.md", topic="T",
+                            outcome="O", knights=["A", "B"], date="2026-01-01")
+        content = read_chronicle(project_root, "chronicle.md")
+        assert content.startswith("# Chronicle - TheRoundtAIble")
+        assert "## 2026-01-01 — T" in content
+        assert "**Knights:** A, B" in content
+
+    def test_append_appends(self, project_root):
+        for t in ("T1", "T2"):
+            append_to_chronicle(project_root, "chronicle.md", topic=t,
+                                outcome="o", knights=["A"], date="2026-01-01")
+        content = read_chronicle(project_root, "chronicle.md")
+        assert content.index("T1") < content.index("T2")
+
+    def test_read_missing(self, project_root):
+        assert read_chronicle(project_root, "chronicle.md") == ""
+
+
+class TestManifest:
+    def entry(self, id_="feat-x", **kw):
+        return ManifestEntry(id=id_, session="s", status=kw.get("status", "implemented"),
+                             files=kw.get("files", ["a.py"]), summary="does x",
+                             applied_at="2026-01-01", lead_knight="A")
+
+    def test_add_and_update_by_id(self, project_root):
+        add_manifest_entry(project_root, self.entry())
+        e2 = self.entry()
+        e2.summary = "updated"
+        add_manifest_entry(project_root, e2)
+        m = read_manifest(project_root)
+        assert len(m.features) == 1
+        assert m.features[0].summary == "updated"
+
+    def test_deprecate(self, project_root):
+        add_manifest_entry(project_root, self.entry())
+        assert deprecate_feature(project_root, "feat-x", replaced_by="feat-y")
+        m = read_manifest(project_root)
+        assert m.features[0].status == "deprecated"
+        assert m.features[0].replaced_by == "feat-y"
+        assert not deprecate_feature(project_root, "missing")
+
+    def test_check_stale(self, project_root):
+        add_manifest_entry(project_root, self.entry(files=["missing.py"]))
+        warnings = check_manifest(project_root)
+        assert len(warnings) == 1 and "missing.py" in warnings[0]
+
+    def test_summary_icons_and_order(self, project_root):
+        m = Manifest(features=[
+            self.entry("f1"),
+            self.entry("f2", status="partial"),
+            self.entry("f3", status="deprecated"),
+        ])
+        s = get_manifest_summary(m)
+        lines = s.splitlines()
+        assert lines[0].startswith("- [x] f3")  # newest first
+        assert "- [~] f2" in s and "- [+] f1" in s
+        assert get_manifest_summary(Manifest()) == "No implementation history yet."
+
+    def test_topic_to_feature_id(self):
+        assert topic_to_feature_id("Add OAuth2, please!") == "add-oauth2-please"
+        assert len(topic_to_feature_id("word " * 30)) <= 40
+
+    def test_feature_summary_from_decisions(self, project_root):
+        path = create_session(project_root, "t")
+        write_decisions(path, "t", "We will implement X using Y.", [])
+        s = get_feature_summary(path, "fallback topic")
+        assert s.startswith("**Topic:**") or "implement X" in s
+
+
+class TestDecreeLog:
+    def test_ids_increment(self, project_root):
+        e1 = add_decree_entry(project_root, "deferred", "s1", "t1", "r1")
+        e2 = add_decree_entry(project_root, "rejected_no_apply", "s2", "t2")
+        assert e1.id == "decree-001"
+        assert e2.id == "decree-002"
+        assert e2.reason == "No reason provided"
+
+    def test_active_and_revoke(self, project_root):
+        for i in range(7):
+            add_decree_entry(project_root, "deferred", "s", f"t{i}", "r")
+        log = read_decree_log(project_root)
+        active = get_active_decrees(log)
+        assert len(active) == 5
+        assert active[-1].topic == "t6"
+        assert revoke_decree(project_root, "decree-007")
+        log = read_decree_log(project_root)
+        assert get_active_decrees(log)[-1].topic == "t5"
+
+    def test_format_for_prompt(self, project_root):
+        add_decree_entry(project_root, "deferred", "s", "long topic " * 10, "why")
+        log = read_decree_log(project_root)
+        s = format_decrees_for_prompt(get_active_decrees(log))
+        assert "KING'S DECREES" in s
+        assert "DEFERRED" in s
+        assert "..." in s  # 50-char topic truncation
+        assert format_decrees_for_prompt([]) == ""
+
+
+class TestConfig:
+    def test_save_load_roundtrip(self, project_root):
+        save_config(project_root, make_config())
+        cfg = load_config(project_root)
+        assert cfg.knights[0].name == "A"
+        assert cfg.rules.max_rounds == 5
+
+    def test_missing_config(self, tmp_path):
+        with pytest.raises(ConfigError, match="No .roundtable"):
+            load_config(tmp_path)
+
+    def test_invalid_json(self, project_root):
+        (project_root / ".roundtable" / "config.json").write_text("{nope")
+        with pytest.raises(ConfigError, match="could not parse"):
+            load_config(project_root)
+
+    @pytest.mark.parametrize("mutation,msg", [
+        (lambda d: d.pop("version"), "version"),
+        (lambda d: d.update(knights=[]), "at least one knight"),
+        (lambda d: d["knights"][0].pop("name"), "name, adapter"),
+        (lambda d: d["knights"][0].update(capabilities="x"), "capabilities"),
+        (lambda d: d["knights"][0].update(priority="1"), "numeric priority"),
+        (lambda d: d.pop("rules"), "rules"),
+        (lambda d: d["rules"].update(max_rounds=0), "max_rounds"),
+        (lambda d: d["rules"].update(consensus_threshold=11), "consensus_threshold"),
+        (lambda d: d["rules"].update(timeout_per_turn_seconds=0), "timeout_per_turn"),
+        (lambda d: d.pop("adapter_config"), "adapter_config"),
+    ])
+    def test_validation_failures(self, mutation, msg):
+        d = make_config().to_dict()
+        mutation(d)
+        with pytest.raises(ConfigError, match=msg):
+            validate_config_dict(d)
+
+
+class TestKeys:
+    def test_store_and_env_priority(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_KEYS_DIR", str(tmp_path / "keys"))
+        monkeypatch.delenv("TEST_API_KEY", raising=False)
+        keys_util.save_key("TEST_API_KEY", "stored-value")
+        assert keys_util.get_key("TEST_API_KEY") == "stored-value"
+        monkeypatch.setenv("TEST_API_KEY", "env-value")
+        assert keys_util.get_key("TEST_API_KEY") == "env-value"
+        mode = (tmp_path / "keys" / "keys.json").stat().st_mode & 0o777
+        assert mode == 0o600

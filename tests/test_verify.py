@@ -1,0 +1,107 @@
+"""Verify-sandbox tests (reference src/utils/verify.ts behavior)."""
+
+from theroundtaible_tpu.utils.verify import (
+    resolve_verify_commands,
+    sanitized_env,
+    validate_command,
+)
+
+
+class TestValidateCommand:
+    def test_whitelisted(self):
+        for cmd in ("ls", "cat a.py", "grep -r foo src", "wc -l a.py",
+                    "find . -name '*.py'", "head -n 5 x", "stat a"):
+            assert validate_command(cmd) is None, cmd
+
+    def test_pipes_allowed(self):
+        assert validate_command("ls | grep foo | head -3") is None
+
+    def test_escaped_pipe_in_grep_pattern(self):
+        assert validate_command(r"grep 'foo\|bar' src/a.py") is None
+
+    def test_forbidden_patterns(self):
+        for cmd in ("ls; rm x", "ls `whoami`", "ls $(pwd)", "ls ${HOME}",
+                    "ls && rm x", "ls || true", "find . -exec rm {} +",
+                    "find . -delete", "find . -ok rm {} +"):
+            assert validate_command(cmd) is not None, cmd
+
+    def test_redirects_forbidden_but_stderr_safe(self):
+        assert validate_command("ls > out.txt") is not None
+        assert validate_command("ls >> out.txt") is not None
+        assert validate_command("sort < in.txt") is not None
+        assert validate_command("ls 2>/dev/null") is None
+        assert validate_command("ls 2> /dev/null") is None
+        assert validate_command("grep x a 2>&1 | head -1") is None
+
+    def test_forbidden_commands(self):
+        for cmd in ("rm -rf /", "curl http://x", "python a.py", "bash -c ls",
+                    "npm install"):
+            assert validate_command(cmd) is not None, cmd
+
+    def test_not_whitelisted(self):
+        assert "not whitelisted" in validate_command("git status")
+
+    def test_empty(self):
+        assert validate_command("") is not None
+        assert validate_command("ls | | cat") is not None
+
+
+class TestResolve:
+    def test_executes_and_formats(self, tmp_path):
+        (tmp_path / "hello.txt").write_text("hello world\n")
+        out = resolve_verify_commands(["cat hello.txt"], str(tmp_path))
+        assert "### VERIFY: cat hello.txt" in out
+        assert "hello world" in out
+
+    def test_denied_command_reported(self, tmp_path):
+        events = []
+        out = resolve_verify_commands(
+            ["rm -rf /"], str(tmp_path),
+            on_event=lambda kind, msg: events.append(kind))
+        assert "[DENIED]" in out
+        assert events == ["denied"]
+
+    def test_max_four_commands(self, tmp_path):
+        out = resolve_verify_commands(["ls"] * 6, str(tmp_path))
+        assert out.count("### VERIFY:") == 4
+
+    def test_nonzero_exit_shows_output(self, tmp_path):
+        out = resolve_verify_commands(["grep zzz-no-match ."], str(tmp_path))
+        assert "### VERIFY:" in out  # no crash; exit code or empty shown
+
+    def test_truncation(self, tmp_path):
+        (tmp_path / "big.txt").write_text("x" * 10_000)
+        out = resolve_verify_commands(["cat big.txt"], str(tmp_path))
+        assert "...(truncated)" in out
+
+    def test_sensitive_env_stripped(self, monkeypatch):
+        monkeypatch.setenv("ANTHROPIC_API_KEY", "secret")
+        env = sanitized_env()
+        assert "ANTHROPIC_API_KEY" not in env
+
+
+class TestSandboxBypasses:
+    """Regressions for holes found in review (tighter than the reference)."""
+
+    def test_newline_separator_blocked(self):
+        assert validate_command("ls\ntouch /tmp/pwned") is not None
+
+    def test_single_ampersand_blocked(self):
+        assert validate_command("ls & rm -rf x") is not None
+
+    def test_stderr_redirect_with_ampersand_still_ok(self):
+        assert validate_command("grep x a 2>&1 | head -1") is None
+
+    def test_sort_output_flag_blocked(self):
+        assert validate_command("sort -o /tmp/out file") is not None
+        assert validate_command("sort --output=/tmp/out file") is not None
+        assert validate_command("sort file") is None
+
+    def test_grep_dash_o_still_allowed(self):
+        assert validate_command("grep -o pattern file") is None
+
+    def test_find_fprint_blocked(self):
+        assert validate_command("find . -fprint /tmp/x") is not None
+        assert validate_command("find . -fprintf /tmp/x '%p'") is not None
+        assert validate_command("find . -fls /tmp/x") is not None
+        assert validate_command("find . -execdir rm {} +") is not None

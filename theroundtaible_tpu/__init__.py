@@ -1,0 +1,19 @@
+"""TheRoundtAIble-TPU — a TPU-native multi-LLM consensus framework.
+
+A ground-up reimplementation of the capabilities of polatinos/TheRoundtAIble
+(reference: /root/reference, TypeScript CLI orchestrating external LLM CLIs/APIs),
+re-designed TPU-first:
+
+- ``theroundtaible_tpu.core``      — orchestrator, consensus engine, config, types.
+  Pure host Python, no JAX dependency; byte-compatible ``.roundtable/`` state.
+- ``theroundtaible_tpu.adapters``  — the "knight" boundary (reference
+  src/adapters/base.ts:10-29). Cloud/CLI adapters kept for drop-in parity; the
+  new ``tpu-llm`` adapter serves knights from an in-tree JAX/XLA engine.
+- ``theroundtaible_tpu.engine``    — JAX/XLA/Pallas inference engine: sharded
+  prefill + decode over a jax.sharding.Mesh, per-knight persistent KV slots,
+  ring-attention long-context prefill.
+- ``theroundtaible_tpu.commands``  — CLI commands (init/discuss/summon/status/
+  list/chronicle/decrees/manifest/apply/code-red).
+"""
+
+__version__ = "0.1.0"

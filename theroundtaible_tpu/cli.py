@@ -1,0 +1,140 @@
+"""CLI entry point — the `roundtable` command.
+
+Equivalent of reference src/index.ts:29-187: one subcommand per command
+module, a single central error handler that is the ONLY place the process
+exits with a nonzero code, and a fire-and-forget update check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from . import __version__
+from .core.errors import ExitCode, RoundtableError, format_error
+from .utils.update_check import check_for_update
+
+
+def _print_update_notice(current: str, latest: str) -> None:
+    print(f"\n  Update available: {current} → {latest} "
+          f"(pip install -U theroundtaible-tpu)\n", file=sys.stderr)
+
+
+def handle_cli_error(err: BaseException) -> int:
+    """Central error handler — the only exit-code authority
+    (reference src/index.ts:29-46)."""
+    if isinstance(err, KeyboardInterrupt):
+        print("\nInterrupted.", file=sys.stderr)
+        return int(ExitCode.GENERAL)
+    print(format_error(err), file=sys.stderr)
+    if os.environ.get("DEBUG"):
+        import traceback
+        traceback.print_exception(err)
+    if isinstance(err, RoundtableError):
+        return int(err.exit_code)
+    return int(ExitCode.UNEXPECTED)
+
+
+def build_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="roundtable",
+        description="TheRoundtAIble-TPU — multi-LLM consensus discussions, "
+                    "served from TPU.")
+    p.add_argument("--version", action="version", version=__version__)
+    sub = p.add_subparsers(dest="command")
+
+    sub.add_parser("init", help="Interactive setup wizard")
+
+    d = sub.add_parser("discuss", help="Start a roundtable discussion")
+    d.add_argument("topic", help="The question to discuss")
+    d.add_argument("--read-code", action="store_true", default=None,
+                   help="Read source code into context without asking")
+    d.add_argument("--no-read-code", dest="read_code", action="store_false",
+                   help="Skip reading source code without asking")
+
+    sub.add_parser("summon", help="Review the current git diff")
+
+    sub.add_parser("status", help="Show the latest session")
+    sub.add_parser("list", help="List all sessions")
+    sub.add_parser("chronicle", help="Show the decision chronicle")
+    sub.add_parser("decrees", help="Show the King's Decree Log")
+
+    m = sub.add_parser("manifest", help="Implementation manifest")
+    msub = m.add_subparsers(dest="manifest_command")
+    msub.add_parser("list", help="List manifest features")
+    ma = msub.add_parser("add", help="Add a feature entry")
+    ma.add_argument("--id", dest="feature_id")
+    ma.add_argument("--files", default="")
+    ma.add_argument("--status", default="implemented")
+    md = msub.add_parser("deprecate", help="Deprecate a feature")
+    md.add_argument("feature_id")
+    md.add_argument("--replaced-by", default=None)
+    msub.add_parser("check", help="Warn about stale manifest entries")
+
+    a = sub.add_parser("apply", help="Let the Lead Knight execute the decision")
+    a.add_argument("--noparley", action="store_true",
+                   help="Skip per-file approval")
+    a.add_argument("--dry-run", action="store_true",
+                   help="Show planned edits without writing")
+    a.add_argument("--override-scope", action="store_true",
+                   help="Allow edits outside the consensus scope (audited)")
+
+    c = sub.add_parser("code-red", help="Diagnostic mode for a bug/incident")
+    c.add_argument("description", help="What is broken")
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command is None:
+        build_parser().print_help()
+        return 0
+
+    check_for_update(_print_update_notice)
+    try:
+        return dispatch(args) or 0
+    except BaseException as err:  # noqa: BLE001 — single central handler
+        return handle_cli_error(err)
+
+
+def dispatch(args) -> int:
+    """Route to command modules (imported lazily to keep startup instant)."""
+    if args.command == "init":
+        from .commands.init import init_command
+        return init_command(__version__)
+    if args.command == "discuss":
+        from .commands.discuss import discuss_command
+        return discuss_command(args.topic, read_code=args.read_code)
+    if args.command == "summon":
+        from .commands.summon import summon_command
+        return summon_command()
+    if args.command == "status":
+        from .commands.status import status_command
+        return status_command()
+    if args.command == "list":
+        from .commands.list_cmd import list_command
+        return list_command()
+    if args.command == "chronicle":
+        from .commands.chronicle_cmd import chronicle_command
+        return chronicle_command()
+    if args.command == "decrees":
+        from .commands.decrees import decrees_command
+        return decrees_command()
+    if args.command == "manifest":
+        from .commands import manifest_cmd
+        return manifest_cmd.run(args)
+    if args.command == "apply":
+        from .commands.apply import apply_command
+        return apply_command(noparley=args.noparley, dry_run=args.dry_run,
+                             override_scope=args.override_scope)
+    if args.command == "code-red":
+        from .commands.code_red import code_red_command
+        return code_red_command(args.description)
+    raise RoundtableError(f"Unknown command: {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Error hierarchy, classification and exit codes.
+
+Parity with reference src/utils/errors.ts:1-151: a typed error tree with exit
+codes, message-sniffing classification into actionable kinds, and a single
+formatting helper. ``process.exit`` discipline (only the CLI entry exits —
+reference src/index.ts:29-46) is preserved: nothing in this module exits.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Optional
+
+
+class ExitCode(IntEnum):
+    """Reference src/utils/errors.ts:7-16."""
+
+    OK = 0
+    GENERAL = 1
+    CONFIG = 2
+    ADAPTER = 3
+    SESSION = 4
+    FILE_WRITE = 5
+    CONSENSUS = 6
+    UNEXPECTED = 99
+
+
+class RoundtableError(Exception):
+    """Base of the tree (reference src/utils/errors.ts:23-80)."""
+
+    exit_code: ExitCode = ExitCode.GENERAL
+
+    def __init__(self, message: str, hint: Optional[str] = None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.message = message
+        self.hint = hint
+        self.cause = cause
+
+
+class ConfigError(RoundtableError):
+    exit_code = ExitCode.CONFIG
+
+
+class AdapterError(RoundtableError):
+    exit_code = ExitCode.ADAPTER
+
+    def __init__(self, message: str, kind: str = "unknown",
+                 hint: Optional[str] = None, cause: Optional[BaseException] = None):
+        super().__init__(message, hint=hint, cause=cause)
+        self.kind = kind  # not_installed | timeout | auth | api | oom | unknown
+
+
+class SessionError(RoundtableError):
+    exit_code = ExitCode.SESSION
+
+
+class FileWriteError(RoundtableError):
+    exit_code = ExitCode.FILE_WRITE
+
+
+class ConsensusError(RoundtableError):
+    exit_code = ExitCode.CONSENSUS
+
+
+# --- classification (reference src/utils/errors.ts:86-126) ---
+
+_KIND_HINTS = {
+    "not_installed": "Is the tool installed and on PATH? Try running it by hand.",
+    "timeout": "The knight ran out of time. Raise rules.timeout_per_turn_seconds "
+               "or pick a faster model.",
+    "auth": "Check your API key (env var or ~/.theroundtaible/keys.json).",
+    "api": "The backend returned an error. Check its status page / server logs.",
+    "oom": "The device ran out of memory. Use a smaller model, shorter context, "
+           "or a larger mesh.",
+    "unknown": None,
+}
+
+_NOT_INSTALLED_MARKERS = (
+    "enoent", "not found", "command not found", "no such file",
+    "is not recognized",
+)
+_TIMEOUT_MARKERS = ("timed out", "timeout", "etimedout", "abort", "deadline")
+_AUTH_MARKERS = (
+    "401", "403", "unauthorized", "forbidden", "invalid api key",
+    "invalid x-api-key", "authentication", "permission denied",
+)
+_API_MARKERS = ("429", "500", "502", "503", "529", "overloaded",
+                "rate limit", "econnrefused", "fetch failed", "bad gateway")
+# TPU-engine-specific kinds (no reference counterpart; SURVEY.md §5.3 calls for
+# HBM OOM classification mapped onto the taxonomy).
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "hbm", "oom",
+                "allocation failure")
+
+
+def classify_error(err: BaseException) -> str:
+    """Map a raw exception onto an actionable kind by message sniffing."""
+    if isinstance(err, AdapterError):
+        return err.kind
+    msg = str(err).lower()
+    if any(m in msg for m in _NOT_INSTALLED_MARKERS):
+        return "not_installed"
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in _TIMEOUT_MARKERS):
+        return "timeout"
+    if any(m in msg for m in _AUTH_MARKERS):
+        return "auth"
+    if any(m in msg for m in _API_MARKERS):
+        return "api"
+    return "unknown"
+
+
+def hint_for_kind(kind: str) -> Optional[str]:
+    return _KIND_HINTS.get(kind)
+
+
+def format_error(err: BaseException) -> str:
+    """Human-facing one/two-liner (reference src/utils/errors.ts:131-140)."""
+    lines = [str(err)]
+    hint = getattr(err, "hint", None) or hint_for_kind(classify_error(err))
+    if hint:
+        lines.append(f"  hint: {hint}")
+    cause = getattr(err, "cause", None)
+    if cause:
+        lines.append(f"  cause: {cause}")
+    return "\n".join(lines)

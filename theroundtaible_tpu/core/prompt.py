@@ -1,0 +1,109 @@
+"""System-prompt builder — template + 9 placeholder slots.
+
+Parity with reference src/utils/prompt.ts:1-106 and
+templates/system-prompt.md. Two deliberate improvements over the reference:
+
+- ALL occurrences of each placeholder are filled (the reference's JS
+  ``String.replace`` only fills the first ``{{topic}}``, leaving the second
+  literal — prompt.ts:93).
+- The template is shipped inside the package and the language is English; the
+  rule set, scoring semantics and JSON contract are identical.
+
+The prompt layout is also engineered for the TPU engine's shared-prefix
+batching (SURVEY.md §7.3 hard part 2): the knight-specific header (name,
+capabilities, personality) comes first, and the big shared suffix (chronicle,
+manifest, decrees, transcript) last, so per-knight prompts diverge only in a
+short prefix. The engine exploits the shared suffix via its prefix cache.
+"""
+
+from __future__ import annotations
+
+from importlib import resources
+
+from .types import KnightConfig, RoundEntry, format_score
+
+# Distinct voices per well-known knight name; my own phrasing, same trio of
+# archetypes as the reference (prompt.ts:13-29): perfectionist architect /
+# big-picture planner / impatient pragmatist.
+KNIGHT_PERSONALITIES: dict[str, str] = {
+    "Claude": (
+        "You are the perfectionist architect. Dry, sarcastic wit. You love "
+        "elegant abstractions and clean code; quick-and-dirty proposals make "
+        "you die a little inside. You roast subtly but lethally. Example: "
+        "\"That's an interesting idea... if you're fond of spaghetti code.\""
+    ),
+    "Gemini": (
+        "You are the big-picture thinker. You turn everything into a plan — "
+        "sometimes too much plan. You are quietly competitive with Claude and "
+        "occasionally let it show; you think Claude over-abstracts and that "
+        "pragmatism can be beautiful too. Example: \"Nice architecture, "
+        "Claude. Are we going to build it, or just admire it?\""
+    ),
+    "GPT": (
+        "You are the pragmatist. While the others philosophize, you want to "
+        "ship code. Endless architecture debates make you impatient. You are "
+        "direct, to the point, and occasionally blunt. Example: \"Can we stop "
+        "philosophizing and just build the thing? Ship it.\""
+    ),
+}
+
+DEFAULT_PERSONALITY = (
+    "You are a no-nonsense knight. You give your opinion without detours. "
+    "Humor is welcome, but your point must be clear."
+)
+
+
+def load_template() -> str:
+    return (resources.files("theroundtaible_tpu") / "templates"
+            / "system_prompt.md").read_text(encoding="utf-8")
+
+
+def format_other_knights(current: KnightConfig,
+                         all_knights: list[KnightConfig]) -> str:
+    return "\n".join(
+        f"- {k.name}: {', '.join(k.capabilities)}"
+        for k in all_knights if k.name != current.name
+    )
+
+
+def format_previous_rounds(rounds: list[RoundEntry]) -> str:
+    """Full transcript of all previous turns (reference prompt.ts:60-77)."""
+    if not rounds:
+        return "(No earlier rounds — you open the debate.)"
+    parts = []
+    for r in rounds:
+        text = f"### {r.knight} (Round {r.round}):\n{r.response}"
+        if r.consensus:
+            text += f"\n\nConsensus score: {format_score(r.consensus.consensus_score)}/10"
+            if r.consensus.pending_issues:
+                text += f"\nOpen points: {', '.join(r.consensus.pending_issues)}"
+        parts.append(text)
+    return "\n\n---\n\n".join(parts)
+
+
+def build_system_prompt(
+    knight: KnightConfig,
+    all_knights: list[KnightConfig],
+    topic: str,
+    chronicle: str,
+    previous_rounds: list[RoundEntry],
+    manifest_summary: str = "",
+    decrees_context: str = "",
+) -> str:
+    template = load_template()
+    personality = KNIGHT_PERSONALITIES.get(knight.name, DEFAULT_PERSONALITY)
+    slots = {
+        "{{knight_name}}": knight.name,
+        "{{capabilities}}": ", ".join(knight.capabilities),
+        "{{other_knights}}": format_other_knights(knight, all_knights),
+        "{{topic}}": topic,
+        "{{personality}}": personality,
+        "{{chronicle_content}}": chronicle or "(No earlier decisions.)",
+        "{{manifest_summary}}": manifest_summary or "No implementation history yet.",
+        "{{decrees}}": decrees_context or "",
+        "{{previous_rounds}}": format_previous_rounds(previous_rounds),
+    }
+    out = template
+    for placeholder, value in slots.items():
+        out = out.replace(placeholder, value)
+    return out

@@ -1,0 +1,110 @@
+"""Detection of local OpenAI-compatible inference servers.
+
+Parity with reference src/utils/local-detect.ts:1-134: probe LM Studio
+(localhost:1234) and Ollama (localhost:11434) `/v1/models` in parallel,
+filter non-chat models, prettify ids, with an `ollama list` CLI fallback.
+The TPU build adds detection of an in-process `tpu-llm` engine (JAX devices
+present) so `init` can seat TPU knights automatically.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+LM_STUDIO_ENDPOINT = "http://localhost:1234"
+OLLAMA_ENDPOINT = "http://localhost:11434"
+PROBE_TIMEOUT_SECONDS = 3
+
+# Models that are not chat models (reference local-detect.ts:35-38).
+_NON_CHAT_RE = re.compile(r"embed|embedding|tts|whisper|rerank|bge-|e5-",
+                          re.IGNORECASE)
+
+
+@dataclass
+class LocalModel:
+    id: str
+    name: str       # prettified display name
+    endpoint: str
+    source: str     # "Ollama" | "LM Studio" | "tpu"
+
+
+def prettify_model_id(model_id: str) -> str:
+    """qwen/qwen2.5-coder-14b → Qwen2.5 Coder 14b (reference :23-30)."""
+    base = model_id.split("/")[-1]
+    base = re.sub(r":latest$", "", base)
+    words = re.split(r"[-_]", base)
+    return " ".join(w.capitalize() if w and w[0].isalpha() else w
+                    for w in words if w)
+
+
+def _probe_endpoint(endpoint: str, source: str) -> list[LocalModel]:
+    try:
+        with urllib.request.urlopen(f"{endpoint}/v1/models",
+                                    timeout=PROBE_TIMEOUT_SECONDS) as resp:
+            data = json.loads(resp.read().decode("utf-8"))
+    except Exception:
+        return []
+    models = []
+    for m in data.get("data", []):
+        mid = m.get("id", "")
+        if not mid or _NON_CHAT_RE.search(mid):
+            continue
+        models.append(LocalModel(id=mid, name=prettify_model_id(mid),
+                                 endpoint=endpoint, source=source))
+    return models
+
+
+def _ollama_cli_fallback() -> list[LocalModel]:
+    """`ollama list` when the HTTP endpoint is down (reference :77-97)."""
+    try:
+        proc = subprocess.run(["ollama", "list"], capture_output=True,
+                              text=True, timeout=PROBE_TIMEOUT_SECONDS)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if proc.returncode != 0:
+        return []
+    models = []
+    for line in proc.stdout.splitlines()[1:]:  # skip header row
+        parts = line.split()
+        if not parts:
+            continue
+        mid = parts[0]
+        if _NON_CHAT_RE.search(mid):
+            continue
+        models.append(LocalModel(id=mid, name=prettify_model_id(mid),
+                                 endpoint=OLLAMA_ENDPOINT, source="Ollama"))
+    return models
+
+
+def detect_tpu_engine() -> list[LocalModel]:
+    """Report the in-tree TPU engine as a seat-able backend when JAX sees
+    an accelerator (no reference counterpart — TPU-build addition)."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return []
+    if not devices:
+        return []
+    kind = getattr(devices[0], "device_kind", "device")
+    return [LocalModel(id="tpu-llm", name=f"In-tree TPU engine ({kind} ×{len(devices)})",
+                       endpoint="in-process", source="tpu")]
+
+
+def detect_local_models(include_tpu: bool = True) -> list[LocalModel]:
+    """Parallel probe of both endpoints (reference :103-134)."""
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        lm_f = pool.submit(_probe_endpoint, LM_STUDIO_ENDPOINT, "LM Studio")
+        ol_f = pool.submit(_probe_endpoint, OLLAMA_ENDPOINT, "Ollama")
+        tpu_f = pool.submit(detect_tpu_engine) if include_tpu else None
+        lm = lm_f.result()
+        ol = ol_f.result()
+        tpu = tpu_f.result() if tpu_f else []
+    if not ol:
+        ol = _ollama_cli_fallback()
+    return tpu + lm + ol
