@@ -12,6 +12,7 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("ROUNDTABLE_DISABLE_TPU_DETECT", "1")
 
 import pytest
 

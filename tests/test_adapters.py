@@ -1,0 +1,198 @@
+"""Adapter-layer tests: factory wiring, init fallback, JSONL parsing,
+local-llm budgets — all hermetic (no network; fake HTTP via monkeypatch)."""
+
+import pytest
+
+import theroundtaible_tpu.adapters.local_llm as local_llm_mod
+from theroundtaible_tpu.adapters.base import KnightTurn
+from theroundtaible_tpu.adapters.cli_adapters import OpenAICliAdapter
+from theroundtaible_tpu.adapters.factory import create_adapter, initialize_adapters
+from theroundtaible_tpu.adapters.fake import FakeAdapter
+from theroundtaible_tpu.adapters.httpx import HttpError
+from theroundtaible_tpu.adapters.local_llm import LocalLlmAdapter
+from theroundtaible_tpu.core.errors import AdapterError
+from theroundtaible_tpu.core.types import KnightConfig, RoundtableConfig, RulesConfig
+
+
+def make_config(knights=None, adapter_config=None):
+    return RoundtableConfig(
+        version="1.0", project="t", language="en",
+        knights=knights or [], rules=RulesConfig(),
+        chronicle="chronicle.md", adapter_config=adapter_config or {})
+
+
+class TestFactory:
+    @pytest.mark.parametrize("adapter_id,cls_name", [
+        ("claude-cli", "ClaudeCliAdapter"),
+        ("gemini-cli", "GeminiCliAdapter"),
+        ("openai-cli", "OpenAICliAdapter"),
+        ("claude-api", "ClaudeApiAdapter"),
+        ("gemini-api", "GeminiApiAdapter"),
+        ("openai-api", "OpenAIApiAdapter"),
+        ("fake", "FakeAdapter"),
+    ])
+    def test_static_ids(self, adapter_id, cls_name):
+        a = create_adapter(adapter_id, make_config())
+        assert a is not None and type(a).__name__ == cls_name
+
+    def test_local_llm_prefix_id(self):
+        cfg = make_config(adapter_config={
+            "local-llm-qwen": {"endpoint": "http://localhost:11434",
+                               "model": "qwen", "source": "Ollama"}})
+        a = create_adapter("local-llm-qwen", cfg)
+        assert isinstance(a, LocalLlmAdapter)
+        assert a.source == "Ollama"
+
+    def test_local_llm_missing_endpoint(self):
+        assert create_adapter("local-llm-x", make_config()) is None
+
+    def test_tpu_llm_prefix_id(self):
+        a = create_adapter("tpu-llm", make_config(
+            adapter_config={"tpu-llm": {"name": "Sage"}}))
+        assert type(a).__name__ == "TpuLlmAdapter"
+        assert a.name == "Sage"
+
+    def test_unknown_id(self):
+        assert create_adapter("nope", make_config()) is None
+
+    def test_initialize_keyed_by_adapter_id(self):
+        knights = [KnightConfig(name="K1", adapter="fake", priority=1),
+                   KnightConfig(name="K2", adapter="fake", priority=2)]
+        adapters = initialize_adapters(make_config(
+            knights=knights, adapter_config={"fake": {}}))
+        assert set(adapters) == {"fake"}
+
+    def test_initialize_skips_unavailable(self, monkeypatch):
+        # claude-cli probe fails (no binary) and no API key → knight missing
+        monkeypatch.delenv("ANTHROPIC_API_KEY", raising=False)
+        monkeypatch.setenv("ROUNDTABLE_KEYS_DIR", "/nonexistent-keys-dir")
+        knights = [KnightConfig(name="C", adapter="claude-cli", priority=1)]
+        events = []
+        adapters = initialize_adapters(
+            make_config(knights=knights,
+                        adapter_config={"claude-cli":
+                                        {"command": "definitely-not-a-cmd"}}),
+            on_event=lambda k, m: events.append(k))
+        assert adapters == {}
+        assert "unavailable" in events
+
+    def test_initialize_cli_to_api_fallback(self, monkeypatch):
+        monkeypatch.setenv("ANTHROPIC_API_KEY", "test-key")
+        knights = [KnightConfig(name="C", adapter="claude-cli", priority=1)]
+        events = []
+        adapters = initialize_adapters(
+            make_config(knights=knights,
+                        adapter_config={"claude-cli":
+                                        {"command": "definitely-not-a-cmd"}}),
+            on_event=lambda k, m: events.append(k))
+        assert "claude-cli" in adapters
+        assert type(adapters["claude-cli"]).__name__ == "ClaudeApiAdapter"
+        assert "fallback" in events
+
+
+class TestOpenAICliJsonl:
+    def test_extract_agent_message(self):
+        jsonl = "\n".join([
+            'banner line',
+            '{"type":"item.started","item":{"type":"agent_message"}}',
+            '{"type":"item.completed","item":{"type":"agent_message",'
+            '"text":"Hello"}}',
+            '{"type":"item.completed","item":{"type":"reasoning",'
+            '"text":"hidden"}}',
+            '{"type":"item.completed","item":{"type":"agent_message",'
+            '"text":"World"}}',
+            'ERROR rollout warning',
+        ])
+        assert OpenAICliAdapter.extract_agent_message(jsonl) == "Hello\nWorld"
+
+    def test_extract_none(self):
+        assert OpenAICliAdapter.extract_agent_message("junk\n{}") == ""
+
+
+class TestLocalLlm:
+    def adapter(self, source="Ollama"):
+        return LocalLlmAdapter("http://localhost:11434/", "gemma", "Gemma",
+                               source=source)
+
+    def test_trailing_slash_stripped(self):
+        assert self.adapter().endpoint == "http://localhost:11434"
+
+    def test_budget_from_detected_context(self):
+        a = self.adapter()
+        a.detected_context_tokens = 32768
+        assert a.get_max_source_chars() == (32768 - 4096 - 3000) * 4
+
+    def test_budget_floor(self):
+        a = self.adapter()
+        a.detected_context_tokens = 4096
+        assert a.get_max_source_chars() == 2000 * 4
+
+    def test_lm_studio_assumed_budget(self):
+        a = self.adapter(source="LM Studio")
+        assert a.get_max_source_chars() == (16384 - 4096 - 3000) * 4
+
+    def test_unknown_source_no_budget(self):
+        a = self.adapter(source=None)
+        assert a.get_max_source_chars() is None
+
+    def test_ollama_num_ctx_dynamic_and_clamped(self, monkeypatch):
+        captured = {}
+
+        def fake_post(url, payload, headers=None, timeout_s=0):
+            captured["url"] = url
+            captured["payload"] = payload
+            return {"message": {"content": "ok"}}
+
+        monkeypatch.setattr(local_llm_mod, "post_json", fake_post)
+        a = self.adapter()
+        a.detected_context_tokens = 8192
+        prompt = "x" * 100_000  # 25000 est tokens + 4608 > 8192 → clamped
+        assert a.execute(prompt) == "ok"
+        assert captured["url"].endswith("/api/chat")
+        assert captured["payload"]["options"]["num_ctx"] == 8192
+        assert captured["payload"]["stream"] is False
+
+    def test_lm_studio_context_error_actionable(self, monkeypatch):
+        def fake_post(url, payload, headers=None, timeout_s=0):
+            raise HttpError(400, "maximum context length exceeded", url)
+
+        monkeypatch.setattr(local_llm_mod, "post_json", fake_post)
+        a = self.adapter(source="LM Studio")
+        with pytest.raises(AdapterError, match="context window too small"):
+            a.execute("prompt")
+
+    def test_model_reloaded_retry(self, monkeypatch):
+        calls = []
+
+        def fake_post(url, payload, headers=None, timeout_s=0):
+            calls.append(url)
+            if len(calls) == 1:
+                raise HttpError(500, "Model reloaded, please retry", url)
+            return {"choices": [{"message": {"content": "recovered"}}]}
+
+        monkeypatch.setattr(local_llm_mod, "post_json", fake_post)
+        monkeypatch.setattr(local_llm_mod.time, "sleep", lambda s: None)
+        a = self.adapter(source="LM Studio")
+        assert a.execute("p") == "recovered"
+        assert len(calls) == 2
+
+    def test_no_max_tokens_sent_openai_compat(self, monkeypatch):
+        captured = {}
+
+        def fake_post(url, payload, headers=None, timeout_s=0):
+            captured["payload"] = payload
+            return {"choices": [{"message": {"content": "ok"}}]}
+
+        monkeypatch.setattr(local_llm_mod, "post_json", fake_post)
+        self.adapter(source="LM Studio").execute("p")
+        assert "max_tokens" not in captured["payload"]
+
+
+class TestBaseBatching:
+    def test_default_execute_round_is_serial(self):
+        fake = FakeAdapter("X", ["r1", "r2"])
+        out = fake.execute_round([KnightTurn("A", "p1"),
+                                  KnightTurn("B", "p2")])
+        assert out == ["r1", "r2"]
+        assert fake.calls == ["p1", "p2"]
+        assert not fake.supports_batched_rounds()

@@ -1,0 +1,155 @@
+"""CLI command tests — driven through the real argparse entry (cli.main)."""
+
+import json
+
+import pytest
+
+from theroundtaible_tpu.adapters.fake import scripted_response
+from theroundtaible_tpu.cli import build_parser, main
+from theroundtaible_tpu.commands.discuss import get_last_proposals
+from theroundtaible_tpu.core.types import ConsensusBlock, RoundEntry
+
+
+def write_config(project_root, knights=None, rules=None):
+    cfg = {
+        "version": "1.0", "project": "t", "language": "en",
+        "knights": knights or [
+            {"name": "A", "adapter": "fake", "capabilities": [],
+             "priority": 1}],
+        "rules": rules or {
+            "max_rounds": 2, "consensus_threshold": 9,
+            "timeout_per_turn_seconds": 5, "escalate_to_user_after": 3,
+            "auto_execute": False, "ignore": [".git"]},
+        "chronicle": "chronicle.md",
+        "adapter_config": {"fake": {"name": "A"}},
+    }
+    (project_root / ".roundtable").mkdir(exist_ok=True)
+    (project_root / ".roundtable" / "config.json").write_text(
+        json.dumps(cfg))
+    return cfg
+
+
+class TestParser:
+    def test_all_commands_registered(self):
+        p = build_parser()
+        for argv in (["init"], ["discuss", "t"], ["summon"], ["status"],
+                     ["list"], ["chronicle"], ["decrees"],
+                     ["manifest", "list"], ["apply"], ["code-red", "x"]):
+            args = p.parse_args(argv)
+            assert args.command == argv[0]
+
+    def test_no_command_prints_help(self, capsys):
+        assert main([]) == 0
+        assert "roundtable" in capsys.readouterr().out
+
+
+class TestReadOnlyCommands:
+    def test_status_empty(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["status"]) == 0
+        assert "No sessions yet" in capsys.readouterr().out
+
+    def test_list_empty(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["list"]) == 0
+        assert "No sessions yet" in capsys.readouterr().out
+
+    def test_chronicle_empty(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["chronicle"]) == 0
+        assert "chronicle is empty" in capsys.readouterr().out
+
+    def test_decrees_empty(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["decrees"]) == 0
+        assert "No decrees yet" in capsys.readouterr().out
+
+    def test_manifest_list_empty(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["manifest", "list"]) == 0
+        assert "manifest is empty" in capsys.readouterr().out
+
+    def test_manifest_check_clean(self, project_root, monkeypatch, capsys):
+        monkeypatch.chdir(project_root)
+        assert main(["manifest", "check"]) == 0
+        assert "clean" in capsys.readouterr().out
+
+
+class TestDiscussCommandE2E:
+    def test_full_discuss_reaches_consensus(self, project_root, monkeypatch,
+                                            capsys):
+        write_config(project_root)
+        monkeypatch.chdir(project_root)
+        rc = main(["discuss", "Should we do X?", "--no-read-code"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "actually agree" in out
+        sessions = list((project_root / ".roundtable" / "sessions").iterdir())
+        assert len(sessions) == 1
+        assert (sessions[0] / "decisions.md").exists()
+
+    def test_discuss_without_config_exits_config_code(self, tmp_path,
+                                                      monkeypatch, capsys):
+        monkeypatch.chdir(tmp_path)
+        rc = main(["discuss", "topic", "--no-read-code"])
+        assert rc == 2  # ExitCode.CONFIG
+        assert "roundtable init" in capsys.readouterr().err
+
+    def test_status_after_discuss(self, project_root, monkeypatch, capsys):
+        write_config(project_root)
+        monkeypatch.chdir(project_root)
+        main(["discuss", "topic one", "--no-read-code"])
+        capsys.readouterr()
+        assert main(["status"]) == 0
+        out = capsys.readouterr().out
+        assert "Consensus reached" in out
+        assert "topic one" in out
+        assert main(["list"]) == 0
+        assert "topic one" in capsys.readouterr().out
+        assert main(["chronicle"]) == 0
+        assert "1 decision(s)" in capsys.readouterr().out
+
+
+class TestInitCommand:
+    def test_non_interactive_scaffold(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.chdir(tmp_path)
+        rc = main(["init"])
+        assert rc == 0
+        cfg_path = tmp_path / ".roundtable" / "config.json"
+        assert cfg_path.exists()
+        cfg = json.loads(cfg_path.read_text())
+        assert cfg["rules"]["max_rounds"] == 5
+        assert cfg["rules"]["consensus_threshold"] == 9
+        assert (tmp_path / ".roundtable" / "sessions").is_dir()
+        assert (tmp_path / ".roundtable" / "manifest.json").exists()
+        assert (tmp_path / "chronicle.md").exists()
+
+    def test_reinit_guard_non_interactive(self, tmp_path, monkeypatch,
+                                          capsys):
+        monkeypatch.chdir(tmp_path)
+        main(["init"])
+        before = (tmp_path / ".roundtable" / "config.json").read_text()
+        rc = main(["init"])
+        assert rc == 0
+        assert (tmp_path / ".roundtable" / "config.json").read_text() == before
+
+
+class TestProposalSummaries:
+    def test_get_last_proposals(self):
+        rounds = [
+            RoundEntry("A", 1, scripted_response(5, text="First analysis "
+                                                 "with enough length"),
+                       ConsensusBlock("A", 1, 5), "ts"),
+            RoundEntry("A", 2, scripted_response(7, text="Second thoughts, "
+                                                 "also long enough"),
+                       ConsensusBlock("A", 2, 7), "ts"),
+            RoundEntry("B", 2, scripted_response(3, text="B disagrees "
+                                                 "strongly here"),
+                       ConsensusBlock("B", 2, 3), "ts"),
+        ]
+        proposals = get_last_proposals(rounds)
+        assert len(proposals) == 2
+        a = next(p for p in proposals if p.knight == "A")
+        assert a.score == 7
+        assert a.summary.startswith("Second thoughts")
+        assert "consensus_score" not in a.summary

@@ -1,0 +1,69 @@
+"""The knight boundary — abstract adapter contract.
+
+Parity with reference src/adapters/base.ts:10-29 plus the one TPU-build
+extension from SURVEY.md §7.1: a batched ``execute_round`` entry point that
+lets the in-tree engine collapse a round's N-knight fan-out into a single
+device program. Serial ``execute`` stays the contract for cloud/CLI adapters.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.consensus import parse_consensus_from_response
+from ..core.types import ConsensusBlock
+
+DEFAULT_TIMEOUT_MS = 120_000
+
+
+@dataclass
+class KnightTurn:
+    """One prompt in a batched round dispatch."""
+
+    knight_name: str
+    prompt: str
+
+
+class BaseAdapter(ABC):
+    """4-method contract (reference base.ts:10-29)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @abstractmethod
+    def execute(self, prompt: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        """Run one prompt to completion and return the raw response text."""
+
+    @abstractmethod
+    def is_available(self) -> bool:
+        """Probe whether this backend can serve requests right now."""
+
+    def get_max_source_chars(self) -> Optional[int]:
+        """Context-budget hook: max source chars this knight can carry.
+
+        None means "no special limit" → orchestrator default 200KB
+        (reference base.ts:22-24, orchestrator.ts:281-292).
+        """
+        return None
+
+    def parse_consensus(self, response: str, round_num: int
+                        ) -> Optional[ConsensusBlock]:
+        """Default delegates to the consensus engine (reference base.ts:26-28)."""
+        return parse_consensus_from_response(response, self.name, round_num)
+
+    # --- TPU-build extension ---
+
+    def supports_batched_rounds(self) -> bool:
+        """True when execute_round is a genuine batched dispatch."""
+        return False
+
+    def execute_round(self, turns: list[KnightTurn],
+                      timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
+        """Execute N same-round prompts. Default: serial loop over execute().
+
+        The tpu-llm adapter overrides this with one batched forward pass over
+        N persistent KV slots (SURVEY.md §2.3 parallelism table).
+        """
+        return [self.execute(t.prompt, timeout_ms) for t in turns]

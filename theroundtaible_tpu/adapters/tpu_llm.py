@@ -1,0 +1,99 @@
+"""`tpu-llm` adapter — knights served by the in-tree JAX/XLA engine.
+
+This is the component that replaces the reference's local-llm → Ollama/
+LM Studio → CUDA llama.cpp stack (reference src/adapters/local-llm.ts;
+SURVEY.md §2.3). The adapter is a thin host-side shim: tokenize → dispatch to
+the engine's sharded prefill+decode → detokenize. Engine construction is lazy
+and cached per checkpoint so several knights (or several adapters) share one
+resident model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..core.errors import AdapterError, classify_error
+from .base import BaseAdapter, DEFAULT_TIMEOUT_MS, KnightTurn
+
+# Reserves mirror the local-llm budget contract (reference local-llm.ts:58-70),
+# but get_max_source_chars answers from REAL tokenizer counts downstream.
+RESPONSE_RESERVE_TOKENS = 4096
+OVERHEAD_RESERVE_TOKENS = 3000
+MIN_AVAILABLE_TOKENS = 2000
+
+
+class TpuLlmAdapter(BaseAdapter):
+    """BaseAdapter over an EngineHandle (theroundtaible_tpu.engine)."""
+
+    def __init__(self, name: str, engine_config: dict[str, Any],
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
+        super().__init__(name)
+        self.engine_config = dict(engine_config)
+        self.default_timeout = timeout_ms
+        self._engine = None
+        self._engine_error: Optional[str] = None
+
+    @classmethod
+    def from_config(cls, adapter_id: str, cfg: dict[str, Any],
+                    timeout_ms: int = DEFAULT_TIMEOUT_MS) -> "TpuLlmAdapter":
+        return cls(name=cfg.get("name", adapter_id), engine_config=cfg,
+                   timeout_ms=timeout_ms)
+
+    # --- engine lifecycle ---
+
+    def _get_engine(self):
+        if self._engine is None and self._engine_error is None:
+            try:
+                from ..engine import get_engine
+                self._engine = get_engine(self.engine_config)
+            except Exception as e:  # noqa: BLE001 — surfaced via is_available
+                self._engine_error = str(e)
+        if self._engine is None:
+            raise AdapterError(
+                f"TPU engine unavailable: {self._engine_error}",
+                kind=classify_error(RuntimeError(self._engine_error or "")))
+        return self._engine
+
+    def is_available(self) -> bool:
+        try:
+            self._get_engine()
+            return True
+        except AdapterError:
+            return False
+
+    # --- serving ---
+
+    def get_max_source_chars(self) -> Optional[int]:
+        """Budget from the engine's real max_seq_len and tokenizer
+        chars-per-token ratio (replaces the 4-chars/token estimate)."""
+        try:
+            engine = self._get_engine()
+        except AdapterError:
+            return None
+        ctx = engine.max_seq_len
+        available = max(ctx - RESPONSE_RESERVE_TOKENS - OVERHEAD_RESERVE_TOKENS,
+                        MIN_AVAILABLE_TOKENS)
+        return int(available * engine.chars_per_token())
+
+    def execute(self, prompt: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        engine = self._get_engine()
+        try:
+            return engine.generate(prompt, slot_name=self.name,
+                                   timeout_s=(timeout_ms or
+                                              self.default_timeout) / 1000)
+        except Exception as e:  # noqa: BLE001
+            raise AdapterError(str(e), kind=classify_error(e), cause=e)
+
+    def supports_batched_rounds(self) -> bool:
+        return True
+
+    def execute_round(self, turns: list[KnightTurn],
+                      timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
+        """One batched forward pass over N persistent per-knight KV slots."""
+        engine = self._get_engine()
+        try:
+            return engine.generate_batch(
+                [(t.knight_name, t.prompt) for t in turns],
+                timeout_s=(timeout_ms or self.default_timeout) / 1000)
+        except Exception as e:  # noqa: BLE001
+            raise AdapterError(str(e), kind=classify_error(e), cause=e)

@@ -1,0 +1,21 @@
+"""`roundtable apply` — Lead Knight executes the consensus decision.
+
+Full implementation lands with the RTDIFF/1 pipeline (reference behavior
+documented in README.md:159-207 / TODO.md:87-138; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.ui import style
+
+
+def apply_command(noparley: bool = False, dry_run: bool = False,
+                  override_scope: bool = False,
+                  project_root: Optional[str] = None) -> int:
+    print(style.yellow("\n  The apply pipeline is being forged "
+                       "(RTDIFF/1 block edits, scope enforcement, parley)."))
+    print(style.dim("  Until then: read decisions.md and wield the sword "
+                    "yourself.\n"))
+    return 1

@@ -1,0 +1,20 @@
+"""`roundtable code-red` — diagnostic mode (triage → blind round → convergence).
+
+Full implementation follows the documented protocol
+(reference architecture-docs.md:119-167; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.ui import style
+
+
+def code_red_command(description: str,
+                     project_root: Optional[str] = None) -> int:
+    print(style.yellow("\n  Code-red diagnostics are being forged "
+                       "(triage → blind round → convergence)."))
+    print(style.dim("  Until then: roundtable discuss "
+                    f'"Diagnose: {description[:60]}"\n'))
+    return 1

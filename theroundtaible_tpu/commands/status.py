@@ -1,0 +1,60 @@
+"""`roundtable status` — show the latest session.
+
+Parity with reference src/commands/status.ts:11-77.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from ..utils.session import find_latest_session
+from ..utils.ui import style
+
+PHASE_DISPLAY = {
+    "discussing": ("⚔️", "The knights are discussing", style.blue),
+    "consensus_reached": ("✓", "Consensus reached", style.green),
+    "escalated": ("!", "Escalated to the King", style.yellow),
+    "applying": ("…", "The Lead Knight is applying the decision", style.cyan),
+    "completed": ("✓", "Completed", style.green),
+}
+
+DECISIONS_PREVIEW_LINES = 10
+
+
+def status_command(project_root: Optional[str] = None) -> int:
+    project_root = project_root or os.getcwd()
+    session = find_latest_session(project_root)
+    if session is None:
+        print(style.dim("\n  No sessions yet. "
+                        'Start one with "roundtable discuss".\n'))
+        return 0
+
+    print(style.bold(f"\n  Latest session: {session.name}"))
+    if session.topic:
+        print(f"  Topic: {session.topic}")
+    if session.status:
+        s = session.status
+        icon, label, color = PHASE_DISPLAY.get(
+            s.phase, ("?", s.phase, style.white))
+        print(f"  Phase: {color(f'{icon} {label}')}")
+        print(f"  Round: {s.round}")
+        print(f"  Consensus: {'yes' if s.consensus_reached else 'no'}")
+        if s.current_knight:
+            print(f"  Current knight: {s.current_knight}")
+        if s.lead_knight:
+            print(f"  Lead knight: {s.lead_knight}")
+        print(style.dim(f"  Started: {s.started_at}"))
+        print(style.dim(f"  Updated: {s.updated_at}"))
+
+    decisions = Path(session.path) / "decisions.md"
+    if decisions.exists():
+        lines = decisions.read_text(encoding="utf-8").split("\n")
+        print(style.bold("\n  Decision preview:"))
+        for line in lines[:DECISIONS_PREVIEW_LINES]:
+            print(style.dim(f"    {line}"))
+        if len(lines) > DECISIONS_PREVIEW_LINES:
+            print(style.dim("    ..."))
+    print("")
+    return 0

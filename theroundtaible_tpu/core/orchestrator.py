@@ -1,0 +1,533 @@
+"""The orchestrator — round loop until consensus, rejection, or escalation.
+
+Parity with reference src/orchestrator.ts:271-673:
+
+- source budget = min over adapters' get_max_source_chars (fairness, :281-292)
+- round 1 in priority order; later rounds shuffled against yes-man drift
+  (:348-357)
+- per-knight turn: status write → prompt build → execute with runtime
+  fallback → consensus parse → file_requests/verify_commands resolution;
+  a crashed knight is classified, hinted, and the round continues (:521-535)
+- per-round: discussion.md rewrite, positive/negative consensus checks,
+  escalation warning; terminal writes to decisions.md/status/chronicle
+- "King sends back" resume via ContinueOptions (:313-344)
+
+TPU-build addition: when every knight in a round is served by one adapter
+that supports batched rounds (the tpu-llm engine) and the config opts in
+(`rules` extension `parallel_rounds`), the inner serial loop collapses into
+one batched dispatch — knights speak simultaneously instead of seeing
+same-round earlier turns. Default stays the reference's sequential semantics.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..adapters.base import BaseAdapter, KnightTurn
+from ..adapters.factory import create_adapter
+from ..utils.chronicle import append_to_chronicle
+from ..utils.context import ProjectContext, build_context
+from ..utils.decree_log import (
+    format_decrees_for_prompt,
+    get_active_decrees,
+    read_decree_log,
+)
+from ..utils.manifest import get_manifest_summary, read_manifest
+from ..utils.session import (
+    create_session,
+    now_iso,
+    update_status,
+    write_decisions,
+    write_discussion,
+)
+from ..utils.verify import resolve_verify_commands
+from .consensus import (
+    check_consensus,
+    check_negative_consensus,
+    strip_consensus_json,
+    summarize_consensus,
+    warn_missing_scope_at_consensus,
+)
+from .errors import classify_error, hint_for_kind
+from .types import (
+    ConsensusBlock,
+    ContinueOptions,
+    KnightConfig,
+    RoundEntry,
+    RoundtableConfig,
+    SessionResult,
+)
+
+DEFAULT_MAX_SOURCE_CHARS = 200_000
+GIT_DIFF_PROMPT_CHARS = 3000
+FILE_REQUEST_DEFAULT_LINES = 200
+
+
+class Reporter:
+    """Display hooks for the command layer; the default is silent so the
+    orchestrator stays import-safe for tests and embedding. The CLI installs
+    a console reporter (commands/discuss.py)."""
+
+    def context_start(self) -> None: ...
+    def context_done(self, context: ProjectContext, manifest_features: int,
+                     decree_count: int) -> None: ...
+    def session_started(self, session_path: str, resumed: bool) -> None: ...
+    def round_started(self, round_num: int, order: list[str],
+                      shuffled: bool) -> None: ...
+    def knight_skipped(self, knight: str) -> None: ...
+    def knight_thinking(self, knight: str) -> Callable[[], None]:
+        return lambda: None
+    def knight_spoke(self, knight: str, round_num: int, display_text: str,
+                     consensus: Optional[ConsensusBlock]) -> None: ...
+    def knight_failed(self, knight: str, kind: str, message: str,
+                      hint: Optional[str]) -> None: ...
+    def fallback_engaged(self, knight: str, fallback_id: str) -> None: ...
+    def resolving_files(self, knight: str, requests: list[str]) -> None: ...
+    def resolving_commands(self, knight: str) -> None: ...
+    def verify_event(self, kind: str, message: str) -> None: ...
+    def consensus_reached(self, blocks: list[ConsensusBlock],
+                          allowed_files: list[str]) -> None: ...
+    def unanimous_rejection(self, blocks: list[ConsensusBlock]) -> None: ...
+    def escalation_warning(self, round_num: int, rounds_left: int) -> None: ...
+    def escalated(self, blocks: list[ConsensusBlock]) -> None: ...
+    def overflow_warning(self, skipped: int, max_chars: int) -> None: ...
+
+
+def shuffle_order(knights: list[KnightConfig],
+                  rng: Optional[random.Random] = None) -> list[KnightConfig]:
+    order = list(knights)
+    (rng or random).shuffle(order)
+    return order
+
+
+def execute_with_fallback(
+    primary: BaseAdapter, knight: KnightConfig, config: RoundtableConfig,
+    prompt: str, timeout_ms: int, adapters: dict[str, BaseAdapter],
+    reporter: Reporter,
+) -> str:
+    """Primary execute; on failure lazily create + cache the knight's
+    configured fallback adapter and retry once (reference :45-73)."""
+    try:
+        return primary.execute(prompt, timeout_ms)
+    except Exception as primary_error:
+        if not knight.fallback:
+            raise
+        cache_key = f"__fallback_{knight.name}"
+        fallback = adapters.get(cache_key)
+        if fallback is None:
+            created = create_adapter(knight.fallback, config, timeout_ms)
+            if created is not None and created.is_available():
+                adapters[cache_key] = created
+                fallback = created
+        if fallback is None:
+            raise primary_error
+        reporter.fallback_engaged(knight.name, knight.fallback)
+        return fallback.execute(prompt, timeout_ms)
+
+
+def select_lead_knight(knights: list[KnightConfig],
+                       blocks: list[ConsensusBlock]) -> KnightConfig:
+    """Top scorer of the last round; priority (lowest number) breaks ties;
+    fallback = highest-priority knight (reference :114-141)."""
+    if blocks:
+        last_round = max(b.round for b in blocks)
+        last_blocks = [b for b in blocks if b.round == last_round]
+        if last_blocks:
+            max_score = max(b.consensus_score for b in last_blocks)
+            top = [b for b in last_blocks if b.consensus_score == max_score]
+            by_name = {k.name: k for k in knights}
+            candidates = sorted(
+                (by_name[b.knight] for b in top if b.knight in by_name),
+                key=lambda k: k.priority)
+            if candidates:
+                return candidates[0]
+    return sorted(knights, key=lambda k: k.priority)[0]
+
+
+def compute_allowed_files(blocks: list[ConsensusBlock]) -> list[str]:
+    """Dedup union of all knights' files_to_modify (reference :145-158)."""
+    seen: dict[str, None] = {}
+    for block in blocks:
+        for f in block.files_to_modify:
+            seen.setdefault(f)
+    return list(seen)
+
+
+def resolve_file_requests(file_requests: list[str], project_root: str,
+                          ignore_patterns: list[str]) -> str:
+    """Read requested files with traversal/ignore guards and range syntax
+    `path:start-end`; 200-line default cap (reference :164-222)."""
+    import os
+    import re
+
+    results: list[str] = []
+    for req in file_requests[:4]:
+        m = re.match(r"^(.+?):(\d+)-(\d+)$", req)
+        file_path = m.group(1) if m else req
+        start = int(m.group(2)) if m else None
+        end = int(m.group(3)) if m else None
+
+        normalized = os.path.normpath(file_path).replace("\\", "/")
+        if ".." in normalized.split("/") or normalized.startswith("/"):
+            results.append(f"[DENIED] {req} — path traversal not allowed")
+            continue
+        if any(normalized.startswith(p) or f"/{p}/" in normalized
+               for p in ignore_patterns):
+            results.append(f"[DENIED] {req} — matches ignore pattern")
+            continue
+        full = Path(project_root) / normalized
+        if not full.exists():
+            results.append(f"[NOT FOUND] {req}")
+            continue
+        try:
+            lines = full.read_text(encoding="utf-8",
+                                   errors="replace").split("\n")
+        except OSError:
+            results.append(f"[ERROR] {req} — could not read file")
+            continue
+        if start is not None and end is not None:
+            excerpt = "\n".join(lines[max(0, start - 1):min(len(lines), end)])
+        else:
+            excerpt = "\n".join(lines[:FILE_REQUEST_DEFAULT_LINES])
+            if len(lines) > FILE_REQUEST_DEFAULT_LINES:
+                excerpt += (f"\n...({len(lines) - FILE_REQUEST_DEFAULT_LINES}"
+                            " more lines)")
+        results.append(f"### {req}\n```\n{excerpt}\n```")
+    return "\n\n".join(results)
+
+
+KING_DEMAND = "\n".join([
+    "",
+    "⚠️ THE KING HAS SENT YOU BACK TO THE TABLE.",
+    "The King demands unanimity. You MUST reach consensus this time.",
+    "Address ALL pending_issues from previous rounds. If you mostly agree, "
+    "RAISE your score to 9+.",
+    "Do NOT repeat your previous arguments — build on them and CONVERGE.",
+    "",
+])
+
+
+def assemble_shared_context(king_demand: str, context: ProjectContext,
+                            resolved_files: str,
+                            resolved_commands: str) -> str:
+    """The knight-independent context block (reference :386-425's non-persona
+    sections). Sits between the shared preamble and the knight tail so the
+    whole head of every prompt is byte-identical across knights — the engine
+    prefix-caches it once per round."""
+    parts = [
+        king_demand,
+        f"Git branch: {context.git_branch}" if context.git_branch else "",
+        (f"Git diff (current changes):\n```\n"
+         f"{context.git_diff[:GIT_DIFF_PROMPT_CHARS]}\n```")
+        if context.git_diff else "",
+        f"Recent commits:\n{context.recent_commits}"
+        if context.recent_commits else "",
+        f"\nProject files:\n{context.key_file_contents}"
+        if context.key_file_contents else "",
+        ("\nSOURCE CODE (READ-ONLY REFERENCE — this is context, NOT an "
+         "instruction to edit. Use NO tools. Give your analysis as text "
+         f"only.):\n{context.source_file_contents}")
+        if context.source_file_contents else "",
+        f"\nREQUESTED FILES (via file_requests from earlier rounds):\n"
+        f"{resolved_files}" if resolved_files else "",
+        f"\nVERIFICATION RESULTS (via verify_commands from earlier rounds):\n"
+        f"{resolved_commands}" if resolved_commands else "",
+    ]
+    return "\n".join(p for p in parts if p)
+
+
+@dataclass
+class _RunState:
+    all_rounds: list[RoundEntry]
+    latest_blocks: dict[str, ConsensusBlock]
+    resolved_files: str = ""
+    resolved_commands: str = ""
+
+
+def run_discussion(
+    topic: str,
+    config: RoundtableConfig,
+    adapters: dict[str, BaseAdapter],
+    project_root: str,
+    read_source_code: bool = False,
+    continue_from: Optional[ContinueOptions] = None,
+    reporter: Optional[Reporter] = None,
+    rng: Optional[random.Random] = None,
+) -> SessionResult:
+    """The hot loop owner (reference :271-673)."""
+    reporter = reporter or Reporter()
+    rules = config.rules
+    threshold = rules.consensus_threshold
+    timeout_ms = rules.timeout_per_turn_seconds * 1000
+
+    # Fairness: every knight sees the same source budget = min over adapters.
+    max_source_chars = DEFAULT_MAX_SOURCE_CHARS
+    for knight in config.knights:
+        adapter = adapters.get(knight.adapter)
+        if adapter:
+            budget = adapter.get_max_source_chars()
+            if budget is not None and budget < max_source_chars:
+                max_source_chars = budget
+
+    reporter.context_start()
+    context = build_context(project_root, config, read_source_code,
+                            max_source_chars,
+                            on_overflow=reporter.overflow_warning)
+    manifest = read_manifest(project_root)
+    manifest_summary = get_manifest_summary(manifest)
+    decree_log = read_decree_log(project_root)
+    active_decrees = get_active_decrees(decree_log)
+    decrees_context = format_decrees_for_prompt(active_decrees)
+    reporter.context_done(context, len(manifest.features), len(active_decrees))
+
+    if continue_from:
+        session_path = continue_from.session_path
+    else:
+        session_path = str(create_session(project_root, topic))
+    reporter.session_started(session_path, resumed=continue_from is not None)
+
+    sorted_knights = sorted(config.knights, key=lambda k: k.priority)
+    state = _RunState(
+        all_rounds=list(continue_from.all_rounds) if continue_from else [],
+        latest_blocks={},
+        resolved_files=continue_from.resolved_files if continue_from else "",
+        resolved_commands=(continue_from.resolved_commands
+                           if continue_from else ""),
+    )
+    if continue_from:
+        for entry in continue_from.all_rounds:
+            if entry.consensus:
+                state.latest_blocks[entry.knight] = entry.consensus
+
+    start_round = continue_from.start_round if continue_from else 1
+    end_round = start_round + rules.max_rounds - 1
+    king_demand = KING_DEMAND if continue_from else ""
+
+    for round_num in range(start_round, end_round + 1):
+        is_first = round_num == start_round and not continue_from
+        round_order = (sorted_knights if is_first
+                       else shuffle_order(sorted_knights, rng))
+        reporter.round_started(round_num, [k.name for k in round_order],
+                               shuffled=not is_first)
+
+        _run_round_turns(
+            round_order, round_num, topic, config, adapters, project_root,
+            session_path, context, manifest_summary, decrees_context,
+            king_demand, state, timeout_ms, reporter)
+
+        write_discussion(session_path, state.all_rounds)
+        current_blocks = list(state.latest_blocks.values())
+
+        if check_consensus(current_blocks, threshold):
+            return _finish_consensus(
+                topic, config, project_root, session_path, round_num,
+                current_blocks, state, reporter)
+
+        if check_negative_consensus(current_blocks):
+            return _finish_rejection(
+                topic, config, project_root, session_path, round_num,
+                current_blocks, state, reporter)
+
+        if rules.escalate_to_user_after <= round_num < end_round:
+            reporter.escalation_warning(round_num, end_round - round_num)
+
+    reporter.escalated(list(state.latest_blocks.values()))
+    update_status(session_path, phase="escalated", consensus_reached=False,
+                  round=end_round)
+    return SessionResult(
+        session_path=session_path, consensus=False, rounds=end_round,
+        decision=None, blocks=list(state.latest_blocks.values()),
+        all_rounds=state.all_rounds,
+        resolved_files=state.resolved_files,
+        resolved_commands=state.resolved_commands,
+    )
+
+
+def _build_turn_prompt(knight, config, topic, context, manifest_summary,
+                       decrees_context, king_demand, state):
+    from .prompt import build_knight_tail, build_shared_preamble
+
+    shared = (build_shared_preamble(
+        topic, context.chronicle, state.all_rounds, manifest_summary,
+        decrees_context)
+        + "\n" + assemble_shared_context(
+            king_demand, context, state.resolved_files,
+            state.resolved_commands))
+    return shared + "\n" + build_knight_tail(knight, config.knights, topic)
+
+
+def _batchable_adapter(round_order, adapters) -> Optional[BaseAdapter]:
+    """The single shared batch-capable adapter for this round, if any."""
+    seen: set[int] = set()
+    found: Optional[BaseAdapter] = None
+    for k in round_order:
+        a = adapters.get(k.adapter)
+        if a is None or not a.supports_batched_rounds():
+            return None
+        if id(a) not in seen:
+            seen.add(id(a))
+            found = a
+    return found if len(seen) == 1 else None
+
+
+def _run_round_turns(round_order, round_num, topic, config, adapters,
+                     project_root, session_path, context, manifest_summary,
+                     decrees_context, king_demand, state, timeout_ms,
+                     reporter) -> None:
+    batch_adapter = (_batchable_adapter(round_order, adapters)
+                     if config.rules.parallel_rounds else None)
+
+    if batch_adapter is not None:
+        # Batched dispatch: all knights speak against the same transcript
+        # snapshot in ONE device program (SURVEY.md §7.1).
+        update_status(session_path, phase="discussing", current_knight=None,
+                      round=round_num)
+        turns = []
+        present = []
+        for knight in round_order:
+            prompt = _build_turn_prompt(
+                knight, config, topic, context, manifest_summary,
+                decrees_context, king_demand, state)
+            turns.append(KnightTurn(knight_name=knight.name, prompt=prompt))
+            present.append(knight)
+        try:
+            responses = batch_adapter.execute_round(turns, timeout_ms)
+            if len(responses) != len(turns):
+                raise RuntimeError(
+                    f"batched round returned {len(responses)} responses "
+                    f"for {len(turns)} turns")
+        except Exception as error:  # noqa: BLE001 — contained per round
+            kind = classify_error(error)
+            for knight in present:
+                reporter.knight_failed(knight.name, kind, str(error),
+                                       hint_for_kind(kind))
+            return
+        for knight, response in zip(present, responses):
+            _record_turn(knight, round_num, response, batch_adapter, config,
+                         project_root, state, reporter)
+        return
+
+    for knight in round_order:
+        adapter = adapters.get(knight.adapter)
+        if adapter is None:
+            reporter.knight_skipped(knight.name)
+            continue
+        update_status(session_path, phase="discussing",
+                      current_knight=knight.name, round=round_num)
+        prompt = _build_turn_prompt(
+            knight, config, topic, context, manifest_summary,
+            decrees_context, king_demand, state)
+        stop_thinking = reporter.knight_thinking(knight.name)
+        try:
+            response = execute_with_fallback(
+                adapter, knight, config, prompt, timeout_ms, adapters,
+                reporter)
+        except Exception as error:  # noqa: BLE001 — turn-level containment
+            stop_thinking()
+            kind = classify_error(error)
+            reporter.knight_failed(knight.name, kind, str(error),
+                                   hint_for_kind(kind))
+            continue
+        stop_thinking()
+        _record_turn(knight, round_num, response, adapter, config,
+                     project_root, state, reporter)
+
+
+def _record_turn(knight, round_num, response, adapter, config, project_root,
+                 state, reporter) -> None:
+    consensus = adapter.parse_consensus(response, round_num)
+    if consensus is not None:
+        # Adapter-level parse keeps the adapter's own knight naming; pin the
+        # turn to the configured knight name for transcript consistency.
+        consensus.knight = knight.name
+    entry = RoundEntry(knight=knight.name, round=round_num, response=response,
+                       consensus=consensus, timestamp=now_iso())
+    state.all_rounds.append(entry)
+    display = strip_consensus_json(response)
+    reporter.knight_spoke(knight.name, round_num, display, consensus)
+
+    if consensus is None:
+        return
+    state.latest_blocks[knight.name] = consensus
+    if consensus.file_requests:
+        reporter.resolving_files(knight.name, consensus.file_requests)
+        new_files = resolve_file_requests(
+            consensus.file_requests, project_root, config.rules.ignore)
+        if new_files:
+            state.resolved_files += \
+                ("\n\n" if state.resolved_files else "") + new_files
+    if consensus.verify_commands:
+        reporter.resolving_commands(knight.name)
+        new_commands = resolve_verify_commands(
+            consensus.verify_commands, project_root,
+            on_event=reporter.verify_event)
+        if new_commands:
+            state.resolved_commands += \
+                ("\n\n" if state.resolved_commands else "") + new_commands
+
+
+def _finish_consensus(topic, config, project_root, session_path, round_num,
+                      current_blocks, state, reporter) -> SessionResult:
+    for block in current_blocks:
+        warning = warn_missing_scope_at_consensus(block)
+        if warning:
+            reporter.verify_event("warning", warning)
+    allowed_files = compute_allowed_files(current_blocks)
+    reporter.consensus_reached(current_blocks, allowed_files)
+
+    last_proposal = None
+    for entry in reversed(state.all_rounds):
+        if entry.consensus and entry.consensus.proposal:
+            last_proposal = entry.consensus.proposal
+            break
+    if last_proposal is None:
+        last_proposal = (state.all_rounds[-1].response if state.all_rounds
+                         else "No proposal text available.")
+
+    lead = select_lead_knight(config.knights, current_blocks)
+    write_decisions(session_path, topic, last_proposal, state.all_rounds)
+    update_status(session_path, phase="consensus_reached",
+                  consensus_reached=True, round=round_num,
+                  allowed_files=allowed_files if allowed_files else None,
+                  lead_knight=lead.name)
+    append_to_chronicle(
+        project_root, config.chronicle, topic=topic,
+        outcome=(f"Consensus in {round_num} round(s). "
+                 f"Lead Knight: {lead.name}.\n\n{last_proposal}"),
+        knights=[b.knight for b in current_blocks],
+        date=datetime.now(timezone.utc).strftime("%Y-%m-%d"))
+    return SessionResult(
+        session_path=session_path, consensus=True, rounds=round_num,
+        decision=last_proposal, blocks=current_blocks,
+        all_rounds=state.all_rounds,
+        resolved_files=state.resolved_files,
+        resolved_commands=state.resolved_commands,
+    )
+
+
+def _finish_rejection(topic, config, project_root, session_path, round_num,
+                      current_blocks, state, reporter) -> SessionResult:
+    reporter.unanimous_rejection(current_blocks)
+    rejection_summary = "\n\n---\n\n".join(
+        f"## {r.knight}\n\n{r.response}"
+        for r in state.all_rounds if r.round == round_num)
+    write_decisions(session_path, topic, rejection_summary, state.all_rounds)
+    update_status(session_path, phase="consensus_reached",
+                  consensus_reached=True, round=round_num)
+    append_to_chronicle(
+        project_root, config.chronicle, topic=topic,
+        outcome=(f"Unanimous rejection in {round_num} round(s). "
+                 "All knights advise against this."),
+        knights=[b.knight for b in current_blocks],
+        date=datetime.now(timezone.utc).strftime("%Y-%m-%d"))
+    return SessionResult(
+        session_path=session_path, consensus=True, unanimous_rejection=True,
+        rounds=round_num, decision=rejection_summary, blocks=current_blocks,
+        all_rounds=state.all_rounds,
+        resolved_files=state.resolved_files,
+        resolved_commands=state.resolved_commands,
+    )

@@ -1,23 +1,25 @@
-"""System-prompt builder — template + 9 placeholder slots.
+"""System-prompt builder — shared preamble + knight-specific tail.
 
 Parity with reference src/utils/prompt.ts:1-106 and
-templates/system-prompt.md. Two deliberate improvements over the reference:
+templates/system-prompt.md. Deliberate improvements over the reference:
 
 - ALL occurrences of each placeholder are filled (the reference's JS
   ``String.replace`` only fills the first ``{{topic}}``, leaving the second
   literal — prompt.ts:93).
 - The template is shipped inside the package and the language is English; the
   rule set, scoring semantics and JSON contract are identical.
-
-The prompt layout is also engineered for the TPU engine's shared-prefix
-batching (SURVEY.md §7.3 hard part 2): the knight-specific header (name,
-capabilities, personality) comes first, and the big shared suffix (chronicle,
-manifest, decrees, transcript) last, so per-knight prompts diverge only in a
-short prefix. The engine exploits the shared suffix via its prefix cache.
+- The prompt is split into a SHARED PREAMBLE (rules, topic, chronicle,
+  manifest, decrees, transcript — identical for every knight) and a short
+  KNIGHT TAIL (name, capabilities, personality). Shared content leads, so
+  per-knight prompts diverge only near the end: exactly the layout a
+  prefix-cache / shared-prefix batched prefill can exploit (SURVEY.md §7.3
+  hard part 2 — "common prefix first"). The reference interleaves them
+  (knight name on line 2), which would defeat KV reuse.
 """
 
 from __future__ import annotations
 
+from functools import cache
 from importlib import resources
 
 from .types import KnightConfig, RoundEntry, format_score
@@ -53,9 +55,10 @@ DEFAULT_PERSONALITY = (
 )
 
 
-def load_template() -> str:
+@cache
+def load_template(name: str = "system_prompt.md") -> str:
     return (resources.files("theroundtaible_tpu") / "templates"
-            / "system_prompt.md").read_text(encoding="utf-8")
+            / name).read_text(encoding="utf-8")
 
 
 def format_other_knights(current: KnightConfig,
@@ -81,6 +84,44 @@ def format_previous_rounds(rounds: list[RoundEntry]) -> str:
     return "\n\n---\n\n".join(parts)
 
 
+def _fill(template: str, slots: dict[str, str]) -> str:
+    for placeholder, value in slots.items():
+        template = template.replace(placeholder, value)
+    return template
+
+
+def build_shared_preamble(
+    topic: str,
+    chronicle: str,
+    previous_rounds: list[RoundEntry],
+    manifest_summary: str = "",
+    decrees_context: str = "",
+) -> str:
+    """The knight-independent prompt head — identical for every knight in a
+    round, so the engine's prefix cache computes it once."""
+    return _fill(load_template("system_prompt.md"), {
+        "{{topic}}": topic,
+        "{{chronicle_content}}": chronicle or "(No earlier decisions.)",
+        "{{manifest_summary}}": manifest_summary
+        or "No implementation history yet.",
+        "{{decrees}}": decrees_context or "",
+        "{{previous_rounds}}": format_previous_rounds(previous_rounds),
+    })
+
+
+def build_knight_tail(knight: KnightConfig, all_knights: list[KnightConfig],
+                      topic: str) -> str:
+    """The short per-knight suffix: identity, personality, the turn ask."""
+    personality = KNIGHT_PERSONALITIES.get(knight.name, DEFAULT_PERSONALITY)
+    return _fill(load_template("knight_tail.md"), {
+        "{{knight_name}}": knight.name,
+        "{{capabilities}}": ", ".join(knight.capabilities),
+        "{{other_knights}}": format_other_knights(knight, all_knights),
+        "{{personality}}": personality,
+        "{{topic}}": topic,
+    })
+
+
 def build_system_prompt(
     knight: KnightConfig,
     all_knights: list[KnightConfig],
@@ -90,20 +131,7 @@ def build_system_prompt(
     manifest_summary: str = "",
     decrees_context: str = "",
 ) -> str:
-    template = load_template()
-    personality = KNIGHT_PERSONALITIES.get(knight.name, DEFAULT_PERSONALITY)
-    slots = {
-        "{{knight_name}}": knight.name,
-        "{{capabilities}}": ", ".join(knight.capabilities),
-        "{{other_knights}}": format_other_knights(knight, all_knights),
-        "{{topic}}": topic,
-        "{{personality}}": personality,
-        "{{chronicle_content}}": chronicle or "(No earlier decisions.)",
-        "{{manifest_summary}}": manifest_summary or "No implementation history yet.",
-        "{{decrees}}": decrees_context or "",
-        "{{previous_rounds}}": format_previous_rounds(previous_rounds),
-    }
-    out = template
-    for placeholder, value in slots.items():
-        out = out.replace(placeholder, value)
-    return out
+    """Full prompt = shared preamble + knight tail (compat composition)."""
+    return (build_shared_preamble(topic, chronicle, previous_rounds,
+                                  manifest_summary, decrees_context)
+            + "\n" + build_knight_tail(knight, all_knights, topic))

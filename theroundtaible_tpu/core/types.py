@@ -67,6 +67,10 @@ class RulesConfig:
     ignore: list[str] = field(
         default_factory=lambda: [".git", "node_modules", "dist", "build", ".next"]
     )
+    # TPU-build extension: when true and all knights share one batch-capable
+    # adapter (tpu-llm), each round is ONE batched forward pass — knights
+    # speak simultaneously instead of seeing same-round earlier turns.
+    parallel_rounds: bool = False
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "RulesConfig":
@@ -84,6 +88,8 @@ class RulesConfig:
             ),
             auto_execute=bool(d.get("auto_execute", default.auto_execute)),
             ignore=list(d.get("ignore", default.ignore)),
+            parallel_rounds=bool(d.get("parallel_rounds",
+                                       default.parallel_rounds)),
         )
 
     def to_dict(self) -> dict[str, Any]:

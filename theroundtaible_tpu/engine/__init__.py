@@ -1,0 +1,37 @@
+"""The in-tree JAX/XLA inference engine (tpu-llm backend).
+
+`get_engine(config)` is the single construction seam used by
+adapters/tpu_llm.py. Engines are cached per (model, checkpoint, mesh) so
+several knights share one resident model (SURVEY.md §7.1).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+_engines: dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _cache_key(config: dict[str, Any]) -> str:
+    relevant = {k: config.get(k) for k in
+                ("model", "checkpoint", "max_seq_len", "dtype", "mesh")}
+    return json.dumps(relevant, sort_keys=True)
+
+
+def get_engine(config: dict[str, Any]):
+    """Build (or reuse) an InferenceEngine for this adapter config."""
+    key = _cache_key(config)
+    with _lock:
+        if key not in _engines:
+            from .engine import InferenceEngine
+            _engines[key] = InferenceEngine.from_config(config)
+        return _engines[key]
+
+
+def reset_engines() -> None:
+    """Drop all cached engines (tests)."""
+    with _lock:
+        _engines.clear()

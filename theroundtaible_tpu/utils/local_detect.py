@@ -81,19 +81,44 @@ def _ollama_cli_fallback() -> list[LocalModel]:
     return models
 
 
-def detect_tpu_engine() -> list[LocalModel]:
+def detect_tpu_engine(timeout_s: float = 5.0) -> list[LocalModel]:
     """Report the in-tree TPU engine as a seat-able backend when JAX sees
-    an accelerator (no reference counterpart — TPU-build addition)."""
-    try:
-        import jax
-        devices = jax.devices()
-    except Exception:
+    an accelerator (no reference counterpart — TPU-build addition).
+
+    jax.devices() can block indefinitely when another process holds the TPU
+    client, so the probe runs in a daemon thread under a timeout; on timeout
+    or CPU-only hosts nothing is reported. ROUNDTABLE_DISABLE_TPU_DETECT=1
+    skips the probe entirely (tests, CI)."""
+    import os
+    import threading
+
+    if os.environ.get("ROUNDTABLE_DISABLE_TPU_DETECT"):
         return []
-    if not devices:
-        return []
-    kind = getattr(devices[0], "device_kind", "device")
-    return [LocalModel(id="tpu-llm", name=f"In-tree TPU engine ({kind} ×{len(devices)})",
-                       endpoint="in-process", source="tpu")]
+
+    result: list[LocalModel] = []
+
+    def probe() -> None:
+        try:
+            import jax
+            devices = jax.devices()
+        except Exception:
+            return
+        if not devices:
+            return
+        platform = getattr(devices[0], "platform", "cpu")
+        if platform == "cpu" and not os.environ.get(
+                "ROUNDTABLE_FORCE_TPU_DETECT"):
+            return  # the engine runs on CPU too, but don't auto-seat there
+        kind = getattr(devices[0], "device_kind", "device")
+        result.append(LocalModel(
+            id="tpu-llm",
+            name=f"In-tree TPU engine ({kind} ×{len(devices)})",
+            endpoint="in-process", source="tpu"))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    return list(result)
 
 
 def detect_local_models(include_tpu: bool = True) -> list[LocalModel]:
