@@ -1,0 +1,78 @@
+"""Benchmark — decode throughput of the flagship model on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures BASELINE.md config 1's engine side (gemma-2b, single chip): chunked
+prefill + jit'd while_loop decode through the production InferenceEngine
+(persistent KV slot, bf16, bucketed shapes). The reference publishes no
+numbers (BASELINE.md "published: {}"), so vs_baseline is computed against
+A100 Ollama gemma-2b decode ≈ 120 tok/s — the wall-clock-parity target the
+driver defines (north star: v5e vs A100 Ollama).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+A100_OLLAMA_GEMMA2B_DECODE_TPS = 120.0  # external anchor, see module docstring
+
+PROMPT = (
+    "You are taking part in a TheRoundtAIble discussion. Topic: should we "
+    "refactor the session store before adding the apply pipeline? Consider "
+    "the trade-offs carefully and end with a consensus JSON block. " * 8
+)
+
+
+def main() -> int:
+    import jax
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg = get_model_config("tiny-gemma")
+        decode_tokens = 64
+    else:
+        cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
+        decode_tokens = 256
+
+    engine = InferenceEngine(
+        cfg, num_slots=4,
+        sampling=SamplingParams(temperature=0.0,
+                                max_new_tokens=decode_tokens))
+
+    # Warmup: compile prefill buckets + decode loop.
+    engine.generate(PROMPT, slot_name="warmup",
+                    max_new_tokens=decode_tokens)
+
+    # Measured run on a fresh slot (no prefix reuse → honest prefill too).
+    t0 = time.monotonic()
+    engine.generate(PROMPT, slot_name="bench", max_new_tokens=decode_tokens)
+    wall = time.monotonic() - t0
+    s = engine.last_stats
+
+    decode_tps = s.decode_tps
+    result = {
+        "metric": f"decode_tokens_per_sec_per_chip[{cfg.name}]",
+        "value": round(decode_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(decode_tps / A100_OLLAMA_GEMMA2B_DECODE_TPS, 3),
+        "detail": {
+            "prefill_tps": round(s.prefill_tps, 1),
+            "prefill_tokens": s.prefill_tokens,
+            "decode_tokens": s.decode_tokens,
+            "wall_s": round(wall, 2),
+            "devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Checkpoint loading tests: HF safetensors layout → engine param tree,
+including numerical equivalence of the attention projections against a
+torch reference computation."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine.checkpoint import (
+    detect_config_from_hf,
+    load_hf_checkpoint,
+)
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.sampling import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def hf_ckpt(tmp_path_factory):
+    """Write a tiny-llama-shaped HF checkpoint with known weights."""
+    from safetensors.numpy import save_file
+
+    cfg = get_model_config("tiny-llama")
+    rng = np.random.default_rng(7)
+    e, h, k, d, f, v = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
+                        cfg.head_dim, cfg.mlp_dim, cfg.vocab_size)
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal(
+            (v, e), dtype=np.float32) * 0.02,
+        "model.norm.weight": np.ones((e,), np.float32),
+        "lm_head.weight": rng.standard_normal(
+            (v, e), dtype=np.float32) * 0.02,
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        tensors.update({
+            f"{p}.self_attn.q_proj.weight": rng.standard_normal(
+                (h * d, e), dtype=np.float32) * 0.02,
+            f"{p}.self_attn.k_proj.weight": rng.standard_normal(
+                (k * d, e), dtype=np.float32) * 0.02,
+            f"{p}.self_attn.v_proj.weight": rng.standard_normal(
+                (k * d, e), dtype=np.float32) * 0.02,
+            f"{p}.self_attn.o_proj.weight": rng.standard_normal(
+                (e, h * d), dtype=np.float32) * 0.02,
+            f"{p}.mlp.gate_proj.weight": rng.standard_normal(
+                (f, e), dtype=np.float32) * 0.02,
+            f"{p}.mlp.up_proj.weight": rng.standard_normal(
+                (f, e), dtype=np.float32) * 0.02,
+            f"{p}.mlp.down_proj.weight": rng.standard_normal(
+                (e, f), dtype=np.float32) * 0.02,
+            f"{p}.input_layernorm.weight": np.ones((e,), np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones((e,), np.float32),
+        })
+    ckpt_dir = tmp_path_factory.mktemp("hf_ckpt")
+    save_file(tensors, str(ckpt_dir / "model.safetensors"))
+    (ckpt_dir / "config.json").write_text(json.dumps(
+        {"model_type": "llama", "hidden_size": e}))
+    return ckpt_dir, tensors
+
+
+class TestHfLoading:
+    def test_shapes_and_values(self, hf_ckpt):
+        ckpt_dir, tensors = hf_ckpt
+        cfg = get_model_config("tiny-llama")
+        params = load_hf_checkpoint(ckpt_dir, cfg, dtype=jnp.float32)
+        e, h, d = cfg.embed_dim, cfg.num_heads, cfg.head_dim
+        assert params["embedding"].shape == (cfg.vocab_size, e)
+        assert params["layers"][0]["q_proj"].shape == (e, h, d)
+        assert params["layers"][0]["o_proj"].shape == (h, d, e)
+        np.testing.assert_allclose(
+            np.asarray(params["embedding"]),
+            tensors["model.embed_tokens.weight"], rtol=1e-6)
+
+    def test_projection_math_matches_torch(self, hf_ckpt):
+        """x @ my_q_proj must equal torch's Linear(W_q)(x) reshaped."""
+        import torch
+
+        ckpt_dir, tensors = hf_ckpt
+        cfg = get_model_config("tiny-llama")
+        params = load_hf_checkpoint(ckpt_dir, cfg, dtype=jnp.float32)
+
+        x = np.random.default_rng(1).standard_normal(
+            (3, cfg.embed_dim), dtype=np.float32)
+        w_q = tensors["model.layers.0.self_attn.q_proj.weight"]
+        torch_out = torch.nn.functional.linear(
+            torch.from_numpy(x), torch.from_numpy(w_q)).numpy() \
+            .reshape(3, cfg.num_heads, cfg.head_dim)
+        mine = np.einsum("be,ehd->bhd", x,
+                         np.asarray(params["layers"][0]["q_proj"]))
+        np.testing.assert_allclose(mine, torch_out, rtol=1e-4, atol=1e-5)
+
+        # o_proj: torch computes y = W_o @ concat(heads)
+        w_o = tensors["model.layers.0.self_attn.o_proj.weight"]
+        heads = np.random.default_rng(2).standard_normal(
+            (3, cfg.num_heads, cfg.head_dim), dtype=np.float32)
+        torch_o = torch.nn.functional.linear(
+            torch.from_numpy(heads.reshape(3, -1)),
+            torch.from_numpy(w_o)).numpy()
+        mine_o = np.einsum("bhd,hde->be", heads,
+                           np.asarray(params["layers"][0]["o_proj"]))
+        np.testing.assert_allclose(mine_o, torch_o, rtol=1e-4, atol=1e-5)
+
+    def test_incomplete_checkpoint_raises(self, tmp_path):
+        from safetensors.numpy import save_file
+        save_file({"model.embed_tokens.weight":
+                   np.zeros((512, 64), np.float32)},
+                  str(tmp_path / "model.safetensors"))
+        cfg = get_model_config("tiny-llama")
+        with pytest.raises(ValueError, match="incomplete"):
+            load_hf_checkpoint(tmp_path, cfg)
+
+    def test_missing_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_hf_checkpoint(tmp_path / "nope",
+                               get_model_config("tiny-llama"))
+
+    def test_detect_config(self, hf_ckpt):
+        ckpt_dir, _ = hf_ckpt
+        assert detect_config_from_hf(ckpt_dir)["model_type"] == "llama"
+
+    def test_engine_serves_from_checkpoint(self, hf_ckpt):
+        ckpt_dir, _ = hf_ckpt
+        engine = InferenceEngine(
+            get_model_config("tiny-llama"), checkpoint=str(ckpt_dir),
+            num_slots=2,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=6))
+        out = engine.generate("checkpointed", slot_name="c",
+                              max_new_tokens=6)
+        assert isinstance(out, str)

@@ -1,0 +1,92 @@
+"""tpu-llm adapter ↔ engine integration, driven through the orchestrator."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from theroundtaible_tpu.adapters.base import KnightTurn
+from theroundtaible_tpu.adapters.factory import create_adapter
+from theroundtaible_tpu.core.orchestrator import run_discussion
+from theroundtaible_tpu.core.types import (
+    KnightConfig,
+    RoundtableConfig,
+    RulesConfig,
+)
+from theroundtaible_tpu.engine import reset_engines
+
+TPU_CFG = {
+    "model": "tiny-gemma",
+    "max_seq_len": 512,
+    "num_slots": 4,
+    "sampling": {"temperature": 0.0, "max_new_tokens": 8},
+}
+
+
+@pytest.fixture(autouse=True, scope="module")
+def clean_engines():
+    reset_engines()
+    yield
+    reset_engines()
+
+
+def make_config(parallel=False):
+    return RoundtableConfig(
+        version="1.0", project="t", language="en",
+        knights=[KnightConfig(name="Sage", adapter="tpu-llm", priority=1),
+                 KnightConfig(name="Oracle", adapter="tpu-llm", priority=2)],
+        rules=RulesConfig(max_rounds=1, timeout_per_turn_seconds=600,
+                          parallel_rounds=parallel),
+        chronicle="chronicle.md",
+        adapter_config={"tpu-llm": TPU_CFG})
+
+
+class TestTpuAdapter:
+    def test_available_and_executes(self):
+        adapter = create_adapter("tpu-llm", make_config())
+        assert adapter.is_available()
+        out = adapter.execute("say something", timeout_ms=600_000)
+        assert isinstance(out, str)
+
+    def test_max_source_chars_from_real_tokenizer(self):
+        adapter = create_adapter("tpu-llm", make_config())
+        budget = adapter.get_max_source_chars()
+        assert budget is not None and budget > 0
+
+    def test_batched_round_support(self):
+        adapter = create_adapter("tpu-llm", make_config())
+        assert adapter.supports_batched_rounds()
+        outs = adapter.execute_round(
+            [KnightTurn("Sage", "prompt one"),
+             KnightTurn("Oracle", "prompt two")], timeout_ms=600_000)
+        assert len(outs) == 2
+        assert all(isinstance(o, str) for o in outs)
+
+    def test_discuss_through_orchestrator_serial(self, project_root):
+        config = make_config(parallel=False)
+        adapter = create_adapter("tpu-llm", config)
+        result = run_discussion("tiny topic", config,
+                                {"tpu-llm": adapter}, str(project_root))
+        # random weights → no consensus JSON → escalated after 1 round
+        assert result.rounds == 1
+        assert len(result.all_rounds) == 2
+
+    def test_discuss_through_orchestrator_batched(self, project_root):
+        config = make_config(parallel=True)
+        adapter = create_adapter("tpu-llm", config)
+        result = run_discussion("tiny topic", config,
+                                {"tpu-llm": adapter}, str(project_root))
+        assert len(result.all_rounds) == 2
+        # per-knight KV slots exist for both knights
+        engine = adapter._get_engine()
+        assert set(engine.kv.slot_names()) >= {"Sage", "Oracle"}
+
+    def test_engine_shared_across_adapters(self):
+        a1 = create_adapter("tpu-llm", make_config())
+        a2 = create_adapter("tpu-llm", make_config())
+        assert a1._get_engine() is a2._get_engine()
+
+    def test_unavailable_on_bad_model(self):
+        cfg = make_config()
+        cfg.adapter_config["tpu-llm"] = {"model": "no-such-model"}
+        adapter = create_adapter("tpu-llm", cfg)
+        assert not adapter.is_available()

@@ -1,0 +1,154 @@
+"""Checkpoint loading: HuggingFace layouts → the engine's param tree.
+
+Supports safetensors shards and torch .bin shards from a local directory
+(gemma/llama/mistral HF layouts share the same module naming), plus orbax
+save/restore of the engine's native tree for fast TPU reloads. Random init
+is the fallback when no checkpoint is configured (tests, benches).
+
+HF name map (all families):
+  model.embed_tokens.weight                  → embedding            [V, E]
+  model.layers.N.self_attn.{q,k,v}_proj      → {q,k,v}_proj         [E, H, D]
+  model.layers.N.self_attn.o_proj            → o_proj               [H, D, E]
+  model.layers.N.mlp.{gate,up,down}_proj     → {gate,up,down}_proj
+  model.layers.N.input_layernorm             → input_norm
+  model.layers.N.post_attention_layernorm    → pre_mlp_norm
+  model.norm.weight                          → final_norm
+  lm_head.weight                             → lm_head (untied only)
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from .models.common import ModelConfig, Params
+
+
+def _iter_hf_tensors(ckpt_dir: Path) -> Iterator[tuple[str, np.ndarray]]:
+    """Yield (name, array) from safetensors or torch-bin shards."""
+    st_files = sorted(ckpt_dir.glob("*.safetensors"))
+    if st_files:
+        from safetensors import safe_open
+        for f in st_files:
+            with safe_open(str(f), framework="np") as reader:
+                for name in reader.keys():
+                    yield name, reader.get_tensor(name)
+        return
+    bin_files = sorted(ckpt_dir.glob("pytorch_model*.bin"))
+    if bin_files:
+        import torch
+        for f in bin_files:
+            state = torch.load(str(f), map_location="cpu",
+                               weights_only=True)
+            for name, tensor in state.items():
+                yield name, tensor.to(torch.float32).numpy()
+        return
+    raise FileNotFoundError(
+        f"No *.safetensors or pytorch_model*.bin in {ckpt_dir}")
+
+
+def load_hf_checkpoint(ckpt_dir: str | Path, cfg: ModelConfig,
+                       dtype=jnp.bfloat16) -> Params:
+    """Assemble the engine param tree from an HF checkpoint directory."""
+    ckpt_dir = Path(ckpt_dir)
+    e, h, k, d = cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    layers: list[dict[str, Any]] = [{} for _ in range(cfg.num_layers)]
+    params: Params = {"layers": layers}
+
+    def as_jnp(x: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(x.astype(np.float32), dtype=dtype)
+
+    placers: dict[str, Callable[[np.ndarray], jnp.ndarray]] = {
+        "q_proj": lambda w: as_jnp(w.T.reshape(e, h, d)),
+        "k_proj": lambda w: as_jnp(w.T.reshape(e, k, d)),
+        "v_proj": lambda w: as_jnp(w.T.reshape(e, k, d)),
+        # HF o_proj.weight is [E, H*D] (out, in); ours is [H, D, E].
+        "o_proj": lambda w: as_jnp(w.reshape(e, h, d).transpose(1, 2, 0)),
+        "gate_proj": lambda w: as_jnp(w.T),
+        "up_proj": lambda w: as_jnp(w.T),
+        "down_proj": lambda w: as_jnp(w.T),
+    }
+
+    for name, tensor in _iter_hf_tensors(ckpt_dir):
+        if name == "model.embed_tokens.weight":
+            params["embedding"] = as_jnp(tensor)
+        elif name == "model.norm.weight":
+            params["final_norm"] = as_jnp(tensor)
+        elif name == "lm_head.weight":
+            if not cfg.tie_embeddings:
+                params["lm_head"] = as_jnp(tensor)
+        elif name.startswith("model.layers."):
+            parts = name.split(".")
+            idx = int(parts[2])
+            if idx >= cfg.num_layers:
+                continue
+            if parts[3] == "self_attn":
+                key = parts[4]
+                if key in placers:
+                    layers[idx][key] = placers[key](tensor)
+            elif parts[3] == "mlp":
+                key = parts[4]
+                if key in placers:
+                    layers[idx][key] = placers[key](tensor)
+            elif parts[3] == "input_layernorm":
+                layers[idx]["input_norm"] = as_jnp(tensor)
+            elif parts[3] == "post_attention_layernorm":
+                layers[idx]["pre_mlp_norm"] = as_jnp(tensor)
+            elif parts[3] == "pre_feedforward_layernorm":
+                layers[idx]["pre_mlp_norm"] = as_jnp(tensor)
+            elif parts[3] == "post_feedforward_layernorm":
+                layers[idx]["post_mlp_norm"] = as_jnp(tensor)
+
+    _validate_loaded(params, cfg)
+    return params
+
+
+def _validate_loaded(params: Params, cfg: ModelConfig) -> None:
+    missing = []
+    if "embedding" not in params:
+        missing.append("embedding")
+    if "final_norm" not in params:
+        missing.append("final_norm")
+    if not cfg.tie_embeddings and "lm_head" not in params:
+        missing.append("lm_head")
+    required = {"q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+                "up_proj", "down_proj", "input_norm", "pre_mlp_norm"}
+    for i, layer in enumerate(params["layers"]):
+        lacking = required - set(layer)
+        if lacking:
+            missing.append(f"layer{i}:{','.join(sorted(lacking))}")
+    if missing:
+        raise ValueError(f"Checkpoint incomplete, missing: {missing[:8]}")
+
+
+# --- native (orbax) engine checkpoints ---
+
+
+def save_native(path: str | Path, params: Params) -> None:
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(Path(path).absolute(), params)
+    ckptr.wait_until_finished()
+
+
+def restore_native(path: str | Path, cfg: ModelConfig) -> Params:
+    import orbax.checkpoint as ocp
+    from .models.common import init_params
+    import jax
+    template = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(Path(path).absolute(), template)
+
+
+def detect_config_from_hf(ckpt_dir: str | Path) -> dict[str, Any]:
+    """Read config.json from an HF checkpoint dir (for model auto-detect)."""
+    cfg_path = Path(ckpt_dir) / "config.json"
+    if not cfg_path.exists():
+        return {}
+    return json.loads(cfg_path.read_text())

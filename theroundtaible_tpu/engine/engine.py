@@ -1,0 +1,369 @@
+"""InferenceEngine — sharded prefill + decode with persistent KV slots.
+
+The TPU-native serving stack replacing Ollama/LM Studio llama.cpp
+(SURVEY.md §3.4): tokenize → chunked, bucketed prefill (delta-only thanks to
+per-knight slot reuse) → jit'd while_loop decode → detokenize.
+
+XLA discipline:
+- prefill chunk lengths are bucketed (powers of two) so transcript growth
+  across rounds does NOT trigger recompiles (SURVEY.md §7.3 hard part 5)
+- the decode loop is ONE device program (lax.while_loop with an on-device
+  all-done predicate), not a Python token loop — no per-token dispatch
+- cache buffers are donated, so slot updates are in-place on HBM
+- batch rows = knight slots; generate_batch serves N knights in the same
+  programs with per-row offsets (SURVEY.md §7 Phase 5)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kvcache import KVCache
+from .models.common import ModelConfig, forward, init_params, param_count
+from .models.registry import get_model_config
+from .sampling import SamplingParams, sample_token
+from .sharding import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    build_mesh,
+    kv_cache_spec,
+    shard_params,
+)
+from .tokenizer import load_tokenizer
+
+PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+MAX_PREFILL_CHUNK = 2048
+DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
+
+
+def _bucket(n: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return b
+    return MAX_PREFILL_CHUNK
+
+
+@dataclass
+class GenStats:
+    prefill_tokens: int = 0
+    reused_tokens: int = 0
+    decode_tokens: int = 0
+    prefill_seconds: float = 0.0
+    decode_seconds: float = 0.0
+
+    @property
+    def prefill_tps(self) -> float:
+        return self.prefill_tokens / self.prefill_seconds \
+            if self.prefill_seconds else 0.0
+
+    @property
+    def decode_tps(self) -> float:
+        return self.decode_tokens / self.decode_seconds \
+            if self.decode_seconds else 0.0
+
+
+class InferenceEngine:
+    """One resident model + its slot cache + compiled step programs."""
+
+    def __init__(self, model_cfg: ModelConfig, *, checkpoint: str = "",
+                 mesh_shape: Optional[dict[str, int]] = None,
+                 num_slots: int = 8, dtype=jnp.bfloat16,
+                 sampling: Optional[SamplingParams] = None,
+                 seed: int = 0):
+        self.cfg = model_cfg
+        self.max_seq_len = model_cfg.max_seq_len
+        self.sampling = sampling or SamplingParams()
+        self.mesh = build_mesh(mesh_shape)
+        self.tokenizer = load_tokenizer(checkpoint or None)
+
+        if checkpoint:
+            from .checkpoint import load_hf_checkpoint
+            params = load_hf_checkpoint(checkpoint, model_cfg, dtype)
+        else:
+            params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
+        self.params = shard_params(params, model_cfg, self.mesh)
+        self.num_params = param_count(self.params)
+
+        cache_sharding = None
+        if self.mesh.devices.size > 1:
+            from jax.sharding import NamedSharding
+            from .sharding import _fallback_replicated
+            spec = _fallback_replicated(
+                kv_cache_spec(),
+                (num_slots, self.max_seq_len, model_cfg.num_kv_heads,
+                 model_cfg.head_dim),
+                self.mesh)
+            cache_sharding = NamedSharding(self.mesh, spec)
+        self.kv = KVCache(model_cfg, num_slots, self.max_seq_len, dtype,
+                          cache_sharding)
+
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._chars_per_token: Optional[float] = None
+        self.last_stats = GenStats()
+
+        # compiled closures (per (batch, bucket) shapes, cached by jit)
+        cfg = model_cfg
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, cache_layers, slot_idx, tokens, offsets,
+                         lengths):
+            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
+            t = tokens.shape[1]
+            positions = offsets[:, None] + jnp.arange(t)[None, :]
+            valid = offsets + lengths
+            logits, new_b = forward(params, cfg, tokens, positions, caches_b,
+                                    offsets, valid)
+            new_layers = [
+                (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
+                for (k, v), (nk, nv) in zip(cache_layers, new_b)]
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return last, new_layers
+
+        self._prefill_step = prefill_step
+
+        @partial(jax.jit, donate_argnums=(1,),
+                 static_argnames=("max_new",))
+        def decode_loop(params, cache_layers, slot_idx, first_token,
+                        start_valid, key, max_new):
+            b = first_token.shape[0]
+            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
+            out = jnp.zeros((b, max_new), jnp.int32)
+            done = jnp.zeros((b,), bool)
+            eos = jnp.int32(self.tokenizer.eos_id)
+
+            def cond(state):
+                step, _, _, done, _, _, _ = state
+                return (step < max_new) & ~jnp.all(done)
+
+            def body(state):
+                step, last, valid, done, out, caches_b, key = state
+                tokens = last[:, None]
+                positions = valid[:, None]
+                logits, caches_b = forward(
+                    params, cfg, tokens, positions, caches_b, valid,
+                    valid + 1)
+                key, sub = jax.random.split(key)
+                nxt = sample_token(logits[:, 0].astype(jnp.float32), sub,
+                                   self.sampling).astype(jnp.int32)
+                nxt = jnp.where(done, eos, nxt)
+                out = out.at[:, step].set(nxt)
+                new_done = done | (nxt == eos)
+                valid = jnp.where(done, valid, valid + 1)
+                return step + 1, nxt, valid, new_done, out, caches_b, key
+
+            state = (jnp.int32(0), first_token, start_valid, done, out,
+                     caches_b, key)
+            step, last, valid, done, out, caches_b, _ = \
+                jax.lax.while_loop(cond, body, state)
+            new_layers = [
+                (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
+                for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
+            return out, step, last, valid, done, new_layers
+
+        self._decode_loop = decode_loop
+
+    # --- construction from adapter config ---
+
+    @classmethod
+    def from_config(cls, config: dict[str, Any]) -> "InferenceEngine":
+        model_name = config.get("model", "tiny-gemma")
+        overrides = {}
+        if config.get("max_seq_len"):
+            overrides["max_seq_len"] = int(config["max_seq_len"])
+        model_cfg = get_model_config(model_name, **overrides)
+        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                 "float16": jnp.float16}[config.get("dtype", "bfloat16")]
+        sampling_cfg = config.get("sampling", {})
+        sampling = SamplingParams(
+            temperature=float(sampling_cfg.get("temperature", 0.7)),
+            top_k=int(sampling_cfg.get("top_k", 0)),
+            top_p=float(sampling_cfg.get("top_p", 1.0)),
+            max_new_tokens=int(sampling_cfg.get("max_new_tokens", 1024)),
+        )
+        return cls(
+            model_cfg,
+            checkpoint=config.get("checkpoint", "") or "",
+            mesh_shape=config.get("mesh"),
+            num_slots=int(config.get("num_slots", 8)),
+            dtype=dtype,
+            sampling=sampling,
+            seed=int(config.get("seed", 0)),
+        )
+
+    # --- serving ---
+
+    def chars_per_token(self) -> float:
+        if self._chars_per_token is None:
+            sample = ("The quick brown fox jumps over the lazy dog. "
+                      "def main(args): return 0  # typical source text\n" * 4)
+            n = len(self.tokenizer.encode(sample, add_bos=False))
+            self._chars_per_token = max(len(sample) / max(n, 1), 0.25)
+        return self._chars_per_token
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _prefill(self, slot_ids: list[int], token_lists: list[list[int]],
+                 offsets: list[int], deadline: float = float("inf")
+                 ) -> jax.Array:
+        """Chunked, bucketed prefill for B rows. Returns last-token logits
+        [B, V] (f32). token_lists are the NOT-yet-cached suffixes."""
+        b = len(slot_ids)
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        offs = list(offsets)
+        remaining = [list(t) for t in token_lists]
+        final_logits: Optional[jax.Array] = None
+        cache_len = self.kv.max_seq_len
+        while any(remaining):
+            max_len = min(max(len(r) for r in remaining), MAX_PREFILL_CHUNK)
+            bucket = _bucket(max_len)
+            # Every row writes a bucket-wide block at its offset; near the
+            # cache end, shrink the bucket so no row's write overruns the
+            # cache (dynamic_update_slice would silently clamp the offset
+            # and corrupt the position-aligned layout).
+            allowed = cache_len - max(offs)
+            if bucket > allowed:
+                smaller = [x for x in PREFILL_BUCKETS if x <= allowed]
+                bucket = smaller[-1] if smaller else max(allowed, 1)
+            chunk = np.full((b, bucket), self.tokenizer.pad_id, np.int32)
+            lengths = np.zeros((b,), np.int32)
+            takes = np.zeros((b,), np.int32)
+            for i, r in enumerate(remaining):
+                take = min(len(r), bucket)
+                takes[i] = take
+                if take:
+                    chunk[i, :take] = r[:take]
+                    del r[:take]
+                # Exhausted rows feed one pad at their current offset; it
+                # stays outside their committed length and decode overwrites
+                # that position with the first real generated token.
+                lengths[i] = max(take, 1)
+            last_logits, self.kv.layers = self._prefill_step(
+                self.params, self.kv.layers, slot_idx,
+                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                jnp.asarray(lengths))
+            # Keep each row's logits from the chunk where its REAL tokens
+            # ended; later pad-only chunks must not clobber them.
+            if final_logits is None:
+                final_logits = last_logits
+            else:
+                final_logits = jnp.where(jnp.asarray(takes > 0)[:, None],
+                                         last_logits, final_logits)
+            for i in range(b):
+                offs[i] += int(takes[i])
+            if time.monotonic() > deadline and any(remaining):
+                raise TimeoutError("prefill timed out")
+        return final_logits
+
+    def generate(self, prompt: str, slot_name: str = "default",
+                 max_new_tokens: Optional[int] = None,
+                 timeout_s: float = 600.0) -> str:
+        return self.generate_batch([(slot_name, prompt)],
+                                   max_new_tokens=max_new_tokens,
+                                   timeout_s=timeout_s)[0]
+
+    def generate_batch(self, turns: list[tuple[str, str]],
+                       max_new_tokens: Optional[int] = None,
+                       timeout_s: float = 600.0) -> list[str]:
+        """Serve N (slot_name, prompt) turns as one batched program pair."""
+        stats = GenStats()
+        deadline = time.monotonic() + timeout_s
+        max_new = max_new_tokens or self.sampling.max_new_tokens
+        # Decode budget can never exceed half the context — misconfigured
+        # max_new_tokens otherwise drives the prompt budget negative and
+        # every prompt would silently collapse to [bos].
+        max_new = max(1, min(max_new, self.max_seq_len // 2))
+
+        pinned = tuple(name for name, _ in turns)
+        slot_ids, suffixes, offsets, all_tokens = [], [], [], []
+        for name, prompt in turns:
+            tokens = self.tokenizer.encode(prompt)
+            budget = self.max_seq_len - max_new - 1
+            if len(tokens) > budget:
+                # Keep the tail — the turn ask and latest transcript live
+                # there (head truncation mirrors context budgeting intent).
+                tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
+            slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
+            slot_ids.append(slot_id)
+            suffixes.append(tokens[reuse:])
+            offsets.append(reuse)
+            all_tokens.append(tokens)
+            stats.reused_tokens += reuse
+            stats.prefill_tokens += len(tokens) - reuse
+
+        t0 = time.monotonic()
+        last_logits = self._prefill(slot_ids, suffixes, offsets,
+                                    deadline=deadline)
+        last_logits.block_until_ready()
+        stats.prefill_seconds = time.monotonic() - t0
+
+        first = sample_token(last_logits.astype(jnp.float32),
+                             self._next_key(), self.sampling) \
+            .astype(jnp.int32)
+        first_np = np.asarray(first)
+        cur_last = first
+        cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
+
+        # Decode in fixed-size segments: one device program each, with
+        # host-side timeout/early-exit checks between segments (a single
+        # XLA program cannot be interrupted, so this is how the adapter's
+        # per-turn timeout contract is honored).
+        t1 = time.monotonic()
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        b = len(turns)
+        segments: list[np.ndarray] = []
+        produced = 0
+        all_done = False
+        while produced < max_new and not all_done:
+            seg = min(DECODE_SEGMENT, max_new - produced)
+            out, steps, cur_last, cur_valid, done, self.kv.layers = \
+                self._decode_loop(
+                    self.params, self.kv.layers, slot_idx, cur_last,
+                    cur_valid, self._next_key(), max_new=seg)
+            out.block_until_ready()
+            segments.append(np.asarray(out))
+            produced += seg
+            all_done = bool(np.all(np.asarray(done)))
+            if time.monotonic() > deadline and not all_done:
+                raise TimeoutError(
+                    f"generation timed out after {timeout_s:.0f}s "
+                    f"({produced}/{max_new} tokens)")
+        stats.decode_seconds = time.monotonic() - t1
+
+        out_np = (np.concatenate(segments, axis=1) if segments
+                  else np.zeros((b, 0), np.int32))
+        results = []
+        for i, (name, _) in enumerate(turns):
+            ids = [int(first_np[i])] + [int(x) for x in out_np[i]]
+            if self.tokenizer.eos_id in ids:
+                ids = ids[:ids.index(self.tokenizer.eos_id)]
+            ids = ids[:max_new]
+            stats.decode_tokens += len(ids)
+            # cache now holds prompt + every fed token (= all but the last
+            # sampled one); commit exactly that for next-turn prefix reuse
+            fed = ids[:-1] if ids else []
+            self.kv.commit(name, all_tokens[i] + fed)
+            results.append(self.tokenizer.decode(ids))
+        self.last_stats = stats
+        return results
+
+    # --- introspection ---
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "model": self.cfg.name,
+            "params": self.num_params,
+            "max_seq_len": self.max_seq_len,
+            "mesh": dict(self.mesh.shape),
+            "num_slots": self.kv.num_slots,
+            "devices": [str(d) for d in self.mesh.devices.flatten()],
+        }

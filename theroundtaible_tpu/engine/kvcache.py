@@ -1,0 +1,117 @@
+"""Per-knight persistent KV-cache slots.
+
+The reference keeps no model state between turns — every turn re-sends the
+full transcript, so token cost grows quadratically with rounds
+(reference src/utils/prompt.ts:60-77; SURVEY.md §3.1 "hot loops"). Here each
+knight owns a slot: device-resident K/V for every layer plus the host-side
+token ids already baked into it. On the next turn the engine prefills only
+the delta beyond the longest common token prefix.
+
+Layout per layer: [num_slots, max_seq_len, kv_heads, head_dim], position-
+aligned (cache index s holds position s). Slots ride the "data" mesh axis,
+kv heads the "model" axis (sharding.kv_cache_spec).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .models.common import ModelConfig
+
+
+@dataclass
+class SlotState:
+    """Host-side bookkeeping for one knight's slot."""
+
+    slot_id: int
+    name: str
+    tokens: list[int] = field(default_factory=list)  # ids baked into cache
+
+
+class KVCache:
+    """num_slots × num_layers of device KV plus slot bookkeeping."""
+
+    def __init__(self, cfg: ModelConfig, num_slots: int,
+                 max_seq_len: Optional[int] = None, dtype=jnp.bfloat16,
+                 sharding=None):
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        shape = (num_slots, self.max_seq_len, cfg.num_kv_heads, cfg.head_dim)
+        make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
+            (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
+        self.layers: list[tuple[jax.Array, jax.Array]] = [
+            (make(), make()) for _ in range(cfg.num_layers)]
+        self._slots: dict[str, SlotState] = {}
+        self._free = list(range(num_slots))
+
+    # --- slot allocation ---
+
+    def acquire(self, name: str, pinned: tuple[str, ...] = ()) -> SlotState:
+        """Get the named knight's slot, allocating on first use.
+
+        `pinned` names are never evicted — generate_batch pins every knight
+        of the in-flight batch so two batch rows can't share a slot_id.
+        """
+        if name in self._slots:
+            return self._slots[name]
+        if not self._free:
+            # Evict the longest-idle slot: first in insertion order that is
+            # not pinned by the current batch.
+            victim = next((n for n in self._slots if n not in pinned), None)
+            if victim is None:
+                raise RuntimeError(
+                    f"KVCache has {self.num_slots} slots but "
+                    f"{len(pinned)} knights are pinned in one batch — "
+                    "raise num_slots in the tpu-llm adapter config")
+            self.release(victim)
+        slot_id = self._free.pop(0)
+        state = SlotState(slot_id=slot_id, name=name)
+        self._slots[name] = state
+        return state
+
+    def release(self, name: str) -> None:
+        state = self._slots.pop(name, None)
+        if state is not None:
+            self._free.append(state.slot_id)
+
+    def reset_slot(self, name: str) -> None:
+        """Forget cached tokens (cache rows need no zeroing — the valid-length
+        mask makes stale entries unreachable)."""
+        if name in self._slots:
+            self._slots[name].tokens = []
+
+    def slot_names(self) -> list[str]:
+        return list(self._slots)
+
+    # --- prefix reuse ---
+
+    @staticmethod
+    def common_prefix_len(cached: list[int], new: list[int]) -> int:
+        n = min(len(cached), len(new))
+        i = 0
+        while i < n and cached[i] == new[i]:
+            i += 1
+        return i
+
+    def reuse_plan(self, name: str, tokens: list[int],
+                   pinned: tuple[str, ...] = ()) -> tuple[int, int]:
+        """(slot_id, reuse_len): how many leading tokens are already baked
+        into the slot's cache. The caller prefills only tokens[reuse_len:].
+
+        reuse_len is capped at len(tokens)-1 so at least one token is always
+        fed (the model needs a last-token logit to start decoding)."""
+        state = self.acquire(name, pinned)
+        reuse = self.common_prefix_len(state.tokens, tokens)
+        reuse = min(reuse, len(tokens) - 1)
+        # A diverging suffix overwrites the stale cache region position-by-
+        # position, so no invalidation step is needed.
+        return state.slot_id, reuse
+
+    def commit(self, name: str, tokens: list[int]) -> None:
+        """Record that the slot's cache now covers exactly `tokens`."""
+        self.acquire(name).tokens = list(tokens)

@@ -1,0 +1,291 @@
+"""Transformer core shared by Gemma / Llama / Mistral — pure functional JAX.
+
+This is the TPU-native replacement for the llama.cpp compute the reference
+reaches through Ollama/LM Studio (reference src/adapters/local-llm.ts;
+SURVEY.md §2.3). Design rules (SURVEY.md §7, pallas_guide):
+
+- params are plain nested-dict pytrees (no framework state), so sharding is
+  a pure tree_map of NamedSharding over the same structure
+- everything below `jit` is static-shape, scan/cond only — no Python control
+  flow on data
+- matmuls run in bf16 with f32 accumulation (preferred_element_type), norms
+  and softmax in f32: MXU-friendly, numerically safe
+- attention is GQA with an explicit KV-cache slot axis; decode attends with
+  a length mask instead of dynamic shapes
+- architecture differences (GeGLU vs SiLU, embedding scaling, RMSNorm +1,
+  sliding window, logit softcap) are ModelConfig flags, not subclasses
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters + family behavior flags."""
+
+    name: str
+    vocab_size: int
+    num_layers: int
+    embed_dim: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    mlp_dim: int
+    max_seq_len: int = 8192
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    # family flags
+    gelu_mlp: bool = False            # Gemma: GeGLU; Llama/Mistral: SiLU
+    scale_embeddings: bool = False    # Gemma: embeddings *= sqrt(embed_dim)
+    rmsnorm_unit_offset: bool = False  # Gemma: weight is (1 + w)
+    post_attn_norm: bool = False      # Gemma2-style extra norms
+    post_mlp_norm: bool = False
+    attn_logit_softcap: Optional[float] = None   # Gemma2: 50.0
+    final_logit_softcap: Optional[float] = None  # Gemma2: 30.0
+    sliding_window: Optional[int] = None         # Mistral: 4096
+    query_pre_attn_scalar: Optional[float] = None  # Gemma: head_dim**-0.5 default
+    tie_embeddings: bool = True       # output head = embedding table
+
+    @property
+    def kv_repeat(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+# --- primitives ---
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             unit_offset: bool) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    w = (1.0 + weight.astype(jnp.float32)) if unit_offset \
+        else weight.astype(jnp.float32)
+    return (x * w).astype(dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [B, T, H, D], positions: [B, T]."""
+    head_dim = x.shape[-1]
+    fraction = jnp.arange(0, head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
+    timescale = theta ** fraction                       # [D/2]
+    angles = positions[..., None].astype(jnp.float32) / timescale  # [B,T,D/2]
+    angles = angles[:, :, None, :]                      # [B, T, 1, D/2]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    if cap is None:
+        return x
+    return cap * jnp.tanh(x / cap)
+
+
+def _einsum(spec: str, a: jax.Array, b: jax.Array) -> jax.Array:
+    # bf16 inputs, f32 accumulation on the MXU.
+    return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
+
+
+def attention(
+    x: jax.Array,                 # [B, T, E]
+    layer: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,         # [B, T] absolute positions
+    kv_cache: Optional[tuple[jax.Array, jax.Array]],  # each [B, S, K, D]
+    cache_offset: Optional[jax.Array],  # [B] write offset into the cache
+    attn_mask: jax.Array,         # [B, T, S] boolean, True = attend
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """GQA attention with in-place cache update.
+
+    Returns (output [B,T,E], updated (k_cache, v_cache)). When kv_cache is
+    None the k/v of this call form the cache (prefill from scratch).
+    """
+    b, t, _ = x.shape
+    q = _einsum("bte,ehd->bthd", x, layer["q_proj"])     # [B,T,H,D]
+    k = _einsum("bte,ekd->btkd", x, layer["k_proj"])     # [B,T,K,D]
+    v = _einsum("bte,ekd->btkd", x, layer["v_proj"])
+
+    q = rope(q.astype(x.dtype), positions, cfg.rope_theta)
+    k = rope(k.astype(x.dtype), positions, cfg.rope_theta)
+    v = v.astype(x.dtype)
+
+    scale = (cfg.query_pre_attn_scalar
+             if cfg.query_pre_attn_scalar is not None
+             else cfg.head_dim ** -0.5)
+    q = q * scale
+
+    if kv_cache is not None:
+        k_cache, v_cache = kv_cache
+        # Scatter this step's K/V into each batch row at its own offset.
+        def write_row(cache_row, new_row, off):
+            return jax.lax.dynamic_update_slice(
+                cache_row, new_row, (off, 0, 0))
+        k_cache = jax.vmap(write_row)(k_cache, k, cache_offset)
+        v_cache = jax.vmap(write_row)(v_cache, v, cache_offset)
+        k_all, v_all = k_cache, v_cache
+    else:
+        k_all, v_all = k, v
+        k_cache, v_cache = k, v
+
+    # GQA: expand K/V heads to match query heads.
+    if cfg.kv_repeat > 1:
+        k_att = jnp.repeat(k_all, cfg.kv_repeat, axis=2)
+        v_att = jnp.repeat(v_all, cfg.kv_repeat, axis=2)
+    else:
+        k_att, v_att = k_all, v_all
+
+    logits = _einsum("bthd,bshd->bhts", q, k_att)        # [B,H,T,S] f32
+    logits = _softcap(logits, cfg.attn_logit_softcap)
+    logits = jnp.where(attn_mask[:, None, :, :], logits, -2.3819763e38)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    out = _einsum("bhts,bshd->bthd", probs, v_att).astype(x.dtype)
+    out = _einsum("bthd,hde->bte", out, layer["o_proj"]).astype(x.dtype)
+    return out, (k_cache, v_cache)
+
+
+def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+    gate = _einsum("bte,ef->btf", x, layer["gate_proj"])
+    up = _einsum("bte,ef->btf", x, layer["up_proj"])
+    act = jax.nn.gelu(gate, approximate=True) if cfg.gelu_mlp \
+        else jax.nn.silu(gate)
+    hidden = (act * up).astype(x.dtype)
+    return _einsum("btf,fe->bte", hidden, layer["down_proj"]).astype(x.dtype)
+
+
+def transformer_block(
+    x: jax.Array, layer: Params, cfg: ModelConfig, positions: jax.Array,
+    kv_cache, cache_offset, attn_mask,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    h = rms_norm(x, layer["input_norm"], cfg.norm_eps, cfg.rmsnorm_unit_offset)
+    attn_out, new_cache = attention(h, layer, cfg, positions, kv_cache,
+                                    cache_offset, attn_mask)
+    if cfg.post_attn_norm:
+        attn_out = rms_norm(attn_out, layer["post_attn_norm"], cfg.norm_eps,
+                            cfg.rmsnorm_unit_offset)
+    x = x + attn_out
+    h = rms_norm(x, layer["pre_mlp_norm"], cfg.norm_eps,
+                 cfg.rmsnorm_unit_offset)
+    mlp_out = mlp(h, layer, cfg)
+    if cfg.post_mlp_norm:
+        mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.norm_eps,
+                           cfg.rmsnorm_unit_offset)
+    return x + mlp_out, new_cache
+
+
+def make_attention_mask(positions: jax.Array, kv_len: int,
+                        kv_valid_len: jax.Array,
+                        sliding_window: Optional[int]) -> jax.Array:
+    """Causal (+ optional sliding window) mask against a padded KV cache.
+
+    positions: [B, T] query absolute positions; kv_valid_len: [B] number of
+    valid cache entries per row. Cache layout is position-aligned (entry s
+    holds position s), so causality is pos_kv <= pos_q AND s < valid.
+    """
+    kv_pos = jnp.arange(kv_len)[None, None, :]           # [1,1,S]
+    q_pos = positions[:, :, None]                        # [B,T,1]
+    mask = kv_pos <= q_pos
+    mask &= kv_pos < kv_valid_len[:, None, None]
+    if sliding_window is not None:
+        mask &= kv_pos > q_pos - sliding_window
+    return mask
+
+
+def forward(
+    params: Params, cfg: ModelConfig,
+    tokens: jax.Array,            # [B, T]
+    positions: jax.Array,         # [B, T]
+    kv_caches: Optional[list[tuple[jax.Array, jax.Array]]],
+    cache_offset: Optional[jax.Array],   # [B]
+    kv_valid_len: jax.Array,      # [B] valid entries AFTER this step
+) -> tuple[jax.Array, list[tuple[jax.Array, jax.Array]]]:
+    """Full model forward. Returns (logits [B,T,V], updated caches)."""
+    x = params["embedding"][tokens].astype(jnp.bfloat16)
+    if cfg.scale_embeddings:
+        x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
+
+    kv_len = (kv_caches[0][0].shape[1] if kv_caches is not None
+              else tokens.shape[1])
+    mask = make_attention_mask(positions, kv_len, kv_valid_len,
+                               cfg.sliding_window)
+
+    new_caches = []
+    for i, layer in enumerate(params["layers"]):
+        cache_i = kv_caches[i] if kv_caches is not None else None
+        x, new_cache = transformer_block(
+            x, layer, cfg, positions, cache_i, cache_offset, mask)
+        new_caches.append(new_cache)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps,
+                 cfg.rmsnorm_unit_offset)
+    head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
+    logits = _einsum("bte,ve->btv", x, head)
+    logits = _softcap(logits, cfg.final_logit_softcap)
+    return logits, new_caches
+
+
+# --- initialization ---
+
+
+def init_params(cfg: ModelConfig, key: jax.Array,
+                dtype=jnp.bfloat16) -> Params:
+    """Random init with sane scales — used for tests and weight-free bench."""
+    k_embed, k_layers = jax.random.split(key)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    layers = []
+    layer_keys = jax.random.split(k_layers, cfg.num_layers)
+    e, h, k_, d, f = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim, cfg.mlp_dim)
+    for lk in layer_keys:
+        ks = jax.random.split(lk, 7)
+        layer = {
+            "q_proj": dense(ks[0], (e, h, d), e),
+            "k_proj": dense(ks[1], (e, k_, d), e),
+            "v_proj": dense(ks[2], (e, k_, d), e),
+            "o_proj": dense(ks[3], (h, d, e), h * d),
+            "gate_proj": dense(ks[4], (e, f), e),
+            "up_proj": dense(ks[5], (e, f), e),
+            "down_proj": dense(ks[6], (f, e), f),
+            "input_norm": jnp.zeros((e,), dtype) if cfg.rmsnorm_unit_offset
+            else jnp.ones((e,), dtype),
+            "pre_mlp_norm": jnp.zeros((e,), dtype) if cfg.rmsnorm_unit_offset
+            else jnp.ones((e,), dtype),
+        }
+        if cfg.post_attn_norm:
+            layer["post_attn_norm"] = layer["input_norm"]
+        if cfg.post_mlp_norm:
+            layer["post_mlp_norm"] = layer["pre_mlp_norm"]
+        layers.append(layer)
+
+    params: Params = {
+        "embedding": (jax.random.normal(
+            k_embed, (cfg.vocab_size, cfg.embed_dim), jnp.float32)
+            * (cfg.embed_dim ** -0.5)).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.zeros((cfg.embed_dim,), dtype)
+        if cfg.rmsnorm_unit_offset else jnp.ones((cfg.embed_dim,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(
+            jax.random.fold_in(k_embed, 1),
+            (cfg.vocab_size, cfg.embed_dim), cfg.embed_dim)
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

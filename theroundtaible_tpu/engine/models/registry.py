@@ -1,0 +1,81 @@
+"""Model registry — the three reference-targeted open-weight families
+(BASELINE.md configs: Gemma-2B/7B, Llama-3-8B, Mistral-7B) plus tiny test
+presets. Architecture behavior lives in ModelConfig flags (common.py); a
+family here is a named hyperparameter set.
+"""
+
+from __future__ import annotations
+
+from .common import ModelConfig
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+# --- Gemma (GeGLU, scaled embeddings, RMSNorm 1+w, tied head) ---
+
+GEMMA_2B = register(ModelConfig(
+    name="gemma-2b-it", vocab_size=256_000, num_layers=18, embed_dim=2048,
+    num_heads=8, num_kv_heads=1, head_dim=256, mlp_dim=16_384,
+    max_seq_len=8192, gelu_mlp=True, scale_embeddings=True,
+    rmsnorm_unit_offset=True, tie_embeddings=True))
+
+GEMMA_7B = register(ModelConfig(
+    name="gemma-7b-it", vocab_size=256_000, num_layers=28, embed_dim=3072,
+    num_heads=16, num_kv_heads=16, head_dim=256, mlp_dim=24_576,
+    max_seq_len=8192, gelu_mlp=True, scale_embeddings=True,
+    rmsnorm_unit_offset=True, tie_embeddings=True))
+
+# --- Llama 3 (SiLU, GQA, untied head, big rope theta) ---
+
+LLAMA3_8B = register(ModelConfig(
+    name="llama-3-8b-instruct", vocab_size=128_256, num_layers=32,
+    embed_dim=4096, num_heads=32, num_kv_heads=8, head_dim=128,
+    mlp_dim=14_336, max_seq_len=8192, rope_theta=500_000.0,
+    norm_eps=1e-5, tie_embeddings=False))
+
+# --- Mistral (SiLU, GQA, sliding window) ---
+
+MISTRAL_7B = register(ModelConfig(
+    name="mistral-7b-instruct", vocab_size=32_000, num_layers=32,
+    embed_dim=4096, num_heads=32, num_kv_heads=8, head_dim=128,
+    mlp_dim=14_336, max_seq_len=8192, rope_theta=1_000_000.0,
+    norm_eps=1e-5, sliding_window=4096, tie_embeddings=False))
+
+# --- tiny presets: CPU tests, sharding dry-runs, CI ---
+
+TINY_GEMMA = register(ModelConfig(
+    name="tiny-gemma", vocab_size=512, num_layers=2, embed_dim=64,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=512, gelu_mlp=True, scale_embeddings=True,
+    rmsnorm_unit_offset=True, tie_embeddings=True))
+
+TINY_LLAMA = register(ModelConfig(
+    name="tiny-llama", vocab_size=512, num_layers=2, embed_dim=64,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=512, tie_embeddings=False))
+
+TINY_MISTRAL = register(ModelConfig(
+    name="tiny-mistral", vocab_size=512, num_layers=2, embed_dim=64,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=512, sliding_window=64, tie_embeddings=False))
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    """Look up a family by name; unknown names raise with the known list."""
+    if name not in _REGISTRY:
+        known = ", ".join(sorted(_REGISTRY))
+        raise ValueError(f"Unknown model '{name}'. Known: {known}")
+    cfg = _REGISTRY[name]
+    if overrides:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)

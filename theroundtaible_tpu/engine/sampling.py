@@ -1,0 +1,47 @@
+"""Token sampling — greedy, temperature, top-k, top-p.
+
+Pure jit-safe functions over a logits row; the decode loop composes them
+under lax.cond-free arithmetic (temperature 0 → greedy via where, not
+Python branching).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0      # 0 = greedy
+    top_k: int = 0                # 0 = disabled
+    top_p: float = 1.0            # 1 = disabled
+    max_new_tokens: int = 1024
+
+
+def sample_token(logits: jax.Array, key: jax.Array,
+                 params: SamplingParams) -> jax.Array:
+    """logits: [B, V] f32 → token ids [B]."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if params.temperature <= 0.0:
+        return greedy
+
+    scaled = logits / jnp.maximum(params.temperature, 1e-6)
+
+    if params.top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -params.top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set whose cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cumulative < params.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    return jax.random.categorical(key, scaled, axis=-1)

@@ -1,0 +1,126 @@
+"""Mesh construction and parameter/cache partition specs.
+
+The scale-out design from SURVEY.md §2.3 / §5.8: shardings are expressed with
+`jax.sharding.Mesh` + `NamedSharding(PartitionSpec)`, XLA inserts the
+collectives (all-reduce for TP activations over ICI), nothing is hand-NCCL'd.
+
+Axes:
+- "data"  — batch/slot parallelism (DP): each replica serves different slots
+- "model" — tensor parallelism (TP): attention heads and MLP hidden sharded
+- ("seq" is introduced by the ring-attention path in longcontext.py)
+
+The same spec tree works on 1 device (everything replicated), a v5e-8, or a
+virtual 8-CPU mesh (tests / dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.common import ModelConfig, Params
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(mesh_shape: Optional[dict[str, int]] = None,
+               devices: Optional[list] = None) -> Mesh:
+    """Build a (data, model) mesh. mesh_shape like {"data": 1, "model": 8};
+    -1 means "all remaining devices". Default: all devices on the model
+    axis (TP-first serving — weights are the big thing to split)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    shape = dict(mesh_shape or {})
+    data = shape.get(DATA_AXIS, 1)
+    model = shape.get(MODEL_AXIS, -1)
+    if model == -1:
+        model = n // max(data, 1)
+    if data == -1:
+        data = n // max(model, 1)
+    if data * model > n:
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {n}")
+    # A strict subset is allowed — heterogeneous serving partitions the pod
+    # into per-model submeshes (SURVEY.md §2.3 "heterogeneous multi-model
+    # scheduler"); callers pass disjoint device lists.
+    dev_array = np.array(devices[:data * model]).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree matching init_params' structure.
+
+    TP sharding: q/o on query heads, k/v on kv heads, MLP on hidden.
+    Embedding sharded on vocab (big tables, cheap all-gather of one row).
+    """
+    layer = {
+        "q_proj": P(None, MODEL_AXIS, None),    # [E, H, D] heads sharded
+        "k_proj": P(None, MODEL_AXIS, None),    # [E, K, D]
+        "v_proj": P(None, MODEL_AXIS, None),
+        "o_proj": P(MODEL_AXIS, None, None),    # [H, D, E] contract sharded
+        "gate_proj": P(None, MODEL_AXIS),       # [E, F]
+        "up_proj": P(None, MODEL_AXIS),
+        "down_proj": P(MODEL_AXIS, None),       # [F, E]
+        "input_norm": P(None),
+        "pre_mlp_norm": P(None),
+    }
+    if cfg.post_attn_norm:
+        layer["post_attn_norm"] = P(None)
+    if cfg.post_mlp_norm:
+        layer["post_mlp_norm"] = P(None)
+    specs: Params = {
+        "embedding": P(MODEL_AXIS, None),       # [V, E] vocab sharded
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(MODEL_AXIS, None)
+    return specs
+
+
+def kv_cache_spec() -> P:
+    """KV cache [B, S, K, D]: slots on data axis, kv heads on model axis."""
+    return P(DATA_AXIS, None, MODEL_AXIS, None)
+
+
+def shardable(cfg: ModelConfig, mesh: Mesh) -> bool:
+    """True when every TP dimension divides by the model-axis size."""
+    m = mesh.shape[MODEL_AXIS]
+    return (cfg.num_heads % m == 0 and cfg.num_kv_heads % m == 0
+            and cfg.mlp_dim % m == 0 and cfg.vocab_size % m == 0)
+
+
+def _fallback_replicated(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Replace axis names whose size doesn't divide the dim with None."""
+    fixed = []
+    for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is None:
+            fixed.append(None)
+        elif dim % mesh.shape[axis] == 0:
+            fixed.append(axis)
+        else:
+            fixed.append(None)
+    return P(*fixed)
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """device_put the param tree with its spec tree; any dimension that
+    doesn't divide the mesh axis falls back to replication (e.g. 1 kv head
+    on an 8-way model axis)."""
+    specs = param_specs(cfg)
+
+    def place(x, spec):
+        spec = _fallback_replicated(spec, x.shape, mesh)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    # tree_map flattens `specs` up to params' treedef, so each PartitionSpec
+    # (a tuple subclass) arrives whole at its matching array leaf.
+    return jax.tree_util.tree_map(place, params, specs)
+
+
+def logical_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
