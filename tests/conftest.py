@@ -7,12 +7,20 @@ import time — before any test module imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("ROUNDTABLE_DISABLE_TPU_DETECT", "1")
+
+# This image pre-imports jax from sitecustomize with a TPU platform pinned
+# in the environment, so an env-var setdefault here is too late. Force the
+# platform through jax.config instead — verified to initialize ONLY the cpu
+# backend (xla_bridge._backends == ['cpu']), so tests never touch the
+# single-claim TPU tunnel even when another process holds it.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -122,7 +122,9 @@ class TestInitCommand:
         assert cfg["rules"]["consensus_threshold"] == 9
         assert (tmp_path / ".roundtable" / "sessions").is_dir()
         assert (tmp_path / ".roundtable" / "manifest.json").exists()
-        assert (tmp_path / "chronicle.md").exists()
+        # chronicle lives INSIDE .roundtable/ (reference init.ts:217,407)
+        assert (tmp_path / ".roundtable" / "chronicle.md").exists()
+        assert cfg["chronicle"] == ".roundtable/chronicle.md"
 
     def test_reinit_guard_non_interactive(self, tmp_path, monkeypatch,
                                           capsys):

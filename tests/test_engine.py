@@ -123,11 +123,33 @@ class TestKVCacheSlots:
         kv.commit("A", [1, 2, 3, 4])
         _, reuse = kv.reuse_plan("A", [1, 2, 3, 4, 5, 6])
         assert reuse == 4
+        kv.commit("A", [1, 2, 3, 4])
         _, reuse = kv.reuse_plan("A", [1, 2, 9, 9])
         assert reuse == 2
         # full-match capped at len-1 so one token is always fed
+        kv.commit("A", [1, 2, 3, 4])
         _, reuse = kv.reuse_plan("A", [1, 2, 3, 4])
         assert reuse == 3
+
+    def test_reuse_plan_truncates_record_for_crash_safety(self):
+        # Positions >= reuse get overwritten by the in-flight turn; if that
+        # turn dies (timeout) before commit, the slot must not still claim
+        # the clobbered region as valid cache.
+        cfg = get_model_config("tiny-gemma")
+        kv = KVCache(cfg, num_slots=2)
+        kv.commit("A", [1, 2, 3, 4])
+        kv.reuse_plan("A", [1, 2, 9, 9])  # turn starts, then "crashes"
+        _, reuse = kv.reuse_plan("A", [1, 2, 3, 4])
+        assert reuse == 2  # only the untouched prefix survives
+
+    def test_eviction_is_lru_not_fifo(self):
+        cfg = get_model_config("tiny-gemma")
+        kv = KVCache(cfg, num_slots=2)
+        kv.acquire("A")
+        kv.acquire("B")
+        kv.acquire("A")  # A is now most recently used
+        kv.acquire("C")  # must evict B, the LRU — not A, the first-inserted
+        assert set(kv.slot_names()) == {"A", "C"}
 
 
 class TestEngineGenerate:

@@ -21,7 +21,7 @@ def chronicle_command(project_root: Optional[str] = None) -> int:
     try:
         chronicle_path = load_config(project_root).chronicle
     except ConfigError:
-        chronicle_path = "chronicle.md"
+        chronicle_path = ".roundtable/chronicle.md"
 
     content = read_chronicle(project_root, chronicle_path)
     if not content.strip():

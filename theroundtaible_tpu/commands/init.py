@@ -191,7 +191,7 @@ def init_command(version: str, project_root: Optional[str] = None,
             "priority": 1, "fallback": "claude-api",
         }],
         "rules": DEFAULT_RULES,
-        "chronicle": "chronicle.md",
+        "chronicle": ".roundtable/chronicle.md",
         "adapter_config": adapter_config or {
             "claude-cli": {"command": "claude", "args": []},
             "claude-api": {"env_key": "ANTHROPIC_API_KEY"},
@@ -202,7 +202,7 @@ def init_command(version: str, project_root: Optional[str] = None,
     (rt_dir / "sessions").mkdir(parents=True, exist_ok=True)
     (rt_dir / "config.json").write_text(json.dumps(config, indent=2),
                                         encoding="utf-8")
-    chronicle = project_root / "chronicle.md"
+    chronicle = rt_dir / "chronicle.md"
     if not chronicle.exists():
         chronicle.write_text(
             "# Chronicle - TheRoundtAIble\n\nBeslissingen log van dit "

@@ -122,7 +122,7 @@ class RoundtableConfig:
             language=d.get("language", "nl"),
             knights=[KnightConfig.from_dict(k) for k in d.get("knights", [])],
             rules=RulesConfig.from_dict(d.get("rules", {})),
-            chronicle=d.get("chronicle", "chronicle.md"),
+            chronicle=d.get("chronicle", ".roundtable/chronicle.md"),
             adapter_config=dict(d.get("adapter_config", {})),
         )
 

@@ -17,7 +17,7 @@ XLA discipline:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
 
@@ -29,13 +29,7 @@ from .kvcache import KVCache
 from .models.common import ModelConfig, forward, init_params, param_count
 from .models.registry import get_model_config
 from .sampling import SamplingParams, sample_token
-from .sharding import (
-    DATA_AXIS,
-    MODEL_AXIS,
-    build_mesh,
-    kv_cache_spec,
-    shard_params,
-)
+from .sharding import build_mesh, kv_cache_spec, shard_params
 from .tokenizer import load_tokenizer
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)

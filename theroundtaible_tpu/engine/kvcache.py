@@ -58,10 +58,12 @@ class KVCache:
         of the in-flight batch so two batch rows can't share a slot_id.
         """
         if name in self._slots:
+            # Refresh recency so eviction below is true LRU, not FIFO.
+            self._slots[name] = self._slots.pop(name)
             return self._slots[name]
         if not self._free:
-            # Evict the longest-idle slot: first in insertion order that is
-            # not pinned by the current batch.
+            # Evict the least-recently-used slot (dict order = recency,
+            # refreshed on every acquire) that is not pinned by the batch.
             victim = next((n for n in self._slots if n not in pinned), None)
             if victim is None:
                 raise RuntimeError(
@@ -108,8 +110,10 @@ class KVCache:
         state = self.acquire(name, pinned)
         reuse = self.common_prefix_len(state.tokens, tokens)
         reuse = min(reuse, len(tokens) - 1)
-        # A diverging suffix overwrites the stale cache region position-by-
-        # position, so no invalidation step is needed.
+        # Positions >= reuse are about to be overwritten by prefill/decode.
+        # Truncate the record NOW: if the turn dies mid-flight (timeout),
+        # the slot must not claim cache contents that were clobbered.
+        state.tokens = state.tokens[:reuse]
         return state.slot_id, reuse
 
     def commit(self, name: str, tokens: list[int]) -> None:

@@ -53,9 +53,13 @@ class HfTokenizer:
     def __init__(self, path: str):
         from transformers import AutoTokenizer
         self._tok = AutoTokenizer.from_pretrained(path)
-        self.bos_id = self._tok.bos_token_id or 1
-        self.eos_id = self._tok.eos_token_id or 2
-        self.pad_id = self._tok.pad_token_id or 0
+        # `x if x is not None` — id 0 is a legitimate special-token id in
+        # several SentencePiece vocabs; `or` would silently replace it.
+        def _id(value, default):
+            return value if value is not None else default
+        self.bos_id = _id(self._tok.bos_token_id, 1)
+        self.eos_id = _id(self._tok.eos_token_id, 2)
+        self.pad_id = _id(self._tok.pad_token_id, 0)
         self.vocab_size = len(self._tok)
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
