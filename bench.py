@@ -45,9 +45,9 @@ def main() -> int:
         sampling=SamplingParams(temperature=0.0,
                                 max_new_tokens=decode_tokens))
 
-    # Warmup: compile prefill buckets + decode loop.
-    engine.generate(PROMPT, slot_name="warmup",
-                    max_new_tokens=decode_tokens)
+    # Compile + layout-stabilize every serving program (two runs per
+    # bucket — see InferenceEngine.warmup).
+    warmup_s = engine.warmup()
 
     # Measured run on a fresh slot (no prefix reuse → honest prefill too).
     t0 = time.monotonic()
@@ -66,6 +66,7 @@ def main() -> int:
             "prefill_tokens": s.prefill_tokens,
             "decode_tokens": s.decode_tokens,
             "wall_s": round(wall, 2),
+            "warmup_s": round(warmup_s, 1),
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
         },

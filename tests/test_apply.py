@@ -1,0 +1,559 @@
+"""Apply pipeline tests — block scanner, RTDIFF parser, validation,
+executor, and the end-to-end command.
+
+Mirrors the reference's own apply test coverage ("157/157 — block-scanner
+34, diff-parser 66, validation 57", reference TODO.md:121).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from theroundtaible_tpu.apply.blocks import (
+    Block,
+    MAX_BLOCK_LINES,
+    TOP_ANCHOR,
+    render_block_map,
+    scan_blocks,
+)
+from theroundtaible_tpu.apply.executor import apply_edits, materialize_edit
+from theroundtaible_tpu.apply.rtdiff import (
+    ParseError,
+    parse_knight_output,
+    parse_rtdiff,
+)
+from theroundtaible_tpu.apply.validate import (
+    sha256_text,
+    validate_edits,
+)
+
+PYFILE = '''"""Module docstring."""
+
+import os
+
+
+def alpha():
+    return 1
+
+
+@decorator
+def beta(x):
+    if x:
+        return 2
+    return 3
+
+
+class Gamma:
+    def method(self):
+        return 4
+'''
+
+
+# ---------------------------------------------------------------- scanner
+
+class TestBlockScanner:
+    def test_covers_every_line_exactly_once(self):
+        blocks = scan_blocks(PYFILE)
+        lines = PYFILE.splitlines()
+        covered = []
+        for b in blocks:
+            covered.extend(range(b.start, b.end + 1))
+        assert covered == list(range(1, len(lines) + 1))
+
+    def test_roundtrip_reconstruction(self):
+        blocks = scan_blocks(PYFILE)
+        assert "\n".join(b.text for b in blocks) == PYFILE.rstrip("\n")
+
+    def test_ids_sequential(self):
+        blocks = scan_blocks(PYFILE)
+        assert [b.id for b in blocks] == \
+            [f"B{i + 1:03d}" for i in range(len(blocks))]
+
+    def test_decorator_attaches_to_function(self):
+        blocks = scan_blocks(PYFILE)
+        beta = next(b for b in blocks if "def beta" in b.text)
+        assert beta.text.splitlines()[0].strip() == "@decorator"
+
+    def test_functions_are_separate_blocks(self):
+        blocks = scan_blocks(PYFILE)
+        assert any(b.text.lstrip().startswith("def alpha") for b in blocks)
+        assert any("class Gamma" in b.text for b in blocks)
+        alpha = next(b for b in blocks if "def alpha" in b.text)
+        assert "beta" not in alpha.text
+
+    def test_indented_lines_never_start_blocks(self):
+        blocks = scan_blocks(PYFILE)
+        for b in blocks:
+            first = b.text.splitlines()[0]
+            assert not first.startswith((" ", "\t"))
+
+    def test_empty_file(self):
+        assert scan_blocks("") == []
+
+    def test_single_line_file(self):
+        blocks = scan_blocks("x = 1\n")
+        assert len(blocks) == 1
+        assert blocks[0].start == 1 and blocks[0].end == 1
+
+    def test_oversized_block_is_split(self):
+        body = "def big():\n" + "\n".join(
+            f"    line_{i} = {i}" for i in range(150))
+        blocks = scan_blocks(body)
+        assert len(blocks) >= 2
+        assert all(b.end - b.start + 1 <= MAX_BLOCK_LINES + 1
+                   for b in blocks)
+
+    def test_split_prefers_blank_lines(self):
+        parts = []
+        for i in range(12):
+            parts.append(f"def f{i}():")
+            parts.extend(f"    x{j} = {j}" for j in range(8))
+            parts.append("")
+        text = "\n".join(parts)
+        for b in scan_blocks(text):
+            # every block starts at a def, not mid-function
+            assert b.text.splitlines()[0].startswith("def ")
+
+    def test_signature_is_first_nonblank(self):
+        b = Block(id="B001", start=1, end=3, text="\n\ndef x(): pass")
+        assert b.signature == "def x(): pass"
+
+    def test_block_map_includes_anchor_and_ranges(self):
+        blocks = scan_blocks(PYFILE)
+        out = render_block_map("m.py", blocks)
+        assert TOP_ANCHOR in out
+        assert "B001 [L1-" in out
+        assert "m.py" in out
+
+    def test_markdown_prose_blocks(self):
+        text = "# Title\n\nPara one line one.\nline two.\n\n## Section\n\nmore\n"
+        blocks = scan_blocks(text)
+        assert len(blocks) >= 3
+
+
+# ---------------------------------------------------------------- parser
+
+RTDIFF_OK = """Some preamble the model chattered.
+
+RTDIFF/1
+FILE: src/app.py
+BLOCK_REPLACE B002
+<<<
+def alpha():
+    return 42
+>>>
+BLOCK_DELETE B003
+FILE: NEW:src/util.py
+FILE_CREATE
+<<<
+def helper():
+    return True
+>>>
+"""
+
+
+class TestRtdiffParser:
+    def test_parses_files_and_ops(self):
+        parsed = parse_rtdiff(RTDIFF_OK)
+        assert len(parsed.edits) == 2
+        app, util = parsed.edits
+        assert app.path == "src/app.py" and not app.is_new
+        assert [op.op for op in app.ops] == ["BLOCK_REPLACE", "BLOCK_DELETE"]
+        assert app.ops[0].content == "def alpha():\n    return 42"
+        assert util.is_new and util.clean_path == "src/util.py"
+        assert util.ops[0].op == "FILE_CREATE"
+
+    def test_tolerates_markdown_fences(self):
+        fenced = "```\n" + RTDIFF_OK + "\n```"
+        parsed = parse_rtdiff(fenced)
+        assert len(parsed.edits) == 2
+
+    def test_no_header_raises(self):
+        with pytest.raises(ParseError, match="header"):
+            parse_rtdiff("FILE: x.py\nBLOCK_DELETE B001\n")
+
+    def test_unterminated_fence_raises(self):
+        bad = "RTDIFF/1\nFILE: a.py\nBLOCK_REPLACE B001\n<<<\nnever closed"
+        with pytest.raises(ParseError, match="unterminated"):
+            parse_rtdiff(bad)
+
+    def test_op_before_file_raises(self):
+        with pytest.raises(ParseError, match="before any FILE"):
+            parse_rtdiff("RTDIFF/1\nBLOCK_DELETE B001\n")
+
+    def test_bad_block_id_raises(self):
+        with pytest.raises(ParseError, match="bad block id"):
+            parse_rtdiff("RTDIFF/1\nFILE: a.py\nBLOCK_DELETE banana\n")
+
+    def test_header_without_ops_raises(self):
+        with pytest.raises(ParseError, match="no complete ops"):
+            parse_rtdiff("RTDIFF/1\nFILE: a.py\n")
+
+    def test_prose_between_ops_warned_not_fatal(self):
+        text = ("RTDIFF/1\nFILE: a.py\nThis modifies the file.\n"
+                "BLOCK_DELETE B001\n")
+        parsed = parse_rtdiff(text)
+        assert parsed.warnings
+        assert parsed.edits[0].ops[0].op == "BLOCK_DELETE"
+
+    def test_insert_after_top_anchor(self):
+        text = ("RTDIFF/1\nFILE: a.py\nBLOCK_INSERT_AFTER B000\n"
+                "<<<\nimport sys\n>>>\n")
+        parsed = parse_rtdiff(text)
+        assert parsed.edits[0].ops[0].block_id == "B000"
+
+    def test_empty_content_preserved_for_validation(self):
+        text = "RTDIFF/1\nFILE: a.py\nBLOCK_REPLACE B001\n<<<\n>>>\n"
+        parsed = parse_rtdiff(text)
+        assert parsed.edits[0].ops[0].content == ""
+
+    def test_content_with_angle_lines(self):
+        text = ("RTDIFF/1\nFILE: a.py\nBLOCK_REPLACE B001\n"
+                "<<<\nif a << 2 > b:\n    pass\n>>>\n")
+        parsed = parse_rtdiff(text)
+        assert "a << 2" in parsed.edits[0].ops[0].content
+
+    def test_legacy_edit_format(self):
+        text = ("EDIT: src/app.py\nSEARCH:\n<<<\nreturn 1\n>>>\n"
+                "REPLACE:\n<<<\nreturn 2\n>>>\n")
+        parsed = parse_knight_output(text)
+        assert parsed.legacy
+        assert parsed.warnings  # deprecation
+        op = parsed.edits[0].ops[0]
+        assert op.op == "SEARCH_REPLACE"
+        assert op.search == "return 1" and op.content == "return 2"
+
+    def test_legacy_missing_replace_raises(self):
+        with pytest.raises(ParseError, match="REPLACE"):
+            parse_knight_output("EDIT: a.py\nSEARCH:\n<<<\nx\n>>>\n")
+
+    def test_neither_format_raises(self):
+        with pytest.raises(ParseError, match="neither"):
+            parse_knight_output("I think we should refactor the auth.")
+
+    def test_rtdiff_wins_over_legacy(self):
+        both = RTDIFF_OK + "\nEDIT: other.py\n"
+        parsed = parse_knight_output(both)
+        assert not parsed.legacy
+
+
+# ------------------------------------------------------------- validation
+
+@pytest.fixture
+def proj(tmp_path):
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "app.py").write_text(PYFILE, encoding="utf-8")
+    return tmp_path
+
+
+def _parsed(text):
+    return parse_knight_output(text)
+
+
+class TestValidation:
+    def _rt(self, body):
+        return _parsed("RTDIFF/1\n" + body)
+
+    def test_clean_edit_passes(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_REPLACE B002\n"
+                          "<<<\nimport sys\n>>>\n")
+        assert validate_edits(parsed, proj, ["src/app.py"]) == []
+
+    def test_out_of_scope_blocked(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n")
+        issues = validate_edits(parsed, proj, ["other.py"])
+        assert any("outside the agreed scope" in i.message for i in issues)
+
+    def test_override_scope_allows(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n")
+        assert validate_edits(parsed, proj, ["other.py"],
+                              override_scope=True) == []
+
+    def test_no_scope_data_no_enforcement(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n")
+        assert validate_edits(parsed, proj, None) == []
+
+    def test_new_prefix_matches_scope_either_form(self, proj):
+        parsed = self._rt("FILE: NEW:src/new.py\nFILE_CREATE\n"
+                          "<<<\nx = 1\n>>>\n")
+        assert validate_edits(parsed, proj, ["NEW:src/new.py"]) == []
+        assert validate_edits(parsed, proj, ["src/new.py"]) == []
+
+    def test_traversal_blocked(self, proj):
+        parsed = self._rt("FILE: ../evil.py\nBLOCK_DELETE B001\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("traversal" in i.message for i in issues)
+
+    def test_absolute_path_blocked(self, proj):
+        parsed = self._rt("FILE: /etc/passwd\nBLOCK_DELETE B001\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("absolute" in i.message for i in issues)
+
+    def test_unknown_block_id(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B099\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("unknown block B099" in i.message for i in issues)
+
+    def test_duplicate_block_ops(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n"
+                          "BLOCK_REPLACE B002\n<<<\nx\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("multiple ops" in i.message for i in issues)
+
+    def test_missing_file(self, proj):
+        parsed = self._rt("FILE: src/ghost.py\nBLOCK_DELETE B001\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("does not exist" in i.message for i in issues)
+
+    def test_create_existing_file_blocked(self, proj):
+        parsed = self._rt("FILE: NEW:src/app.py\nFILE_CREATE\n"
+                          "<<<\nx\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("already exists" in i.message for i in issues)
+
+    def test_create_without_new_prefix_blocked(self, proj):
+        parsed = self._rt("FILE: src/fresh.py\nFILE_CREATE\n<<<\nx\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("NEW: path prefix" in i.message for i in issues)
+
+    def test_new_without_create_blocked(self, proj):
+        parsed = self._rt("FILE: NEW:src/fresh.py\nBLOCK_DELETE B001\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("without a FILE_CREATE" in i.message for i in issues)
+
+    def test_empty_replace_blocked(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_REPLACE B002\n"
+                          "<<<\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("empty content" in i.message for i in issues)
+
+    def test_sha_mismatch_blocks(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n")
+        stale = {"src/app.py": sha256_text("old content")}
+        issues = validate_edits(parsed, proj, None, source_hashes=stale)
+        assert any("sha256 mismatch" in i.message for i in issues)
+
+    def test_sha_match_passes(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n")
+        good = {"src/app.py": sha256_text(PYFILE)}
+        assert validate_edits(parsed, proj, None, source_hashes=good) == []
+
+    def test_top_anchor_only_insert(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_REPLACE B000\n"
+                          "<<<\nx\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("anchor" in i.message for i in issues)
+
+    def test_legacy_search_not_found(self, proj):
+        parsed = _parsed("EDIT: src/app.py\nSEARCH:\n<<<\nNO SUCH\n>>>\n"
+                         "REPLACE:\n<<<\nx\n>>>\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("not found" in i.message for i in issues)
+
+    def test_legacy_ambiguous_search(self, proj):
+        parsed = _parsed("EDIT: src/app.py\nSEARCH:\n<<<\n    return\n>>>\n"
+                         "REPLACE:\n<<<\n    pass\n>>>\n")
+        # "    return" occurs in alpha (return 1)? substring matching:
+        # count occurrences of the exact text
+        issues = validate_edits(parsed, proj, None)
+        # either ambiguous (>1) or not-found — both are blocked
+        assert issues
+
+    def test_duplicate_file_sections(self, proj):
+        parsed = self._rt("FILE: src/app.py\nBLOCK_DELETE B002\n"
+                          "FILE: src/app.py\nBLOCK_DELETE B003\n")
+        issues = validate_edits(parsed, proj, None)
+        assert any("multiple FILE: sections" in i.message for i in issues)
+
+
+# --------------------------------------------------------------- executor
+
+class TestExecutor:
+    def test_block_replace(self):
+        blocks = scan_blocks(PYFILE)
+        alpha = next(b for b in blocks if "def alpha" in b.text)
+        parsed = _parsed(
+            f"RTDIFF/1\nFILE: src/app.py\nBLOCK_REPLACE {alpha.id}\n"
+            "<<<\ndef alpha():\n    return 42\n>>>\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert "return 42" in out
+        assert "return 1" not in out
+        assert "def beta" in out  # neighbors untouched
+
+    def test_block_delete(self):
+        blocks = scan_blocks(PYFILE)
+        gamma = next(b for b in blocks if "class Gamma" in b.text)
+        parsed = _parsed(
+            f"RTDIFF/1\nFILE: a.py\nBLOCK_DELETE {gamma.id}\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert "class Gamma" not in out
+        assert "def beta" in out
+
+    def test_insert_after(self):
+        blocks = scan_blocks(PYFILE)
+        alpha = next(b for b in blocks if "def alpha" in b.text)
+        parsed = _parsed(
+            f"RTDIFF/1\nFILE: a.py\nBLOCK_INSERT_AFTER {alpha.id}\n"
+            "<<<\ndef alpha2():\n    return 11\n>>>\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert out.index("def alpha2") > out.index("def alpha()")
+        assert out.index("def alpha2") < out.index("def beta")
+
+    def test_insert_at_top(self):
+        parsed = _parsed(
+            "RTDIFF/1\nFILE: a.py\nBLOCK_INSERT_AFTER B000\n"
+            "<<<\n#!/usr/bin/env python\n>>>\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert out.splitlines()[0] == "#!/usr/bin/env python"
+
+    def test_multiple_ops_bottom_up(self):
+        blocks = scan_blocks(PYFILE)
+        alpha = next(b for b in blocks if "def alpha" in b.text)
+        gamma = next(b for b in blocks if "class Gamma" in b.text)
+        parsed = _parsed(
+            f"RTDIFF/1\nFILE: a.py\n"
+            f"BLOCK_REPLACE {alpha.id}\n<<<\ndef alpha():\n    return 9\n>>>\n"
+            f"BLOCK_DELETE {gamma.id}\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert "return 9" in out and "class Gamma" not in out
+
+    def test_file_create(self):
+        parsed = _parsed(
+            "RTDIFF/1\nFILE: NEW:x.py\nFILE_CREATE\n<<<\nx = 1\n>>>\n")
+        assert materialize_edit(parsed.edits[0], None) == "x = 1\n"
+
+    def test_legacy_search_replace(self):
+        parsed = _parsed(
+            "EDIT: a.py\nSEARCH:\n<<<\n    return 1\n>>>\n"
+            "REPLACE:\n<<<\n    return 99\n>>>\n")
+        out = materialize_edit(parsed.edits[0], PYFILE)
+        assert "return 99" in out
+
+    def test_trailing_newline_preserved(self):
+        parsed = _parsed("RTDIFF/1\nFILE: a.py\nBLOCK_REPLACE B001\n"
+                         "<<<\ny = 2\n>>>\n")
+        out = materialize_edit(parsed.edits[0], "x = 1\n")
+        assert out == "y = 2\n"
+
+    def test_apply_edits_backup_and_write(self, proj):
+        parsed = _parsed(
+            "RTDIFF/1\nFILE: src/app.py\nBLOCK_REPLACE B001\n"
+            "<<<\n\"\"\"New docstring.\"\"\"\n>>>\n"
+            "FILE: NEW:src/fresh.py\nFILE_CREATE\n<<<\nz = 3\n>>>\n")
+        outcome = apply_edits(parsed.edits, proj, "sess-1")
+        assert sorted(outcome.written) == ["src/app.py", "src/fresh.py"]
+        assert (proj / "src" / "fresh.py").read_text() == "z = 3\n"
+        assert "New docstring" in (proj / "src" / "app.py").read_text()
+        # pre-image backed up
+        assert outcome.backup_dir is not None
+        backup = Path(outcome.backup_dir) / "src" / "app.py"
+        assert backup.read_text() == PYFILE
+
+    def test_apply_edits_dry_run_writes_nothing(self, proj):
+        parsed = _parsed(
+            "RTDIFF/1\nFILE: src/app.py\nBLOCK_DELETE B002\n")
+        outcome = apply_edits(parsed.edits, proj, "sess-1", dry_run=True)
+        assert outcome.written == ["src/app.py"]
+        assert (proj / "src" / "app.py").read_text() == PYFILE
+
+    def test_apply_edits_parley_skip(self, proj):
+        parsed = _parsed(
+            "RTDIFF/1\nFILE: src/app.py\nBLOCK_DELETE B002\n")
+        outcome = apply_edits(parsed.edits, proj, "s",
+                              approve=lambda p, t: False)
+        assert outcome.skipped == ["src/app.py"]
+        assert (proj / "src" / "app.py").read_text() == PYFILE
+
+
+# ------------------------------------------------------------ end-to-end
+
+class TestApplyCommand:
+    def _setup_session(self, tmp_path, allowed, lead="A"):
+        from theroundtaible_tpu.utils.session import (
+            create_session, update_status, write_decisions)
+        rt = tmp_path / ".roundtable"
+        (rt / "sessions").mkdir(parents=True)
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "app.py").write_text(PYFILE, encoding="utf-8")
+        config = {
+            "version": "1.0", "project_name": "t", "language": "en",
+            "knights": [{"name": lead, "adapter": "fake",
+                         "capabilities": [], "priority": 1}],
+            "rules": {"max_rounds": 2, "consensus_threshold": 9,
+                      "timeout_per_turn_seconds": 10,
+                      "escalate_to_user_after": 2, "auto_execute": False,
+                      "ignore": []},
+            "adapter_config": {"fake": {"name": lead}},
+        }
+        (rt / "config.json").write_text(json.dumps(config))
+        path = create_session(tmp_path, "improve the app")
+        write_decisions(path, "improve the app", "Replace alpha with 42.",
+                        [])
+        update_status(path, phase="consensus_reached",
+                      consensus_reached=True, lead_knight=lead,
+                      allowed_files=allowed)
+        return path
+
+    def _script_fake(self, monkeypatch, response):
+        from theroundtaible_tpu.adapters import factory
+        from theroundtaible_tpu.adapters.fake import FakeAdapter
+
+        def fake_create(adapter_id, config, timeout_ms):
+            if adapter_id == "fake":
+                return FakeAdapter(name="A", script=[response])
+            return None
+        monkeypatch.setattr(factory, "create_adapter", fake_create)
+        # apply imports initialize_adapters from factory; patch create in
+        # the factory module which initialize_adapters calls
+        return fake_create
+
+    def test_apply_end_to_end(self, tmp_path, monkeypatch, capsys):
+        from theroundtaible_tpu.commands.apply import apply_command
+        self._setup_session(tmp_path, ["src/app.py"])
+        blocks = scan_blocks(PYFILE)
+        alpha = next(b for b in blocks if "def alpha" in b.text)
+        self._script_fake(monkeypatch,
+                          f"RTDIFF/1\nFILE: src/app.py\n"
+                          f"BLOCK_REPLACE {alpha.id}\n"
+                          "<<<\ndef alpha():\n    return 42\n>>>\n")
+        rc = apply_command(noparley=True, project_root=str(tmp_path))
+        assert rc == 0
+        assert "return 42" in (tmp_path / "src" / "app.py").read_text()
+        # manifest auto-updated
+        manifest = json.loads(
+            (tmp_path / ".roundtable" / "manifest.json").read_text())
+        assert manifest["features"]
+        assert manifest["features"][-1]["status"] == "implemented"
+
+    def test_apply_out_of_scope_blocked(self, tmp_path, monkeypatch,
+                                        capsys):
+        from theroundtaible_tpu.commands.apply import apply_command
+        self._setup_session(tmp_path, ["other.py"])
+        self._script_fake(monkeypatch,
+                          "RTDIFF/1\nFILE: src/app.py\nBLOCK_DELETE B001\n")
+        rc = apply_command(noparley=True, project_root=str(tmp_path))
+        assert rc == 4
+        assert (tmp_path / "src" / "app.py").read_text() == PYFILE
+
+    def test_apply_dry_run(self, tmp_path, monkeypatch, capsys):
+        from theroundtaible_tpu.commands.apply import apply_command
+        self._setup_session(tmp_path, ["src/app.py"])
+        self._script_fake(monkeypatch,
+                          "RTDIFF/1\nFILE: src/app.py\nBLOCK_DELETE B002\n")
+        rc = apply_command(dry_run=True, project_root=str(tmp_path))
+        assert rc == 0
+        assert (tmp_path / "src" / "app.py").read_text() == PYFILE
+
+    def test_apply_no_consensus_session(self, tmp_path, monkeypatch):
+        from theroundtaible_tpu.commands.apply import apply_command
+        from theroundtaible_tpu.core.errors import SessionError
+        path = self._setup_session(tmp_path, ["src/app.py"])
+        update = __import__(
+            "theroundtaible_tpu.utils.session",
+            fromlist=["update_status"]).update_status
+        update(path, consensus_reached=False)
+        with pytest.raises(SessionError, match="no consensus"):
+            apply_command(noparley=True, project_root=str(tmp_path))

@@ -294,10 +294,12 @@ class TestReviewRegressions:
             get_model_config("tiny-gemma", max_seq_len=160), num_slots=2,
             sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
         # turn 1 fills most of the cache; turn 2 adds a short suffix whose
-        # 64-bucket pad would overrun 160 without the shrink logic
+        # 64-bucket pad would overrun 160 without the shrink logic.
+        # Prompt budget = max_seq_len - roundup(max_new, DECODE_SEGMENT) - 1
+        # = 160 - 64 - 1 = 95 tokens; +3 fed decode tokens = 98 cached.
         engine.generate("a" * 120, slot_name="edge", max_new_tokens=4)
         cached = len(engine.kv.acquire("edge").tokens)
-        assert cached > 100
+        assert cached == 98
         out_reused = engine.generate("a" * 120 + "bcd", slot_name="edge",
                                      max_new_tokens=4)
         out_fresh = engine.generate("a" * 120 + "bcd", slot_name="fresh",

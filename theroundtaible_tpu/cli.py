@@ -80,6 +80,8 @@ def build_parser():
                    help="Show planned edits without writing")
     a.add_argument("--override-scope", action="store_true",
                    help="Allow edits outside the consensus scope (audited)")
+    a.add_argument("--session", default=None,
+                   help="Apply a specific session instead of the latest")
 
     c = sub.add_parser("code-red", help="Diagnostic mode for a bug/incident")
     c.add_argument("description", help="What is broken")
@@ -129,7 +131,8 @@ def dispatch(args) -> int:
     if args.command == "apply":
         from .commands.apply import apply_command
         return apply_command(noparley=args.noparley, dry_run=args.dry_run,
-                             override_scope=args.override_scope)
+                             override_scope=args.override_scope,
+                             session_name=args.session)
     if args.command == "code-red":
         from .commands.code_red import code_red_command
         return code_red_command(args.description)

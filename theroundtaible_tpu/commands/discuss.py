@@ -78,6 +78,7 @@ def discuss_command(topic: str, read_code: Optional[bool] = None,
                 _handle_rejection(result)
             else:
                 _handle_consensus(result)
+                _kings_decree(result, topic, project_root)
             break
         action = _handle_no_consensus(result, topic, project_root)
         if action != "send_back":
@@ -105,6 +106,48 @@ def _handle_consensus(result: SessionResult) -> None:
     print(style.bold("\n  The advice has been recorded."))
     print(style.dim(
         f"  Read the decision: {result.session_path}/decisions.md\n"))
+
+
+def _kings_decree(result: SessionResult, topic: str,
+                  project_root: str) -> None:
+    """Post-consensus decree menu: apply now / wield the sword myself /
+    decide later (reference architecture-docs.md:209 'King's Choice: apply
+    now, do it yourself, or decide later'; decree writes on self/later per
+    reference TODO.md:100 — the gap SURVEY.md §2.2 flags). Interactive
+    only: scripted/piped runs keep the classic 'run apply yourself' hint.
+    """
+    import sys
+    session_name = os.path.basename(result.session_path)
+    if not sys.stdin.isatty():
+        print(style.dim("  Execute it with: roundtable apply\n"))
+        return
+    print(style.bold("\n  What is your decree, Your Majesty?\n"))
+    print(f"  {style.bold('1.')} {style.green('Apply now')} — the Lead "
+          "Knight executes the decision")
+    sword = style.cyan("I will wield the sword myself")
+    print(f"  {style.bold('2.')} {sword} — no apply, the King codes it")
+    print(f"  {style.bold('3.')} {style.dim('Decide later')} — "
+          "adjourn; roundtable apply still works afterwards\n")
+    answer = ask(style.bold(style.yellow("  Your decree? [1-3] ")),
+                 default="3")
+    if answer.strip() == "1":
+        from .apply import apply_command
+        try:
+            apply_command(project_root=project_root)
+        except Exception as e:  # apply failures must not unwind discuss
+            print(style.red(f"  Apply failed: {e}"))
+            print(style.dim("  The decision is saved — retry with "
+                            "roundtable apply."))
+        return
+    if answer.strip() == "2":
+        add_decree_entry(project_root, "rejected_no_apply", session_name,
+                         topic, "King wields the sword personally")
+        print(style.dim("\n  So be it. The code is yours, Your Majesty.\n"))
+        return
+    add_decree_entry(project_root, "deferred", session_name, topic,
+                     "King will decide later")
+    print(style.dim("\n  The decision rests. roundtable apply awaits "
+                    "your command.\n"))
 
 
 def _handle_rejection(result: SessionResult) -> None:

@@ -126,7 +126,11 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1,),
                  static_argnames=("max_new",))
         def decode_loop(params, cache_layers, slot_idx, first_token,
-                        start_valid, key, max_new):
+                        start_valid, key, budget, max_new):
+            # max_new is the STATIC segment size (one compiled program per
+            # value — always DECODE_SEGMENT in serving); budget is the
+            # DYNAMIC number of tokens actually wanted from this segment,
+            # so short tails exit early without a fresh compile.
             b = first_token.shape[0]
             caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
             out = jnp.zeros((b, max_new), jnp.int32)
@@ -135,7 +139,7 @@ class InferenceEngine:
 
             def cond(state):
                 step, _, _, done, _, _, _ = state
-                return (step < max_new) & ~jnp.all(done)
+                return (step < max_new) & (step < budget) & ~jnp.all(done)
 
             def body(state):
                 step, last, valid, done, out, caches_b, key = state
@@ -193,6 +197,36 @@ class InferenceEngine:
         )
 
     # --- serving ---
+
+    def warmup(self, max_prompt_tokens: int = MAX_PREFILL_CHUNK,
+               batch_sizes: tuple[int, ...] = (1,)) -> float:
+        """Compile-and-stabilize every serving program.
+
+        Each (batch, bucket) prefill program and the decode segment are run
+        TWICE: the first run compiles, but its donated cache outputs come
+        back in XLA's preferred layout — different from the fresh
+        jnp.zeros layout — so the very next serving call would recompile
+        (~seconds). The second run reaches the layout fixpoint, making
+        steady-state serving dispatch ~1ms. Returns seconds spent.
+        """
+        t0 = time.monotonic()
+        limit = min(max_prompt_tokens,
+                    self.max_seq_len - DECODE_SEGMENT - 1)
+        buckets = [b for b in PREFILL_BUCKETS if b <= _bucket(limit)]
+        for b in batch_sizes:
+            if b > self.kv.num_slots:
+                continue
+            for bucket in buckets:
+                n = min(bucket, limit)  # lands exactly in `bucket`
+                tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
+                turns = [(f"__warmup_{i}", tokens) for i in range(b)]
+                for _ in range(2):
+                    for name, _p in turns:
+                        self.kv.release(name)
+                    self.generate_batch(turns, max_new_tokens=1)
+        for i in range(max(batch_sizes)):
+            self.kv.release(f"__warmup_{i}")
+        return time.monotonic() - t0
 
     def chars_per_token(self) -> float:
         if self._chars_per_token is None:
@@ -277,11 +311,21 @@ class InferenceEngine:
         # every prompt would silently collapse to [bos].
         max_new = max(1, min(max_new, self.max_seq_len // 2))
 
+        # Decode runs in whole DECODE_SEGMENT programs, so up to
+        # round-up(max_new, segment) cache positions get written; the
+        # prompt budget must reserve the padded figure or the surplus
+        # tokens' K/V writes would clamp onto (and corrupt) the last
+        # committed cache position.
+        max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
+
         pinned = tuple(name for name, _ in turns)
         slot_ids, suffixes, offsets, all_tokens = [], [], [], []
         for name, prompt in turns:
-            tokens = self.tokenizer.encode(prompt)
-            budget = self.max_seq_len - max_new - 1
+            # A list of ids is accepted as a pre-tokenized prompt (warmup
+            # uses this to hit exact bucket shapes).
+            tokens = (list(prompt) if isinstance(prompt, list)
+                      else self.tokenizer.encode(prompt))
+            budget = self.max_seq_len - max_new_padded - 1
             if len(tokens) > budget:
                 # Keep the tail — the turn ask and latest transcript live
                 # there (head truncation mirrors context budgeting intent).
@@ -297,7 +341,10 @@ class InferenceEngine:
         t0 = time.monotonic()
         last_logits = self._prefill(slot_ids, suffixes, offsets,
                                     deadline=deadline)
-        last_logits.block_until_ready()
+        # A scalar fetch, not block_until_ready: some PJRT transports
+        # (the axon relay) return from block_until_ready before the
+        # computation finishes, which would blame prefill time on decode.
+        float(last_logits[0, 0])
         stats.prefill_seconds = time.monotonic() - t0
 
         first = sample_token(last_logits.astype(jnp.float32),
@@ -310,7 +357,10 @@ class InferenceEngine:
         # Decode in fixed-size segments: one device program each, with
         # host-side timeout/early-exit checks between segments (a single
         # XLA program cannot be interrupted, so this is how the adapter's
-        # per-turn timeout contract is honored).
+        # per-turn timeout contract is honored). The segment size is ALWAYS
+        # DECODE_SEGMENT — a variable tail (max_new % 64) would compile a
+        # fresh program per distinct tail length (~seconds each); surplus
+        # tokens are cheaper than recompiles and get trimmed below.
         t1 = time.monotonic()
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
         b = len(turns)
@@ -318,14 +368,14 @@ class InferenceEngine:
         produced = 0
         all_done = False
         while produced < max_new and not all_done:
-            seg = min(DECODE_SEGMENT, max_new - produced)
             out, steps, cur_last, cur_valid, done, self.kv.layers = \
                 self._decode_loop(
                     self.params, self.kv.layers, slot_idx, cur_last,
-                    cur_valid, self._next_key(), max_new=seg)
-            out.block_until_ready()
-            segments.append(np.asarray(out))
-            produced += seg
+                    cur_valid, self._next_key(),
+                    jnp.int32(max_new - produced), max_new=DECODE_SEGMENT)
+            steps_n = int(steps)  # forces completion of the segment
+            segments.append(np.asarray(out)[:, :steps_n])
+            produced += steps_n
             all_done = bool(np.all(np.asarray(done)))
             if time.monotonic() > deadline and not all_done:
                 raise TimeoutError(
