@@ -1,0 +1,296 @@
+"""Code-red diagnostic mode tests: parser, fuzzy keys, convergence,
+error log, and the end-to-end command flow."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from theroundtaible_tpu.core.diagnostic import (
+    DiagnosticBlock,
+    check_convergence,
+    keys_match,
+    parse_diagnostic_from_response,
+    strip_diagnostic_json,
+    summarize_diagnosis,
+)
+from theroundtaible_tpu.utils.error_log import (
+    add_error_entry,
+    count_by_status,
+    next_cr_id,
+    read_error_log,
+    set_entry_status,
+)
+
+
+def _diag(doctor="A", round_num=2, conf=9, key="stale-token",
+          requests=None):
+    return DiagnosticBlock(
+        doctor=doctor, round=round_num, confidence_score=conf,
+        root_cause_key=key, file_requests=requests or [])
+
+
+class TestDiagnosticParser:
+    def test_fenced_json(self):
+        resp = ("The token is stale.\n```json\n"
+                '{"confidence_score": 8, "root_cause_key": "stale-token",\n'
+                ' "evidence": ["expires after 1h"], "rules_out": ["cors"],\n'
+                ' "confirms": [], "file_requests": ["src/a.ts:1-20"],\n'
+                ' "next_test": "check refresh"}\n```')
+        b = parse_diagnostic_from_response(resp, "Claude", 2)
+        assert b is not None
+        assert b.confidence_score == 8
+        assert b.root_cause_key == "stale-token"
+        assert b.evidence == ["expires after 1h"]
+        assert b.file_requests == ["src/a.ts:1-20"]
+        assert b.next_test == "check refresh"
+
+    def test_bare_json_balanced_braces(self):
+        resp = ('Diagnosis: {"confidence_score": 7, "root_cause_key": '
+                '"race-in-writer", "evidence": []} trailing prose')
+        b = parse_diagnostic_from_response(resp, "D", 1)
+        assert b is not None and b.root_cause_key == "race-in-writer"
+
+    def test_sloppy_json_repaired(self):
+        resp = ("```json\n{'confidence_score': 9, // high\n"
+                "'root_cause_key': 'off-by-one',}\n```")
+        b = parse_diagnostic_from_response(resp, "D", 1)
+        assert b is not None and b.root_cause_key == "off-by-one"
+
+    def test_no_json_returns_none(self):
+        assert parse_diagnostic_from_response("no idea", "D", 1) is None
+
+    def test_confidence_clamped(self):
+        resp = '{"confidence_score": 99, "root_cause_key": "x"}'
+        b = parse_diagnostic_from_response(resp, "D", 1)
+        assert b.confidence_score == 10.0
+
+    def test_file_requests_capped_at_4(self):
+        reqs = json.dumps([f"f{i}.py" for i in range(8)])
+        resp = ('{"confidence_score": 5, "root_cause_key": "k", '
+                f'"file_requests": {reqs}}}')
+        b = parse_diagnostic_from_response(resp, "D", 1)
+        assert len(b.file_requests) == 4
+
+    def test_strip_diagnostic_json(self):
+        resp = ("My analysis here.\n```json\n"
+                '{"confidence_score": 8, "root_cause_key": "k"}\n```')
+        assert strip_diagnostic_json(resp).strip() == "My analysis here."
+
+
+class TestFuzzyKeys:
+    def test_exact_match(self):
+        assert keys_match("stale-token", "stale-token")
+
+    def test_case_insensitive(self):
+        assert keys_match("Stale-Token", "stale-token")
+
+    def test_subset_match(self):
+        assert keys_match("stale-auth-token",
+                          "stale-auth-token-not-refreshed")
+
+    def test_jaccard_overlap(self):
+        # reordered same tokens → match
+        assert keys_match("race-session-write", "session-write-race")
+        # one shared generic token out of many → no match
+        assert not keys_match("token-cache-stale", "dns-resolver-token")
+
+    def test_different_keys_no_match(self):
+        assert not keys_match("cors-misconfig", "stale-token")
+
+    def test_stopwords_ignored(self):
+        assert keys_match("the-stale-token-bug", "stale-token")
+
+    def test_empty_never_matches(self):
+        assert not keys_match("", "")
+        assert not keys_match("x", "")
+
+
+class TestConvergence:
+    def test_two_doctors_same_key(self):
+        got = check_convergence([_diag("A"), _diag("B")])
+        assert got is not None
+        key, group = got
+        assert key == "stale-token" and len(group) == 2
+
+    def test_low_confidence_blocks(self):
+        assert check_convergence([_diag("A", conf=7), _diag("B")]) is None
+
+    def test_single_doctor_insufficient(self):
+        assert check_convergence([_diag("A")]) is None
+
+    def test_same_doctor_twice_counts_once(self):
+        got = check_convergence([_diag("A", round_num=2),
+                                 _diag("A", round_num=3)])
+        assert got is None
+
+    def test_fuzzy_group(self):
+        got = check_convergence([
+            _diag("A", key="stale-auth-token"),
+            _diag("B", key="stale-auth-token-not-refreshed"),
+            _diag("C", key="completely-different", conf=9),
+        ])
+        assert got is not None
+        assert len(got[1]) == 2
+
+    def test_largest_group_wins(self):
+        got = check_convergence([
+            _diag("A", key="cache-invalidation"),
+            _diag("B", key="cache-invalidation"),
+            _diag("C", key="dns-ttl"),
+            _diag("D", key="dns-ttl"),
+            _diag("E", key="dns-ttl"),
+        ])
+        assert got is not None
+        assert "dns" in got[0]
+
+    def test_summary_mentions_doctors(self):
+        key, group = check_convergence([_diag("A"), _diag("B")])
+        text = summarize_diagnosis(key, group)
+        assert "**A**" in text and "**B**" in text
+        assert "ROOT CAUSE: stale-token" in text
+
+
+class TestErrorLog:
+    def test_ids_increment(self, tmp_path):
+        assert next_cr_id(tmp_path) == "CR-001"
+        add_error_entry(tmp_path, "it broke", None)
+        assert next_cr_id(tmp_path) == "CR-002"
+        add_error_entry(tmp_path, "it broke again", None)
+        assert next_cr_id(tmp_path) == "CR-003"
+
+    def test_entry_contents(self, tmp_path):
+        cr = add_error_entry(tmp_path, "crash on submit", "ROOT CAUSE: x",
+                             session="sess-1")
+        text = read_error_log(tmp_path)
+        assert f"## {cr}" in text
+        assert "**Status:** OPEN" in text
+        assert "crash on submit" in text
+        assert "ROOT CAUSE: x" in text
+        assert "sess-1" in text
+
+    def test_status_flip(self, tmp_path):
+        cr = add_error_entry(tmp_path, "s", None)
+        assert set_entry_status(tmp_path, cr, "RESOLVED")
+        assert "**Status:** RESOLVED" in read_error_log(tmp_path)
+        assert not set_entry_status(tmp_path, "CR-999", "PARKED")
+
+    def test_counts(self, tmp_path):
+        a = add_error_entry(tmp_path, "one", None)
+        add_error_entry(tmp_path, "two", None)
+        set_entry_status(tmp_path, a, "PARKED")
+        counts = count_by_status(tmp_path)
+        assert counts == {"OPEN": 1, "RESOLVED": 0, "PARKED": 1}
+
+
+DIAG_RESPONSE = """The evidence points one way.
+```json
+{"confidence_score": 9, "root_cause_key": "stale-cache-key",
+ "evidence": ["cache never invalidated"], "rules_out": ["network"],
+ "confirms": [], "file_requests": ["app.py"], "next_test": "clear cache"}
+```"""
+
+TRIAGE_RESPONSE = """Too early to say.
+```json
+{"confidence_score": 4, "root_cause_key": "unknown-yet",
+ "evidence": [], "rules_out": [], "confirms": [],
+ "file_requests": ["app.py"], "next_test": "read the code"}
+```"""
+
+
+class TestCodeRedCommand:
+    def _setup(self, tmp_path, scripts):
+        (tmp_path / ".roundtable" / "sessions").mkdir(parents=True)
+        (tmp_path / "app.py").write_text("x = 1\n", encoding="utf-8")
+        knights = []
+        adapter_config = {}
+        for i, name in enumerate(scripts):
+            knights.append({"name": name, "adapter": f"fake-{name}",
+                            "capabilities": [], "priority": i + 1})
+            adapter_config[f"fake-{name}"] = {"name": name}
+        config = {
+            "version": "1.0", "project_name": "t", "language": "en",
+            "knights": knights,
+            "rules": {"max_rounds": 4, "consensus_threshold": 9,
+                      "timeout_per_turn_seconds": 10,
+                      "escalate_to_user_after": 3, "auto_execute": False,
+                      "ignore": []},
+            "adapter_config": adapter_config,
+        }
+        (tmp_path / ".roundtable" / "config.json").write_text(
+            json.dumps(config))
+
+    def _patch_fakes(self, monkeypatch, scripts):
+        from theroundtaible_tpu.adapters import factory
+        from theroundtaible_tpu.adapters.fake import FakeAdapter
+
+        def fake_create(adapter_id, config, timeout_ms):
+            for name, script in scripts.items():
+                if adapter_id == f"fake-{name}":
+                    return FakeAdapter(name=name, script=script)
+            return None
+        monkeypatch.setattr(factory, "create_adapter", fake_create)
+
+    def test_convergence_flow(self, tmp_path, monkeypatch, capsys):
+        from theroundtaible_tpu.commands.code_red import code_red_command
+        scripts = {
+            "A": [TRIAGE_RESPONSE, DIAG_RESPONSE, DIAG_RESPONSE],
+            "B": [TRIAGE_RESPONSE, DIAG_RESPONSE, DIAG_RESPONSE],
+        }
+        self._setup(tmp_path, scripts)
+        self._patch_fakes(monkeypatch, scripts)
+        rc = code_red_command("login crashes on submit",
+                              project_root=str(tmp_path))
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "DIAGNOSIS CONVERGED: stale-cache-key" in out
+        log = read_error_log(tmp_path)
+        assert "CR-001" in log and "**Status:** OPEN" in log
+        # scope collected from the doctors' file_requests
+        from theroundtaible_tpu.utils.session import find_latest_session
+        status = find_latest_session(str(tmp_path)).status
+        assert status.consensus_reached
+        assert status.allowed_files == ["app.py"]
+
+    def test_no_convergence_escalates(self, tmp_path, monkeypatch, capsys):
+        from theroundtaible_tpu.commands.code_red import code_red_command
+        different = DIAG_RESPONSE.replace("stale-cache-key",
+                                          "totally-other-cause")
+        scripts = {
+            "A": [TRIAGE_RESPONSE, DIAG_RESPONSE],
+            "B": [TRIAGE_RESPONSE, different],
+        }
+        self._setup(tmp_path, scripts)
+        self._patch_fakes(monkeypatch, scripts)
+        rc = code_red_command("mystery bug", project_root=str(tmp_path))
+        assert rc == 1
+        assert "could not agree" in capsys.readouterr().out
+        assert "**Status:** OPEN" in read_error_log(tmp_path)
+
+    def test_blind_round_withholds_transcript(self, tmp_path, monkeypatch):
+        from theroundtaible_tpu.commands.code_red import code_red_command
+        from theroundtaible_tpu.adapters import factory
+        from theroundtaible_tpu.adapters.fake import FakeAdapter
+
+        captured: dict[str, list[str]] = {"A": [], "B": []}
+
+        def fake_create(adapter_id, config, timeout_ms):
+            for name in captured:
+                if adapter_id == f"fake-{name}":
+                    return FakeAdapter(
+                        name=name,
+                        script=[TRIAGE_RESPONSE, DIAG_RESPONSE,
+                                DIAG_RESPONSE],
+                        on_execute=captured[name].append)
+            return None
+        scripts = {"A": None, "B": None}
+        self._setup(tmp_path, scripts)
+        monkeypatch.setattr(factory, "create_adapter", fake_create)
+        code_red_command("bug", project_root=str(tmp_path))
+        # round 2 (blind): prompt must NOT contain round-1 responses
+        blind_prompt_a = captured["A"][1]
+        assert "withheld" in blind_prompt_a
+        assert "Too early to say" not in blind_prompt_a
+        # round 1 (triage) had no transcript yet; a convergence round—if it
+        # ran—would include it; blind is the anti-anchoring guarantee
