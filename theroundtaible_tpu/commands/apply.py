@@ -108,7 +108,12 @@ def _parley(path: str, new_text: str, state: dict) -> bool:
 def apply_command(noparley: bool = False, dry_run: bool = False,
                   override_scope: bool = False,
                   project_root: Optional[str] = None,
-                  session_name: Optional[str] = None) -> int:
+                  session_name: Optional[str] = None,
+                  result: Optional[dict] = None) -> int:
+    """`result`, when given, receives {"written": [...]} so callers (code-red
+    fix-now) can distinguish a real apply from an all-skipped rc==0 run."""
+    if result is not None:
+        result.setdefault("written", [])
     project_root = project_root or os.getcwd()
     config = load_config(project_root)
 
@@ -245,6 +250,8 @@ def apply_command(noparley: bool = False, dry_run: bool = False,
         lead_knight=lead.name,
     ))
     update_status(session_path, phase="completed")
+    if result is not None:
+        result["written"] = list(outcome.written)
     print(style.bold(style.green(
         f"\n  The decision has been carried out — {len(outcome.written)} "
         f"file(s) written ({manifest_status}).")))

@@ -119,7 +119,6 @@ def code_red_command(description: str,
     reporter = ConsoleReporter()
 
     transcript: list[RoundEntry] = []
-    blocks: list[DiagnosticBlock] = []
     resolved_files = ""
     phases = ["triage", "blind"] + \
         ["convergence"] * (MAX_DIAG_ROUNDS - 2)
@@ -166,7 +165,6 @@ def code_red_command(description: str,
                 knight=knight.name, round=round_num, response=response,
                 consensus=None, timestamp=now_iso()))
 
-        blocks.extend(round_blocks)
         write_discussion(session_path, transcript)
 
         if pending_requests:
@@ -225,13 +223,18 @@ def code_red_command(description: str,
     if answer == "1":
         from .apply import apply_command
         try:
-            rc = apply_command(project_root=project_root)
+            apply_result: dict = {}
+            rc = apply_command(project_root=project_root,
+                               result=apply_result)
             # apply returning success with files written = resolved; a
-            # 0-file apply must NOT flip the status (reference TODO.md:227
-            # "code-red false RESOLVED" fix).
-            if rc == 0:
+            # 0-file apply (everything skipped at parley) must NOT flip the
+            # status (reference TODO.md:227 "code-red false RESOLVED" fix).
+            if rc == 0 and apply_result.get("written"):
                 set_entry_status(project_root, cr_id, "RESOLVED")
                 print(style.green(f"  {cr_id} RESOLVED."))
+            elif rc == 0:
+                print(style.yellow(
+                    f"  Nothing was written — {cr_id} stays OPEN."))
         except Exception as e:
             print(style.red(f"  Surgery failed: {format_error(e)}"))
         return 0

@@ -17,7 +17,8 @@ _lock = threading.Lock()
 
 def _cache_key(config: dict[str, Any]) -> str:
     relevant = {k: config.get(k) for k in
-                ("model", "checkpoint", "max_seq_len", "dtype", "mesh")}
+                ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
+                 "seq_parallel", "long_scheme", "long_threshold")}
     return json.dumps(relevant, sort_keys=True)
 
 

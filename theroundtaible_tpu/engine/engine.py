@@ -70,7 +70,9 @@ class InferenceEngine:
                  mesh_shape: Optional[dict[str, int]] = None,
                  num_slots: int = 8, dtype=jnp.bfloat16,
                  sampling: Optional[SamplingParams] = None,
-                 seed: int = 0):
+                 seed: int = 0, seq_parallel: int = 0,
+                 long_threshold: int = 2048,
+                 long_scheme: str = "ring"):
         self.cfg = model_cfg
         self.max_seq_len = model_cfg.max_seq_len
         self.sampling = sampling or SamplingParams()
@@ -101,6 +103,36 @@ class InferenceEngine:
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
         self.last_stats = GenStats()
+
+        # Sequence-parallel long-context prefill (SURVEY.md §7 Phase 6):
+        # ring attention (or Ulysses) over a ("seq",) mesh for fresh long
+        # prompts; decode + delta prefills stay on the chunked path.
+        self.long_threshold = long_threshold
+        self.seq_mesh = None
+        self._ring_prefill_fn = None
+        if seq_parallel and seq_parallel > 1:
+            from .longcontext import build_seq_mesh, make_ring_prefill
+            # The seq mesh must span EXACTLY the engine mesh's devices
+            # (params live there; jit reshards them into the ring program),
+            # so the ring width is the engine mesh size and seq_parallel
+            # acts as the opt-in. Pick the width via mesh_shape.
+            devs = list(self.mesh.devices.flatten())
+            self.seq_mesh = build_seq_mesh(len(devs), devs)
+            self._ring_prefill_fn = make_ring_prefill(
+                model_cfg, self.seq_mesh, scheme=long_scheme)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def scatter_kv(cache_layers, slot_idx, new_layers):
+            # Write whole-sequence K/V from sequence-parallel prefill into
+            # the slot cache at offset 0 (ring path only runs offset-0).
+            out = []
+            for (k, v), (nk, nv) in zip(cache_layers, new_layers):
+                t = nk.shape[1]
+                out.append((k.at[slot_idx, :t].set(nk.astype(k.dtype)),
+                            v.at[slot_idx, :t].set(nv.astype(v.dtype))))
+            return out
+
+        self._scatter_kv = scatter_kv
 
         # compiled closures (per (batch, bucket) shapes, cached by jit)
         cfg = model_cfg
@@ -194,6 +226,9 @@ class InferenceEngine:
             dtype=dtype,
             sampling=sampling,
             seed=int(config.get("seed", 0)),
+            seq_parallel=int(config.get("seq_parallel", 0)),
+            long_threshold=int(config.get("long_threshold", 2048)),
+            long_scheme=config.get("long_scheme", "ring"),
         )
 
     # --- serving ---
@@ -213,17 +248,45 @@ class InferenceEngine:
         limit = min(max_prompt_tokens,
                     self.max_seq_len - DECODE_SEGMENT - 1)
         buckets = [b for b in PREFILL_BUCKETS if b <= _bucket(limit)]
-        for b in batch_sizes:
-            if b > self.kv.num_slots:
-                continue
-            for bucket in buckets:
-                n = min(bucket, limit)  # lands exactly in `bucket`
-                tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
-                turns = [(f"__warmup_{i}", tokens) for i in range(b)]
-                for _ in range(2):
-                    for name, _p in turns:
-                        self.kv.release(name)
-                    self.generate_batch(turns, max_new_tokens=1)
+        # Warm the CHUNKED programs with the ring path disabled — with
+        # seq_parallel on, warmup's offset-0 long runs would otherwise be
+        # hijacked by the ring program and delta prefills (offset>0, long
+        # suffix) would hit an unwarmed chunked bucket mid-serve.
+        ring_fn, self._ring_prefill_fn = self._ring_prefill_fn, None
+        try:
+            for b in batch_sizes:
+                if b > self.kv.num_slots:
+                    continue
+                for bucket in buckets:
+                    n = min(bucket, limit)  # lands exactly in `bucket`
+                    tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
+                    turns = [(f"__warmup_{i}", tokens) for i in range(b)]
+                    for _ in range(2):
+                        for name, _p in turns:
+                            self.kv.release(name)
+                        self.generate_batch(turns, max_new_tokens=1)
+        finally:
+            self._ring_prefill_fn = ring_fn
+
+        # Ring programs are whole-prompt-sized, so their buckets run up to
+        # the cache cap (not max_prompt_tokens): threshold, 2×, ... cap.
+        ring_limit = self.max_seq_len - DECODE_SEGMENT - 1
+        if ring_fn is not None and ring_limit >= self.long_threshold:
+            for b in batch_sizes:
+                if b > self.kv.num_slots:
+                    continue
+                length = self.long_threshold
+                while True:
+                    n = min(length, ring_limit)
+                    tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
+                    turns = [(f"__warmup_{i}", tokens) for i in range(b)]
+                    for _ in range(2):
+                        for name, _p in turns:
+                            self.kv.release(name)
+                        self.generate_batch(turns, max_new_tokens=1)
+                    if length >= ring_limit:
+                        break
+                    length *= 2
         for i in range(max(batch_sizes)):
             self.kv.release(f"__warmup_{i}")
         return time.monotonic() - t0
@@ -243,6 +306,42 @@ class InferenceEngine:
     def _prefill(self, slot_ids: list[int], token_lists: list[list[int]],
                  offsets: list[int], deadline: float = float("inf")
                  ) -> jax.Array:
+        """Prefill dispatch: fresh long prompts go to the sequence-parallel
+        ring program; everything else (short prompts, delta prefills on a
+        reused prefix) takes the chunked bucketed path."""
+        if (self._ring_prefill_fn is not None
+                and all(o == 0 for o in offsets)
+                and max(len(t) for t in token_lists) >= self.long_threshold):
+            from .longcontext import SEQ_AXIS, pad_to_ring
+            n_seq = self.seq_mesh.shape[SEQ_AXIS]
+            tpad = pad_to_ring(max(len(t) for t in token_lists), n_seq,
+                               self.kv.max_seq_len)
+            if tpad:
+                return self._prefill_ring(slot_ids, token_lists, tpad)
+        return self._prefill_chunked(slot_ids, token_lists, offsets, deadline)
+
+    def _prefill_ring(self, slot_ids: list[int],
+                      token_lists: list[list[int]], tpad: int) -> jax.Array:
+        """One sequence-parallel program prefills the whole batch; the
+        full-sequence K/V is scattered into the slot cache so decode and
+        later delta-prefills continue on the normal path."""
+        b = len(slot_ids)
+        tokens = np.full((b, tpad), self.tokenizer.pad_id, np.int32)
+        for i, t in enumerate(token_lists):
+            tokens[i, :len(t)] = t
+        positions = np.broadcast_to(np.arange(tpad, dtype=np.int32),
+                                    (b, tpad))
+        lengths = np.asarray([len(t) for t in token_lists], np.int32)
+        logits, caches = self._ring_prefill_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(lengths))
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        self.kv.layers = self._scatter_kv(self.kv.layers, slot_idx, caches)
+        return logits
+
+    def _prefill_chunked(self, slot_ids: list[int],
+                         token_lists: list[list[int]], offsets: list[int],
+                         deadline: float = float("inf")) -> jax.Array:
         """Chunked, bucketed prefill for B rows. Returns last-token logits
         [B, V] (f32). token_lists are the NOT-yet-cached suffixes."""
         b = len(slot_ids)

@@ -98,6 +98,30 @@ def _einsum(spec: str, a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
 
 
+def project_qkv(
+    x: jax.Array,                 # [B, T, E]
+    layer: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,         # [B, T] absolute positions
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """QKV projection + rope + query scaling.
+
+    Shared by dense attention below and the sequence-parallel cores in
+    longcontext.py (which replace only the softmax(QK)V part)."""
+    q = _einsum("bte,ehd->bthd", x, layer["q_proj"])     # [B,T,H,D]
+    k = _einsum("bte,ekd->btkd", x, layer["k_proj"])     # [B,T,K,D]
+    v = _einsum("bte,ekd->btkd", x, layer["v_proj"])
+
+    q = rope(q.astype(x.dtype), positions, cfg.rope_theta)
+    k = rope(k.astype(x.dtype), positions, cfg.rope_theta)
+    v = v.astype(x.dtype)
+
+    scale = (cfg.query_pre_attn_scalar
+             if cfg.query_pre_attn_scalar is not None
+             else cfg.head_dim ** -0.5)
+    return q * scale, k, v
+
+
 def attention(
     x: jax.Array,                 # [B, T, E]
     layer: Params,
@@ -112,19 +136,7 @@ def attention(
     Returns (output [B,T,E], updated (k_cache, v_cache)). When kv_cache is
     None the k/v of this call form the cache (prefill from scratch).
     """
-    b, t, _ = x.shape
-    q = _einsum("bte,ehd->bthd", x, layer["q_proj"])     # [B,T,H,D]
-    k = _einsum("bte,ekd->btkd", x, layer["k_proj"])     # [B,T,K,D]
-    v = _einsum("bte,ekd->btkd", x, layer["v_proj"])
-
-    q = rope(q.astype(x.dtype), positions, cfg.rope_theta)
-    k = rope(k.astype(x.dtype), positions, cfg.rope_theta)
-    v = v.astype(x.dtype)
-
-    scale = (cfg.query_pre_attn_scalar
-             if cfg.query_pre_attn_scalar is not None
-             else cfg.head_dim ** -0.5)
-    q = q * scale
+    q, k, v = project_qkv(x, layer, cfg, positions)
 
     if kv_cache is not None:
         k_cache, v_cache = kv_cache
@@ -166,11 +178,18 @@ def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
 
 def transformer_block(
     x: jax.Array, layer: Params, cfg: ModelConfig, positions: jax.Array,
-    kv_cache, cache_offset, attn_mask,
+    kv_cache, cache_offset, attn_mask, attn_fn=None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One block. `attn_fn(h, layer) -> (out, (k, v))`, when given, replaces
+    dense attention — the hook longcontext.py uses to drop in ring/Ulysses
+    sequence-parallel cores while keeping the norm/residual/MLP wiring (and
+    every family flag) in exactly one place."""
     h = rms_norm(x, layer["input_norm"], cfg.norm_eps, cfg.rmsnorm_unit_offset)
-    attn_out, new_cache = attention(h, layer, cfg, positions, kv_cache,
-                                    cache_offset, attn_mask)
+    if attn_fn is None:
+        attn_out, new_cache = attention(h, layer, cfg, positions, kv_cache,
+                                        cache_offset, attn_mask)
+    else:
+        attn_out, new_cache = attn_fn(h, layer)
     if cfg.post_attn_norm:
         attn_out = rms_norm(attn_out, layer["post_attn_norm"], cfg.norm_eps,
                             cfg.rmsnorm_unit_offset)
