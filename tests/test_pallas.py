@@ -1,0 +1,153 @@
+"""Pallas attention kernels vs the dense reference path.
+
+Runs in interpret mode on the CPU backend (conftest pins jax to cpu); the
+same kernels compile for TPU in serving (engine._resolve_attn "auto").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theroundtaible_tpu.engine.pallas.attention import (
+    NEG_INF, flash_prefill_attention, ragged_decode_attention, supported)
+
+
+def dense_ref(q, k, v, offsets, valid, window=None, softcap=None):
+    """The models/common.py dense path, inlined for comparison."""
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    ka = jnp.repeat(k, H // K, axis=2)
+    va = jnp.repeat(v, H // K, axis=2)
+    logits = jnp.einsum("bthd,bshd->bhts", q, ka).astype(jnp.float32)
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
+    qpos = offsets[:, None] + jnp.arange(T)[None, :]
+    kv = jnp.arange(S)[None, None, :]
+    mask = (kv <= qpos[:, :, None]) & (kv < valid[:, None, None])
+    if window:
+        mask = mask & (kv > qpos[:, :, None] - window)
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, -1)
+    return jnp.einsum("bhts,bshd->bthd", probs, va)
+
+
+def make_inputs(B=3, T=192, H=8, K=2, D=32, S=1024, seed=0):
+    """Default shapes exercise the MULTI-block machinery: T=192 → three
+    64-wide q blocks, S=1024 → two 512-wide kv blocks, so online-softmax
+    accumulation (alpha rescaling) and the kv index-map clamps run."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, K, D)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("window,softcap", [
+    (None, None), (48, None), (None, 30.0), (700, 30.0)])
+def test_prefill_matches_dense(window, softcap):
+    q, k, v = make_inputs()
+    # ragged rows: different offsets (delta prefill) and lengths, with one
+    # row's valid range crossing the kv-block boundary at 512
+    offsets = jnp.asarray([0, 10, 600], jnp.int32)
+    lengths = np.asarray([192, 40, 192])
+    valid = offsets + jnp.asarray(lengths, jnp.int32)
+    out = flash_prefill_attention(q, k, v, offsets, valid,
+                                  sliding_window=window, softcap=softcap,
+                                  interpret=True)
+    ref = dense_ref(q, k, v, offsets, valid, window, softcap)
+    assert out.shape == q.shape
+    # compare only each row's REAL query positions — padded tail rows are
+    # fully masked under small windows and never read by the engine
+    for b, n in enumerate(lengths):
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(ref)[b, :n],
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_prefill_mha_no_gqa():
+    q, k, v = make_inputs(H=4, K=4)
+    offsets = jnp.zeros((3,), jnp.int32)
+    valid = jnp.full((3,), 192, jnp.int32)
+    out = flash_prefill_attention(q, k, v, offsets, valid, interpret=True)
+    ref = dense_ref(q, k, v, offsets, valid)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("window,softcap", [
+    (None, None), (48, None), (None, 30.0), (700, None)])
+def test_decode_matches_dense(window, softcap):
+    _, k, v = make_inputs()
+    rng = np.random.default_rng(1)
+    qd = jnp.asarray(rng.normal(size=(3, 1, 8, 32)), jnp.float32)
+    # rows below, at, and beyond the 512 kv-block boundary
+    valid = jnp.asarray([1, 512, 1024], jnp.int32)
+    out = ragged_decode_attention(qd, k, v, valid, sliding_window=window,
+                                  softcap=softcap, interpret=True)
+    ref = dense_ref(qd, k, v, valid - 1, valid, window, softcap)
+    assert out.shape == qd.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_decode_single_query_group():
+    """MHA (group=1) exercises the sublane-1 decode block."""
+    _, k, v = make_inputs(H=2, K=2)
+    rng = np.random.default_rng(2)
+    qd = jnp.asarray(rng.normal(size=(3, 1, 2, 32)), jnp.float32)
+    valid = jnp.asarray([5, 600, 1000], jnp.int32)
+    out = ragged_decode_attention(qd, k, v, valid, interpret=True)
+    ref = dense_ref(qd, k, v, valid - 1, valid)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_supported_shapes():
+    assert supported(64, 512, 16)          # interpret mode: any D
+    assert supported(1, 2048, 128)
+    assert not supported(63, 512, 16)      # T has no block divisor
+    assert not supported(64, 100, 16)      # S has no block divisor
+
+
+def test_engine_forward_flash_matches_dense():
+    """Full forward pass: flash vs dense logits on a tiny model."""
+    import dataclasses
+
+    from theroundtaible_tpu.engine.models.common import forward, init_params
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-mistral", max_seq_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    tokens = jnp.asarray([[1, 5, 9, 8] * 8], jnp.int32)     # T=32
+    positions = jnp.arange(32)[None, :]
+    valid = jnp.asarray([32], jnp.int32)
+
+    cfg_flash = dataclasses.replace(cfg, attn_impl="flash")
+    logits_d, _ = forward(params, cfg, tokens, positions, None, None, valid)
+    logits_f, _ = forward(params, cfg_flash, tokens, positions, None, None,
+                          valid)
+    # activations are bf16 inside forward, so the two summation orders can
+    # differ by O(bf16 eps) per logit
+    np.testing.assert_allclose(np.asarray(logits_d), np.asarray(logits_f),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_engine_generate_with_flash():
+    """End-to-end generate through the engine with attn='flash'."""
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    cfg = get_model_config("tiny-gemma")
+    eng = InferenceEngine(cfg, num_slots=2, attn="flash",
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_new_tokens=8))
+    assert eng.cfg.attn_impl == "flash"
+    out = eng.generate("hello knights", slot_name="a", max_new_tokens=8)
+    assert isinstance(out, str)
+    # slot reuse path (delta prefill at offset > 0) under flash
+    out2 = eng.generate("hello knights, round two", slot_name="a",
+                        max_new_tokens=8)
+    assert isinstance(out2, str)
+    assert eng.last_stats.reused_tokens > 0

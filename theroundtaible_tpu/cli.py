@@ -54,7 +54,11 @@ def build_parser():
     d.add_argument("--no-read-code", dest="read_code", action="store_false",
                    help="Skip reading source code without asking")
 
-    sub.add_parser("summon", help="Review the current git diff")
+    s = sub.add_parser("summon", help="Review the current git diff")
+    s.add_argument("--read-code", action="store_true", default=None,
+                   help="Read source code into context without asking")
+    s.add_argument("--no-read-code", dest="read_code", action="store_false",
+                   help="Skip reading source code without asking")
 
     sub.add_parser("status", help="Show the latest session")
     sub.add_parser("list", help="List all sessions")
@@ -112,7 +116,7 @@ def dispatch(args) -> int:
         return discuss_command(args.topic, read_code=args.read_code)
     if args.command == "summon":
         from .commands.summon import summon_command
-        return summon_command()
+        return summon_command(read_code=args.read_code)
     if args.command == "status":
         from .commands.status import status_command
         return status_command()

@@ -72,11 +72,13 @@ class InferenceEngine:
                  sampling: Optional[SamplingParams] = None,
                  seed: int = 0, seq_parallel: int = 0,
                  long_threshold: int = 2048,
-                 long_scheme: str = "ring"):
+                 long_scheme: str = "ring", attn: str = "auto"):
+        self.mesh = build_mesh(mesh_shape)
+        model_cfg = self._resolve_attn(model_cfg, attn,
+                                       self.mesh.devices.size)
         self.cfg = model_cfg
         self.max_seq_len = model_cfg.max_seq_len
         self.sampling = sampling or SamplingParams()
-        self.mesh = build_mesh(mesh_shape)
         self.tokenizer = load_tokenizer(checkpoint or None)
 
         if checkpoint:
@@ -200,6 +202,27 @@ class InferenceEngine:
 
         self._decode_loop = decode_loop
 
+    @staticmethod
+    def _resolve_attn(model_cfg: ModelConfig, attn: str,
+                      mesh_size: int) -> ModelConfig:
+        """Pick the attention implementation (SURVEY.md §7.3 hard part 1).
+
+        "auto" enables the Pallas kernels on a single-device TPU mesh with
+        lane-aligned head_dim; under a multi-device mesh the kernels would
+        need a shard_map wrapper to partition (plain pallas_call inside a
+        pjit'd program is not SPMD-partitionable), so auto stays dense
+        there. Explicit "flash"/"dense" always wins."""
+        import dataclasses
+        if attn not in ("auto", "flash", "dense"):
+            raise ValueError(
+                f"attn must be auto|flash|dense, got {attn!r}")
+        if attn in ("flash", "dense"):
+            return dataclasses.replace(model_cfg, attn_impl=attn)
+        if (jax.default_backend() == "tpu" and mesh_size == 1
+                and model_cfg.head_dim % 128 == 0):
+            return dataclasses.replace(model_cfg, attn_impl="flash")
+        return dataclasses.replace(model_cfg, attn_impl="dense")
+
     # --- construction from adapter config ---
 
     @classmethod
@@ -229,6 +252,7 @@ class InferenceEngine:
             seq_parallel=int(config.get("seq_parallel", 0)),
             long_threshold=int(config.get("long_threshold", 2048)),
             long_scheme=config.get("long_scheme", "ring"),
+            attn=config.get("attn", "auto"),
         )
 
     # --- serving ---

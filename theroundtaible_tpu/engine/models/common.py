@@ -27,6 +27,11 @@ import jax.numpy as jnp
 
 Params = dict[str, Any]
 
+# Masked-attention-logit sentinel — finite (not -inf) so a fully-masked row
+# softmaxes to uniform instead of NaN. Shared with the Pallas kernels so
+# dense and flash masking semantics cannot drift apart.
+MASK_VALUE = -2.3819763e38
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
@@ -54,6 +59,10 @@ class ModelConfig:
     sliding_window: Optional[int] = None         # Mistral: 4096
     query_pre_attn_scalar: Optional[float] = None  # Gemma: head_dim**-0.5 default
     tie_embeddings: bool = True       # output head = embedding table
+    # runtime implementation choice, not architecture: "dense" = XLA einsum
+    # attention; "flash" = Pallas blockwise kernels (engine/pallas/) that
+    # stream KV through VMEM and skip blocks beyond each row's valid length
+    attn_impl: str = "dense"
 
     @property
     def kv_repeat(self) -> int:
@@ -130,6 +139,7 @@ def attention(
     kv_cache: Optional[tuple[jax.Array, jax.Array]],  # each [B, S, K, D]
     cache_offset: Optional[jax.Array],  # [B] write offset into the cache
     attn_mask: jax.Array,         # [B, T, S] boolean, True = attend
+    kv_valid: Optional[jax.Array] = None,  # [B] valid entries after step
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """GQA attention with in-place cache update.
 
@@ -151,6 +161,24 @@ def attention(
         k_all, v_all = k, v
         k_cache, v_cache = k, v
 
+    if cfg.attn_impl == "flash" and kv_valid is not None:
+        from ..pallas import attention as pattn
+        t = q.shape[1]
+        if pattn.supported(t, k_all.shape[1], cfg.head_dim):
+            if t > 1:
+                out = pattn.flash_prefill_attention(
+                    q, k_all, v_all, positions[:, 0], kv_valid,
+                    sliding_window=cfg.sliding_window,
+                    softcap=cfg.attn_logit_softcap)
+            else:
+                out = pattn.ragged_decode_attention(
+                    q, k_all, v_all, kv_valid,
+                    sliding_window=cfg.sliding_window,
+                    softcap=cfg.attn_logit_softcap)
+            out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
+                .astype(x.dtype)
+            return out, (k_cache, v_cache)
+
     # GQA: expand K/V heads to match query heads.
     if cfg.kv_repeat > 1:
         k_att = jnp.repeat(k_all, cfg.kv_repeat, axis=2)
@@ -160,7 +188,7 @@ def attention(
 
     logits = _einsum("bthd,bshd->bhts", q, k_att)        # [B,H,T,S] f32
     logits = _softcap(logits, cfg.attn_logit_softcap)
-    logits = jnp.where(attn_mask[:, None, :, :], logits, -2.3819763e38)
+    logits = jnp.where(attn_mask[:, None, :, :], logits, MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     out = _einsum("bhts,bshd->bthd", probs, v_att).astype(x.dtype)
     out = _einsum("bthd,hde->bte", out, layer["o_proj"]).astype(x.dtype)
@@ -178,7 +206,7 @@ def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
 
 def transformer_block(
     x: jax.Array, layer: Params, cfg: ModelConfig, positions: jax.Array,
-    kv_cache, cache_offset, attn_mask, attn_fn=None,
+    kv_cache, cache_offset, attn_mask, attn_fn=None, kv_valid=None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One block. `attn_fn(h, layer) -> (out, (k, v))`, when given, replaces
     dense attention — the hook longcontext.py uses to drop in ring/Ulysses
@@ -187,7 +215,7 @@ def transformer_block(
     h = rms_norm(x, layer["input_norm"], cfg.norm_eps, cfg.rmsnorm_unit_offset)
     if attn_fn is None:
         attn_out, new_cache = attention(h, layer, cfg, positions, kv_cache,
-                                        cache_offset, attn_mask)
+                                        cache_offset, attn_mask, kv_valid)
     else:
         attn_out, new_cache = attn_fn(h, layer)
     if cfg.post_attn_norm:
@@ -243,7 +271,8 @@ def forward(
     for i, layer in enumerate(params["layers"]):
         cache_i = kv_caches[i] if kv_caches is not None else None
         x, new_cache = transformer_block(
-            x, layer, cfg, positions, cache_i, cache_offset, mask)
+            x, layer, cfg, positions, cache_i, cache_offset, mask,
+            kv_valid=kv_valid_len)
         new_caches.append(new_cache)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,

@@ -1,0 +1,348 @@
+"""Pallas TPU attention kernels for the serving hot path.
+
+Replaces the dense softmax(QK^T)V in models/common.py for the two op shapes
+that dominate serving (SURVEY.md §7.3 hard part 1 — ragged per-knight KV
+slots; reference compute equivalent: llama.cpp attention reached through
+src/adapters/local-llm.ts):
+
+- flash_prefill_attention: blockwise online-softmax attention for prefill
+  chunks against a position-aligned KV cache. The dense path materializes
+  [B, H, T, S] logits against the FULL cache every chunk; this kernel
+  streams KV blocks through VMEM and — via scalar-prefetched per-row valid
+  lengths — never fetches blocks beyond a row's causal/valid frontier.
+- ragged_decode_attention: single-position decode attention over the padded
+  cache. Rows with valid=600 in an S=8192 cache read 600 tokens of KV, not
+  8192: the kv-block index map clamps to the row's frontier, and Pallas
+  elides the DMA when consecutive grid steps map to the same block.
+
+Both kernels handle GQA natively (kv head = q head // group) so the
+[B, S, K, D] cache is never repeated to [B, S, H, D] in HBM, and support
+Mistral's sliding window and Gemma-2-style logit softcap.
+
+On non-TPU backends the kernels run in Pallas interpret mode — this is how
+the CPU test suite validates them against the dense reference path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.common import MASK_VALUE as NEG_INF
+
+_LANES = 128  # TPU lane width; m/l scratch is replicated across lanes
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(n: int, candidates: tuple[int, ...]) -> Optional[int]:
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return None
+
+
+def supported(t: int, s: int, d: int) -> bool:
+    """Can the kernels serve these shapes? (TPU wants lane-aligned D; any
+    shape goes in interpret mode.)"""
+    if _pick_block(s, (512, 256, 128, 64, 32, 16, 8)) is None:
+        return False
+    if t > 1 and _pick_block(t, (128, 64, 32, 16, 8)) is None:
+        return False
+    if not _interpret() and d % 128 != 0:
+        return False
+    return True
+
+
+# --- prefill kernel ---
+
+
+def _prefill_kernel(offs_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
+                    m_scr, l_scr, acc_scr, *, block_q: int, block_kv: int,
+                    num_kv_blocks: int, group: int,
+                    sliding_window: Optional[int],
+                    softcap: Optional[float]):
+    # Grid (B, KV_heads, T_blocks, S_blocks): one step computes a whole GQA
+    # group (all `group` query heads sharing one kv head) against one kv
+    # block, so each kv block is DMA'd exactly once per (row, kv head) and
+    # the output block flushes once per (row, kv head, q block) — s-block
+    # steps keep the same output index, and the index maps clamp skipped
+    # steps to the frontier so they fetch nothing new.
+    b = pl.program_id(0)
+    tb = pl.program_id(2)
+    sb = pl.program_id(3)
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    offs = offs_ref[b]
+    valid = valid_ref[b]
+    q_start = offs + tb * block_q
+    hi = jnp.minimum((q_start + block_q - 1) // block_kv,
+                     (valid - 1) // block_kv)
+    if sliding_window is None:
+        lo = jnp.int32(0)
+    else:
+        lo = jnp.maximum(0, (q_start - sliding_window + 1) // block_kv)
+
+    @pl.when((sb >= lo) & (sb <= hi))
+    def _compute():
+        q = q_ref[0, 0].reshape(group * block_q, -1)       # [G*bq, D]
+        k = k_ref[0, 0]                                    # [bkv, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [G*bq, bkv]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+
+        # positions only depend on the q row WITHIN the block, identical
+        # across the group; build [bq, bkv] then tile over the group rows
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        kv_pos = sb * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        mask = (kv_pos <= q_pos) & (kv_pos < valid)
+        if sliding_window is not None:
+            mask &= kv_pos > q_pos - sliding_window
+        mask = jnp.broadcast_to(mask[None], (group, block_q, block_kv)) \
+            .reshape(group * block_q, block_kv)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]                                  # [G*bq, LANES]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [G*bq, D]
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(sb == num_kv_blocks - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        d = o_ref.shape[-1]
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype) \
+            .reshape(group, block_q, d)
+
+
+def flash_prefill_attention(
+    q: jax.Array,                 # [B, T, H, D] (pre-scaled, rope'd)
+    k: jax.Array,                 # [B, S, K, D] position-aligned cache
+    v: jax.Array,                 # [B, S, K, D]
+    offsets: jax.Array,           # [B] absolute position of q row start
+    kv_valid: jax.Array,          # [B] valid cache entries per row
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise causal attention of a prefill chunk against the cache.
+
+    Rows are assumed position-contiguous (position of q[:, i] is
+    offsets[b] + i) — true for every chunked-prefill call in the engine.
+    Returns [B, T, H, D] in q's dtype.
+    """
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    block_q = _pick_block(t, (128, 64, 32, 16, 8))
+    block_kv = _pick_block(s, (512, 256, 128, 64, 32, 16, 8))
+    if block_q is None or block_kv is None:
+        raise ValueError(f"unsupported shapes T={t} S={s}")
+    interpret = _interpret() if interpret is None else interpret
+
+    # [B, T, H, D] → [B, K, G, T, D]: q heads grouped by their kv head
+    # (head kh*G+g shares kv head kh, matching the dense path's repeat)
+    qt = q.transpose(0, 2, 1, 3).reshape(b, kh, group, t, d)
+    kt = k.transpose(0, 2, 1, 3)        # [B, K, S, D]
+    vt = v.transpose(0, 2, 1, 3)
+    num_kv_blocks = s // block_kv
+
+    def kv_index(bi, khi, tb, sb, offs_ref, valid_ref):
+        q_start = offs_ref[bi] + tb * block_q
+        hi_blk = jnp.minimum((q_start + block_q - 1) // block_kv,
+                             (valid_ref[bi] - 1) // block_kv)
+        if sliding_window is None:
+            lo_blk = jnp.int32(0)
+        else:
+            lo_blk = jnp.maximum(
+                0, (q_start - sliding_window + 1) // block_kv)
+        sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
+        return (bi, khi, sb, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh, t // block_q, num_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, block_q, d),
+                         lambda bi, khi, tb, sb, o_, v_:
+                         (bi, khi, 0, tb, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), kv_index),
+            pl.BlockSpec((1, 1, block_kv, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, block_q, d),
+            lambda bi, khi, tb, sb, o_, v_: (bi, khi, 0, tb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((group * block_q, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_q=block_q, block_kv=block_kv,
+        num_kv_blocks=num_kv_blocks, group=group,
+        sliding_window=sliding_window, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(offsets.astype(jnp.int32), kv_valid.astype(jnp.int32), qt, kt, vt)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# --- decode kernel ---
+
+
+def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_kv: int,
+                   num_kv_blocks: int, group: int,
+                   sliding_window: Optional[int],
+                   softcap: Optional[float]):
+    b = pl.program_id(0)
+    sb = pl.program_id(2)
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    valid = valid_ref[b]
+    hi = (valid - 1) // block_kv
+    if sliding_window is None:
+        lo = jnp.int32(0)
+    else:
+        lo = jnp.maximum(0, (valid - sliding_window) // block_kv)
+
+    @pl.when((sb >= lo) & (sb <= hi))
+    def _compute():
+        q = q_ref[0, 0]                                    # [G, D]
+        k = k_ref[0, 0]                                    # [bkv, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [G, bkv]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        kv_pos = sb * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (group, block_kv), 1)
+        mask = kv_pos < valid
+        if sliding_window is not None:
+            mask &= kv_pos > (valid - 1) - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(sb == num_kv_blocks - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def ragged_decode_attention(
+    q: jax.Array,                 # [B, 1, H, D] this step's query
+    k: jax.Array,                 # [B, S, K, D] cache incl. this step's K
+    v: jax.Array,                 # [B, S, K, D]
+    kv_valid: jax.Array,          # [B] valid entries INCLUDING this step
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-position attention over each row's valid cache prefix.
+
+    The query position is kv_valid-1 (decode always appends), so causality
+    reduces to kv_pos < kv_valid. Returns [B, 1, H, D].
+    """
+    b, t, h, d = q.shape
+    assert t == 1, "decode kernel serves exactly one position"
+    s, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    block_kv = _pick_block(s, (512, 256, 128, 64, 32, 16, 8))
+    if block_kv is None:
+        raise ValueError(f"unsupported cache length S={s}")
+    interpret = _interpret() if interpret is None else interpret
+
+    # [B, 1, H, D] → [B, K, G, D]: rows of one kv-head's query group
+    qt = q[:, 0].reshape(b, kh, group, d)
+    kt = k.transpose(0, 2, 1, 3)        # [B, K, S, D]
+    vt = v.transpose(0, 2, 1, 3)
+    num_kv_blocks = s // block_kv
+
+    def kv_index(bi, khi, sb, valid_ref):
+        hi_blk = (valid_ref[bi] - 1) // block_kv
+        if sliding_window is None:
+            lo_blk = jnp.int32(0)
+        else:
+            lo_blk = jnp.maximum(
+                0, (valid_ref[bi] - sliding_window) // block_kv)
+        sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
+        return (bi, khi, sb, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, kh, num_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bi, khi, sb, v_: (bi, khi, 0, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), kv_index),
+            pl.BlockSpec((1, 1, block_kv, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, d),
+            lambda bi, khi, sb, v_: (bi, khi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_kv=block_kv, num_kv_blocks=num_kv_blocks,
+        group=group, sliding_window=sliding_window, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(kv_valid.astype(jnp.int32), qt, kt, vt)
+    return out.reshape(b, 1, h, d)

@@ -85,11 +85,13 @@ def write_decisions(session_path: str | Path, topic: str, decision: str,
                     rounds: list[RoundEntry]) -> None:
     """Write final decisions.md (reference session.ts:94-115)."""
     knights = list(dict.fromkeys(r.knight for r in rounds))
+    # entries are per knight-turn; the header counts discussion rounds
+    num_rounds = len({r.round for r in rounds})
     lines = [
         "# Decision\n",
         f"**Topic:** {topic}",
         f"**Knights:** {', '.join(knights)}",
-        f"**Rounds:** {len(rounds)}",
+        f"**Rounds:** {num_rounds}",
         f"**Date:** {datetime.now(timezone.utc).strftime('%Y-%m-%d')}",
         "",
         "---\n",
