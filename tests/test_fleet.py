@@ -1,0 +1,188 @@
+"""Heterogeneous multi-model fleet: device partitioning, config planning,
+per-submesh engines, and the orchestrator's concurrent group fan-out
+(BASELINE.md config 3; SURVEY.md §2.3 heterogeneous scheduler)."""
+
+import jax
+import pytest
+
+from theroundtaible_tpu.engine.fleet import (
+    estimate_param_count, partition_devices, plan_fleet)
+from theroundtaible_tpu.engine.models.registry import get_model_config
+
+
+class TestPartitionDevices:
+    def test_equal_weights_8_devices(self):
+        groups = partition_devices([100, 100, 100], 8)
+        assert [len(g) for g in groups] == [4, 2, 2]
+        # contiguous + disjoint + power-of-two
+        flat = [i for g in groups for i in g]
+        assert flat == sorted(set(flat))
+        for g in groups:
+            assert g == list(range(g[0], g[0] + len(g)))
+
+    def test_skewed_weights(self):
+        groups = partition_devices([1000, 10], 8)
+        assert len(groups[0]) >= len(groups[1])
+        assert all(len(g) & (len(g) - 1) == 0 for g in groups)
+
+    def test_more_models_than_devices(self):
+        groups = partition_devices([1, 1, 1], 2)
+        assert groups == [[0], [1], [0]]
+
+    def test_single_model(self):
+        assert partition_devices([7], 8) == [list(range(8))]
+
+    def test_empty(self):
+        assert partition_devices([], 8) == []
+
+
+class TestEstimateParams:
+    def test_matches_real_count(self):
+        from theroundtaible_tpu.engine.models.common import (
+            init_params, param_count)
+        cfg = get_model_config("tiny-llama")
+        est = estimate_param_count(cfg)
+        real = param_count(init_params(cfg, jax.random.PRNGKey(0)))
+        assert abs(est - real) / real < 0.01
+
+    def test_bigger_model_bigger_estimate(self):
+        assert (estimate_param_count(get_model_config("llama-3-8b-instruct"))
+                > estimate_param_count(get_model_config("gemma-2b-it")))
+
+
+class TestPlanFleet:
+    def test_heterogeneous_gets_disjoint_devices(self):
+        cfgs = [{"model": "tiny-gemma"}, {"model": "tiny-llama"},
+                {"model": "tiny-mistral"}]
+        plan_fleet(cfgs, n_devices=8)
+        seen = set()
+        for c in cfgs:
+            assert c["devices"], c
+            assert not (seen & set(c["devices"]))
+            seen.update(c["devices"])
+
+    def test_same_model_shares_group(self):
+        cfgs = [{"model": "tiny-gemma"}, {"model": "tiny-gemma"},
+                {"model": "tiny-llama"}]
+        plan_fleet(cfgs, n_devices=8)
+        assert cfgs[0]["devices"] == cfgs[1]["devices"]
+        assert set(cfgs[0]["devices"]).isdisjoint(cfgs[2]["devices"])
+
+    def test_homogeneous_untouched(self):
+        cfgs = [{"model": "tiny-gemma"}, {"model": "tiny-gemma"}]
+        plan_fleet(cfgs, n_devices=8)
+        assert "devices" not in cfgs[0]
+
+    def test_explicit_layout_wins(self):
+        cfgs = [{"model": "tiny-gemma", "mesh": {"model": 2}},
+                {"model": "tiny-llama"}]
+        plan_fleet(cfgs, n_devices=8)
+        assert "devices" not in cfgs[1]
+
+
+class TestFleetEngines:
+    def test_two_engines_disjoint_submeshes(self):
+        from theroundtaible_tpu.engine import get_engine, reset_engines
+        reset_engines()
+        try:
+            cfgs = [
+                {"model": "tiny-gemma", "max_seq_len": 256,
+                 "devices": [0, 1, 2, 3]},
+                {"model": "tiny-llama", "max_seq_len": 256,
+                 "devices": [4, 5]},
+            ]
+            engines = [get_engine(c) for c in cfgs]
+            d0 = set(engines[0].describe()["devices"])
+            d1 = set(engines[1].describe()["devices"])
+            assert len(d0) == 4 and len(d1) == 2 and not (d0 & d1)
+            for eng in engines:
+                out = eng.generate("test prompt", slot_name="k",
+                                   max_new_tokens=4)
+                assert isinstance(out, str)
+        finally:
+            reset_engines()
+
+
+class TestFactoryFleetPlanning:
+    def test_initialize_adapters_plans_heterogeneous_fleet(self):
+        from theroundtaible_tpu.adapters.factory import initialize_adapters
+        from theroundtaible_tpu.core.types import (
+            KnightConfig, RoundtableConfig, RulesConfig)
+        from theroundtaible_tpu.engine import reset_engines
+
+        reset_engines()
+        try:
+            adapter_config = {
+                "tpu-llm-g": {"model": "tiny-gemma", "max_seq_len": 128},
+                "tpu-llm-l": {"model": "tiny-llama", "max_seq_len": 128},
+            }
+            config = RoundtableConfig(
+                version="1.0", project="p", language="en",
+                knights=[
+                    KnightConfig(name="G", adapter="tpu-llm-g", priority=1),
+                    KnightConfig(name="L", adapter="tpu-llm-l", priority=2),
+                ],
+                rules=RulesConfig(max_rounds=1),
+                chronicle="chronicle.md",
+                adapter_config=adapter_config)
+            adapters = initialize_adapters(config)
+            assert set(adapters) == {"tpu-llm-g", "tpu-llm-l"}
+            dg = adapter_config["tpu-llm-g"]["devices"]
+            dl = adapter_config["tpu-llm-l"]["devices"]
+            assert dg and dl and set(dg).isdisjoint(dl)
+            # engines actually live on their assigned submeshes
+            eg = adapters["tpu-llm-g"]._get_engine()
+            el = adapters["tpu-llm-l"]._get_engine()
+            assert len(eg.describe()["devices"]) == len(dg)
+            assert len(el.describe()["devices"]) == len(dl)
+        finally:
+            reset_engines()
+
+
+class TestOrchestratorFleetFanout:
+    def test_concurrent_groups_and_serial_mix(self, project_root):
+        """Two batch-capable adapters (different models) + one plain fake
+        knight: groups run concurrently, serial knight still speaks."""
+        import threading
+
+        from theroundtaible_tpu.adapters.fake import (
+            FakeAdapter, scripted_response)
+        from theroundtaible_tpu.core.orchestrator import run_discussion
+        from theroundtaible_tpu.core.types import (
+            KnightConfig, RoundtableConfig, RulesConfig)
+
+        entered = []
+        barrier = threading.Barrier(2, timeout=20)
+
+        class BatchFake(FakeAdapter):
+            def supports_batched_rounds(self):
+                return True
+
+            def execute_round(self, turns, timeout_ms=0):
+                entered.append(self.name)
+                barrier.wait()  # proves both groups are in-flight at once
+                return [scripted_response(9) for _ in turns]
+
+        adapters = {
+            "tpu-llm-a": BatchFake("A"),
+            "tpu-llm-b": BatchFake("B"),
+            "fake": FakeAdapter("C", script=[scripted_response(9)] * 9),
+        }
+        config = RoundtableConfig(
+            version="1.0", project="p", language="en",
+            knights=[
+                KnightConfig(name="Alpha", adapter="tpu-llm-a", priority=1),
+                KnightConfig(name="Beta", adapter="tpu-llm-b", priority=2),
+                KnightConfig(name="Gamma", adapter="fake", priority=3),
+            ],
+            rules=RulesConfig(max_rounds=2, consensus_threshold=9,
+                              parallel_rounds=True),
+            chronicle="chronicle.md",
+            adapter_config={},
+        )
+        result = run_discussion("topic", config, adapters,
+                                str(project_root), read_source_code=False)
+        assert result.consensus
+        assert sorted(entered) == ["A", "B"]
+        spoke = {e.knight for e in result.all_rounds}
+        assert spoke == {"Alpha", "Beta", "Gamma"}

@@ -85,6 +85,8 @@ def initialize_adapters(
     timeout_ms = config.rules.timeout_per_turn_seconds * 1000
     adapters: dict[str, BaseAdapter] = {}
 
+    _plan_tpu_fleet(config, on_event)
+
     for knight in config.knights:
         adapter_id = knight.adapter
         if adapter_id in adapters:
@@ -112,6 +114,36 @@ def initialize_adapters(
             on_event("unavailable",
                      f"{knight.name} ({adapter_id}) is unavailable")
     return adapters
+
+
+def _plan_tpu_fleet(config: RoundtableConfig,
+                    on_event: Optional[Callable[[str, str], None]]) -> None:
+    """Heterogeneous serving: when several knights use DIFFERENT tpu-llm
+    models, partition the chips into per-model submeshes before any engine
+    is built (engine/fleet.py; SURVEY.md §2.3). Homogeneous setups and
+    configs with explicit mesh/devices are untouched."""
+    tpu_cfgs = []
+    for knight in config.knights:
+        if knight.adapter.startswith("tpu-llm"):
+            # Unconfigured tpu-llm ids get a dict INSERTED into the config
+            # map so the planner's device assignment reaches the adapter —
+            # leaving one engine on the full default mesh would overlap the
+            # submeshes planned for the others and double-book HBM.
+            cfg = config.adapter_config.setdefault(knight.adapter, {})
+            if isinstance(cfg, dict):
+                tpu_cfgs.append(cfg)
+    if len(tpu_cfgs) < 2:
+        return
+    try:
+        from ..engine.fleet import plan_fleet
+        plan_fleet(tpu_cfgs)
+    except Exception as e:  # noqa: BLE001 — engines still run (sharing the
+        # full default mesh), but the operator must hear planning failed:
+        # the symptom otherwise is an unexplained HBM OOM at weight load.
+        if on_event:
+            on_event("unavailable",
+                     f"fleet planning failed ({e}); engines will share "
+                     f"the full device mesh")
 
 
 def _post_init(adapter: BaseAdapter) -> None:

@@ -360,58 +360,92 @@ def _build_turn_prompt(knight, config, topic, context, manifest_summary,
     return shared + "\n" + build_knight_tail(knight, config.knights, topic)
 
 
-def _batchable_adapter(round_order, adapters) -> Optional[BaseAdapter]:
-    """The single shared batch-capable adapter for this round, if any."""
-    seen: set[int] = set()
-    found: Optional[BaseAdapter] = None
+def _batch_groups(round_order, adapters):
+    """Partition the round into batch-capable adapter groups + the rest.
+
+    Knights sharing one batch-capable adapter (same resident model) form a
+    group served by ONE batched device program. DIFFERENT batch-capable
+    adapters (heterogeneous fleet — per-model submeshes, engine/fleet.py)
+    become separate groups that run CONCURRENTLY: their submeshes are
+    disjoint chips, so the round's wall-clock is max, not sum. Knights on
+    non-batchable adapters (CLI/API/local) stay on the serial path.
+    """
+    groups: dict[int, tuple[BaseAdapter, list]] = {}
+    serial = []
     for k in round_order:
         a = adapters.get(k.adapter)
-        if a is None or not a.supports_batched_rounds():
-            return None
-        if id(a) not in seen:
-            seen.add(id(a))
-            found = a
-    return found if len(seen) == 1 else None
+        if a is not None and a.supports_batched_rounds():
+            groups.setdefault(id(a), (a, []))[1].append(k)
+        else:
+            serial.append(k)
+    # A lone batchable knight gains nothing from the batch path but would
+    # lose its place in the speaking order (batch groups dispatch against
+    # the round-start snapshot, ahead of serial knights) — keep the round
+    # fully serial unless there's real batching or fleet concurrency.
+    if sum(len(ks) for _, ks in groups.values()) < 2:
+        return [], list(round_order)
+    return list(groups.values()), serial
 
 
 def _run_round_turns(round_order, round_num, topic, config, adapters,
                      project_root, session_path, context, manifest_summary,
                      decrees_context, king_demand, state, timeout_ms,
                      reporter) -> None:
-    batch_adapter = (_batchable_adapter(round_order, adapters)
-                     if config.rules.parallel_rounds else None)
+    if config.rules.parallel_rounds:
+        groups, serial_order = _batch_groups(round_order, adapters)
+    else:
+        groups, serial_order = [], round_order
 
-    if batch_adapter is not None:
-        # Batched dispatch: all knights speak against the same transcript
-        # snapshot in ONE device program (SURVEY.md §7.1).
+    if groups:
+        # Batched dispatch: each group's knights speak against the same
+        # transcript snapshot in ONE device program (SURVEY.md §7.1);
+        # multiple groups (heterogeneous models) dispatch concurrently.
         update_status(session_path, phase="discussing", current_knight=None,
                       round=round_num)
-        turns = []
-        present = []
-        for knight in round_order:
-            prompt = _build_turn_prompt(
-                knight, config, topic, context, manifest_summary,
-                decrees_context, king_demand, state)
-            turns.append(KnightTurn(knight_name=knight.name, prompt=prompt))
-            present.append(knight)
-        try:
-            responses = batch_adapter.execute_round(turns, timeout_ms)
+        jobs = []
+        for adapter, knights in groups:
+            turns = [KnightTurn(
+                knight_name=k.name,
+                prompt=_build_turn_prompt(
+                    k, config, topic, context, manifest_summary,
+                    decrees_context, king_demand, state))
+                for k in knights]
+            jobs.append((adapter, knights, turns))
+
+        def run_group(job):
+            adapter, knights, turns = job
+            responses = adapter.execute_round(turns, timeout_ms)
             if len(responses) != len(turns):
                 raise RuntimeError(
                     f"batched round returned {len(responses)} responses "
                     f"for {len(turns)} turns")
-        except Exception as error:  # noqa: BLE001 — contained per round
-            kind = classify_error(error)
-            for knight in present:
-                reporter.knight_failed(knight.name, kind, str(error),
-                                       hint_for_kind(kind))
-            return
-        for knight, response in zip(present, responses):
-            _record_turn(knight, round_num, response, batch_adapter, config,
-                         project_root, state, reporter)
-        return
+            return responses
 
-    for knight in round_order:
+        if len(jobs) == 1:
+            results = [_try(run_group, jobs[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+                results = list(pool.map(lambda j: _try(run_group, j), jobs))
+
+        # Record in round order regardless of completion order.
+        response_by_knight = {}
+        for (adapter, knights, _turns), outcome in zip(jobs, results):
+            if isinstance(outcome, Exception):
+                kind = classify_error(outcome)
+                for k in knights:
+                    reporter.knight_failed(k.name, kind, str(outcome),
+                                           hint_for_kind(kind))
+                continue
+            for k, resp in zip(knights, outcome):
+                response_by_knight[k.name] = (resp, adapter)
+        for knight in round_order:
+            if knight.name in response_by_knight:
+                resp, adapter = response_by_knight[knight.name]
+                _record_turn(knight, round_num, resp, adapter, config,
+                             project_root, state, reporter)
+
+    for knight in serial_order:
         adapter = adapters.get(knight.adapter)
         if adapter is None:
             reporter.knight_skipped(knight.name)
@@ -435,6 +469,15 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
         stop_thinking()
         _record_turn(knight, round_num, response, adapter, config,
                      project_root, state, reporter)
+
+
+def _try(fn, arg):
+    """Run fn(arg), returning the exception instead of raising (used to
+    contain per-group failures in the concurrent fan-out)."""
+    try:
+        return fn(arg)
+    except Exception as e:  # noqa: BLE001 — containment by design
+        return e
 
 
 def _record_turn(knight, round_num, response, adapter, config, project_root,

@@ -18,7 +18,8 @@ _lock = threading.Lock()
 def _cache_key(config: dict[str, Any]) -> str:
     relevant = {k: config.get(k) for k in
                 ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
-                 "seq_parallel", "long_scheme", "long_threshold")}
+                 "seq_parallel", "long_scheme", "long_threshold",
+                 "devices", "attn")}
     return json.dumps(relevant, sort_keys=True)
 
 

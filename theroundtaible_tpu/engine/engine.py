@@ -72,8 +72,15 @@ class InferenceEngine:
                  sampling: Optional[SamplingParams] = None,
                  seed: int = 0, seq_parallel: int = 0,
                  long_threshold: int = 2048,
-                 long_scheme: str = "ring", attn: str = "auto"):
-        self.mesh = build_mesh(mesh_shape)
+                 long_scheme: str = "ring", attn: str = "auto",
+                 devices: Optional[list[int]] = None):
+        # devices: indices into jax.devices() — the fleet planner assigns
+        # disjoint per-model submeshes this way (engine/fleet.py)
+        device_list = None
+        if devices:
+            all_devices = jax.devices()
+            device_list = [all_devices[i] for i in devices]
+        self.mesh = build_mesh(mesh_shape, device_list)
         model_cfg = self._resolve_attn(model_cfg, attn,
                                        self.mesh.devices.size)
         self.cfg = model_cfg
@@ -253,6 +260,7 @@ class InferenceEngine:
             long_threshold=int(config.get("long_threshold", 2048)),
             long_scheme=config.get("long_scheme", "ring"),
             attn=config.get("attn", "auto"),
+            devices=config.get("devices"),
         )
 
     # --- serving ---

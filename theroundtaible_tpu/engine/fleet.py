@@ -1,0 +1,117 @@
+"""Heterogeneous multi-model fleet planning.
+
+BASELINE.md config 3 serves three DIFFERENT checkpoints (Gemma-7B /
+Llama-3-8B / Mistral-7B) from one pod at once — a capability with no
+reference counterpart (the reference time-multiplexes Ollama's single GPU;
+SURVEY.md §2.3 "heterogeneous multi-model scheduler"). The TPU answer is
+spatial: partition the pod's chips into disjoint per-model submeshes sized
+by each model's weight footprint, so every model is resident and the
+orchestrator can fan a round out to all knights concurrently.
+
+`plan_fleet` runs at adapter-initialization time (before any engine is
+built): it groups the knights' tpu-llm engine configs by model identity,
+sizes each group's submesh (power-of-two growth, weighted by parameter
+bytes), and injects the chosen device indices into each config. Engines
+then build their meshes over exactly those chips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .models.common import ModelConfig
+from .models.registry import get_model_config
+
+
+def estimate_param_count(cfg: ModelConfig) -> int:
+    """Closed-form parameter count (no arrays built)."""
+    e, h, k, d, f = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim, cfg.mlp_dim)
+    per_layer = 2 * e * h * d + 2 * e * k * d + 3 * e * f + 2 * e
+    total = cfg.num_layers * per_layer + cfg.vocab_size * e + e
+    if not cfg.tie_embeddings:
+        total += cfg.vocab_size * e
+    return total
+
+
+def partition_devices(weights: list[int], n_devices: int) -> list[list[int]]:
+    """Split device indices 0..n-1 into one contiguous group per weight.
+
+    Every group starts at 1 device; remaining devices are granted by
+    repeated DOUBLING (keeps each submesh a power of two, so TP axis sizes
+    divide heads/mlp cleanly), always to the group with the highest
+    bytes-per-device. Groups are contiguous index ranges — on a real slice,
+    neighboring indices are ICI neighbors, so a submesh's collectives stay
+    on-torus. Leftover devices (when no group can double) stay idle.
+
+    If there are more models than devices, groups share: model i gets
+    device i % n_devices (time-multiplexed residency, still correct —
+    XLA serializes programs per device).
+    """
+    m = len(weights)
+    if m == 0:
+        return []
+    if n_devices < m:
+        return [[i % n_devices] for i in range(m)]
+
+    sizes = [1] * m
+    remaining = n_devices - m
+    while True:
+        # candidate = most HBM-pressured group whose doubling fits
+        best, best_load = None, -1.0
+        for i in range(m):
+            if sizes[i] <= remaining:
+                load = weights[i] / sizes[i]
+                if load > best_load:
+                    best, best_load = i, load
+        if best is None:
+            break
+        remaining -= sizes[best]
+        sizes[best] *= 2
+
+    groups: list[list[int]] = []
+    start = 0
+    for size in sizes:
+        groups.append(list(range(start, start + size)))
+        start += size
+    return groups
+
+
+def _engine_identity(cfg: dict[str, Any]) -> str:
+    """Two configs with the same identity share one engine (and submesh)."""
+    return f"{cfg.get('model', 'tiny-gemma')}|{cfg.get('checkpoint', '')}"
+
+
+def plan_fleet(engine_configs: list[dict[str, Any]],
+               n_devices: Optional[int] = None) -> None:
+    """Assign disjoint device groups to heterogeneous engine configs.
+
+    Mutates each config dict, setting "devices" (a list of device indices
+    into jax.devices()). No-ops when: fewer than two distinct models, any
+    config already pins "devices" or "mesh" (explicit layout wins), or
+    device count can't be determined.
+    """
+    configs = [c for c in engine_configs if c is not None]
+    if any(c.get("devices") or c.get("mesh") for c in configs):
+        return
+    identities: dict[str, list[dict[str, Any]]] = {}
+    for c in configs:
+        identities.setdefault(_engine_identity(c), []).append(c)
+    if len(identities) < 2:
+        return
+
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+
+    weights = []
+    for ident, cfgs in identities.items():
+        model_name = cfgs[0].get("model", "tiny-gemma")
+        try:
+            weights.append(estimate_param_count(get_model_config(model_name)))
+        except ValueError:
+            weights.append(1)
+    groups = partition_devices(weights, n_devices)
+    for (ident, cfgs), group in zip(identities.items(), groups):
+        for c in cfgs:
+            c["devices"] = list(group)
