@@ -1,0 +1,117 @@
+"""Session metrics (metrics.json) + profiler gating (SURVEY.md §5.1/§5.5 —
+the observability layer the reference lacks entirely)."""
+
+import json
+
+from theroundtaible_tpu.adapters.fake import FakeAdapter, scripted_response
+from theroundtaible_tpu.core.orchestrator import run_discussion
+from theroundtaible_tpu.core.types import (
+    KnightConfig, RoundtableConfig, RulesConfig)
+from theroundtaible_tpu.utils.metrics import SessionMetrics
+
+
+def make_config(knights, rules=None):
+    return RoundtableConfig(
+        version="1.0", project="t", language="en", knights=knights,
+        rules=rules or RulesConfig(max_rounds=3),
+        chronicle="chronicle.md", adapter_config={})
+
+
+class TestSessionMetrics:
+    def test_round_and_turn_recording(self, tmp_path):
+        m = SessionMetrics(tmp_path)
+        m.start_round(1)
+        m.record_turn("A", 1, 1.5, chars_in=100, chars_out=50,
+                      engine={"prefill_tokens": 30, "reused_tokens": 10,
+                              "decode_tokens": 20, "decode_seconds": 0.5})
+        m.record_turn("B", 1, 2.0, chars_in=100, chars_out=60)
+        m.end_round()
+        m.finish("consensus_reached")
+
+        data = json.loads((tmp_path / "metrics.json").read_text())
+        assert data["outcome"] == "consensus_reached"
+        assert data["totals"]["turns"] == 2
+        assert data["totals"]["chars_in"] == 200
+        assert data["totals"]["engine_prefill_tokens"] == 30
+        assert data["totals"]["engine_decode_tps"] == 40.0
+        assert len(data["rounds"]) == 1
+        assert data["rounds"][0]["turns"][0]["knight"] == "A"
+
+    def test_record_without_start_round_autostarts(self, tmp_path):
+        m = SessionMetrics(tmp_path)
+        m.record_turn("A", 2, 0.1)
+        assert m.rounds[0].round == 2
+
+    def test_resume_preserves_prior_rounds(self, tmp_path):
+        m1 = SessionMetrics(tmp_path)
+        m1.start_round(1)
+        m1.record_turn("A", 1, 1.0, chars_in=10)
+        m1.end_round()
+        m1.finish("escalated")
+        # "King sends back" resume re-enters the same session dir
+        m2 = SessionMetrics(tmp_path)
+        m2.start_round(2)
+        m2.record_turn("A", 2, 1.0, chars_in=20)
+        m2.end_round()
+        m2.finish("consensus_reached")
+        data = json.loads((tmp_path / "metrics.json").read_text())
+        assert [r["round"] for r in data["rounds"]] == [1, 2]
+        assert data["totals"]["turns"] == 2
+        assert data["outcome"] == "consensus_reached"
+
+    def test_unwritable_path_never_raises(self, tmp_path):
+        m = SessionMetrics(tmp_path / "nope" / "deeper")
+        m.record_turn("A", 1, 0.1)
+        m.write()  # directory missing — swallowed by design
+
+
+class TestDiscussionMetrics:
+    def test_metrics_json_written_by_discussion(self, project_root):
+        adapters = {
+            "fa": FakeAdapter("A", script=[scripted_response(9)] * 3),
+            "fb": FakeAdapter("B", script=[scripted_response(9)] * 3),
+        }
+        config = make_config([
+            KnightConfig(name="A", adapter="fa", priority=1),
+            KnightConfig(name="B", adapter="fb", priority=2),
+        ])
+        result = run_discussion("topic", config, adapters,
+                                str(project_root), read_source_code=False)
+        assert result.consensus
+        import pathlib
+        data = json.loads((pathlib.Path(result.session_path)
+                           / "metrics.json").read_text())
+        assert data["outcome"] == "consensus_reached"
+        assert data["totals"]["turns"] == 2
+        assert data["rounds"][0]["turns"][0]["wall_s"] >= 0
+        # fake adapters carry no engine stats
+        assert data["totals"]["engine_decode_tokens"] == 0
+
+    def test_metrics_with_batched_tpu_round(self, project_root):
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        from theroundtaible_tpu.engine import reset_engines
+
+        reset_engines()
+        try:
+            adapter = TpuLlmAdapter("rt", {
+                "model": "tiny-gemma", "max_seq_len": 256,
+                "sampling": {"max_new_tokens": 8}})
+            adapters = {"tpu-llm": adapter}
+            config = make_config(
+                [KnightConfig(name="A", adapter="tpu-llm", priority=1),
+                 KnightConfig(name="B", adapter="tpu-llm", priority=2)],
+                rules=RulesConfig(max_rounds=1, parallel_rounds=True))
+            result = run_discussion("topic", config, adapters,
+                                    str(project_root),
+                                    read_source_code=False)
+            import pathlib
+            data = json.loads((pathlib.Path(result.session_path)
+                               / "metrics.json").read_text())
+            assert data["totals"]["turns"] == 2
+            assert data["totals"]["engine_decode_tokens"] > 0
+            engine_turns = [t for r in data["rounds"] for t in r["turns"]
+                            if t["engine"]]
+            assert len(engine_turns) == 1  # attached once per group
+            assert engine_turns[0]["engine"]["model"] == "tiny-gemma"
+        finally:
+            reset_engines()

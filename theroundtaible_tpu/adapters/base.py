@@ -59,6 +59,12 @@ class BaseAdapter(ABC):
         """True when execute_round is a genuine batched dispatch."""
         return False
 
+    def last_stats(self) -> Optional[dict]:
+        """Engine-side numbers for the most recent execute/execute_round
+        (token counts, prefill/decode tok/s) — None for backends that
+        don't measure. Consumed by the session metrics (utils/metrics.py)."""
+        return None
+
     def execute_round(self, turns: list[KnightTurn],
                       timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
         """Execute N same-round prompts. Default: serial loop over execute().

@@ -32,6 +32,7 @@ class TpuLlmAdapter(BaseAdapter):
         self.default_timeout = timeout_ms
         self._engine = None
         self._engine_error: Optional[str] = None
+        self._last_stats: Optional[dict] = None
 
     @classmethod
     def from_config(cls, adapter_id: str, cfg: dict[str, Any],
@@ -76,13 +77,8 @@ class TpuLlmAdapter(BaseAdapter):
         return int(available * engine.chars_per_token())
 
     def execute(self, prompt: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
-        engine = self._get_engine()
-        try:
-            return engine.generate(prompt, slot_name=self.name,
-                                   timeout_s=(timeout_ms or
-                                              self.default_timeout) / 1000)
-        except Exception as e:  # noqa: BLE001
-            raise AdapterError(str(e), kind=classify_error(e), cause=e)
+        return self.execute_round(
+            [KnightTurn(knight_name=self.name, prompt=prompt)], timeout_ms)[0]
 
     def supports_batched_rounds(self) -> bool:
         return True
@@ -91,9 +87,26 @@ class TpuLlmAdapter(BaseAdapter):
                       timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
         """One batched forward pass over N persistent per-knight KV slots."""
         engine = self._get_engine()
+        self._last_stats = None  # a failed call must not leave stale stats
         try:
-            return engine.generate_batch(
+            responses, stats = engine.generate_batch_with_stats(
                 [(t.knight_name, t.prompt) for t in turns],
                 timeout_s=(timeout_ms or self.default_timeout) / 1000)
         except Exception as e:  # noqa: BLE001
             raise AdapterError(str(e), kind=classify_error(e), cause=e)
+        # per-call snapshot, NOT engine.last_stats — adapters sharing one
+        # cached engine would otherwise read each other's numbers
+        self._last_stats = {
+            "model": engine.cfg.name,
+            "prefill_tokens": stats.prefill_tokens,
+            "reused_tokens": stats.reused_tokens,
+            "decode_tokens": stats.decode_tokens,
+            "prefill_seconds": round(stats.prefill_seconds, 3),
+            "decode_seconds": round(stats.decode_seconds, 3),
+            "prefill_tps": round(stats.prefill_tps, 1),
+            "decode_tps": round(stats.decode_tps, 1),
+        }
+        return responses
+
+    def last_stats(self) -> Optional[dict]:
+        return self._last_stats

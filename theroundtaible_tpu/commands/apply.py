@@ -181,7 +181,7 @@ def apply_command(noparley: bool = False, dry_run: bool = False,
     timeout_ms = config.rules.timeout_per_turn_seconds * 1000
 
     from .reporter import ConsoleReporter
-    response = execute_with_fallback(
+    response, _served_by = execute_with_fallback(
         adapter, lead, config, ctx.prompt, timeout_ms, adapters,
         ConsoleReporter())
 

@@ -138,7 +138,7 @@ def code_red_command(description: str,
             update_status(session_path, phase="diagnosing",
                           current_knight=knight.name, round=round_num)
             try:
-                response = execute_with_fallback(
+                response, _served_by = execute_with_fallback(
                     adapter, knight, config, prompt, timeout_ms,
                     adapters, reporter)
             except Exception as e:

@@ -152,3 +152,20 @@ class ConsoleReporter(Reporter):
         print(style.dim(
             "  Tip: narrow the scope with ignore patterns in "
             ".roundtable/config.json, or seat knights with bigger context.\n"))
+
+    def round_footer(self, round_metric) -> None:
+        """Per-round timing + engine throughput (SURVEY.md §5.1 — the
+        tok/s surfaced where the reference had only spinner theater)."""
+        from ..utils.metrics import aggregate_engine_stats
+        agg = aggregate_engine_stats(round_metric.turns)
+        line = f"\n  ⏱  Round {round_metric.round}: {round_metric.wall_s:.1f}s"
+        if agg["prefill_tokens"] or agg["decode_tokens"]:
+            total_in = agg["prefill_tokens"] + agg["reused_tokens"]
+            pct = (round(100 * agg["reused_tokens"] / total_in)
+                   if total_in else 0)
+            tps = (f" @ {agg['decode_tps']:.0f} tok/s"
+                   if agg["decode_seconds"] else "")
+            line += (f" · prefill {agg['prefill_tokens']} tok "
+                     f"({pct}% cache reuse)"
+                     f" · decode {agg['decode_tokens']} tok{tps}")
+        print(style.dim(line))

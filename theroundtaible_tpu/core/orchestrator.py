@@ -22,6 +22,7 @@ same-round earlier turns. Default stays the reference's sequential semantics.
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from pathlib import Path
@@ -95,6 +96,7 @@ class Reporter:
     def escalation_warning(self, round_num: int, rounds_left: int) -> None: ...
     def escalated(self, blocks: list[ConsensusBlock]) -> None: ...
     def overflow_warning(self, skipped: int, max_chars: int) -> None: ...
+    def round_footer(self, round_metric) -> None: ...
 
 
 def shuffle_order(knights: list[KnightConfig],
@@ -108,11 +110,12 @@ def execute_with_fallback(
     primary: BaseAdapter, knight: KnightConfig, config: RoundtableConfig,
     prompt: str, timeout_ms: int, adapters: dict[str, BaseAdapter],
     reporter: Reporter,
-) -> str:
+) -> tuple[str, BaseAdapter]:
     """Primary execute; on failure lazily create + cache the knight's
-    configured fallback adapter and retry once (reference :45-73)."""
+    configured fallback adapter and retry once (reference :45-73).
+    Returns (response, the adapter that actually served it)."""
     try:
-        return primary.execute(prompt, timeout_ms)
+        return primary.execute(prompt, timeout_ms), primary
     except Exception as primary_error:
         if not knight.fallback:
             raise
@@ -126,7 +129,7 @@ def execute_with_fallback(
         if fallback is None:
             raise primary_error
         reporter.fallback_engaged(knight.name, knight.fallback)
-        return fallback.execute(prompt, timeout_ms)
+        return fallback.execute(prompt, timeout_ms), fallback
 
 
 def select_lead_knight(knights: list[KnightConfig],
@@ -246,6 +249,7 @@ class _RunState:
     latest_blocks: dict[str, ConsensusBlock]
     resolved_files: str = ""
     resolved_commands: str = ""
+    metrics: object = None  # SessionMetrics (utils/metrics.py)
 
 
 def run_discussion(
@@ -307,34 +311,45 @@ def run_discussion(
     end_round = start_round + rules.max_rounds - 1
     king_demand = KING_DEMAND if continue_from else ""
 
-    for round_num in range(start_round, end_round + 1):
-        is_first = round_num == start_round and not continue_from
-        round_order = (sorted_knights if is_first
-                       else shuffle_order(sorted_knights, rng))
-        reporter.round_started(round_num, [k.name for k in round_order],
-                               shuffled=not is_first)
+    from ..utils.metrics import SessionMetrics, maybe_profile
+    state.metrics = SessionMetrics(session_path)
 
-        _run_round_turns(
-            round_order, round_num, topic, config, adapters, project_root,
-            session_path, context, manifest_summary, decrees_context,
-            king_demand, state, timeout_ms, reporter)
+    with maybe_profile(session_path):
+        for round_num in range(start_round, end_round + 1):
+            is_first = round_num == start_round and not continue_from
+            round_order = (sorted_knights if is_first
+                           else shuffle_order(sorted_knights, rng))
+            reporter.round_started(round_num, [k.name for k in round_order],
+                                   shuffled=not is_first)
 
-        write_discussion(session_path, state.all_rounds)
-        current_blocks = list(state.latest_blocks.values())
+            state.metrics.start_round(round_num)
+            _run_round_turns(
+                round_order, round_num, topic, config, adapters,
+                project_root, session_path, context, manifest_summary,
+                decrees_context, king_demand, state, timeout_ms, reporter)
+            state.metrics.end_round()
+            if state.metrics.rounds:
+                reporter.round_footer(state.metrics.rounds[-1])
 
-        if check_consensus(current_blocks, threshold):
-            return _finish_consensus(
-                topic, config, project_root, session_path, round_num,
-                current_blocks, state, reporter)
+            write_discussion(session_path, state.all_rounds)
+            current_blocks = list(state.latest_blocks.values())
 
-        if check_negative_consensus(current_blocks):
-            return _finish_rejection(
-                topic, config, project_root, session_path, round_num,
-                current_blocks, state, reporter)
+            if check_consensus(current_blocks, threshold):
+                state.metrics.finish("consensus_reached")
+                return _finish_consensus(
+                    topic, config, project_root, session_path, round_num,
+                    current_blocks, state, reporter)
 
-        if rules.escalate_to_user_after <= round_num < end_round:
-            reporter.escalation_warning(round_num, end_round - round_num)
+            if check_negative_consensus(current_blocks):
+                state.metrics.finish("unanimous_rejection")
+                return _finish_rejection(
+                    topic, config, project_root, session_path, round_num,
+                    current_blocks, state, reporter)
 
+            if rules.escalate_to_user_after <= round_num < end_round:
+                reporter.escalation_warning(round_num, end_round - round_num)
+
+    state.metrics.finish("escalated")
     reporter.escalated(list(state.latest_blocks.values()))
     update_status(session_path, phase="escalated", consensus_reached=False,
                   round=end_round)
@@ -414,12 +429,13 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
 
         def run_group(job):
             adapter, knights, turns = job
+            t0 = time.monotonic()
             responses = adapter.execute_round(turns, timeout_ms)
             if len(responses) != len(turns):
                 raise RuntimeError(
                     f"batched round returned {len(responses)} responses "
                     f"for {len(turns)} turns")
-            return responses
+            return responses, time.monotonic() - t0, adapter.last_stats()
 
         if len(jobs) == 1:
             results = [_try(run_group, jobs[0])]
@@ -430,14 +446,25 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
 
         # Record in round order regardless of completion order.
         response_by_knight = {}
-        for (adapter, knights, _turns), outcome in zip(jobs, results):
+        for (adapter, knights, turns), outcome in zip(jobs, results):
             if isinstance(outcome, Exception):
                 kind = classify_error(outcome)
                 for k in knights:
                     reporter.knight_failed(k.name, kind, str(outcome),
                                            hint_for_kind(kind))
                 continue
-            for k, resp in zip(knights, outcome):
+            responses, group_wall, engine_stats = outcome
+            if state.metrics:
+                # one batched program served the whole group: group wall
+                # for every knight, engine numbers attached once (to the
+                # first knight) so totals don't multiply
+                for i, (k, t, resp) in enumerate(
+                        zip(knights, turns, responses)):
+                    state.metrics.record_turn(
+                        k.name, round_num, group_wall,
+                        chars_in=len(t.prompt), chars_out=len(resp),
+                        engine=engine_stats if i == 0 else None)
+            for k, resp in zip(knights, responses):
                 response_by_knight[k.name] = (resp, adapter)
         for knight in round_order:
             if knight.name in response_by_knight:
@@ -456,8 +483,9 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
             knight, config, topic, context, manifest_summary,
             decrees_context, king_demand, state)
         stop_thinking = reporter.knight_thinking(knight.name)
+        t0 = time.monotonic()
         try:
-            response = execute_with_fallback(
+            response, served_by = execute_with_fallback(
                 adapter, knight, config, prompt, timeout_ms, adapters,
                 reporter)
         except Exception as error:  # noqa: BLE001 — turn-level containment
@@ -467,7 +495,12 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
                                    hint_for_kind(kind))
             continue
         stop_thinking()
-        _record_turn(knight, round_num, response, adapter, config,
+        if state.metrics:
+            state.metrics.record_turn(
+                knight.name, round_num, time.monotonic() - t0,
+                chars_in=len(prompt), chars_out=len(response),
+                engine=served_by.last_stats())
+        _record_turn(knight, round_num, response, served_by, config,
                      project_root, state, reporter)
 
 

@@ -16,6 +16,7 @@ XLA discipline:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -112,6 +113,10 @@ class InferenceEngine:
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
         self.last_stats = GenStats()
+        # Serving mutates the slot cache (donated buffers): one generation
+        # at a time per engine. Distinct engines (fleet submeshes) still
+        # run concurrently — each has its own lock.
+        self._serve_lock = threading.Lock()
 
         # Sequence-parallel long-context prefill (SURVEY.md §7 Phase 6):
         # ring attention (or Ulysses) over a ("seq",) mesh for fresh long
@@ -433,7 +438,23 @@ class InferenceEngine:
     def generate_batch(self, turns: list[tuple[str, str]],
                        max_new_tokens: Optional[int] = None,
                        timeout_s: float = 600.0) -> list[str]:
-        """Serve N (slot_name, prompt) turns as one batched program pair."""
+        return self.generate_batch_with_stats(
+            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s)[0]
+
+    def generate_batch_with_stats(
+            self, turns: list[tuple[str, str]],
+            max_new_tokens: Optional[int] = None,
+            timeout_s: float = 600.0) -> tuple[list[str], GenStats]:
+        """Serve N (slot_name, prompt) turns as one batched program pair.
+
+        Returns (responses, this call's stats) — callers needing stats must
+        take them from the return value, not from `last_stats`, which is a
+        convenience field that concurrent callers may overwrite."""
+        with self._serve_lock:
+            return self._generate_batch_locked(turns, max_new_tokens,
+                                               timeout_s)
+
+    def _generate_batch_locked(self, turns, max_new_tokens, timeout_s):
         stats = GenStats()
         deadline = time.monotonic() + timeout_s
         max_new = max_new_tokens or self.sampling.max_new_tokens
@@ -529,7 +550,7 @@ class InferenceEngine:
             self.kv.commit(name, all_tokens[i] + fed)
             results.append(self.tokenizer.decode(ids))
         self.last_stats = stats
-        return results
+        return results, stats
 
     # --- introspection ---
 

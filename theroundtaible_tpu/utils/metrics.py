@@ -1,0 +1,175 @@
+"""Per-session metrics + optional device profiling.
+
+The reference has no metrics at all — console chalk output only
+(SURVEY.md §5.1/§5.5: "no structured logs, no metrics files"). This module
+adds the quantities BASELINE.md measures: per-round wall-clock, per-knight
+turn latency, and the engine's token counts and prefill/decode throughput,
+written crash-safe to `<session>/metrics.json` after every round.
+
+Profiling: set ROUNDTABLE_PROFILE=1 (trace into `<session>/profile/`) or
+ROUNDTABLE_PROFILE=/some/dir to capture a jax.profiler device+host trace of
+the whole discussion, viewable in XProf/Perfetto (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+
+@dataclass
+class TurnMetric:
+    knight: str
+    round: int
+    wall_s: float
+    chars_in: int = 0
+    chars_out: int = 0
+    # engine-side numbers, present only for tpu-llm turns
+    engine: Optional[dict[str, Any]] = None
+
+
+@dataclass
+class RoundMetric:
+    round: int
+    wall_s: float = 0.0
+    turns: list[TurnMetric] = field(default_factory=list)
+
+
+class SessionMetrics:
+    """Collects and persists metrics.json; every mutation rewrites the file
+    (same crash-safety stance as status.json, reference session.ts:120-149).
+    """
+
+    def __init__(self, session_path: str | Path):
+        self.path = Path(session_path) / "metrics.json"
+        self.rounds: list[RoundMetric] = []
+        self.outcome: Optional[str] = None
+        self._started = time.monotonic()
+        self._round_started = self._started
+        self._prior_wall = 0.0
+        self._load_existing()
+
+    def _load_existing(self) -> None:
+        """A resumed session ("King sends back", ContinueOptions) reuses the
+        session dir — earlier rounds' metrics must survive the rewrite."""
+        try:
+            data = json.loads(self.path.read_text(encoding="utf-8"))
+            for r in data.get("rounds", []):
+                self.rounds.append(RoundMetric(
+                    round=r["round"], wall_s=r.get("wall_s", 0.0),
+                    turns=[TurnMetric(**t) for t in r.get("turns", [])]))
+            self._prior_wall = data.get("totals", {}).get("wall_s", 0.0)
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+
+    # --- recording ---
+
+    def start_round(self, round_num: int) -> None:
+        self.rounds.append(RoundMetric(round=round_num))
+        self._round_started = time.monotonic()
+
+    def record_turn(self, knight: str, round_num: int, wall_s: float,
+                    chars_in: int = 0, chars_out: int = 0,
+                    engine: Optional[dict[str, Any]] = None) -> None:
+        if not self.rounds or self.rounds[-1].round != round_num:
+            self.start_round(round_num)
+        self.rounds[-1].turns.append(TurnMetric(
+            knight=knight, round=round_num, wall_s=round(wall_s, 3),
+            chars_in=chars_in, chars_out=chars_out, engine=engine))
+
+    def end_round(self) -> None:
+        if self.rounds:
+            self.rounds[-1].wall_s = round(
+                time.monotonic() - self._round_started, 3)
+        self.write()
+
+    def finish(self, outcome: str) -> None:
+        self.outcome = outcome
+        self.write()
+
+    # --- aggregation ---
+
+    def totals(self) -> dict[str, Any]:
+        agg = aggregate_engine_stats(
+            t for r in self.rounds for t in r.turns)
+        chars_in = sum(t.chars_in for r in self.rounds for t in r.turns)
+        chars_out = sum(t.chars_out for r in self.rounds for t in r.turns)
+        return {
+            "wall_s": round(
+                self._prior_wall + time.monotonic() - self._started, 3),
+            "rounds": len(self.rounds),
+            "turns": sum(len(r.turns) for r in self.rounds),
+            "chars_in": chars_in,
+            "chars_out": chars_out,
+            "engine_prefill_tokens": agg["prefill_tokens"],
+            "engine_reused_tokens": agg["reused_tokens"],
+            "engine_decode_tokens": agg["decode_tokens"],
+            "engine_decode_tps": agg["decode_tps"],
+        }
+
+    def write(self) -> None:
+        payload = {
+            "outcome": self.outcome,
+            "totals": self.totals(),
+            "rounds": [asdict(r) for r in self.rounds],
+        }
+        try:
+            self.path.write_text(json.dumps(payload, indent=2),
+                                 encoding="utf-8")
+        except OSError:
+            pass  # metrics must never kill a discussion
+
+
+def aggregate_engine_stats(turns) -> dict[str, Any]:
+    """Sum engine-side numbers over TurnMetrics (shared by totals() and the
+    console round footer so the two can't drift)."""
+    prefill = reused = decode = 0
+    decode_time = 0.0
+    for t in turns:
+        if t.engine:
+            prefill += t.engine.get("prefill_tokens", 0)
+            reused += t.engine.get("reused_tokens", 0)
+            decode += t.engine.get("decode_tokens", 0)
+            decode_time += t.engine.get("decode_seconds", 0.0)
+    return {
+        "prefill_tokens": prefill,
+        "reused_tokens": reused,
+        "decode_tokens": decode,
+        "decode_seconds": decode_time,
+        "decode_tps": round(decode / decode_time, 2) if decode_time else 0.0,
+    }
+
+
+@contextmanager
+def maybe_profile(session_path: str | Path):
+    """jax.profiler trace of the block when ROUNDTABLE_PROFILE is set.
+
+    Profiling must never kill a discussion: a missing jax install or a
+    failed start_trace degrades to a warning + no trace.
+    """
+    target = os.environ.get("ROUNDTABLE_PROFILE")
+    if not target:
+        yield
+        return
+    trace_dir = (Path(session_path) / "profile" if target == "1"
+                 else Path(target))
+    profiler = None
+    try:
+        import jax
+        jax.profiler.start_trace(str(trace_dir))
+        profiler = jax
+    except Exception as e:  # noqa: BLE001 — opt-in feature, degrade loudly
+        print(f"  (ROUNDTABLE_PROFILE set but tracing unavailable: {e})")
+    try:
+        yield
+    finally:
+        if profiler is not None:
+            try:
+                profiler.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
