@@ -95,6 +95,27 @@ def load_hf_checkpoint(ckpt_dir: str | Path, cfg: ModelConfig,
                 key = parts[4]
                 if key in placers:
                     layers[idx][key] = placers[key](tensor)
+            elif parts[3] == "block_sparse_moe" and cfg.num_experts:
+                # Mixtral MoE: gate.weight [X, E] router; experts.M.w1/w3
+                # [F, E] (gate/up), w2 [E, F] (down). Ours stacks experts
+                # leading: gate/up [X, E, F], down [X, F, E].
+                layer = layers[idx]
+                if parts[4] == "gate":
+                    layer["router"] = as_jnp(tensor.T)        # [E, X]
+                elif parts[4] == "experts":
+                    xi, wname = int(parts[5]), parts[6]
+                    if xi >= cfg.num_experts:
+                        raise ValueError(
+                            f"Checkpoint has expert index {xi} but config "
+                            f"{cfg.name} expects {cfg.num_experts} experts "
+                            f"— config/checkpoint mismatch")
+                    experts = layer.setdefault("experts", {})
+                    tgt = {"w1": "gate_proj", "w3": "up_proj",
+                           "w2": "down_proj"}.get(wname)
+                    if tgt:
+                        stack = experts.setdefault(
+                            tgt, [None] * cfg.num_experts)
+                        stack[xi] = as_jnp(tensor.T)
             elif parts[3] == "input_layernorm":
                 layers[idx]["input_norm"] = as_jnp(tensor)
             elif parts[3] == "post_attention_layernorm":
@@ -104,6 +125,14 @@ def load_hf_checkpoint(ckpt_dir: str | Path, cfg: ModelConfig,
             elif parts[3] == "post_feedforward_layernorm":
                 layers[idx]["post_mlp_norm"] = as_jnp(tensor)
 
+    if cfg.num_experts:
+        for layer in layers:
+            experts = layer.get("experts")
+            if experts:
+                for key, stack in experts.items():
+                    if isinstance(stack, list) and all(
+                            s is not None for s in stack):
+                        experts[key] = jnp.stack(stack)
     _validate_loaded(params, cfg)
     return params
 
@@ -116,12 +145,24 @@ def _validate_loaded(params: Params, cfg: ModelConfig) -> None:
         missing.append("final_norm")
     if not cfg.tie_embeddings and "lm_head" not in params:
         missing.append("lm_head")
-    required = {"q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
-                "up_proj", "down_proj", "input_norm", "pre_mlp_norm"}
+    required = {"q_proj", "k_proj", "v_proj", "o_proj", "input_norm",
+                "pre_mlp_norm"}
+    required |= ({"router", "experts"} if cfg.num_experts
+                 else {"gate_proj", "up_proj", "down_proj"})
     for i, layer in enumerate(params["layers"]):
         lacking = required - set(layer)
         if lacking:
             missing.append(f"layer{i}:{','.join(sorted(lacking))}")
+        experts = layer.get("experts")
+        if cfg.num_experts and isinstance(experts, dict):
+            for key in ("gate_proj", "up_proj", "down_proj"):
+                stack = experts.get(key)
+                if stack is None:
+                    missing.append(f"layer{i}:experts.{key}")
+                elif isinstance(stack, list):
+                    holes = [j for j, s in enumerate(stack) if s is None]
+                    if holes:
+                        missing.append(f"layer{i}:experts.{key}[{holes[:4]}]")
     if missing:
         raise ValueError(f"Checkpoint incomplete, missing: {missing[:8]}")
 

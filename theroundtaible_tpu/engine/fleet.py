@@ -27,7 +27,10 @@ def estimate_param_count(cfg: ModelConfig) -> int:
     """Closed-form parameter count (no arrays built)."""
     e, h, k, d, f = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
                      cfg.head_dim, cfg.mlp_dim)
-    per_layer = 2 * e * h * d + 2 * e * k * d + 3 * e * f + 2 * e
+    mlp = 3 * e * f
+    if cfg.num_experts:
+        mlp = cfg.num_experts * 3 * e * f + e * cfg.num_experts  # + router
+    per_layer = 2 * e * h * d + 2 * e * k * d + mlp + 2 * e
     total = cfg.num_layers * per_layer + cfg.vocab_size * e + e
     if not cfg.tie_embeddings:
         total += cfg.vocab_size * e

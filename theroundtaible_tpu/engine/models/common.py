@@ -59,6 +59,9 @@ class ModelConfig:
     sliding_window: Optional[int] = None         # Mistral: 4096
     query_pre_attn_scalar: Optional[float] = None  # Gemma: head_dim**-0.5 default
     tie_embeddings: bool = True       # output head = embedding table
+    # MoE (Mixtral): None = dense MLP; X experts, top-k routed
+    num_experts: Optional[int] = None
+    num_experts_per_tok: int = 2
     # runtime implementation choice, not architecture: "dense" = XLA einsum
     # attention; "flash" = Pallas blockwise kernels (engine/pallas/) that
     # stream KV through VMEM and skip blocks beyond each row's valid length
@@ -196,12 +199,52 @@ def attention(
 
 
 def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+    if cfg.num_experts:
+        return moe_mlp(x, layer, cfg)
     gate = _einsum("bte,ef->btf", x, layer["gate_proj"])
     up = _einsum("bte,ef->btf", x, layer["up_proj"])
     act = jax.nn.gelu(gate, approximate=True) if cfg.gelu_mlp \
         else jax.nn.silu(gate)
     hidden = (act * up).astype(x.dtype)
     return _einsum("btf,fe->bte", hidden, layer["down_proj"]).astype(x.dtype)
+
+
+def moe_mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+    """Mixtral-style sparse MoE, computed expert-dense for SPMD.
+
+    Router picks top-k experts per token (softmax over the top-k logits,
+    Mixtral semantics); the expert matmuls run batched over a leading
+    expert axis and combine under the routing weights in one contraction.
+    Compute-dense-combine-sparse is the EP-friendly layout: the expert
+    axis shards on the mesh's "model" axis (sharding.param_specs), every
+    device runs its local experts for all tokens, and the combining
+    einsum's contraction over the sharded axis becomes one XLA all-reduce
+    over ICI — no ragged per-expert token dispatch, fully static shapes.
+    (A top-k gather path saves FLOPs at large batch; tracked as a future
+    kernel.)
+    """
+    experts = layer["experts"]
+    x_dim = cfg.num_experts
+    k = cfg.num_experts_per_tok
+
+    router_logits = _einsum("bte,ex->btx", x, layer["router"])   # f32
+    top_vals, top_idx = jax.lax.top_k(router_logits, k)          # [B,T,k]
+    gates = jax.nn.softmax(top_vals, axis=-1)                    # Mixtral
+    # dense routing weights [B,T,X]: sum of gate * one_hot(expert)
+    weights = jnp.sum(
+        jax.nn.one_hot(top_idx, x_dim, dtype=jnp.float32)
+        * gates[..., None], axis=-2)
+
+    gate_h = _einsum("bte,xef->btxf", x, experts["gate_proj"])
+    up_h = _einsum("bte,xef->btxf", x, experts["up_proj"])
+    act = jax.nn.gelu(gate_h, approximate=True) if cfg.gelu_mlp \
+        else jax.nn.silu(gate_h)
+    # routing weights fold into the hidden activations elementwise, so the
+    # final contraction (sharded expert axis → one all-reduce) is a plain
+    # two-operand matmul
+    hidden = (act * up_h * weights[..., None]).astype(x.dtype)
+    out = _einsum("btxf,xfe->bte", hidden, experts["down_proj"])
+    return out.astype(x.dtype)
 
 
 def transformer_block(
@@ -300,20 +343,31 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     e, h, k_, d, f = (cfg.embed_dim, cfg.num_heads, cfg.num_kv_heads,
                       cfg.head_dim, cfg.mlp_dim)
     for lk in layer_keys:
-        ks = jax.random.split(lk, 7)
+        ks = jax.random.split(lk, 8)
         layer = {
             "q_proj": dense(ks[0], (e, h, d), e),
             "k_proj": dense(ks[1], (e, k_, d), e),
             "v_proj": dense(ks[2], (e, k_, d), e),
             "o_proj": dense(ks[3], (h, d, e), h * d),
-            "gate_proj": dense(ks[4], (e, f), e),
-            "up_proj": dense(ks[5], (e, f), e),
-            "down_proj": dense(ks[6], (f, e), f),
             "input_norm": jnp.zeros((e,), dtype) if cfg.rmsnorm_unit_offset
             else jnp.ones((e,), dtype),
             "pre_mlp_norm": jnp.zeros((e,), dtype) if cfg.rmsnorm_unit_offset
             else jnp.ones((e,), dtype),
         }
+        if cfg.num_experts:
+            x_ = cfg.num_experts
+            layer["router"] = dense(ks[7], (e, x_), e)
+            layer["experts"] = {
+                "gate_proj": dense(ks[4], (x_, e, f), e),
+                "up_proj": dense(ks[5], (x_, e, f), e),
+                "down_proj": dense(ks[6], (x_, f, e), f),
+            }
+        else:
+            layer.update({
+                "gate_proj": dense(ks[4], (e, f), e),
+                "up_proj": dense(ks[5], (e, f), e),
+                "down_proj": dense(ks[6], (f, e), f),
+            })
         if cfg.post_attn_norm:
             layer["post_attn_norm"] = layer["input_norm"]
         if cfg.post_mlp_norm:

@@ -46,6 +46,15 @@ MISTRAL_7B = register(ModelConfig(
     mlp_dim=14_336, max_seq_len=8192, rope_theta=1_000_000.0,
     norm_eps=1e-5, sliding_window=4096, tie_embeddings=False))
 
+# --- Mixtral (SiLU, GQA, sparse MoE, sliding window in v0.1 only) ---
+
+MIXTRAL_8X7B = register(ModelConfig(
+    name="mixtral-8x7b-instruct", vocab_size=32_000, num_layers=32,
+    embed_dim=4096, num_heads=32, num_kv_heads=8, head_dim=128,
+    mlp_dim=14_336, max_seq_len=8192, rope_theta=1_000_000.0,
+    norm_eps=1e-5, tie_embeddings=False,
+    num_experts=8, num_experts_per_tok=2))
+
 # --- tiny presets: CPU tests, sharding dry-runs, CI ---
 
 TINY_GEMMA = register(ModelConfig(
@@ -63,6 +72,12 @@ TINY_MISTRAL = register(ModelConfig(
     name="tiny-mistral", vocab_size=512, num_layers=2, embed_dim=64,
     num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
     max_seq_len=512, sliding_window=64, tie_embeddings=False))
+
+TINY_MIXTRAL = register(ModelConfig(
+    name="tiny-mixtral", vocab_size=512, num_layers=2, embed_dim=64,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=512, tie_embeddings=False,
+    num_experts=4, num_experts_per_tok=2))
 
 
 def get_model_config(name: str, **overrides) -> ModelConfig:

@@ -62,12 +62,25 @@ def param_specs(cfg: ModelConfig) -> Params:
         "k_proj": P(None, MODEL_AXIS, None),    # [E, K, D]
         "v_proj": P(None, MODEL_AXIS, None),
         "o_proj": P(MODEL_AXIS, None, None),    # [H, D, E] contract sharded
-        "gate_proj": P(None, MODEL_AXIS),       # [E, F]
-        "up_proj": P(None, MODEL_AXIS),
-        "down_proj": P(MODEL_AXIS, None),       # [F, E]
         "input_norm": P(None),
         "pre_mlp_norm": P(None),
     }
+    if cfg.num_experts:
+        # EP: experts ride the model axis — each device computes its local
+        # experts for all tokens; the combine contraction over the sharded
+        # expert axis becomes one all-reduce (models/common.py moe_mlp)
+        layer["router"] = P(None, None)
+        layer["experts"] = {
+            "gate_proj": P(MODEL_AXIS, None, None),   # [X, E, F]
+            "up_proj": P(MODEL_AXIS, None, None),
+            "down_proj": P(MODEL_AXIS, None, None),   # [X, F, E]
+        }
+    else:
+        layer.update({
+            "gate_proj": P(None, MODEL_AXIS),   # [E, F]
+            "up_proj": P(None, MODEL_AXIS),
+            "down_proj": P(MODEL_AXIS, None),   # [F, E]
+        })
     if cfg.post_attn_norm:
         layer["post_attn_norm"] = P(None)
     if cfg.post_mlp_norm:
@@ -88,10 +101,12 @@ def kv_cache_spec() -> P:
 
 
 def shardable(cfg: ModelConfig, mesh: Mesh) -> bool:
-    """True when every TP dimension divides by the model-axis size."""
+    """True when every TP/EP dimension divides by the model-axis size."""
     m = mesh.shape[MODEL_AXIS]
+    mlp_ok = (cfg.num_experts % m == 0 if cfg.num_experts
+              else cfg.mlp_dim % m == 0)
     return (cfg.num_heads % m == 0 and cfg.num_kv_heads % m == 0
-            and cfg.mlp_dim % m == 0 and cfg.vocab_size % m == 0)
+            and mlp_ok and cfg.vocab_size % m == 0)
 
 
 def _fallback_replicated(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:

@@ -119,9 +119,10 @@ class SessionMetrics:
             "rounds": [asdict(r) for r in self.rounds],
         }
         try:
-            self.path.write_text(json.dumps(payload, indent=2),
-                                 encoding="utf-8")
-        except OSError:
+            self.path.write_text(
+                json.dumps(payload, indent=2, default=str),
+                encoding="utf-8")
+        except (OSError, TypeError, ValueError):
             pass  # metrics must never kill a discussion
 
 
