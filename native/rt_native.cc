@@ -1,0 +1,215 @@
+// Native runtime components for theroundtaible_tpu.
+//
+// The reference's operational system leans on llama.cpp's C++ for its local
+// compute, including its GGUF weight loader (reference src/adapters/
+// local-llm.ts reaches it over HTTP; SURVEY.md §2.3). The TPU build's
+// compute is XLA, but the host-side runtime around it is native here:
+//
+//   st_convert  — checkpoint data-loader: mmap'd safetensors payload,
+//                 multithreaded dtype conversion (bf16/f16 → f32) straight
+//                 into caller-owned numpy buffers. Python parses the tiny
+//                 JSON header; this does the gigabytes.
+//   rt_lcp      — KV slot allocator primitive: longest common token prefix
+//                 between a cached slot and an incoming prompt (the
+//                 delta-prefill decision, engine/kvcache.py).
+//
+// Built as a plain shared library; bound via ctypes (no pybind11 in the
+// image). Every entry point is C ABI.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Matches theroundtaible_tpu/native/loader.py
+enum DType : int32_t {
+  DT_F32 = 0,
+  DT_F16 = 1,
+  DT_BF16 = 2,
+  DT_F64 = 3,
+  DT_I64 = 4,
+  DT_I32 = 5,
+  DT_U8 = 6,
+  DT_I8 = 7,
+};
+
+struct TensorJob {
+  uint64_t src_offset;  // byte offset of tensor data within the file
+  uint64_t n_elems;
+  int32_t src_dtype;
+  int32_t pad;
+  void* dst;  // caller-owned f32 (or i64/i32 passthrough) buffer
+};
+
+static inline float bf16_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+static inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t mant = h & 0x3FFu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;  // ±0
+    } else {        // subnormal: normalize. mant MSB at bit p gives
+      int shift = 0;  // value (1.f)·2^(p-24) → biased f32 exp 103+p
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FFu;
+      bits = sign | ((127 - 15 + 1 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1F) {
+    bits = sign | 0x7F800000u | (mant << 13);  // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+static void convert_range(const uint8_t* src, int32_t src_dtype, float* dst,
+                          uint64_t begin, uint64_t end) {
+  switch (src_dtype) {
+    case DT_F32:
+      std::memcpy(dst + begin, src + begin * 4, (end - begin) * 4);
+      break;
+    case DT_BF16: {
+      const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+      for (uint64_t i = begin; i < end; ++i) dst[i] = bf16_to_f32(s[i]);
+      break;
+    }
+    case DT_F16: {
+      const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+      for (uint64_t i = begin; i < end; ++i) dst[i] = f16_to_f32(s[i]);
+      break;
+    }
+    case DT_F64: {
+      const double* s = reinterpret_cast<const double*>(src);
+      for (uint64_t i = begin; i < end; ++i)
+        dst[i] = static_cast<float>(s[i]);
+      break;
+    }
+    case DT_I64: {
+      const int64_t* s = reinterpret_cast<const int64_t*>(src);
+      for (uint64_t i = begin; i < end; ++i)
+        dst[i] = static_cast<float>(s[i]);
+      break;
+    }
+    case DT_I32: {
+      const int32_t* s = reinterpret_cast<const int32_t*>(src);
+      for (uint64_t i = begin; i < end; ++i)
+        dst[i] = static_cast<float>(s[i]);
+      break;
+    }
+    case DT_U8: {
+      for (uint64_t i = begin; i < end; ++i)
+        dst[i] = static_cast<float>(src[i]);
+      break;
+    }
+    case DT_I8: {
+      const int8_t* s = reinterpret_cast<const int8_t*>(src);
+      for (uint64_t i = begin; i < end; ++i)
+        dst[i] = static_cast<float>(s[i]);
+      break;
+    }
+  }
+}
+
+// Convert n_jobs tensors from the mmap'd safetensors payload into the
+// caller's f32 buffers using n_threads workers. Large tensors are split
+// across workers in ~4M-element slices. Returns 0 on success, negative
+// errno-style codes on failure.
+int st_convert(const char* path, const TensorJob* jobs, int64_t n_jobs,
+               int32_t n_threads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -2;
+  }
+  size_t file_size = static_cast<size_t>(st.st_size);
+  void* base = mmap(nullptr, file_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return -3;
+  const uint8_t* data = static_cast<const uint8_t*>(base);
+
+  static const uint64_t kElemSize[] = {4, 2, 2, 8, 8, 4, 1, 1};
+
+  // Bounds-check every job before touching anything.
+  for (int64_t j = 0; j < n_jobs; ++j) {
+    const TensorJob& job = jobs[j];
+    if (job.src_dtype < 0 || job.src_dtype > DT_I8 ||
+        job.src_offset + job.n_elems * kElemSize[job.src_dtype] >
+            file_size) {
+      munmap(base, file_size);
+      return -4;
+    }
+  }
+
+  // Work queue: (job index, begin, end) slices.
+  struct Slice {
+    int64_t job;
+    uint64_t begin, end;
+  };
+  std::vector<Slice> slices;
+  const uint64_t kChunk = 4u << 20;  // elements per slice
+  for (int64_t j = 0; j < n_jobs; ++j) {
+    for (uint64_t b = 0; b < jobs[j].n_elems; b += kChunk) {
+      uint64_t e = b + kChunk < jobs[j].n_elems ? b + kChunk
+                                                : jobs[j].n_elems;
+      slices.push_back({j, b, e});
+    }
+  }
+
+  std::atomic<size_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= slices.size()) return;
+      const Slice& s = slices[i];
+      const TensorJob& job = jobs[s.job];
+      convert_range(data + job.src_offset, job.src_dtype,
+                    static_cast<float*>(job.dst), s.begin, s.end);
+    }
+  };
+
+  int nt = n_threads > 0 ? n_threads
+                         : static_cast<int>(
+                               std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (static_cast<size_t>(nt) > slices.size()) nt = slices.size();
+  std::vector<std::thread> threads;
+  for (int t = 1; t < nt; ++t) threads.emplace_back(worker);
+  worker();
+  for (auto& th : threads) th.join();
+
+  munmap(base, file_size);
+  return 0;
+}
+
+// Longest common prefix of two int32 token sequences.
+int64_t rt_lcp(const int32_t* a, int64_t n, const int32_t* b, int64_t m) {
+  int64_t limit = n < m ? n : m;
+  int64_t i = 0;
+  while (i < limit && a[i] == b[i]) ++i;
+  return i;
+}
+
+}  // extern "C"

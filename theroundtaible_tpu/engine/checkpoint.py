@@ -29,11 +29,20 @@ from .models.common import ModelConfig, Params
 
 
 def _iter_hf_tensors(ckpt_dir: Path) -> Iterator[tuple[str, np.ndarray]]:
-    """Yield (name, array) from safetensors or torch-bin shards."""
+    """Yield (name, array) from safetensors or torch-bin shards.
+
+    Safetensors shards go through the native mmap + multithreaded-convert
+    reader (native/rt_native.cc) when built; the pure-Python `safetensors`
+    package is the fallback."""
     st_files = sorted(ckpt_dir.glob("*.safetensors"))
     if st_files:
-        from safetensors import safe_open
+        from ..native.loader import iter_safetensors, native_can_read
         for f in st_files:
+            if native_can_read(f):
+                # streaming: one tensor's f32 copy resident at a time
+                yield from iter_safetensors(f)
+                continue
+            from safetensors import safe_open
             with safe_open(str(f), framework="np") as reader:
                 for name in reader.keys():
                     yield name, reader.get_tensor(name)

@@ -94,11 +94,9 @@ class KVCache:
 
     @staticmethod
     def common_prefix_len(cached: list[int], new: list[int]) -> int:
-        n = min(len(cached), len(new))
-        i = 0
-        while i < n and cached[i] == new[i]:
-            i += 1
-        return i
+        # native rt_lcp when built (falls back to a Python loop inside)
+        from ..native import lcp
+        return lcp(cached, new)
 
     def reuse_plan(self, name: str, tokens: list[int],
                    pinned: tuple[str, ...] = ()) -> tuple[int, int]:

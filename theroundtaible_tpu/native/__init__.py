@@ -1,0 +1,2 @@
+from .loader import (  # noqa: F401
+    lcp, native_available, read_safetensors)
