@@ -152,12 +152,18 @@ int st_convert(const char* path, const TensorJob* jobs, int64_t n_jobs,
 
   static const uint64_t kElemSize[] = {4, 2, 2, 8, 8, 4, 1, 1};
 
-  // Bounds-check every job before touching anything.
+  // Bounds-check every job before touching anything. Ordered so no
+  // intermediate can wrap uint64 (a hostile header with a huge/negative
+  // offset must fail here, not segfault in convert_range).
   for (int64_t j = 0; j < n_jobs; ++j) {
     const TensorJob& job = jobs[j];
-    if (job.src_dtype < 0 || job.src_dtype > DT_I8 ||
-        job.src_offset + job.n_elems * kElemSize[job.src_dtype] >
-            file_size) {
+    if (job.src_dtype < 0 || job.src_dtype > DT_I8) {
+      munmap(base, file_size);
+      return -4;
+    }
+    uint64_t elem = kElemSize[job.src_dtype];
+    if (job.n_elems > file_size / elem ||
+        job.src_offset > file_size - job.n_elems * elem) {
       munmap(base, file_size);
       return -4;
     }

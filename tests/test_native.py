@@ -112,9 +112,29 @@ class TestSafetensorsReader:
                 .astype(np.float32).T.reshape(e, h, d))
         np.testing.assert_allclose(got, want, atol=1e-6)
 
-    def test_missing_file_returns_none_gracefully(self, tmp_path):
-        from theroundtaible_tpu.native.loader import _get_lib
-        if _get_lib() is None:
-            pytest.skip("no lib")
+    def test_missing_file_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             read_safetensors(tmp_path / "absent.safetensors")
+
+    def test_shape_offsets_mismatch_rejected(self, tmp_path):
+        """A header whose data_offsets disagree with shape must fail
+        loudly, never silently read the neighbor tensor's bytes."""
+        import json as _json
+        import struct as _struct
+
+        from theroundtaible_tpu.native.loader import iter_safetensors
+
+        header = {"w": {"dtype": "F32", "shape": [16],
+                        "data_offsets": [0, 32]}}  # 16 f32 needs 64 bytes
+        raw = _json.dumps(header).encode()
+        blob = _struct.pack("<Q", len(raw)) + raw + b"\x00" * 64
+        p = tmp_path / "bad.safetensors"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError, match="disagree"):
+            list(iter_safetensors(p))
+
+    def test_truncated_file_falls_back_cleanly(self, tmp_path):
+        from theroundtaible_tpu.native.loader import native_can_read
+        p = tmp_path / "trunc.safetensors"
+        p.write_bytes(b"\x04")  # shorter than the 8-byte header length
+        assert native_can_read(p) is False

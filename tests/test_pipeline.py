@@ -1,0 +1,79 @@
+"""Pipeline-parallel prefill: GPipe schedule over the virtual pipe mesh
+must reproduce the plain forward pass exactly (SURVEY.md §2.3 PP row)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine.models.common import forward, init_params
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.pipeline import (
+    build_pipe_mesh, make_pp_prefill, stack_stage_params)
+
+
+def reference_logits(cfg, params, tokens, positions, valid):
+    logits, _ = forward(params, cfg, tokens, positions, None, None, valid)
+    return np.asarray(logits, np.float32)
+
+
+@pytest.mark.parametrize("model,n_stages,n_micro", [
+    ("tiny-llama", 2, 2),
+    ("tiny-llama", 2, 4),
+    ("tiny-gemma", 2, 2),       # scaled embeddings + tied head
+    ("tiny-mistral", 2, 2),     # sliding window inside stages
+])
+def test_pp_matches_dense_forward(model, n_stages, n_micro):
+    cfg = get_model_config(model, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    b, t = n_micro * 2, 16
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, (b, t)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    valid = jnp.full((b,), t, jnp.int32)
+
+    mesh = build_pipe_mesh(n_stages)
+    shared, staged = stack_stage_params(params, cfg, n_stages, mesh)
+    pp = make_pp_prefill(cfg, mesh, n_micro)
+    got = np.asarray(pp(shared, staged, tokens, positions, valid),
+                     np.float32)
+    want = reference_logits(cfg, params, tokens, positions, valid)
+    np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+
+def test_four_stage_pipeline():
+    cfg = get_model_config("tiny-llama", max_seq_len=64,
+                           num_layers=4)
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    b, t = 4, 8
+    tokens = jnp.ones((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    valid = jnp.full((b,), t, jnp.int32)
+
+    mesh = build_pipe_mesh(4)
+    shared, staged = stack_stage_params(params, cfg, 4, mesh)
+    pp = make_pp_prefill(cfg, mesh, n_micro=2)
+    got = np.asarray(pp(shared, staged, tokens, positions, valid))
+    want = reference_logits(cfg, params, tokens, positions, valid)
+    np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+
+def test_stage_params_actually_sharded():
+    cfg = get_model_config("tiny-llama")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    mesh = build_pipe_mesh(2)
+    _shared, staged = stack_stage_params(params, cfg, 2, mesh)
+    q = staged["q_proj"]  # [2 stages, 1 layer, E, H, D]
+    assert q.shape[0] == 2
+    shard_shapes = {s.data.shape for s in q.addressable_shards}
+    assert all(s[0] == 1 for s in shard_shapes)  # one stage per device
+
+
+def test_indivisible_layers_raise():
+    cfg = get_model_config("tiny-llama")  # 2 layers
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    mesh = build_pipe_mesh(2)
+    with pytest.raises(ValueError, match="split"):
+        stack_stage_params(params, cfg, 3, mesh)

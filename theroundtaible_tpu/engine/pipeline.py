@@ -1,0 +1,193 @@
+"""Pipeline parallelism — GPipe-style microbatched prefill over a "pipe"
+mesh axis.
+
+SURVEY.md §2.3: "PP — only needed for models too large for one TP group;
+design the mesh abstraction to allow a (pipeline, tensor, data) axis split
+even if v0 uses PP=1." This module is that design, shipped working and
+tested on the virtual CPU mesh: layers are split into contiguous stages
+(one per pipe-axis device, stage parameters stacked and sharded on a
+leading stage axis), microbatches flow through the classic
+(n_stages + n_micro - 1)-step schedule, and activations move stage→stage
+with lax.ppermute over ICI — XLA overlaps the permute with the next
+step's compute.
+
+v0 scope: full-sequence prefill compute (logits), the piece PP exists for
+(weights too big for one TP group). Decode keeps TP/EP: per-token PP
+bubbles dominate at batch sizes this orchestrator produces, so the engine
+does not enable PP for its slot-persistent serving loop yet. The module
+is the documented seam to widen (stage-local KV caches are the follow-up:
+each stage would keep its layer range's slots exactly as kvcache.py does
+globally).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.common import (
+    ModelConfig, Params, make_attention_mask, rms_norm, transformer_block)
+
+PIPE_AXIS = "pipe"
+
+
+def build_pipe_mesh(n_stages: int, devices: Optional[list] = None) -> Mesh:
+    import numpy as np
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < n_stages:
+        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n_stages]), (PIPE_AXIS,))
+
+
+def stack_stage_params(params: Params, cfg: ModelConfig, n_stages: int,
+                       mesh: Mesh) -> tuple[Params, Params]:
+    """Split the per-layer param list into n_stages contiguous stages.
+
+    Returns (shared, staged): `shared` = embedding/final_norm/lm_head
+    replicated on every stage; `staged` = each layer tensor stacked to
+    [n_stages, layers_per_stage, ...] and sharded on the leading stage
+    axis, so each pipe device holds exactly its own layers.
+    """
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError(
+            f"{cfg.num_layers} layers do not split into {n_stages} stages")
+    per = cfg.num_layers // n_stages
+
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (n_stages, per) + leaves[0].shape),
+        *params["layers"])
+    staged = jax.device_put(
+        stacked,
+        jax.tree_util.tree_map(
+            lambda x: NamedSharding(
+                mesh, P(PIPE_AXIS, *(None,) * (x.ndim - 1))),
+            stacked))
+
+    shared = {k: v for k, v in params.items() if k != "layers"}
+    shared = jax.device_put(
+        shared, jax.tree_util.tree_map(
+            lambda x: NamedSharding(mesh, P()), shared))
+    return shared, staged
+
+
+def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, n_micro: int):
+    """Build jit'd fn(shared, staged, tokens [B,T]) → logits [B,T,V].
+
+    B must divide into n_micro microbatches. Schedule: at step i, stage s
+    works on microbatch i-s (when 0 ≤ i-s < n_micro); stage 0 injects
+    embeddings, the last stage banks its outputs, ppermute advances the
+    ring. The rotating-buffer trick keeps shapes static: every stage
+    computes every step (idle steps process garbage that is never banked).
+    """
+    n_stages = mesh.shape[PIPE_AXIS]
+    if cfg.num_layers % n_stages != 0:
+        raise ValueError(
+            f"{cfg.num_layers} layers do not split into {n_stages} stages")
+
+    def stage_compute(stage_layers, x, positions, valid):
+        """Run this stage's `per` layers (scan over stacked params)."""
+        mask = make_attention_mask(positions, x.shape[1], valid,
+                                   cfg.sliding_window)
+
+        def body(h, layer):
+            h, _cache = transformer_block(h, layer, cfg, positions, None,
+                                          None, mask, kv_valid=valid)
+            return h, None
+
+        x, _ = jax.lax.scan(body, x, stage_layers)
+        return x
+
+    def pp_fn(shared, staged, tokens, positions, valid):
+        # [B,T] → [n_micro, mb, T]
+        b, t = tokens.shape
+        mb = b // n_micro
+        tok_mb = tokens.reshape(n_micro, mb, t)
+        pos_mb = positions.reshape(n_micro, mb, t)
+        valid_mb = valid.reshape(n_micro, mb)
+
+        emb = shared["embedding"][tok_mb].astype(jnp.bfloat16)
+        if cfg.scale_embeddings:
+            emb = emb * jnp.sqrt(
+                jnp.float32(cfg.embed_dim)).astype(emb.dtype)
+
+        def per_stage(stage_layers, emb, pos_mb, valid_mb):
+            # under shard_map: stage_layers [1, per, ...] — this stage only
+            stage_layers = jax.tree_util.tree_map(
+                lambda x: x[0], stage_layers)
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            n_steps = n_stages + n_micro - 1
+
+            # initial carries must be typed as varying over the pipe axis
+            # (each stage's loop state diverges immediately)
+            state = jax.lax.pcast(jnp.zeros_like(emb[0]), (PIPE_AXIS,),
+                                  to="varying")
+            banked = jax.lax.pcast(jnp.zeros_like(emb), (PIPE_AXIS,),
+                                   to="varying")
+
+            def step(i, carry):
+                state, banked = carry
+                # stage 0 injects microbatch i (clamped; only banked when
+                # in schedule), others take the permuted activation
+                inject = emb[jnp.clip(i, 0, n_micro - 1)]
+                x_in = jnp.where(stage == 0,
+                                 jnp.where(i < n_micro, inject, state),
+                                 state)
+                my_mb = jnp.clip(i - stage, 0, n_micro - 1)
+                pos = pos_mb[my_mb]
+                vld = valid_mb[my_mb]
+                out = stage_compute(stage_layers, x_in, pos, vld)
+                # last stage banks microbatch j = i - (n_stages-1)
+                j = i - (n_stages - 1)
+                bank_now = (stage == n_stages - 1) & (j >= 0)
+                banked = jnp.where(
+                    bank_now,
+                    banked.at[jnp.clip(j, 0, n_micro - 1)].set(out),
+                    banked)
+                state = jax.lax.ppermute(
+                    out, PIPE_AXIS,
+                    [(s, (s + 1) % n_stages) for s in range(n_stages)])
+                return state, banked
+
+            _state, banked = jax.lax.fori_loop(
+                0, n_steps, step, (state, banked))
+            # replicate the last stage's banked outputs to every stage
+            banked = jax.lax.psum(
+                jnp.where(stage == n_stages - 1, banked, 0.0)
+                .astype(jnp.float32),
+                PIPE_AXIS).astype(banked.dtype)
+            return banked
+
+        hidden = shard_map(
+            per_stage, mesh=mesh,
+            in_specs=(P(PIPE_AXIS), P(), P(), P()),
+            out_specs=P(),
+        )(staged, emb, pos_mb, valid_mb)
+
+        hidden = hidden.reshape(b, t, cfg.embed_dim)
+        hidden = rms_norm(hidden, shared["final_norm"], cfg.norm_eps,
+                          cfg.rmsnorm_unit_offset)
+        head = (shared["embedding"] if cfg.tie_embeddings
+                else shared["lm_head"])
+        logits = jnp.einsum("bte,ve->btv", hidden, head,
+                            preferred_element_type=jnp.float32)
+        if cfg.final_logit_softcap is not None:
+            logits = cfg.final_logit_softcap * jnp.tanh(
+                logits / cfg.final_logit_softcap)
+        return logits
+
+    jitted = jax.jit(pp_fn)
+
+    def call(shared, staged, tokens, positions, valid):
+        if tokens.shape[0] % n_micro != 0:
+            raise ValueError(
+                f"batch {tokens.shape[0]} does not split into "
+                f"{n_micro} microbatches")
+        return jitted(shared, staged, tokens, positions, valid)
+
+    return call

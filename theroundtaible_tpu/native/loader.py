@@ -49,13 +49,19 @@ class _TensorJob(ctypes.Structure):
 def _build() -> bool:
     if not _SRC_PATH.exists():
         return False
+    # build to a temp path + atomic rename: another process racing this
+    # build must never dlopen a half-written .so
+    import os
+    tmp = _SO_PATH.with_suffix(f".tmp{os.getpid()}.so")
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-             "-o", str(_SO_PATH), str(_SRC_PATH)],
+             "-o", str(tmp), str(_SRC_PATH)],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
         return _SO_PATH.exists()
     except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
         return False
 
 
@@ -64,11 +70,12 @@ def _get_lib(build: bool = True):
     with _lock:
         if _lib_tried:
             return _lib
-        if not _SO_PATH.exists() and not build:
-            # caller is on a latency-sensitive path — don't shell out to
-            # g++ from here; stay on the Python fallback until some load
-            # path builds the library
-            return None
+        if not build:
+            # latency-sensitive caller: load only if the .so already
+            # exists; never shell out to g++ and never latch a negative
+            # result (a later load path may still build it)
+            if not _SO_PATH.exists():
+                return None
         _lib_tried = True
         if not _SO_PATH.exists() and not _build():
             return None
@@ -111,14 +118,23 @@ def iter_safetensors(path: str | Path, n_threads: int = 0):
         header = json.loads(f.read(header_len))
     payload_base = 8 + header_len
 
+    _ELEM_SIZE = {"F32": 4, "F16": 2, "BF16": 2, "F64": 8, "I64": 8,
+                  "I32": 4, "U8": 1, "I8": 1}
     for name, meta in header.items():
         if name == "__metadata__":
             continue
         dtype = meta["dtype"]
         if dtype not in _DTYPES:
             raise ValueError(f"unsupported safetensors dtype {dtype}")
-        begin, _end = meta["data_offsets"]
+        begin, end = meta["data_offsets"]
         out = np.empty(meta["shape"], np.float32)
+        # a shape/offsets mismatch must fail loudly, not read the next
+        # tensor's bytes as this one's tail
+        if (begin < 0 or end < begin
+                or end - begin != out.size * _ELEM_SIZE[dtype]):
+            raise ValueError(
+                f"tensor {name}: data_offsets {begin}:{end} disagree "
+                f"with shape {meta['shape']} ({dtype})")
         job = (_TensorJob * 1)()
         job[0].src_offset = payload_base + begin
         job[0].n_elems = out.size
@@ -139,10 +155,11 @@ def native_can_read(path: str | Path) -> bool:
         with open(path, "rb") as f:
             (header_len,) = struct.unpack("<Q", f.read(8))
             header = json.loads(f.read(header_len))
-    except (OSError, ValueError):
-        return False
-    return all(meta.get("dtype") in _DTYPES
-               for name, meta in header.items() if name != "__metadata__")
+        return all(meta.get("dtype") in _DTYPES
+                   for name, meta in header.items()
+                   if name != "__metadata__")
+    except Exception:  # noqa: BLE001 — contract: malformed file → False,
+        return False   # caller takes the safetensors-package fallback
 
 
 def read_safetensors(path: str | Path,
@@ -167,17 +184,18 @@ def read_safetensors(path: str | Path,
 def lcp(a: list[int], b: list[int]) -> int:
     """Longest common prefix of two token-id sequences (KV reuse).
 
-    Serving hot path: uses the library only if it's ALREADY built (never
-    triggers the g++ self-build from here)."""
-    lib = _get_lib(build=False)
-    if lib is None:
-        n = min(len(a), len(b))
+    Serving hot path: consults only the already-loaded library handle (no
+    lock, no filesystem stat, never the g++ self-build). Short inputs and
+    early mismatches stay on the Python loop — it exits at the first
+    differing token, cheaper than materializing int32 arrays."""
+    n = min(len(a), len(b))
+    if _lib is None or n < 1024 or a[0] != b[0]:
         i = 0
         while i < n and a[i] == b[i]:
             i += 1
         return i
     arr_a = np.asarray(a, np.int32)
     arr_b = np.asarray(b, np.int32)
-    return int(lib.rt_lcp(
+    return int(_lib.rt_lcp(
         arr_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(arr_a),
         arr_b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(arr_b)))
