@@ -44,6 +44,7 @@ from ..utils.session import (
     update_status,
     write_decisions,
     write_discussion,
+    write_transcript,
 )
 from ..utils.verify import resolve_verify_commands
 from .consensus import (
@@ -309,7 +310,8 @@ def run_discussion(
 
     start_round = continue_from.start_round if continue_from else 1
     end_round = start_round + rules.max_rounds - 1
-    king_demand = KING_DEMAND if continue_from else ""
+    king_demand = (KING_DEMAND if continue_from and continue_from.king_demand
+                   else "")
 
     from ..utils.metrics import SessionMetrics, maybe_profile
     state.metrics = SessionMetrics(session_path)
@@ -332,6 +334,7 @@ def run_discussion(
                 reporter.round_footer(state.metrics.rounds[-1])
 
             write_discussion(session_path, state.all_rounds)
+            write_transcript(session_path, state.all_rounds)
             current_blocks = list(state.latest_blocks.values())
 
             if check_consensus(current_blocks, threshold):

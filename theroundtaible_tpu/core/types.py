@@ -287,13 +287,20 @@ class SessionResult:
 
 @dataclass
 class ContinueOptions:
-    """State for the King's "send back" resume (reference src/types.ts:101-107)."""
+    """State for re-entering a session (reference src/types.ts:101-107).
+
+    Two users: the King's "send back" (unchanged reference behavior,
+    king_demand=True injects the unanimity ultimatum into every prompt)
+    and crash resume via `discuss --continue` (king_demand=False — the
+    knights just pick up where the dead process stopped; reference marks
+    this future work at TODO.md:179)."""
 
     session_path: str
     all_rounds: list[RoundEntry]
     start_round: int
     resolved_files: str = ""
     resolved_commands: str = ""
+    king_demand: bool = True
 
 
 # --- Manifest types (reference src/types.ts:109-129) ---

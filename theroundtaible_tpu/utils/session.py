@@ -59,6 +59,61 @@ def _write_status(session_path: Path, status: SessionStatus) -> None:
     )
 
 
+def write_transcript(session_path: str | Path,
+                     rounds: list[RoundEntry]) -> None:
+    """Machine-readable twin of discussion.md, rewritten per round.
+
+    This is what makes crash resume (`discuss --continue`) possible — the
+    reference persists only display markdown, so a dead process loses the
+    structured transcript (TODO.md:179 future work). Schema: a JSON list
+    of RoundEntry dicts with the consensus block inlined."""
+    payload = []
+    for e in rounds:
+        payload.append({
+            "knight": e.knight,
+            "round": e.round,
+            "response": e.response,
+            "timestamp": e.timestamp,
+            "consensus": e.consensus.to_dict() if e.consensus else None,
+        })
+    (Path(session_path) / "transcript.json").write_text(
+        json.dumps(payload, indent=1), encoding="utf-8")
+
+
+def read_transcript(session_path: str | Path) -> list[RoundEntry]:
+    """Rebuild RoundEntries from transcript.json (empty if absent)."""
+    from ..core.types import ConsensusBlock
+
+    path = Path(session_path) / "transcript.json"
+    if not path.exists():
+        return []
+    try:
+        payload = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return []
+    entries = []
+    for d in payload:
+        block = None
+        if d.get("consensus"):
+            c = d["consensus"]
+            block = ConsensusBlock(
+                knight=c.get("knight", d.get("knight", "")),
+                round=int(c.get("round", d.get("round", 1))),
+                consensus_score=float(c.get("consensus_score", 0)),
+                agrees_with=list(c.get("agrees_with", [])),
+                pending_issues=list(c.get("pending_issues", [])),
+                proposal=c.get("proposal"),
+                files_to_modify=list(c.get("files_to_modify", [])),
+                file_requests=list(c.get("file_requests", [])),
+                verify_commands=list(c.get("verify_commands", [])),
+            )
+        entries.append(RoundEntry(
+            knight=d.get("knight", ""), round=int(d.get("round", 1)),
+            response=d.get("response", ""),
+            timestamp=d.get("timestamp", ""), consensus=block))
+    return entries
+
+
 def write_discussion(session_path: str | Path, rounds: list[RoundEntry]) -> None:
     """Full rewrite of discussion.md (reference session.ts:62-89)."""
     lines: list[str] = ["# Discussion\n"]
