@@ -8,15 +8,33 @@ prefill + jit'd while_loop decode through the production InferenceEngine
 numbers (BASELINE.md "published: {}"), so vs_baseline is computed against
 A100 Ollama gemma-2b decode ≈ 120 tok/s — the wall-clock-parity target the
 driver defines (north star: v5e vs A100 Ollama).
+
+Cold-start discipline (round-1 lesson: the JSON must land well inside the
+driver's capture window):
+- persistent XLA compilation cache (engine.enable_compilation_cache) — the
+  second-ever process run deserializes instead of compiling;
+- minimal warmup: ONLY the programs this bench prompt actually dispatches
+  (its prefill buckets + the decode segment), run twice for the donated-
+  buffer layout fixpoint — NOT InferenceEngine.warmup()'s full bucket grid;
+- watchdog + retry: the single-claim TPU tunnel HANGS (not errors) while
+  another process holds the chip, and a hung PJRT init cannot be
+  interrupted in-process — so the measurement runs in a child process the
+  parent can kill and relaunch with backoff.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 A100_OLLAMA_GEMMA2B_DECODE_TPS = 120.0  # external anchor, see module docstring
+
+ATTEMPT_TIMEOUT_S = 240.0  # cold compile measured ≈70s; generous margin
+MAX_ATTEMPTS = 3
+RETRY_DELAY_S = 20.0
 
 PROMPT = (
     "You are taking part in a TheRoundtAIble discussion. Topic: should we "
@@ -25,8 +43,18 @@ PROMPT = (
 )
 
 
-def main() -> int:
+def child() -> int:
+    """The actual measurement (runs in a watchdogged subprocess)."""
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
     import jax
+
+    # Local smoke-testing escape hatch: this image's sitecustomize pins
+    # JAX_PLATFORMS=axon before user env is consulted, so an env var alone
+    # cannot select cpu — mirror tests/conftest.py's config override.
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
 
     from theroundtaible_tpu.engine.engine import InferenceEngine
     from theroundtaible_tpu.engine.models.registry import get_model_config
@@ -40,14 +68,26 @@ def main() -> int:
         cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
         decode_tokens = 256
 
+    t_build = time.monotonic()
     engine = InferenceEngine(
         cfg, num_slots=4,
         sampling=SamplingParams(temperature=0.0,
                                 max_new_tokens=decode_tokens))
+    build_s = time.monotonic() - t_build
 
-    # Compile + layout-stabilize every serving program (two runs per
-    # bucket — see InferenceEngine.warmup).
-    warmup_s = engine.warmup()
+    # Minimal warmup: serve the bench prompt itself on a throwaway slot.
+    # This compiles exactly the (batch=1, bucket) prefill programs the
+    # prompt's chunking hits plus the one decode-segment program; the second
+    # pass reaches the donated-buffer layout fixpoint (see
+    # InferenceEngine.warmup docstring). Slot released between passes so
+    # each is an honest full prefill.
+    t_warm = time.monotonic()
+    for _ in range(2):
+        engine.kv.release("__bench_warmup")
+        engine.generate(PROMPT, slot_name="__bench_warmup",
+                        max_new_tokens=decode_tokens)
+    engine.kv.release("__bench_warmup")
+    warmup_s = time.monotonic() - t_warm
 
     # Measured run on a fresh slot (no prefix reuse → honest prefill too).
     t0 = time.monotonic()
@@ -66,6 +106,7 @@ def main() -> int:
             "prefill_tokens": s.prefill_tokens,
             "decode_tokens": s.decode_tokens,
             "wall_s": round(wall, 2),
+            "build_s": round(build_s, 1),
             "warmup_s": round(warmup_s, 1),
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
@@ -75,5 +116,28 @@ def main() -> int:
     return 0
 
 
+def main() -> int:
+    """Watchdog: run `child` in a subprocess; kill and retry on hang/error."""
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S)
+            out = proc.stdout.strip().splitlines()
+            if proc.returncode == 0 and out:
+                print(out[-1])  # the one JSON line
+                return 0
+            print(f"bench attempt {attempt}: rc={proc.returncode} "
+                  f"stderr tail: {proc.stderr[-400:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench attempt {attempt}: timed out after "
+                  f"{ATTEMPT_TIMEOUT_S:.0f}s (TPU claim hang?) — killed",
+                  file=sys.stderr)
+        if attempt < MAX_ATTEMPTS:
+            time.sleep(RETRY_DELAY_S)
+    print("bench: all attempts failed", file=sys.stderr)
+    return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(child() if "--child" in sys.argv else main())

@@ -110,6 +110,76 @@ class TestDiscussCommandE2E:
         assert "1 decision(s)" in capsys.readouterr().out
 
 
+class TestContinueCommand:
+    """`discuss --continue` crash resume (ADVICE r1: the path was broken —
+    SessionInfo was treated as a path — and unreachable from the CLI)."""
+
+    def test_parser_accepts_continue(self):
+        p = build_parser()
+        args = p.parse_args(["discuss", "--continue"])
+        assert args.continue_session is True
+        assert args.topic is None
+
+    def test_parser_rejects_topic_plus_continue(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["discuss", "t", "--continue"])
+
+    def test_continue_without_sessions(self, project_root, monkeypatch,
+                                       capsys):
+        write_config(project_root)
+        monkeypatch.chdir(project_root)
+        rc = main(["discuss", "--continue", "--no-read-code"])
+        assert rc == 1
+        assert "No sessions to continue" in capsys.readouterr().out
+
+    def test_continue_resumes_crashed_session(self, project_root,
+                                              monkeypatch, capsys):
+        from theroundtaible_tpu.utils.session import (
+            create_session, update_status, write_transcript)
+
+        write_config(project_root)
+        monkeypatch.chdir(project_root)
+        # Simulate a crash after round 1: session dir + transcript.json
+        # exist, phase still "discussing", no decisions.md.
+        sp = create_session(project_root, "an unfinished topic")
+        entry = RoundEntry("A", 1, scripted_response(5),
+                           ConsensusBlock("A", 1, 5), "ts")
+        write_transcript(sp, [entry])
+        update_status(sp, phase="discussing", round=1)
+
+        rc = main(["discuss", "--continue", "--no-read-code"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Resuming" in out
+        # default FakeAdapter scores 9 → consensus in the resumed round
+        assert "actually agree" in out
+        assert (sp / "decisions.md").exists()
+        # no second session dir was created — same session resumed
+        sessions = list((project_root / ".roundtable" / "sessions").iterdir())
+        assert len(sessions) == 1
+
+    def test_continue_rejects_finished_session(self, project_root,
+                                               monkeypatch, capsys):
+        write_config(project_root)
+        monkeypatch.chdir(project_root)
+        main(["discuss", "done topic", "--no-read-code"])
+        capsys.readouterr()
+        rc = main(["discuss", "--continue", "--no-read-code"])
+        assert rc == 1
+        assert "not resumable" in capsys.readouterr().out
+
+
+class TestAtomicWrites:
+    def test_atomic_write_replaces_and_cleans_up(self, tmp_path):
+        from theroundtaible_tpu.utils.session import atomic_write_text
+        target = tmp_path / "status.json"
+        target.write_text("old")
+        atomic_write_text(target, "new")
+        assert target.read_text() == "new"
+        # no stray temp files left behind
+        assert [p.name for p in tmp_path.iterdir()] == ["status.json"]
+
+
 class TestInitCommand:
     def test_non_interactive_scaffold(self, tmp_path, monkeypatch, capsys):
         monkeypatch.chdir(tmp_path)

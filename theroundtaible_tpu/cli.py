@@ -48,7 +48,12 @@ def build_parser():
     sub.add_parser("init", help="Interactive setup wizard")
 
     d = sub.add_parser("discuss", help="Start a roundtable discussion")
-    d.add_argument("topic", help="The question to discuss")
+    dgroup = d.add_mutually_exclusive_group(required=True)
+    dgroup.add_argument("topic", nargs="?", help="The question to discuss")
+    dgroup.add_argument("--continue", dest="continue_session",
+                        action="store_true",
+                        help="Resume the latest unfinished session "
+                             "(crash recovery)")
     d.add_argument("--read-code", action="store_true", default=None,
                    help="Read source code into context without asking")
     d.add_argument("--no-read-code", dest="read_code", action="store_false",
@@ -112,6 +117,9 @@ def dispatch(args) -> int:
         from .commands.init import init_command
         return init_command(__version__)
     if args.command == "discuss":
+        if getattr(args, "continue_session", False):
+            from .commands.discuss import continue_command
+            return continue_command(read_code=args.read_code)
         from .commands.discuss import discuss_command
         return discuss_command(args.topic, read_code=args.read_code)
     if args.command == "summon":

@@ -49,27 +49,28 @@ def continue_command(read_code: Optional[bool] = None,
     crash/interrupt (reference future work TODO.md:179). The transcript is
     rebuilt from the session's transcript.json; knights pick up at the
     next round with no King's ultimatum injected."""
-    from ..utils.session import find_latest_session, read_status, \
-        read_transcript
+    from pathlib import Path
+
+    from ..utils.session import find_latest_session, read_transcript
 
     project_root = project_root or os.getcwd()
-    session = find_latest_session(project_root)
+    session = find_latest_session(project_root)  # SessionInfo, not a path
     if session is None:
         print(style.dim("\n  No sessions to continue.\n"))
         return 1
-    status = read_status(session)
+    status = session.status
     if status is None or status.phase not in ("discussing", "escalated"):
         print(style.dim(
-            f"\n  Latest session ({Path(session).name}) is not resumable "
+            f"\n  Latest session ({session.name}) is not resumable "
             f"(phase: {status.phase if status else 'unknown'}).\n"))
         return 1
-    rounds = read_transcript(session)
+    rounds = read_transcript(session.path)
     if not rounds:
         print(style.yellow(
             "\n  No transcript.json in the session — nothing to rebuild "
             "from (sessions from older versions can't be continued).\n"))
         return 1
-    topic_file = Path(session) / "topic.md"
+    topic_file = Path(session.path) / "topic.md"
     topic = ""
     if topic_file.exists():
         for line in topic_file.read_text(encoding="utf-8").splitlines():
@@ -77,10 +78,10 @@ def continue_command(read_code: Optional[bool] = None,
                 topic = line.strip()
                 break
     last_round = max(e.round for e in rounds)
-    print(style.bold(f"\n  Resuming: {Path(session).name} "
+    print(style.bold(f"\n  Resuming: {session.name} "
                      f"(round {last_round} done)\n"))
     continue_from = ContinueOptions(
-        session_path=str(session), all_rounds=rounds,
+        session_path=session.path, all_rounds=rounds,
         start_round=last_round + 1, king_demand=False)
     return discuss_command(topic or "(resumed session)", read_code,
                            project_root, continue_from=continue_from)

@@ -8,18 +8,47 @@ several knights share one resident model (SURVEY.md §7.1).
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Any
 
 _engines: dict[str, Any] = {}
 _lock = threading.Lock()
+_compile_cache_enabled = False
+
+
+def enable_compilation_cache() -> str:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    Every engine process otherwise pays a full XLA compile per
+    (batch, bucket) program — minutes of cold-start on a real chip
+    (SURVEY.md §7.3 hard part 5). The cache dir is stable across runs so
+    `discuss` cold-start after the first ever run is dominated by
+    deserialization, not compilation. Override with ROUNDTABLE_XLA_CACHE.
+    """
+    global _compile_cache_enabled
+    cache_dir = os.environ.get(
+        "ROUNDTABLE_XLA_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "theroundtaible_tpu", "xla-cache"))
+    if _compile_cache_enabled:
+        return cache_dir
+    import jax
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache even fast compiles: serving has many small bucket programs and
+    # the default 1s threshold would skip exactly the ones that add up.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _compile_cache_enabled = True
+    return cache_dir
 
 
 def _cache_key(config: dict[str, Any]) -> str:
     relevant = {k: config.get(k) for k in
                 ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
                  "seq_parallel", "long_scheme", "long_threshold",
-                 "devices", "attn")}
+                 "devices", "attn", "num_slots", "sampling", "seed")}
     return json.dumps(relevant, sort_keys=True)
 
 

@@ -75,6 +75,10 @@ class InferenceEngine:
                  long_threshold: int = 2048,
                  long_scheme: str = "ring", attn: str = "auto",
                  devices: Optional[list[int]] = None):
+        # Persistent XLA compile cache: first-ever run compiles, every
+        # later process deserializes (SURVEY.md §7.3 hard part 5).
+        from . import enable_compilation_cache
+        enable_compilation_cache()
         # devices: indices into jax.devices() — the fleet planner assigns
         # disjoint per-model submeshes this way (engine/fleet.py)
         device_list = None
@@ -228,6 +232,11 @@ class InferenceEngine:
         if attn not in ("auto", "flash", "dense"):
             raise ValueError(
                 f"attn must be auto|flash|dense, got {attn!r}")
+        if attn == "flash" and mesh_size > 1:
+            raise ValueError(
+                "attn='flash' is not supported on a multi-device mesh yet "
+                "(a plain pallas_call inside the pjit'd program is not "
+                "SPMD-partitionable) — use attn='auto' or 'dense'")
         if attn in ("flash", "dense"):
             return dataclasses.replace(model_cfg, attn_impl=attn)
         if (jax.default_backend() == "tpu" and mesh_size == 1

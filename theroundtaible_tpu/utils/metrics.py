@@ -119,9 +119,9 @@ class SessionMetrics:
             "rounds": [asdict(r) for r in self.rounds],
         }
         try:
-            self.path.write_text(
-                json.dumps(payload, indent=2, default=str),
-                encoding="utf-8")
+            from .session import atomic_write_text
+            atomic_write_text(self.path,
+                              json.dumps(payload, indent=2, default=str))
         except (OSError, TypeError, ValueError):
             pass  # metrics must never kill a discussion
 

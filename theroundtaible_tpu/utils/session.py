@@ -8,7 +8,9 @@ states), status.json (read-merge-write).
 from __future__ import annotations
 
 import json
+import os
 import re
+import tempfile
 from dataclasses import dataclass
 from datetime import datetime, timezone
 from pathlib import Path
@@ -17,6 +19,24 @@ from typing import Any, Optional
 from ..core.types import RoundEntry, SessionStatus, format_score
 
 SESSIONS_SUBDIR = Path(".roundtable") / "sessions"
+
+
+def atomic_write_text(path: str | Path, text: str) -> None:
+    """Write-to-temp + os.replace so a crash mid-write can never leave a
+    truncated file — crash resume (`discuss --continue`) reads these files,
+    so in-place write_text would undercut the very thing it enables."""
+    path = Path(path)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def now_iso() -> str:
@@ -54,9 +74,8 @@ def create_session(project_root: str | Path, topic: str) -> Path:
 
 
 def _write_status(session_path: Path, status: SessionStatus) -> None:
-    (session_path / "status.json").write_text(
-        json.dumps(status.to_dict(), indent=2), encoding="utf-8"
-    )
+    atomic_write_text(session_path / "status.json",
+                      json.dumps(status.to_dict(), indent=2))
 
 
 def write_transcript(session_path: str | Path,
@@ -76,8 +95,8 @@ def write_transcript(session_path: str | Path,
             "timestamp": e.timestamp,
             "consensus": e.consensus.to_dict() if e.consensus else None,
         })
-    (Path(session_path) / "transcript.json").write_text(
-        json.dumps(payload, indent=1), encoding="utf-8")
+    atomic_write_text(Path(session_path) / "transcript.json",
+                      json.dumps(payload, indent=1))
 
 
 def read_transcript(session_path: str | Path) -> list[RoundEntry]:
@@ -180,7 +199,7 @@ def update_status(session_path: str | Path, **updates: Any) -> None:
         }
     current.update({k: v for k, v in updates.items() if v is not ...})
     current["updated_at"] = now_iso()
-    status_path.write_text(json.dumps(current, indent=2), encoding="utf-8")
+    atomic_write_text(status_path, json.dumps(current, indent=2))
 
 
 def read_status(session_path: str | Path) -> Optional[SessionStatus]:
