@@ -45,16 +45,19 @@ PROMPT = (
 
 def child() -> int:
     """The actual measurement (runs in a watchdogged subprocess)."""
-    from theroundtaible_tpu.engine import enable_compilation_cache
-
-    enable_compilation_cache()
     import jax
 
     # Local smoke-testing escape hatch: this image's sitecustomize pins
     # JAX_PLATFORMS=axon before user env is consulted, so an env var alone
-    # cannot select cpu — mirror tests/conftest.py's config override.
+    # cannot select cpu — mirror tests/conftest.py's config override. Must
+    # run before anything initializes the backend (incl. the compilation
+    # cache, which checks jax.default_backend()).
     if os.environ.get("ROUNDTABLE_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from theroundtaible_tpu.engine.engine import InferenceEngine
     from theroundtaible_tpu.engine.models.registry import get_model_config

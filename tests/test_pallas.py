@@ -133,6 +133,143 @@ def test_engine_forward_flash_matches_dense():
                                atol=5e-2, rtol=5e-2)
 
 
+class TestFlashSpmd:
+    """flash under a multi-device mesh via shard_map (VERDICT r1 #4)."""
+
+    def _mesh(self, model=2, data=1):
+        from theroundtaible_tpu.engine.sharding import build_mesh
+        return build_mesh({"data": data, "model": model},
+                          jax.devices()[:data * model])
+
+    def test_spmd_prefill_matches_dense(self):
+        from theroundtaible_tpu.engine.pallas.attention import (
+            flash_attention_spmd)
+        q, k, v = make_inputs()  # H=8, K=2 → divisible by model=2
+        offsets = jnp.asarray([0, 10, 600], jnp.int32)
+        valid = offsets + jnp.asarray([192, 40, 192], jnp.int32)
+        out = flash_attention_spmd(self._mesh(), q, k, v, offsets, valid,
+                                   interpret=True)
+        assert out is not None
+        ref = dense_ref(q, k, v, offsets, valid)
+        for b, n in enumerate([192, 40, 192]):
+            np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                       np.asarray(ref)[b, :n],
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_spmd_decode_matches_dense(self):
+        from theroundtaible_tpu.engine.pallas.attention import (
+            flash_attention_spmd)
+        _, k, v = make_inputs()
+        rng = np.random.default_rng(3)
+        qd = jnp.asarray(rng.normal(size=(3, 1, 8, 32)), jnp.float32)
+        valid = jnp.asarray([1, 512, 1024], jnp.int32)
+        out = flash_attention_spmd(self._mesh(), qd, k, v, valid - 1, valid,
+                                   interpret=True)
+        assert out is not None
+        ref = dense_ref(qd, k, v, valid - 1, valid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_spmd_batch_on_data_axis(self):
+        from theroundtaible_tpu.engine.pallas.attention import (
+            flash_attention_spmd)
+        q, k, v = make_inputs(B=4)
+        offsets = jnp.zeros((4,), jnp.int32)
+        valid = jnp.full((4,), 192, jnp.int32)
+        out = flash_attention_spmd(self._mesh(model=2, data=2), q, k, v,
+                                   offsets, valid, interpret=True)
+        assert out is not None
+        ref = dense_ref(q, k, v, offsets, valid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-5, rtol=5e-5)
+
+    def test_spmd_mqa_replicated_kv(self):
+        """MQA (kh=1, the gemma-2b shape): q heads shard, kv replicates."""
+        from theroundtaible_tpu.engine.pallas.attention import (
+            flash_attention_spmd)
+        q, k, v = make_inputs(H=8, K=1)
+        offsets = jnp.asarray([0, 10, 600], jnp.int32)
+        valid = offsets + jnp.asarray([192, 40, 192], jnp.int32)
+        out = flash_attention_spmd(self._mesh(model=4), q, k, v, offsets,
+                                   valid, interpret=True)
+        assert out is not None
+        ref = dense_ref(q, k, v, offsets, valid)
+        for b, n in enumerate([192, 40, 192]):
+            np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                       np.asarray(ref)[b, :n],
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_engine_flash_tp_mqa(self):
+        """End-to-end MQA engine under 4-way TP with flash: greedy parity
+        with the dense engine (the gemma-2b-on-v5e-8 head layout)."""
+        import dataclasses
+
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        from theroundtaible_tpu.engine.models.registry import get_model_config
+        from theroundtaible_tpu.engine.sampling import SamplingParams
+
+        cfg = dataclasses.replace(get_model_config("tiny-gemma"),
+                                  num_kv_heads=1, max_seq_len=256)
+
+        def build(attn):
+            return InferenceEngine(
+                cfg, mesh_shape={"data": 1, "model": 4}, num_slots=2,
+                attn=attn,
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+
+        flash_eng, dense_eng = build("flash"), build("dense")
+        assert flash_eng.cfg.attn_impl == "flash"
+        o_f = flash_eng.generate("a question", slot_name="a",
+                                 max_new_tokens=8)
+        o_d = dense_eng.generate("a question", slot_name="a",
+                                 max_new_tokens=8)
+        assert o_f == o_d
+
+    def test_spmd_refuses_indivisible_heads(self):
+        from theroundtaible_tpu.engine.pallas.attention import (
+            flash_attention_spmd)
+        q, k, v = make_inputs()  # K=2 does not divide model=8
+        offsets = jnp.zeros((3,), jnp.int32)
+        valid = jnp.full((3,), 192, jnp.int32)
+        assert flash_attention_spmd(self._mesh(model=8), q, k, v,
+                                    offsets, valid, interpret=True) is None
+
+    def test_engine_flash_tp_matches_dense_tp(self):
+        """Greedy parity: flash vs dense engines on the same 2-way TP mesh,
+        including the slot-reuse (delta prefill) second turn."""
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        from theroundtaible_tpu.engine.models.registry import get_model_config
+        from theroundtaible_tpu.engine.sampling import SamplingParams
+
+        def build(attn):
+            return InferenceEngine(
+                get_model_config("tiny-llama", max_seq_len=256),
+                mesh_shape={"data": 1, "model": 2}, num_slots=2, attn=attn,
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+
+        flash_eng, dense_eng = build("flash"), build("dense")
+        assert flash_eng.cfg.attn_impl == "flash"
+        prompts = ["the knights debate caching",
+                   "the knights debate caching, round two with more detail"]
+        outs = []
+        for eng in (flash_eng, dense_eng):
+            o1 = eng.generate(prompts[0], slot_name="a", max_new_tokens=8)
+            o2 = eng.generate(prompts[1], slot_name="a", max_new_tokens=8)
+            assert eng.last_stats.reused_tokens > 0
+            outs.append((o1, o2))
+        assert outs[0] == outs[1]
+
+    def test_engine_flash_raises_on_indivisible_mesh(self):
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        from theroundtaible_tpu.engine.models.registry import get_model_config
+
+        with pytest.raises(ValueError, match="divisible"):
+            InferenceEngine(
+                get_model_config("tiny-llama", max_seq_len=256),
+                mesh_shape={"data": 1, "model": 8}, num_slots=2,
+                attn="flash")
+
+
 def test_engine_generate_with_flash():
     """End-to-end generate through the engine with attn='flash'."""
     from theroundtaible_tpu.engine.engine import InferenceEngine
@@ -140,7 +277,9 @@ def test_engine_generate_with_flash():
     from theroundtaible_tpu.engine.sampling import SamplingParams
 
     cfg = get_model_config("tiny-gemma")
+    # single-device mesh: the plain (non-shard_map) kernel path
     eng = InferenceEngine(cfg, num_slots=2, attn="flash",
+                          mesh_shape={"data": 1, "model": 1},
                           sampling=SamplingParams(temperature=0.0,
                                                   max_new_tokens=8))
     assert eng.cfg.attn_impl == "flash"

@@ -17,7 +17,7 @@ _lock = threading.Lock()
 _compile_cache_enabled = False
 
 
-def enable_compilation_cache() -> str:
+def enable_compilation_cache():
     """Turn on JAX's persistent compilation cache (idempotent).
 
     Every engine process otherwise pays a full XLA compile per
@@ -25,22 +25,32 @@ def enable_compilation_cache() -> str:
     (SURVEY.md §7.3 hard part 5). The cache dir is stable across runs so
     `discuss` cold-start after the first ever run is dominated by
     deserialization, not compilation. Override with ROUNDTABLE_XLA_CACHE.
+
+    CPU backends are a no-op: tiny-shape CPU compiles are seconds, and
+    XLA:CPU AOT cache entries embed host machine features — reloading one
+    compiled under different flags/machines warns "could lead to SIGILL".
+    The dir is namespaced by backend so mixed-platform runs can't collide.
     """
     global _compile_cache_enabled
-    cache_dir = os.environ.get(
-        "ROUNDTABLE_XLA_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache",
-                     "theroundtaible_tpu", "xla-cache"))
     if _compile_cache_enabled:
-        return cache_dir
+        return _compile_cache_enabled
     import jax
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return None
+    cache_dir = os.path.join(
+        os.environ.get(
+            "ROUNDTABLE_XLA_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "theroundtaible_tpu", "xla-cache")),
+        backend)
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache even fast compiles: serving has many small bucket programs and
     # the default 1s threshold would skip exactly the ones that add up.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    _compile_cache_enabled = True
+    _compile_cache_enabled = cache_dir
     return cache_dir
 
 

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .kvcache import KVCache
-from .models.common import ModelConfig, forward, init_params, param_count
+from .models.common import (ModelConfig, forward, init_params, param_count,
+                            spmd_mesh)
 from .models.registry import get_model_config
 from .sampling import SamplingParams, sample_token
 from .sharding import build_mesh, kv_cache_spec, shard_params
@@ -86,8 +87,7 @@ class InferenceEngine:
             all_devices = jax.devices()
             device_list = [all_devices[i] for i in devices]
         self.mesh = build_mesh(mesh_shape, device_list)
-        model_cfg = self._resolve_attn(model_cfg, attn,
-                                       self.mesh.devices.size)
+        model_cfg = self._resolve_attn(model_cfg, attn, self.mesh)
         self.cfg = model_cfg
         self.max_seq_len = model_cfg.max_seq_len
         self.sampling = sampling or SamplingParams()
@@ -155,21 +155,27 @@ class InferenceEngine:
         # compiled closures (per (batch, bucket) shapes, cached by jit)
         cfg = model_cfg
 
+        mesh = self.mesh
+
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache_layers, slot_idx, tokens, offsets,
                          lengths):
-            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
-            t = tokens.shape[1]
-            positions = offsets[:, None] + jnp.arange(t)[None, :]
-            valid = offsets + lengths
-            logits, new_b = forward(params, cfg, tokens, positions, caches_b,
-                                    offsets, valid)
-            new_layers = [
-                (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
-                for (k, v), (nk, nv) in zip(cache_layers, new_b)]
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            return last, new_layers
+            # spmd_mesh is a TRACE-time context: it tells attention() which
+            # mesh to shard_map the Pallas kernels over (models/common.py).
+            with spmd_mesh(mesh):
+                caches_b = [(k[slot_idx], v[slot_idx])
+                            for k, v in cache_layers]
+                t = tokens.shape[1]
+                positions = offsets[:, None] + jnp.arange(t)[None, :]
+                valid = offsets + lengths
+                logits, new_b = forward(params, cfg, tokens, positions,
+                                        caches_b, offsets, valid)
+                new_layers = [
+                    (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
+                    for (k, v), (nk, nv) in zip(cache_layers, new_b)]
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                return last, new_layers
 
         self._prefill_step = prefill_step
 
@@ -209,8 +215,9 @@ class InferenceEngine:
 
             state = (jnp.int32(0), first_token, start_valid, done, out,
                      caches_b, key)
-            step, last, valid, done, out, caches_b, _ = \
-                jax.lax.while_loop(cond, body, state)
+            with spmd_mesh(mesh):
+                step, last, valid, done, out, caches_b, _ = \
+                    jax.lax.while_loop(cond, body, state)
             new_layers = [
                 (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
                 for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
@@ -220,27 +227,34 @@ class InferenceEngine:
 
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
-                      mesh_size: int) -> ModelConfig:
+                      mesh) -> ModelConfig:
         """Pick the attention implementation (SURVEY.md §7.3 hard part 1).
 
-        "auto" enables the Pallas kernels on a single-device TPU mesh with
-        lane-aligned head_dim; under a multi-device mesh the kernels would
-        need a shard_map wrapper to partition (plain pallas_call inside a
-        pjit'd program is not SPMD-partitionable), so auto stays dense
-        there. Explicit "flash"/"dense" always wins."""
+        "auto" enables the Pallas kernels on TPU with lane-aligned
+        head_dim. On a multi-device mesh they run under shard_map with kv
+        heads partitioned on the "model" axis (pallas/attention.py
+        flash_attention_spmd), which requires both head counts to divide
+        the model-axis size — otherwise auto stays dense (matching
+        _fallback_replicated's cache layout). Explicit "flash"/"dense"
+        always wins; explicit "flash" on a non-divisible mesh raises."""
         import dataclasses
         if attn not in ("auto", "flash", "dense"):
             raise ValueError(
                 f"attn must be auto|flash|dense, got {attn!r}")
-        if attn == "flash" and mesh_size > 1:
+        from .pallas.attention import spmd_partitionable
+        n_model = dict(mesh.shape).get("model", 1)
+        heads_divide = spmd_partitionable(
+            model_cfg.num_heads, model_cfg.num_kv_heads, n_model)
+        if attn == "flash" and mesh.devices.size > 1 and not heads_divide:
             raise ValueError(
-                "attn='flash' is not supported on a multi-device mesh yet "
-                "(a plain pallas_call inside the pjit'd program is not "
-                "SPMD-partitionable) — use attn='auto' or 'dense'")
+                f"attn='flash' on a {n_model}-way model axis needs head "
+                f"counts divisible by it (got H={model_cfg.num_heads}, "
+                f"K={model_cfg.num_kv_heads}) — use attn='auto' or 'dense'")
         if attn in ("flash", "dense"):
             return dataclasses.replace(model_cfg, attn_impl=attn)
-        if (jax.default_backend() == "tpu" and mesh_size == 1
-                and model_cfg.head_dim % 128 == 0):
+        if (jax.default_backend() == "tpu"
+                and model_cfg.head_dim % 128 == 0
+                and (mesh.devices.size == 1 or heads_divide)):
             return dataclasses.replace(model_cfg, attn_impl="flash")
         return dataclasses.replace(model_cfg, attn_impl="dense")
 

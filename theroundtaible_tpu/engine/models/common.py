@@ -32,6 +32,39 @@ Params = dict[str, Any]
 # dense and flash masking semantics cannot drift apart.
 MASK_VALUE = -2.3819763e38
 
+# SPMD mesh context: the engine sets this (at trace time, inside its jit'd
+# programs) so attention() can wrap the Pallas kernels in shard_map on a
+# multi-device mesh. A trace-time Python context, not a traced value — the
+# mesh is static per compiled program. Thread-local because distinct
+# engines (fleet submeshes) trace concurrently from different threads —
+# a shared stack would hand one engine's mesh to another's trace.
+import threading as _threading
+
+_MESH_CTX = _threading.local()
+
+
+class spmd_mesh:
+    """Context manager announcing the mesh the enclosing jit traces under."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        stack = getattr(_MESH_CTX, "stack", None)
+        if stack is None:
+            stack = _MESH_CTX.stack = []
+        stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _MESH_CTX.stack.pop()
+        return False
+
+
+def current_spmd_mesh():
+    stack = getattr(_MESH_CTX, "stack", None)
+    return stack[-1] if stack else None
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
@@ -167,7 +200,16 @@ def attention(
     if cfg.attn_impl == "flash" and kv_valid is not None:
         from ..pallas import attention as pattn
         t = q.shape[1]
-        if pattn.supported(t, k_all.shape[1], cfg.head_dim):
+        out = None
+        mesh = current_spmd_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            # multi-device: kernels under shard_map (kv heads on "model",
+            # rows on "data"); None = not partitionable → dense below
+            out = pattn.flash_attention_spmd(
+                mesh, q, k_all, v_all, positions[:, 0], kv_valid,
+                sliding_window=cfg.sliding_window,
+                softcap=cfg.attn_logit_softcap)
+        elif pattn.supported(t, k_all.shape[1], cfg.head_dim):
             if t > 1:
                 out = pattn.flash_prefill_attention(
                     q, k_all, v_all, positions[:, 0], kv_valid,
@@ -178,6 +220,7 @@ def attention(
                     q, k_all, v_all, kv_valid,
                     sliding_window=cfg.sliding_window,
                     softcap=cfg.attn_logit_softcap)
+        if out is not None:
             out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
                 .astype(x.dtype)
             return out, (k_cache, v_cache)

@@ -49,6 +49,18 @@ def _pick_block(n: int, candidates: tuple[int, ...]) -> Optional[int]:
     return None
 
 
+def spmd_partitionable(num_heads: int, num_kv_heads: int,
+                       n_model: int) -> bool:
+    """Can flash_attention_spmd partition this head layout over an n_model-
+    way model axis? Single source of truth shared with the engine's
+    _resolve_attn so config-time choice and kernel-time dispatch can't
+    drift. True when q heads divide AND (kv heads divide, or MQA's single
+    kv head replicates)."""
+    if num_heads % n_model:
+        return False
+    return num_kv_heads % n_model == 0 or num_kv_heads == 1
+
+
 def supported(t: int, s: int, d: int) -> bool:
     """Can the kernels serve these shapes? (TPU wants lane-aligned D; any
     shape goes in interpret mode.)"""
@@ -219,6 +231,79 @@ def flash_prefill_attention(
 
 
 # --- decode kernel ---
+
+
+def flash_attention_spmd(
+    mesh,
+    q: jax.Array,                 # [B, T, H, D] (T==1 → decode)
+    k: jax.Array,                 # [B, S, K, D] position-aligned cache
+    v: jax.Array,                 # [B, S, K, D]
+    offsets: jax.Array,           # [B] absolute position of q row start
+    kv_valid: jax.Array,          # [B] valid cache entries per row
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> Optional[jax.Array]:
+    """The kernels under a multi-device (data, model) mesh via shard_map.
+
+    A plain pallas_call inside a pjit'd program is not SPMD-partitionable;
+    this wrapper partitions the problem the way TP shards it anyway — kv
+    heads on "model" (each device already holds its heads' slice of the KV
+    cache, sharding.kv_cache_spec), batch rows on "data" — and runs the
+    kernel per-device on its local heads. Attention is embarrassingly
+    parallel over (batch, kv head), so the body needs NO collectives; the
+    o_proj contraction after (sharded over query heads) stays outside and
+    gets its all-reduce from XLA as usual.
+
+    Returns None when the shapes don't partition (heads don't divide the
+    model axis — the engine's dense path is the fallback, matching
+    _fallback_replicated's cache layout in that case).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    axes = dict(mesh.shape)
+    n_model = axes.get("model", 1)
+    n_data = axes.get("data", 1)
+    if not spmd_partitionable(h, kh, n_model):
+        return None
+    # kv-head partitioning: when kh divides, each device's contiguous q-head
+    # slice maps exactly onto its kv-head slice (q head j ↔ kv head
+    # j // group), so both shard on "model". MQA (kh == 1) replicates the
+    # single kv head — matching _fallback_replicated's cache layout — and
+    # shards only q heads (this is the gemma-2b-on-TP case). Any other
+    # non-dividing kh would scramble the q↔kv grouping per device: dense
+    # (spmd_partitionable rejects it above).
+    kv_head_ax = ("model" if n_model > 1 and kh % n_model == 0 else None)
+    if not supported(t, s, d):
+        return None
+    batch_ax = "data" if (n_data > 1 and b % n_data == 0) else None
+    head_ax = "model" if n_model > 1 else None
+
+    q_spec = P(batch_ax, None, head_ax, None)
+    kv_spec = P(batch_ax, None, kv_head_ax, None)
+    row_spec = P(batch_ax)
+    out_spec = q_spec
+
+    def body(ql, kl, vl, offs_l, valid_l):
+        if t > 1:
+            return flash_prefill_attention(
+                ql, kl, vl, offs_l, valid_l,
+                sliding_window=sliding_window, softcap=softcap,
+                interpret=interpret)
+        return ragged_decode_attention(
+            ql, kl, vl, valid_l,
+            sliding_window=sliding_window, softcap=softcap,
+            interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(q_spec, kv_spec, kv_spec, row_spec, row_spec),
+                   out_specs=out_spec, check_vma=False)
+    return fn(q, k, v, offsets.astype(jnp.int32),
+              kv_valid.astype(jnp.int32))
 
 
 def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
