@@ -213,6 +213,87 @@ class TestEngineGenerate:
         assert _bucket(9999) == 2048
 
 
+class TestSharedPrefix:
+    """Cross-knight shared-prefix reuse (SURVEY §7.3 hard part 2,
+    VERDICT r1 #3): K/V spans copied between slots instead of
+    re-prefilling the common context+transcript preamble."""
+
+    SHARED = ("The roundtable context: the codebase uses a session store "
+              "under .roundtable with chronicle, manifest and decree logs. "
+              "Transcript so far: knight A proposed caching; knight B "
+              "objected on memory grounds; scores were 7 and 5. ")
+
+    def _fresh_engine(self):
+        return InferenceEngine(
+            get_model_config("tiny-gemma"), num_slots=4,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+
+    def _control(self, prompts):
+        """Full-prefill outputs: every slot's record cleared between calls
+        so neither own-slot LCP nor donor copies can kick in."""
+        eng = self._fresh_engine()
+        outs = []
+        for name, p in prompts:
+            for n in list(eng.kv.slot_names()):
+                eng.kv.release(n)
+            outs.append(eng.generate(p, slot_name=name, max_new_tokens=8))
+            assert eng.last_stats.reused_tokens == 0
+        return outs
+
+    def test_donor_reuse_across_slot_names(self):
+        """Knight B's FRESH slot copies knight A's committed K/V for the
+        shared preamble — reuse across different slot names."""
+        eng = self._fresh_engine()
+        prompts = [("knight-a", self.SHARED + "You are A. Respond."),
+                   ("knight-b", self.SHARED + "You are B, the skeptic.")]
+        out_a = eng.generate(prompts[0][1], slot_name="knight-a",
+                             max_new_tokens=8)
+        assert eng.last_stats.reused_tokens == 0  # nothing to share yet
+        out_b = eng.generate(prompts[1][1], slot_name="knight-b",
+                             max_new_tokens=8)
+        assert eng.last_stats.reused_tokens >= len(self.SHARED) - 8
+        control = self._control(prompts)
+        assert [out_a, out_b] == control
+
+    def test_batch_leader_shares_prefix(self):
+        """3-knight fresh batch: the shared span prefills once, the other
+        rows copy it — prefill_tokens ≈ shared + Σ small deltas."""
+        eng = self._fresh_engine()
+        tails = ["You are A. Speak.", "You are B. Speak.",
+                 "You are C. Speak."]
+        prompts = [(f"knight-{i}", self.SHARED + t)
+                   for i, t in enumerate(tails)]
+        outs, stats = eng.generate_batch_with_stats(prompts,
+                                                    max_new_tokens=8)
+        total = sum(len(eng.tokenizer.encode(p)) for _, p in prompts)
+        shared_len = len(eng.tokenizer.encode(self.SHARED + "You are "))
+        # prefill ≈ shared once + three tails; reused ≈ 2 × shared
+        assert stats.prefill_tokens <= total - shared_len
+        assert stats.reused_tokens >= 2 * (shared_len - 16)
+        assert self._control(prompts) == outs
+
+    def test_second_round_delta_still_reuses_own_slot(self):
+        """Sharing must not break own-slot LCP across rounds."""
+        eng = self._fresh_engine()
+        p1 = [("a", self.SHARED + "A speaks."),
+              ("b", self.SHARED + "B speaks.")]
+        eng.generate_batch(p1, max_new_tokens=8)
+        grown = self.SHARED + "Round 1 happened; new arguments appeared. "
+        p2 = [("a", grown + "A speaks."), ("b", grown + "B speaks.")]
+        outs, stats = eng.generate_batch_with_stats(p2, max_new_tokens=8)
+        # both rows kept their own shared-preamble coverage
+        assert stats.reused_tokens >= 2 * (len(self.SHARED) - 8)
+        assert self._control(p2) == outs
+
+    def test_short_prefix_not_shared(self):
+        """Below MIN_SHARED_PREFIX the copy program must not dispatch."""
+        eng = self._fresh_engine()
+        outs, stats = eng.generate_batch_with_stats(
+            [("x", "tiny common A"), ("y", "tiny common B")],
+            max_new_tokens=8)
+        assert stats.reused_tokens == 0
+
+
 class TestSharding:
     def test_mesh_default_all_model(self):
         mesh = build_mesh()

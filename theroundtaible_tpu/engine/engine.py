@@ -37,6 +37,9 @@ from .tokenizer import load_tokenizer
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 MAX_PREFILL_CHUNK = 2048
 DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
+# Cross-slot K/V copies are bandwidth-cheap but still a program dispatch;
+# below this many shared tokens a plain prefill is faster than the copy.
+MIN_SHARED_PREFIX = 64
 
 
 def _bucket(n: int) -> int:
@@ -151,6 +154,26 @@ class InferenceEngine:
             return out
 
         self._scatter_kv = scatter_kv
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def copy_spans(cache_layers, src_idx, dst_idx, lo, hi):
+            # Copy K/V positions [lo_i, hi_i) from slot src_idx[i] into
+            # slot dst_idx[i], per layer — the device side of cross-knight
+            # prefix sharing. Positions are cache-aligned (entry s holds
+            # position s) so a row-masked where is an exact copy; the
+            # whole-row traffic is bandwidth-trivial next to a prefill.
+            s_len = cache_layers[0][0].shape[1]
+            pos = jnp.arange(s_len)[None, :, None, None]
+            mask = ((pos >= lo[:, None, None, None])
+                    & (pos < hi[:, None, None, None]))
+            out = []
+            for k, v in cache_layers:
+                nk = jnp.where(mask, k[src_idx], k[dst_idx])
+                nv = jnp.where(mask, v[src_idx], v[dst_idx])
+                out.append((k.at[dst_idx].set(nk), v.at[dst_idx].set(nv)))
+            return out
+
+        self._copy_spans = copy_spans
 
         # compiled closures (per (batch, bucket) shapes, cached by jit)
         cfg = model_cfg
@@ -319,8 +342,12 @@ class InferenceEngine:
                     continue
                 for bucket in buckets:
                     n = min(bucket, limit)  # lands exactly in `bucket`
-                    tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
-                    turns = [(f"__warmup_{i}", tokens) for i in range(b)]
+                    # Rows diverge at position 1 so cross-slot prefix
+                    # sharing can't collapse the batch — warmup must
+                    # compile the REAL (b, bucket) prefill programs.
+                    turns = [(f"__warmup_{i}",
+                              [self.tokenizer.bos_id] + [5 + i] * (n - 1))
+                             for i in range(b)]
                     for _ in range(2):
                         for name, _p in turns:
                             self.kv.release(name)
@@ -338,8 +365,9 @@ class InferenceEngine:
                 length = self.long_threshold
                 while True:
                     n = min(length, ring_limit)
-                    tokens = [self.tokenizer.bos_id] + [5] * (n - 1)
-                    turns = [(f"__warmup_{i}", tokens) for i in range(b)]
+                    turns = [(f"__warmup_{i}",
+                              [self.tokenizer.bos_id] + [5 + i] * (n - 1))
+                             for i in range(b)]
                     for _ in range(2):
                         for name, _p in turns:
                             self.kv.release(name)
@@ -347,7 +375,19 @@ class InferenceEngine:
                     if length >= ring_limit:
                         break
                     length *= 2
-        for i in range(max(batch_sizes)):
+        # Warm the shared-prefix copy program (copy_spans is ONE shape
+        # thanks to _apply_copies' padding) and the layout fixpoint of the
+        # prefill/decode programs that run right after a copy — otherwise
+        # the first real round with a shared preamble compiles mid-serve.
+        if self.kv.num_slots >= 2 and limit > MIN_SHARED_PREFIX + 8:
+            shared = [self.tokenizer.bos_id] + [7] * (MIN_SHARED_PREFIX + 4)
+            turns = [(f"__warmup_{i}", shared + [9 + i] * 4)
+                     for i in range(2)]
+            for _ in range(2):
+                for name, _p in turns:
+                    self.kv.release(name)
+                self.generate_batch(turns, max_new_tokens=1)
+        for i in range(max(max(batch_sizes), 2)):
             self.kv.release(f"__warmup_{i}")
         return time.monotonic() - t0
 
@@ -451,6 +491,95 @@ class InferenceEngine:
                 raise TimeoutError("prefill timed out")
         return final_logits
 
+    def _apply_copies(self, copies: list[tuple[int, int, int, int]]) -> None:
+        """Dispatch queued (src_slot, dst_slot, lo, hi) K/V span copies.
+
+        The list is padded to num_slots rows so copy_spans compiles exactly
+        ONE shape per engine (no mid-serve recompiles as batch compositions
+        vary). Pad rows self-copy an empty span of a slot that is NOT a
+        real destination — dst indices must stay distinct because scatter
+        order among duplicate indices is unspecified."""
+        if not copies:
+            return
+        width = self.kv.num_slots
+        if len(copies) < width:
+            used = {c[1] for c in copies}
+            pad_dst = next(i for i in range(width) if i not in used)
+            copies = copies + [(pad_dst, pad_dst, 0, 0)] * (width -
+                                                            len(copies))
+        self.kv.layers = self._copy_spans(
+            self.kv.layers,
+            jnp.asarray([c[0] for c in copies], jnp.int32),
+            jnp.asarray([c[1] for c in copies], jnp.int32),
+            jnp.asarray([c[2] for c in copies], jnp.int32),
+            jnp.asarray([c[3] for c in copies], jnp.int32))
+
+    def _share_prefixes(self, names: list[str], slot_ids: list[int],
+                        all_tokens: list[list[int]], offsets: list[int],
+                        deadline: float) -> tuple[list[int], int]:
+        """Cross-knight shared-prefix reuse (SURVEY.md §7.3 hard part 2;
+        reference prompt assembly src/orchestrator.ts:397-425 makes all
+        knights share the giant context+transcript preamble, which the
+        orchestrator here lays out as a common PREFIX).
+
+        Two mechanisms, both copying position-aligned K/V between slots:
+        (a) donor pass — a slot committed by an earlier call (another
+            knight's turn) that shares a longer token prefix than this
+            row's own history donates its K/V span;
+        (b) leader pass — within one batch of fresh rows, the row with the
+            most cache coverage prefills the batch-wide common span ONCE
+            (ring-eligible when long) and the others copy it.
+
+        Returns (updated offsets, leader-prefilled token count). Prefill
+        FLOPs for the shared span are paid once instead of N times; HBM
+        still holds per-slot copies (true page-level dedup is the paged-KV
+        allocator's job)."""
+        b = len(names)
+        offsets = list(offsets)
+        extra_prefill = 0
+
+        # (a) donors from earlier calls — apply before the leader pass so
+        # leader-sourced copies below never read a pending span.
+        copies = []
+        for i in range(b):
+            cap = len(all_tokens[i]) - 1
+            donor, dlen = self.kv.best_donor(names[i], all_tokens[i])
+            dlen = min(dlen, cap)
+            if donor is not None and dlen - offsets[i] >= MIN_SHARED_PREFIX:
+                copies.append((donor.slot_id, slot_ids[i], offsets[i], dlen))
+                offsets[i] = dlen
+        self._apply_copies(copies)
+
+        if b < 2:
+            return offsets, extra_prefill
+
+        # (b) batch-wide common prefix, leader prefills it once.
+        shared = all_tokens[0]
+        for t in all_tokens[1:]:
+            n = self.kv.common_prefix_len(shared, t)
+            shared = shared[:n]
+        l_shared = min(len(shared),
+                       min(len(t) for t in all_tokens) - 1)
+        m = max(range(b), key=lambda i: offsets[i])
+        laggards = [i for i in range(b)
+                    if i != m and l_shared - offsets[i] >= MIN_SHARED_PREFIX]
+        if not laggards:
+            return offsets, extra_prefill
+        if offsets[m] < l_shared:
+            # _prefill (not _prefill_chunked): a fresh long shared span
+            # takes the ring path on sequence-parallel engines
+            self._prefill([slot_ids[m]],
+                          [all_tokens[m][offsets[m]:l_shared]],
+                          [offsets[m]], deadline)
+            extra_prefill += l_shared - offsets[m]
+            offsets[m] = l_shared
+        copies = []
+        for i in laggards:
+            copies.append((slot_ids[m], slot_ids[i], offsets[i], l_shared))
+            offsets[i] = l_shared
+        self._apply_copies(copies)
+        return offsets, extra_prefill
+
     def generate(self, prompt: str, slot_name: str = "default",
                  max_new_tokens: Optional[int] = None,
                  timeout_s: float = 600.0) -> str:
@@ -494,7 +623,7 @@ class InferenceEngine:
         max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
 
         pinned = tuple(name for name, _ in turns)
-        slot_ids, suffixes, offsets, all_tokens = [], [], [], []
+        slot_ids, offsets, all_tokens = [], [], []
         for name, prompt in turns:
             # A list of ids is accepted as a pre-tokenized prompt (warmup
             # uses this to hit exact bucket shapes).
@@ -507,13 +636,21 @@ class InferenceEngine:
                 tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
             slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
             slot_ids.append(slot_id)
-            suffixes.append(tokens[reuse:])
             offsets.append(reuse)
             all_tokens.append(tokens)
-            stats.reused_tokens += reuse
-            stats.prefill_tokens += len(tokens) - reuse
 
         t0 = time.monotonic()
+        # Cross-knight shared-prefix reuse raises offsets by copying other
+        # slots' K/V; only the per-knight deltas remain to prefill.
+        offsets, leader_prefill = self._share_prefixes(
+            [name for name, _ in turns], slot_ids, all_tokens, offsets,
+            deadline)
+        suffixes = [t[o:] for t, o in zip(all_tokens, offsets)]
+        stats.prefill_tokens = leader_prefill + sum(
+            len(s) for s in suffixes)
+        # "reused" counts both own-slot LCP hits and copied donor spans.
+        stats.reused_tokens = sum(
+            len(t) for t in all_tokens) - stats.prefill_tokens
         last_logits = self._prefill(slot_ids, suffixes, offsets,
                                     deadline=deadline)
         # A scalar fetch, not block_until_ready: some PJRT transports

@@ -117,3 +117,22 @@ class KVCache:
     def commit(self, name: str, tokens: list[int]) -> None:
         """Record that the slot's cache now covers exactly `tokens`."""
         self.acquire(name).tokens = list(tokens)
+
+    def best_donor(self, name: str,
+                   tokens: list[int]) -> tuple[Optional[SlotState], int]:
+        """The OTHER slot sharing the longest committed token prefix with
+        `tokens` — the cross-knight reuse seam (SURVEY.md §7.3 hard part 2):
+        knights' prompts share the giant context+transcript preamble
+        (orchestrator _build_turn_prompt lays shared text first), so knight
+        B's fresh slot can copy knight A's K/V for the common span instead
+        of re-prefilling it. Donor records are truncated by reuse_plan when
+        they join a batch, so a donor never advertises positions that are
+        about to be overwritten."""
+        best, best_len = None, 0
+        for state in self._slots.values():
+            if state.name == name or not state.tokens:
+                continue
+            n = self.common_prefix_len(state.tokens, tokens)
+            if n > best_len:
+                best, best_len = state, n
+        return best, best_len

@@ -344,7 +344,9 @@ def forward(
     kv_valid_len: jax.Array,      # [B] valid entries AFTER this step
 ) -> tuple[jax.Array, list[tuple[jax.Array, jax.Array]]]:
     """Full model forward. Returns (logits [B,T,V], updated caches)."""
-    x = params["embedding"][tokens].astype(jnp.bfloat16)
+    # Activations follow the param dtype: bf16 params (serving) keep the
+    # whole network bf16; f32 params (HF logit-parity tests) stay f32.
+    x = params["embedding"][tokens]
     if cfg.scale_embeddings:
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
 
