@@ -204,7 +204,9 @@ def make_ring_prefill(cfg: ModelConfig, mesh: Mesh, scheme: str = "ring"):
     n = mesh.shape[SEQ_AXIS]
 
     def shard_fn(params, tokens, positions, lengths):
-        x = params["embedding"][tokens].astype(jnp.bfloat16)
+        # follows the param dtype (bf16 serving, f32 parity tests) — same
+        # rule as models/common.py forward
+        x = params["embedding"][tokens]
         if cfg.scale_embeddings:
             x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
         q_pos = positions

@@ -111,7 +111,9 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, n_micro: int):
         pos_mb = positions.reshape(n_micro, mb, t)
         valid_mb = valid.reshape(n_micro, mb)
 
-        emb = shared["embedding"][tok_mb].astype(jnp.bfloat16)
+        # follows the param dtype (bf16 serving, f32 parity tests) — same
+        # rule as models/common.py forward
+        emb = shared["embedding"][tok_mb]
         if cfg.scale_embeddings:
             emb = emb * jnp.sqrt(
                 jnp.float32(cfg.embed_dim)).astype(emb.dtype)
