@@ -1,0 +1,193 @@
+"""Paged KV cache: allocator invariants, HBM accounting, and end-to-end
+parity with the contiguous layout (VERDICT r1 missing #3; PAPERS.md
+"Ragged Paged Attention")."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.paging import PagedKVCache
+from theroundtaible_tpu.engine.sampling import SamplingParams
+
+PS = 16  # small pages so tiny prompts span several
+
+
+def make_cache(num_slots=4, max_seq=128, num_pages=None, copies=None):
+    cfg = get_model_config("tiny-gemma", max_seq_len=max_seq)
+    recorded = []
+
+    def copy_fn(pools, src, dst):
+        recorded.append((np.asarray(src), np.asarray(dst)))
+        out = []
+        for k, v in pools:
+            out.append((k.at[dst].set(k[src]), v.at[dst].set(v[src])))
+        return out
+
+    kv = PagedKVCache(cfg, num_slots, max_seq, jnp.float32,
+                      page_size=PS, num_pages=num_pages,
+                      copy_pages_fn=copy_fn)
+    if copies is not None:
+        copies.extend([recorded])  # alias for inspection
+    kv._recorded_copies = recorded
+    return kv
+
+
+class TestAllocator:
+    def test_capacity_allocates_and_frees(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 40, write_from=0)   # 3 pages of 16
+        assert kv.pages_in_use() == 3
+        kv.commit("a", list(range(20)))             # 2 pages needed
+        assert kv.pages_in_use() == 2
+        kv.release("a")
+        assert kv.pages_in_use() == 0
+
+    def test_hbm_scales_with_pool_not_slots(self):
+        cfg = get_model_config("tiny-gemma", max_seq_len=128)
+        small = PagedKVCache(cfg, 8, 128, jnp.float32, page_size=PS,
+                             num_pages=9, copy_pages_fn=None)
+        big = PagedKVCache(cfg, 8, 128, jnp.float32, page_size=PS,
+                           num_pages=65, copy_pages_fn=None)
+        assert small.hbm_bytes() * 7 < big.hbm_bytes()
+        # contiguous equivalent: 8 slots × 128 positions = 64 pages worth;
+        # the small pool serves the same slot COUNT in 1/7th the HBM
+        assert small.num_pages == 9
+
+    def test_alias_span_shares_whole_pages(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 64, write_from=0)
+        kv.commit("a", list(range(64)))             # 4 full pages
+        before = kv.pages_in_use()
+        kv.acquire("b")
+        kv.alias_span("a", "b", 0, 48)              # 3 whole pages
+        # aliasing added ZERO new pages (pure refcount)
+        assert kv.pages_in_use() == before
+        assert kv._slots["b"].pages == kv._slots["a"].pages[:3]
+        assert not kv._recorded_copies
+
+    def test_alias_span_copies_partial_boundary(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 64, write_from=0)
+        kv.commit("a", list(range(64)))
+        kv.acquire("b")
+        kv.alias_span("a", "b", 0, 40)  # 2 whole pages + 8 into page 2
+        assert kv._slots["b"].pages[:2] == kv._slots["a"].pages[:2]
+        # boundary page is a COPY, not an alias
+        assert kv._slots["b"].pages[2] != kv._slots["a"].pages[2]
+        assert len(kv._recorded_copies) == 1
+
+    def test_cow_on_write_into_shared_page(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 48, write_from=0)
+        kv.commit("a", list(range(48)))
+        kv.acquire("b")
+        kv.alias_span("a", "b", 0, 48)              # 3 aliased pages
+        shared_page = kv._slots["b"].pages[2]
+        # b now extends: writing from position 40 lands inside page 2
+        kv.ensure_capacity("b", 80, write_from=40)
+        assert kv._slots["b"].pages[2] != shared_page   # COW'd
+        assert kv._slots["a"].pages[2] == shared_page   # donor untouched
+
+    def test_eviction_frees_pages_for_new_slots(self):
+        kv = make_cache(num_slots=4, num_pages=9)   # 8 usable pages
+        kv.acquire("a")
+        kv.ensure_capacity("a", 128, write_from=0)  # all 8 pages
+        kv.commit("a", list(range(128)))
+        kv.acquire("b")
+        kv.ensure_capacity("b", 32, write_from=0, pinned=("b",))
+        assert "a" not in kv._slots                 # evicted
+        assert kv.pages_in_use() == 2
+
+    def test_alias_span_never_evicts_donor(self):
+        """Boundary-copy allocation under pressure must not evict the
+        donor whose pages are about to be aliased (review r2 finding:
+        incref after eviction would resurrect freed pages)."""
+        kv = make_cache(num_slots=4, num_pages=7)   # 6 usable pages
+        kv.acquire("a")
+        kv.ensure_capacity("a", 96, write_from=0)   # all 6 pages
+        kv.commit("a", list(range(96)))
+        kv.acquire("b")
+        with pytest.raises(RuntimeError, match="exhaust"):
+            kv.alias_span("a", "b", 0, 40)          # tail copy needs alloc
+        # the donor survived with its pages intact
+        assert len(kv._slots["a"].pages) == 6
+        assert kv.pages_in_use() == 6
+
+    def test_pool_exhaustion_raises_when_all_pinned(self):
+        kv = make_cache(num_slots=4, num_pages=9)
+        kv.acquire("a")
+        kv.ensure_capacity("a", 128, write_from=0, pinned=("a", "b"))
+        kv.acquire("b")
+        with pytest.raises(RuntimeError, match="exhaust"):
+            kv.ensure_capacity("b", 32, write_from=0, pinned=("a", "b"))
+
+
+class TestPagedEngineParity:
+    """The paged engine must produce byte-identical greedy output to the
+    contiguous engine — same model, same seed, every serving feature."""
+
+    def _engines(self, **kw):
+        def build(layout):
+            return InferenceEngine(
+                get_model_config("tiny-gemma", max_seq_len=256),
+                num_slots=4, kv_layout=layout, page_size=32,
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=8),
+                **kw)
+        return build("paged"), build("contiguous")
+
+    def test_generate_parity(self):
+        paged, dense = self._engines()
+        p = "the knights debate the session store design at length"
+        assert (paged.generate(p, slot_name="a", max_new_tokens=8)
+                == dense.generate(p, slot_name="a", max_new_tokens=8))
+
+    def test_multiturn_delta_prefill_parity(self):
+        paged, dense = self._engines()
+        base = "round one establishes the shared context for everyone here."
+        ext = base + " round two adds new arguments and asks for a score."
+        outs = []
+        for eng in (paged, dense):
+            eng.generate(base, slot_name="k", max_new_tokens=8)
+            outs.append(eng.generate(ext, slot_name="k", max_new_tokens=8))
+            assert eng.last_stats.reused_tokens > 0
+        assert outs[0] == outs[1]
+
+    def test_batch_with_shared_prefix_parity(self):
+        paged, dense = self._engines()
+        shared = ("the common context paragraph that every knight receives "
+                  "before their personal instructions begin here. ")
+        prompts = [(f"kn{i}", shared + f"You are knight {i}.")
+                   for i in range(3)]
+        out_p, stats_p = paged.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        out_d, stats_d = dense.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        assert out_p == out_d
+        # both layouts shared the prefix; paged did it by aliasing
+        assert stats_p.reused_tokens > 0
+        assert stats_p.reused_tokens == stats_d.reused_tokens
+
+    def test_paged_engine_pages_scale_with_use(self):
+        paged, _ = self._engines()
+        paged.generate("short", slot_name="s", max_new_tokens=8)
+        used_short = paged.kv.pages_in_use()
+        paged.generate("a much longer prompt " * 8, slot_name="l",
+                       max_new_tokens=8)
+        assert paged.kv.pages_in_use() > used_short
+        d = paged.describe()
+        assert d["kv_layout"] == "paged"
+        assert d["kv_hbm_bytes"] > 0
+
+    def test_paged_rejects_seq_parallel(self):
+        with pytest.raises(ValueError, match="paged"):
+            InferenceEngine(
+                get_model_config("tiny-gemma", max_seq_len=256),
+                num_slots=2, kv_layout="paged", seq_parallel=8)

@@ -58,7 +58,8 @@ def _cache_key(config: dict[str, Any]) -> str:
     relevant = {k: config.get(k) for k in
                 ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
                  "seq_parallel", "long_scheme", "long_threshold",
-                 "devices", "attn", "num_slots", "sampling", "seed")}
+                 "devices", "attn", "num_slots", "sampling", "seed",
+                 "kv_layout", "page_size", "num_pages")}
     return json.dumps(relevant, sort_keys=True)
 
 

@@ -78,7 +78,9 @@ class InferenceEngine:
                  seed: int = 0, seq_parallel: int = 0,
                  long_threshold: int = 2048,
                  long_scheme: str = "ring", attn: str = "auto",
-                 devices: Optional[list[int]] = None):
+                 devices: Optional[list[int]] = None,
+                 kv_layout: str = "contiguous", page_size: int = 128,
+                 num_pages: Optional[int] = None):
         # Persistent XLA compile cache: first-ever run compiles, every
         # later process deserializes (SURVEY.md §7.3 hard part 5).
         from . import enable_compilation_cache
@@ -104,18 +106,88 @@ class InferenceEngine:
         self.params = shard_params(params, model_cfg, self.mesh)
         self.num_params = param_count(self.params)
 
-        cache_sharding = None
-        if self.mesh.devices.size > 1:
-            from jax.sharding import NamedSharding
-            from .sharding import _fallback_replicated
-            spec = _fallback_replicated(
-                kv_cache_spec(),
-                (num_slots, self.max_seq_len, model_cfg.num_kv_heads,
-                 model_cfg.head_dim),
-                self.mesh)
-            cache_sharding = NamedSharding(self.mesh, spec)
-        self.kv = KVCache(model_cfg, num_slots, self.max_seq_len, dtype,
-                          cache_sharding)
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be contiguous|paged, got {kv_layout!r}")
+        if kv_layout == "paged" and seq_parallel and seq_parallel > 1:
+            raise ValueError(
+                "kv_layout='paged' + seq_parallel is not supported yet — "
+                "the ring scatter writes whole contiguous sequences")
+        self.kv_layout = kv_layout
+
+        if kv_layout == "paged":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .paging import PagedKVCache
+            from .sharding import MODEL_AXIS, _fallback_replicated
+            pool_sharding = None
+            if self.mesh.devices.size > 1:
+                spec = _fallback_replicated(
+                    P(None, None, MODEL_AXIS, None),
+                    (1, page_size, model_cfg.num_kv_heads,
+                     model_cfg.head_dim),
+                    self.mesh)
+                pool_sharding = NamedSharding(self.mesh, spec)
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def copy_pages(pools, src_ids, dst_ids):
+                # Whole-page copies (copy-on-write + alias boundaries).
+                # Callers pad the id lists to a fixed width so this
+                # compiles exactly one shape (pad rows copy the scratch
+                # page onto itself — identical bytes, any scatter order).
+                out = []
+                for k, v in pools:
+                    out.append((k.at[dst_ids].set(k[src_ids]),
+                                v.at[dst_ids].set(v[src_ids])))
+                return out
+
+            # Fixed copy width: COW/boundary copies are typically 1-2
+            # pages, so chunking at 8 keeps padding waste small, compiles
+            # exactly ONE program shape, and bounds per-dispatch traffic
+            # (vs padding to pages_per_seq, which would move a whole
+            # sequence's worth of pages for a 1-page copy).
+            copy_width = 8
+
+            def copy_pages_padded(pools, src_ids, dst_ids):
+                n = int(src_ids.shape[0])
+                for start in range(0, n, copy_width):
+                    s = src_ids[start:start + copy_width]
+                    d = dst_ids[start:start + copy_width]
+                    pad = copy_width - int(s.shape[0])
+                    if pad:
+                        s = jnp.concatenate(
+                            [s, jnp.zeros((pad,), jnp.int32)])
+                        d = jnp.concatenate(
+                            [d, jnp.zeros((pad,), jnp.int32)])
+                    pools = copy_pages(pools, s, d)
+                return pools
+
+            # Default pool HALVES the contiguous HBM budget per device. The
+            # pool is replicated over the data axis (pages are dynamically
+            # owned, so they cannot shard the way contiguous slots do),
+            # hence the per-device budget divides by the data-axis size.
+            data_size = dict(self.mesh.shape).get("data", 1)
+            if num_pages is None:
+                pages_per_seq = self.max_seq_len // page_size
+                num_pages = max(
+                    num_slots * pages_per_seq // (2 * data_size),
+                    pages_per_seq) + 1
+            self.kv = PagedKVCache(
+                model_cfg, num_slots, self.max_seq_len, dtype,
+                pool_sharding, page_size=page_size, num_pages=num_pages,
+                copy_pages_fn=copy_pages_padded)
+        else:
+            cache_sharding = None
+            if self.mesh.devices.size > 1:
+                from jax.sharding import NamedSharding
+                from .sharding import _fallback_replicated
+                spec = _fallback_replicated(
+                    kv_cache_spec(),
+                    (num_slots, self.max_seq_len, model_cfg.num_kv_heads,
+                     model_cfg.head_dim),
+                    self.mesh)
+                cache_sharding = NamedSharding(self.mesh, spec)
+            self.kv = KVCache(model_cfg, num_slots, self.max_seq_len, dtype,
+                              cache_sharding)
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
@@ -248,6 +320,96 @@ class InferenceEngine:
 
         self._decode_loop = decode_loop
 
+        # --- paged variants: identical math on a table-gathered view ---
+        # pool[table] materializes the SAME position-aligned [B, S, K, D]
+        # view the contiguous path gathers per slot, so forward() and the
+        # Pallas kernels are layout-agnostic; the updated view scatters
+        # back through the same table. Aliased (shared-prefix) pages are
+        # never in any row's write range (ensure_capacity copy-on-writes
+        # them), so duplicate-index scatters only ever rewrite identical
+        # bytes.
+        if kv_layout == "paged":
+            n_pages_seq = self.max_seq_len // page_size
+
+            def gather_view(pools, tables, b):
+                caches_b = []
+                for k_pool, v_pool in pools:
+                    tail = k_pool.shape[2:]
+                    kb = k_pool[tables].reshape(
+                        b, n_pages_seq * page_size, *tail)
+                    vb = v_pool[tables].reshape(
+                        b, n_pages_seq * page_size, *tail)
+                    caches_b.append((kb, vb))
+                return caches_b
+
+            def scatter_view(pools, tables, new_b, b):
+                out = []
+                for (k_pool, v_pool), (nk, nv) in zip(pools, new_b):
+                    tail = k_pool.shape[2:]
+                    nk5 = nk.reshape(b, n_pages_seq, page_size, *tail)
+                    nv5 = nv.reshape(b, n_pages_seq, page_size, *tail)
+                    out.append((k_pool.at[tables].set(nk5),
+                                v_pool.at[tables].set(nv5)))
+                return out
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_step_paged(params, pools, tables, tokens, offsets,
+                                   lengths):
+                with spmd_mesh(mesh):
+                    b, t = tokens.shape
+                    caches_b = gather_view(pools, tables, b)
+                    positions = offsets[:, None] + jnp.arange(t)[None, :]
+                    valid = offsets + lengths
+                    logits, new_b = forward(params, cfg, tokens, positions,
+                                            caches_b, offsets, valid)
+                    new_pools = scatter_view(pools, tables, new_b, b)
+                    last = jnp.take_along_axis(
+                        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                    return last, new_pools
+
+            self._prefill_step_paged = prefill_step_paged
+
+            @partial(jax.jit, donate_argnums=(1,),
+                     static_argnames=("max_new",))
+            def decode_loop_paged(params, pools, tables, first_token,
+                                  start_valid, key, budget, max_new):
+                b = first_token.shape[0]
+                caches_b = gather_view(pools, tables, b)
+                out = jnp.zeros((b, max_new), jnp.int32)
+                done = jnp.zeros((b,), bool)
+                eos = jnp.int32(self.tokenizer.eos_id)
+
+                def cond(state):
+                    step, _, _, done, _, _, _ = state
+                    return ((step < max_new) & (step < budget)
+                            & ~jnp.all(done))
+
+                def body(state):
+                    step, last, valid, done, out, caches_b, key = state
+                    logits, caches_b = forward(
+                        params, cfg, last[:, None], valid[:, None],
+                        caches_b, valid, valid + 1)
+                    key, sub = jax.random.split(key)
+                    nxt = sample_token(
+                        logits[:, 0].astype(jnp.float32), sub,
+                        self.sampling).astype(jnp.int32)
+                    nxt = jnp.where(done, eos, nxt)
+                    out = out.at[:, step].set(nxt)
+                    new_done = done | (nxt == eos)
+                    valid = jnp.where(done, valid, valid + 1)
+                    return (step + 1, nxt, valid, new_done, out, caches_b,
+                            key)
+
+                state = (jnp.int32(0), first_token, start_valid, done, out,
+                         caches_b, key)
+                with spmd_mesh(mesh):
+                    step, last, valid, done, out, caches_b, _ = \
+                        jax.lax.while_loop(cond, body, state)
+                new_pools = scatter_view(pools, tables, caches_b, b)
+                return out, step, last, valid, done, new_pools
+
+            self._decode_loop_paged = decode_loop_paged
+
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
                       mesh) -> ModelConfig:
@@ -312,6 +474,10 @@ class InferenceEngine:
             long_scheme=config.get("long_scheme", "ring"),
             attn=config.get("attn", "auto"),
             devices=config.get("devices"),
+            kv_layout=config.get("kv_layout", "contiguous"),
+            page_size=int(config.get("page_size", 128)),
+            num_pages=(int(config["num_pages"])
+                       if config.get("num_pages") else None),
         )
 
     # --- serving ---
@@ -404,8 +570,8 @@ class InferenceEngine:
         return sub
 
     def _prefill(self, slot_ids: list[int], token_lists: list[list[int]],
-                 offsets: list[int], deadline: float = float("inf")
-                 ) -> jax.Array:
+                 offsets: list[int], deadline: float = float("inf"),
+                 names: Optional[list[str]] = None) -> jax.Array:
         """Prefill dispatch: fresh long prompts go to the sequence-parallel
         ring program; everything else (short prompts, delta prefills on a
         reused prefix) takes the chunked bucketed path."""
@@ -418,7 +584,8 @@ class InferenceEngine:
                                self.kv.max_seq_len)
             if tpad:
                 return self._prefill_ring(slot_ids, token_lists, tpad)
-        return self._prefill_chunked(slot_ids, token_lists, offsets, deadline)
+        return self._prefill_chunked(slot_ids, token_lists, offsets,
+                                     deadline, names)
 
     def _prefill_ring(self, slot_ids: list[int],
                       token_lists: list[list[int]], tpad: int) -> jax.Array:
@@ -441,11 +608,17 @@ class InferenceEngine:
 
     def _prefill_chunked(self, slot_ids: list[int],
                          token_lists: list[list[int]], offsets: list[int],
-                         deadline: float = float("inf")) -> jax.Array:
+                         deadline: float = float("inf"),
+                         names: Optional[list[str]] = None) -> jax.Array:
         """Chunked, bucketed prefill for B rows. Returns last-token logits
         [B, V] (f32). token_lists are the NOT-yet-cached suffixes."""
         b = len(slot_ids)
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        tables = None
+        if self.kv_layout == "paged":
+            # Page tables are fixed for the whole call (capacity is
+            # ensured before any prefill dispatch).
+            tables = jnp.asarray(self.kv.table_for(names))
         offs = list(offsets)
         remaining = [list(t) for t in token_lists]
         final_logits: Optional[jax.Array] = None
@@ -474,10 +647,16 @@ class InferenceEngine:
                 # stays outside their committed length and decode overwrites
                 # that position with the first real generated token.
                 lengths[i] = max(take, 1)
-            last_logits, self.kv.layers = self._prefill_step(
-                self.params, self.kv.layers, slot_idx,
-                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                jnp.asarray(lengths))
+            if tables is not None:
+                last_logits, self.kv.pools = self._prefill_step_paged(
+                    self.params, self.kv.pools, tables,
+                    jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                    jnp.asarray(lengths))
+            else:
+                last_logits, self.kv.layers = self._prefill_step(
+                    self.params, self.kv.layers, slot_idx,
+                    jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                    jnp.asarray(lengths))
             # Keep each row's logits from the chunk where its REAL tokens
             # ended; later pad-only chunks must not clobber them.
             if final_logits is None:
@@ -535,18 +714,27 @@ class InferenceEngine:
         still holds per-slot copies (true page-level dedup is the paged-KV
         allocator's job)."""
         b = len(names)
+        paged = self.kv_layout == "paged"
+        pinned = tuple(names)
         offsets = list(offsets)
         extra_prefill = 0
 
         # (a) donors from earlier calls — apply before the leader pass so
-        # leader-sourced copies below never read a pending span.
+        # leader-sourced copies below never read a pending span. Paged
+        # caches ALIAS the donor's whole pages (refcount, zero copy) and
+        # device-copy only the partial boundary pages.
         copies = []
         for i in range(b):
             cap = len(all_tokens[i]) - 1
             donor, dlen = self.kv.best_donor(names[i], all_tokens[i])
             dlen = min(dlen, cap)
             if donor is not None and dlen - offsets[i] >= MIN_SHARED_PREFIX:
-                copies.append((donor.slot_id, slot_ids[i], offsets[i], dlen))
+                if paged:
+                    self.kv.alias_span(donor.name, names[i], offsets[i],
+                                       dlen, pinned)
+                else:
+                    copies.append((donor.slot_id, slot_ids[i], offsets[i],
+                                   dlen))
                 offsets[i] = dlen
         self._apply_copies(copies)
 
@@ -566,16 +754,25 @@ class InferenceEngine:
         if not laggards:
             return offsets, extra_prefill
         if offsets[m] < l_shared:
+            if paged:
+                self.kv.ensure_capacity(names[m], l_shared,
+                                        write_from=offsets[m],
+                                        pinned=pinned)
             # _prefill (not _prefill_chunked): a fresh long shared span
             # takes the ring path on sequence-parallel engines
             self._prefill([slot_ids[m]],
                           [all_tokens[m][offsets[m]:l_shared]],
-                          [offsets[m]], deadline)
+                          [offsets[m]], deadline, names=[names[m]])
             extra_prefill += l_shared - offsets[m]
             offsets[m] = l_shared
         copies = []
         for i in laggards:
-            copies.append((slot_ids[m], slot_ids[i], offsets[i], l_shared))
+            if paged:
+                self.kv.alias_span(names[m], names[i], offsets[i],
+                                   l_shared, pinned)
+            else:
+                copies.append((slot_ids[m], slot_ids[i], offsets[i],
+                               l_shared))
             offsets[i] = l_shared
         self._apply_copies(copies)
         return offsets, extra_prefill
@@ -640,11 +837,20 @@ class InferenceEngine:
             all_tokens.append(tokens)
 
         t0 = time.monotonic()
-        # Cross-knight shared-prefix reuse raises offsets by copying other
-        # slots' K/V; only the per-knight deltas remain to prefill.
+        names = [name for name, _ in turns]
+        # Cross-knight shared-prefix reuse raises offsets by copying (or,
+        # paged, aliasing) other slots' K/V; only the per-knight deltas
+        # remain to prefill.
         offsets, leader_prefill = self._share_prefixes(
-            [name for name, _ in turns], slot_ids, all_tokens, offsets,
-            deadline)
+            names, slot_ids, all_tokens, offsets, deadline)
+        if self.kv_layout == "paged":
+            # Allocate pages for the whole call (prompt + padded decode)
+            # and copy-on-write any shared page in the write range, so the
+            # jit'd programs below never allocate or touch aliased pages.
+            for i, name in enumerate(names):
+                self.kv.ensure_capacity(
+                    name, len(all_tokens[i]) + max_new_padded,
+                    write_from=offsets[i], pinned=pinned)
         suffixes = [t[o:] for t, o in zip(all_tokens, offsets)]
         stats.prefill_tokens = leader_prefill + sum(
             len(s) for s in suffixes)
@@ -652,7 +858,7 @@ class InferenceEngine:
         stats.reused_tokens = sum(
             len(t) for t in all_tokens) - stats.prefill_tokens
         last_logits = self._prefill(slot_ids, suffixes, offsets,
-                                    deadline=deadline)
+                                    deadline=deadline, names=names)
         # A scalar fetch, not block_until_ready: some PJRT transports
         # (the axon relay) return from block_until_ready before the
         # computation finishes, which would blame prefill time on decode.
@@ -675,16 +881,26 @@ class InferenceEngine:
         # tokens are cheaper than recompiles and get trimmed below.
         t1 = time.monotonic()
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        tables = (jnp.asarray(self.kv.table_for(names))
+                  if self.kv_layout == "paged" else None)
         b = len(turns)
         segments: list[np.ndarray] = []
         produced = 0
         all_done = False
         while produced < max_new and not all_done:
-            out, steps, cur_last, cur_valid, done, self.kv.layers = \
-                self._decode_loop(
-                    self.params, self.kv.layers, slot_idx, cur_last,
-                    cur_valid, self._next_key(),
-                    jnp.int32(max_new - produced), max_new=DECODE_SEGMENT)
+            if tables is not None:
+                out, steps, cur_last, cur_valid, done, self.kv.pools = \
+                    self._decode_loop_paged(
+                        self.params, self.kv.pools, tables, cur_last,
+                        cur_valid, self._next_key(),
+                        jnp.int32(max_new - produced),
+                        max_new=DECODE_SEGMENT)
+            else:
+                out, steps, cur_last, cur_valid, done, self.kv.layers = \
+                    self._decode_loop(
+                        self.params, self.kv.layers, slot_idx, cur_last,
+                        cur_valid, self._next_key(),
+                        jnp.int32(max_new - produced), max_new=DECODE_SEGMENT)
             steps_n = int(steps)  # forces completion of the segment
             segments.append(np.asarray(out)[:, :steps_n])
             produced += steps_n
@@ -715,11 +931,17 @@ class InferenceEngine:
     # --- introspection ---
 
     def describe(self) -> dict[str, Any]:
-        return {
+        info = {
             "model": self.cfg.name,
             "params": self.num_params,
             "max_seq_len": self.max_seq_len,
             "mesh": dict(self.mesh.shape),
             "num_slots": self.kv.num_slots,
+            "kv_layout": self.kv_layout,
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
+        if self.kv_layout == "paged":
+            info["page_size"] = self.kv.page_size
+            info["num_pages"] = self.kv.num_pages
+            info["kv_hbm_bytes"] = self.kv.hbm_bytes()
+        return info

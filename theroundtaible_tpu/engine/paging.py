@@ -1,0 +1,328 @@
+"""Paged KV cache — page-pool allocation with copy-on-write sharing.
+
+Replaces the contiguous `[num_slots, max_seq_len, K, D]` per-layer cache
+(kvcache.py) whose HBM cost is num_slots × max_seq_len regardless of use
+(VERDICT r1 missing #3; PAPERS.md "Ragged Paged Attention"). Here each
+layer owns a page POOL `[num_pages, page_size, K, D]` and each slot maps
+its logical positions onto pool pages through a page table:
+
+- HBM scales with tokens actually cached, not slots × max_seq_len — the
+  freed budget is what lets a second model stay resident (SURVEY.md §7.3
+  hard part 3).
+- Pages are position-aligned (page j of a slot covers absolute positions
+  [j*page_size, (j+1)*page_size)), so two slots whose token prefixes agree
+  can ALIAS the same pages: cross-knight shared-prefix reuse becomes a
+  refcount bump instead of a device copy. Only the boundary page where the
+  prompts diverge is copied (copy-on-write).
+- Page 0 is a reserved scratch page: table rows are padded with it, and
+  batch rows scatter their unused tail there. It is never aliased and
+  never read (valid-length masks bound every attention read).
+
+Sharding limitation: the pool shards kv heads on the "model" axis but is
+REPLICATED over the "data" axis (pages are dynamically owned, so they
+cannot ride the data axis the way contiguous slots do) — the engine
+divides the default pool size by the data-axis width to keep the
+per-device budget honest. Sharding pages over data-parallel replicas
+(per-replica pools) is future work.
+
+The device side stays simple on purpose: the engine's jit'd programs
+gather `pool[table]` into the same position-aligned `[B, S, K, D]` view
+the contiguous path uses — forward() and the Pallas kernels are layout-
+agnostic — and scatter the updated view back through the same table. The
+gather/scatter traffic equals the contiguous path's per-slot row
+gather/scatter; the win is RESIDENT memory, not per-step traffic.
+
+The reference has no counterpart (its KV memory lives inside Ollama's
+llama.cpp, reference src/adapters/local-llm.ts); this is the engine-side
+equivalent of vLLM/tpu-inference paged attention, re-designed for XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.common import ModelConfig
+
+
+@dataclass
+class PagedSlot:
+    """Host-side bookkeeping for one knight's slot."""
+
+    name: str
+    tokens: list[int] = field(default_factory=list)  # ids baked into cache
+    pages: list[int] = field(default_factory=list)   # logical order
+
+
+class PagedKVCache:
+    """Page-pool KV cache with the same slot interface as KVCache.
+
+    `copy_pages_fn(pools, src_ids, dst_ids)` is the engine-provided jit'd
+    program that copies whole pages (used for copy-on-write); it is the
+    only device operation the allocator itself triggers.
+    """
+
+    def __init__(self, cfg: ModelConfig, num_slots: int,
+                 max_seq_len: Optional[int] = None, dtype=jnp.bfloat16,
+                 sharding=None, page_size: int = 128,
+                 num_pages: Optional[int] = None,
+                 copy_pages_fn: Optional[Callable] = None):
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        if self.max_seq_len % page_size:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} must be a multiple of "
+                f"page_size {page_size}")
+        self.page_size = page_size
+        self.pages_per_seq = self.max_seq_len // page_size
+        # Default pool: HALF the contiguous budget — the honest claim of
+        # paging is serving the same slots in less HBM. +1 for scratch
+        # page 0.
+        self.num_pages = (num_pages if num_pages is not None else
+                          max(num_slots * self.pages_per_seq // 2,
+                              self.pages_per_seq) + 1)
+        if self.num_pages < self.pages_per_seq + 1:
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold even one full "
+                f"sequence ({self.pages_per_seq} pages + scratch)")
+        shape = (self.num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+        make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
+            (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
+        self.pools: list[tuple[jax.Array, jax.Array]] = [
+            (make(), make()) for _ in range(cfg.num_layers)]
+        self._copy_pages_fn = copy_pages_fn
+        self._slots: dict[str, PagedSlot] = {}
+        self._free: list[int] = list(range(1, self.num_pages))  # 0 = scratch
+        self._refs: dict[int, int] = {}
+
+    # --- introspection / accounting ---
+
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    def hbm_bytes(self) -> int:
+        """Resident pool bytes across all layers (the accounting the
+        contiguous layout can't improve on)."""
+        k, _ = self.pools[0]
+        return 2 * k.size * k.dtype.itemsize * len(self.pools)
+
+    def slot_names(self) -> list[str]:
+        return list(self._slots)
+
+    # --- slot lifecycle (KVCache-compatible surface) ---
+
+    def acquire(self, name: str, pinned: tuple[str, ...] = ()) -> PagedSlot:
+        if name in self._slots:
+            self._slots[name] = self._slots.pop(name)  # LRU refresh
+            return self._slots[name]
+        if len(self._slots) >= self.num_slots:
+            victim = next((n for n in self._slots if n not in pinned), None)
+            if victim is None:
+                raise RuntimeError(
+                    f"PagedKVCache has {self.num_slots} slots but "
+                    f"{len(pinned)} knights are pinned in one batch — "
+                    "raise num_slots in the tpu-llm adapter config")
+            self.release(victim)
+        state = PagedSlot(name=name)
+        self._slots[name] = state
+        return state
+
+    def release(self, name: str) -> None:
+        state = self._slots.pop(name, None)
+        if state is not None:
+            for p in state.pages:
+                self._decref(p)
+
+    def reset_slot(self, name: str) -> None:
+        if name in self._slots:
+            state = self._slots[name]
+            for p in state.pages:
+                self._decref(p)
+            state.pages = []
+            state.tokens = []
+
+    # --- refcounting ---
+
+    def _decref(self, page: int) -> None:
+        n = self._refs.get(page, 1) - 1
+        if n <= 0:
+            self._refs.pop(page, None)
+            self._free.append(page)
+        else:
+            self._refs[page] = n
+
+    def _incref(self, page: int) -> None:
+        self._refs[page] = self._refs.get(page, 1) + 1
+
+    def _shared(self, page: int) -> bool:
+        return self._refs.get(page, 1) > 1
+
+    def _alloc_page(self, pinned_names: tuple[str, ...]) -> int:
+        if not self._free:
+            # Evict LRU slots (dict order = recency) until a page frees.
+            for victim in list(self._slots):
+                if victim in pinned_names:
+                    continue
+                self.release(victim)
+                if self._free:
+                    break
+        if not self._free:
+            raise RuntimeError(
+                "Page pool exhausted: all pages pinned by the in-flight "
+                "batch — raise num_pages (tpu-llm adapter config) or "
+                "lower max_new_tokens")
+        return self._free.pop(0)
+
+    # --- prefix bookkeeping ---
+
+    @staticmethod
+    def common_prefix_len(cached: list[int], new: list[int]) -> int:
+        from ..native import lcp
+        return lcp(cached, new)
+
+    def reuse_plan(self, name: str, tokens: list[int],
+                   pinned: tuple[str, ...] = ()) -> tuple[int, int]:
+        """(-1, reuse_len) — same shape as KVCache.reuse_plan, but paged
+        rows are keyed by table_for(names), never by a device slot id (the
+        -1 sentinel fails loudly if ever used as an index). Truncates the
+        record now (crash safety) and drops whole pages beyond the reuse
+        frontier."""
+        state = self.acquire(name, pinned)
+        reuse = self.common_prefix_len(state.tokens, tokens)
+        reuse = min(reuse, len(tokens) - 1)
+        state.tokens = state.tokens[:reuse]
+        self._trim_pages(state, reuse)
+        # Paged layout has no device slot id — every program keys rows by
+        # table_for(names). Return a sentinel so a future caller indexing
+        # device arrays with it fails loudly instead of corrupting rows.
+        return -1, reuse
+
+    def _trim_pages(self, state: PagedSlot, tokens_kept: int) -> None:
+        """Free pages wholly beyond ceil(tokens_kept / page_size)."""
+        keep = -(-tokens_kept // self.page_size) if tokens_kept else 0
+        while len(state.pages) > keep:
+            self._decref(state.pages.pop())
+
+    def commit(self, name: str, tokens: list[int]) -> None:
+        state = self.acquire(name)
+        state.tokens = list(tokens)
+        self._trim_pages(state, len(tokens))
+
+    def best_donor(self, name: str,
+                   tokens: list[int]) -> tuple[Optional[PagedSlot], int]:
+        best, best_len = None, 0
+        for state in self._slots.values():
+            if state.name == name or not state.tokens:
+                continue
+            n = self.common_prefix_len(state.tokens, tokens)
+            if n > best_len:
+                best, best_len = state, n
+        return best, best_len
+
+    # --- capacity + sharing ---
+
+    def ensure_capacity(self, name: str, upto_tokens: int,
+                        write_from: int,
+                        pinned: tuple[str, ...] = ()) -> None:
+        """Make positions [0, upto_tokens) addressable and positions
+        [write_from, upto_tokens) EXCLUSIVELY owned (copy-on-write any
+        shared page the upcoming prefill/decode will write)."""
+        pinned = tuple(pinned) + (name,)  # never self-evict mid-alloc
+        state = self.acquire(name, pinned)
+        need = -(-upto_tokens // self.page_size)
+        while len(state.pages) < need:
+            state.pages.append(self._alloc_page(pinned))
+        first_write_page = write_from // self.page_size
+        cow_src, cow_dst = [], []
+        for j in range(first_write_page, len(state.pages)):
+            p = state.pages[j]
+            if self._shared(p):
+                fresh = self._alloc_page(pinned)
+                cow_src.append(p)
+                cow_dst.append(fresh)
+                self._decref(p)
+                state.pages[j] = fresh
+        if cow_src:
+            self.pools = self._copy_pages_fn(
+                self.pools, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32))
+
+    def alias_span(self, src_name: str, dst_name: str, lo: int,
+                   hi: int, pinned: tuple[str, ...] = ()) -> None:
+        """Give dst the K/V for positions [lo, hi) from src: whole pages
+        alias (refcount++), the partial boundary pages are device-copied.
+        Precondition: src's cache covers [0, hi) and the two token streams
+        agree on [0, hi) (guaranteed by LCP-based callers)."""
+        # Pin BOTH endpoints: _alloc_page's eviction may otherwise release
+        # the donor mid-call and the later incref loop would resurrect
+        # pages already sitting in the free list — silent corruption once
+        # a future alloc hands the same page to another slot.
+        pinned = tuple(pinned) + (src_name, dst_name)
+        src = self.acquire(src_name, pinned)
+        dst = self.acquire(dst_name, pinned)
+        ps = self.page_size
+        lo_page, hi_page = lo // ps, hi // ps
+        # dst keeps its own pages below lo; drop anything it holds beyond.
+        self._trim_pages(dst, lo)
+        if len(dst.pages) < lo_page:
+            # lo is dst's cached length, so this cannot happen — guard for
+            # misuse rather than corrupt silently.
+            raise RuntimeError("alias_span: dst does not cover up to lo")
+        cow_src, cow_dst = [], []
+        if lo % ps and lo_page < hi_page:
+            # dst's partial boundary page: copy src's full page then let
+            # dst's own [lo%ps, ps) region be overwritten... dst's page
+            # holds dst tokens [lo_page*ps, lo) == src's (common prefix),
+            # so copying src's page is a superset update — but dst may
+            # share that page with a third slot, so COW first.
+            j = lo_page
+            if j < len(dst.pages):
+                if self._shared(dst.pages[j]):
+                    fresh = self._alloc_page(pinned)
+                    self._decref(dst.pages[j])
+                    dst.pages[j] = fresh
+            else:
+                dst.pages.append(self._alloc_page(pinned))
+            cow_src.append(src.pages[j])
+            cow_dst.append(dst.pages[j])
+            lo_page += 1
+        # whole pages [lo_page, hi_page): pure aliasing
+        for j in range(lo_page, hi_page):
+            if j < len(dst.pages):
+                self._decref(dst.pages[j])
+                dst.pages[j] = src.pages[j]
+            else:
+                dst.pages.append(src.pages[j])
+            self._incref(src.pages[j])
+        # partial tail [hi_page*ps, hi): device-copy src's page
+        if hi % ps:
+            j = hi_page
+            if j < len(src.pages):
+                if j < len(dst.pages):
+                    if self._shared(dst.pages[j]):
+                        fresh = self._alloc_page(pinned)
+                        self._decref(dst.pages[j])
+                        dst.pages[j] = fresh
+                else:
+                    dst.pages.append(self._alloc_page(pinned))
+                cow_src.append(src.pages[j])
+                cow_dst.append(dst.pages[j])
+        if cow_src:
+            self.pools = self._copy_pages_fn(
+                self.pools, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32))
+
+    # --- device tables ---
+
+    def table_for(self, names: list[str]) -> np.ndarray:
+        """[B, pages_per_seq] int32 page table, scratch-page padded."""
+        table = np.zeros((len(names), self.pages_per_seq), np.int32)
+        for i, name in enumerate(names):
+            pages = self._slots[name].pages
+            table[i, :len(pages)] = pages
+        return table
