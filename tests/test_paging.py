@@ -110,7 +110,7 @@ class TestAllocator:
         """Boundary-copy allocation under pressure must not evict the
         donor whose pages are about to be aliased (review r2 finding:
         incref after eviction would resurrect freed pages)."""
-        kv = make_cache(num_slots=4, num_pages=7)   # 6 usable pages
+        kv = make_cache(num_slots=4, max_seq=96, num_pages=7)  # 6 usable
         kv.acquire("a")
         kv.ensure_capacity("a", 96, write_from=0)   # all 6 pages
         kv.commit("a", list(range(96)))
