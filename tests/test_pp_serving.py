@@ -1,0 +1,105 @@
+"""Pipeline-parallel SERVING (engine/pp_serving.py): stage-local KV
+prefill + decode must match the single-mesh engine token for token, and
+be reachable from the tpu-llm adapter config (VERDICT r1 #7)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.pp_serving import PPEngine
+from theroundtaible_tpu.engine.sampling import SamplingParams
+
+
+# Cross-engine comparisons run in f32: PP's program structure (stacked
+# scan, psum gathers) legitimately reorders bf16 summations, and random
+# tiny-model logits sit close enough to ties that greedy argmax flips on
+# bf16 rounding alone (the reference engine's own batch-vs-single outputs
+# differ the same way under bf16).
+def build_pp(n_stages=2, n_micro=2, **kw):
+    return PPEngine(
+        get_model_config("tiny-llama", max_seq_len=256),
+        n_stages=n_stages, n_micro=n_micro, num_slots=4,
+        dtype=jnp.float32,
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=8), **kw)
+
+
+def build_ref():
+    return InferenceEngine(
+        get_model_config("tiny-llama", max_seq_len=256),
+        mesh_shape={"data": 1, "model": 1}, num_slots=4,
+        dtype=jnp.float32,
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+
+
+class TestPPServingParity:
+    def test_single_prompt_matches_reference(self):
+        pp, ref = build_pp(), build_ref()
+        p = "the knights debate the merits of pipeline parallel serving"
+        assert (pp.generate(p, slot_name="a", max_new_tokens=8)
+                == ref.generate(p, slot_name="a", max_new_tokens=8))
+
+    def test_batch_microbatched_matches_reference(self):
+        pp, ref = build_pp(n_micro=2), build_ref()
+        prompts = [("a", "first knight question about caching"),
+                   ("b", "second knight question, a bit longer than one")]
+        assert (pp.generate_batch(prompts, max_new_tokens=8)
+                == ref.generate_batch(prompts, max_new_tokens=8))
+
+    def test_slot_reuse_across_turns(self):
+        """Second turn extending the first must delta-prefill against the
+        stage-local caches and match a fresh computation."""
+        pp = build_pp()
+        base = "round one says the store needs an event log."
+        ext = base + " round two asks for sizing estimates."
+        pp.generate(base, slot_name="k", max_new_tokens=8)
+        out_reused = pp.generate(ext, slot_name="k", max_new_tokens=8)
+        assert pp.last_stats.reused_tokens > 0
+        out_fresh = build_pp().generate(ext, slot_name="f",
+                                        max_new_tokens=8)
+        assert out_reused == out_fresh
+
+    def test_four_stages(self):
+        pp = PPEngine(
+            get_model_config("tiny-llama", max_seq_len=256, num_layers=4),
+            n_stages=4, n_micro=2, num_slots=2, dtype=jnp.float32,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=6))
+        ref = InferenceEngine(
+            get_model_config("tiny-llama", max_seq_len=256, num_layers=4),
+            mesh_shape={"data": 1, "model": 1}, num_slots=2,
+            dtype=jnp.float32,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=6))
+        p = "four stage pipeline question"
+        assert (pp.generate(p, slot_name="x", max_new_tokens=6)
+                == ref.generate(p, slot_name="x", max_new_tokens=6))
+
+
+class TestPPAdapterConfig:
+    def test_reachable_from_adapter_config(self):
+        """mesh {'pipe': N} in the tpu-llm adapter config builds a
+        PPEngine and serves a round end to end."""
+        from theroundtaible_tpu.adapters.base import KnightTurn
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        from theroundtaible_tpu.engine import reset_engines
+
+        reset_engines()
+        adapter = TpuLlmAdapter("pp-knight", {
+            "model": "tiny-llama", "max_seq_len": 256,
+            "mesh": {"pipe": 2}, "n_micro": 2, "num_slots": 4,
+            "sampling": {"temperature": 0.0, "max_new_tokens": 8}})
+        assert adapter.is_available()
+        assert adapter._get_engine().describe()["mesh"] == {"pipe": 2}
+        outs = adapter.execute_round(
+            [KnightTurn("a", "what say you about pipelines?"),
+             KnightTurn("b", "and what about stage local caches?")])
+        assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+        assert adapter.last_stats()["decode_tokens"] > 0
+        reset_engines()
+
+    def test_describe_scope_is_honest(self):
+        d = build_pp().describe()
+        assert d["kv_layout"] == "stage-local contiguous"
+        assert "no cross-knight donor sharing" in d["scope"]

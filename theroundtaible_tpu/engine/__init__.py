@@ -59,17 +59,25 @@ def _cache_key(config: dict[str, Any]) -> str:
                 ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
                  "seq_parallel", "long_scheme", "long_threshold",
                  "devices", "attn", "num_slots", "sampling", "seed",
-                 "kv_layout", "page_size", "num_pages")}
+                 "kv_layout", "page_size", "num_pages", "n_micro")}
     return json.dumps(relevant, sort_keys=True)
 
 
 def get_engine(config: dict[str, Any]):
-    """Build (or reuse) an InferenceEngine for this adapter config."""
+    """Build (or reuse) an engine for this adapter config.
+
+    A mesh with a "pipe" axis selects the pipeline-parallel serving
+    engine (stage-local weights + KV, engine/pp_serving.py); everything
+    else gets the main InferenceEngine."""
     key = _cache_key(config)
     with _lock:
         if key not in _engines:
-            from .engine import InferenceEngine
-            _engines[key] = InferenceEngine.from_config(config)
+            if (config.get("mesh") or {}).get("pipe"):
+                from .pp_serving import PPEngine
+                _engines[key] = PPEngine.from_config(config)
+            else:
+                from .engine import InferenceEngine
+                _engines[key] = InferenceEngine.from_config(config)
         return _engines[key]
 
 

@@ -31,22 +31,16 @@ from .models.common import (ModelConfig, forward, init_params, param_count,
                             spmd_mesh)
 from .models.registry import get_model_config
 from .sampling import SamplingParams, sample_token
+from .serving_loop import (DECODE_SEGMENT, MAX_PREFILL_CHUNK,
+                           PREFILL_BUCKETS, bucket_for as _bucket,
+                           chunked_prefill, decode_segments,
+                           finalize_outputs)
 from .sharding import build_mesh, kv_cache_spec, shard_params
 from .tokenizer import load_tokenizer
 
-PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
-MAX_PREFILL_CHUNK = 2048
-DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
 # Cross-slot K/V copies are bandwidth-cheap but still a program dispatch;
 # below this many shared tokens a plain prefill is faster than the copy.
 MIN_SHARED_PREFIX = 64
-
-
-def _bucket(n: int) -> int:
-    for b in PREFILL_BUCKETS:
-        if n <= b:
-            return b
-    return MAX_PREFILL_CHUNK
 
 
 @dataclass
@@ -610,65 +604,31 @@ class InferenceEngine:
                          token_lists: list[list[int]], offsets: list[int],
                          deadline: float = float("inf"),
                          names: Optional[list[str]] = None) -> jax.Array:
-        """Chunked, bucketed prefill for B rows. Returns last-token logits
-        [B, V] (f32). token_lists are the NOT-yet-cached suffixes."""
-        b = len(slot_ids)
+        """Chunked, bucketed prefill for B rows (serving_loop loop with
+        this engine's step program). Returns last-token logits [B, V]."""
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
         tables = None
         if self.kv_layout == "paged":
             # Page tables are fixed for the whole call (capacity is
             # ensured before any prefill dispatch).
             tables = jnp.asarray(self.kv.table_for(names))
-        offs = list(offsets)
-        remaining = [list(t) for t in token_lists]
-        final_logits: Optional[jax.Array] = None
-        cache_len = self.kv.max_seq_len
-        while any(remaining):
-            max_len = min(max(len(r) for r in remaining), MAX_PREFILL_CHUNK)
-            bucket = _bucket(max_len)
-            # Every row writes a bucket-wide block at its offset; near the
-            # cache end, shrink the bucket so no row's write overruns the
-            # cache (dynamic_update_slice would silently clamp the offset
-            # and corrupt the position-aligned layout).
-            allowed = cache_len - max(offs)
-            if bucket > allowed:
-                smaller = [x for x in PREFILL_BUCKETS if x <= allowed]
-                bucket = smaller[-1] if smaller else max(allowed, 1)
-            chunk = np.full((b, bucket), self.tokenizer.pad_id, np.int32)
-            lengths = np.zeros((b,), np.int32)
-            takes = np.zeros((b,), np.int32)
-            for i, r in enumerate(remaining):
-                take = min(len(r), bucket)
-                takes[i] = take
-                if take:
-                    chunk[i, :take] = r[:take]
-                    del r[:take]
-                # Exhausted rows feed one pad at their current offset; it
-                # stays outside their committed length and decode overwrites
-                # that position with the first real generated token.
-                lengths[i] = max(take, 1)
+
+        def dispatch(chunk, offs, lengths):
             if tables is not None:
-                last_logits, self.kv.pools = self._prefill_step_paged(
+                last, self.kv.pools = self._prefill_step_paged(
                     self.params, self.kv.pools, tables,
                     jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                     jnp.asarray(lengths))
             else:
-                last_logits, self.kv.layers = self._prefill_step(
+                last, self.kv.layers = self._prefill_step(
                     self.params, self.kv.layers, slot_idx,
                     jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                     jnp.asarray(lengths))
-            # Keep each row's logits from the chunk where its REAL tokens
-            # ended; later pad-only chunks must not clobber them.
-            if final_logits is None:
-                final_logits = last_logits
-            else:
-                final_logits = jnp.where(jnp.asarray(takes > 0)[:, None],
-                                         last_logits, final_logits)
-            for i in range(b):
-                offs[i] += int(takes[i])
-            if time.monotonic() > deadline and any(remaining):
-                raise TimeoutError("prefill timed out")
-        return final_logits
+            return last
+
+        return chunked_prefill(dispatch, token_lists, offsets,
+                               self.kv.max_seq_len, self.tokenizer.pad_id,
+                               deadline)
 
     def _apply_copies(self, copies: list[tuple[int, int, int, int]]) -> None:
         """Dispatch queued (src_slot, dst_slot, lo, hi) K/V span copies.
@@ -869,62 +829,36 @@ class InferenceEngine:
                              self._next_key(), self.sampling) \
             .astype(jnp.int32)
         first_np = np.asarray(first)
-        cur_last = first
         cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
 
-        # Decode in fixed-size segments: one device program each, with
-        # host-side timeout/early-exit checks between segments (a single
-        # XLA program cannot be interrupted, so this is how the adapter's
-        # per-turn timeout contract is honored). The segment size is ALWAYS
-        # DECODE_SEGMENT — a variable tail (max_new % 64) would compile a
-        # fresh program per distinct tail length (~seconds each); surplus
-        # tokens are cheaper than recompiles and get trimmed below.
         t1 = time.monotonic()
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
         tables = (jnp.asarray(self.kv.table_for(names))
                   if self.kv_layout == "paged" else None)
-        b = len(turns)
-        segments: list[np.ndarray] = []
-        produced = 0
-        all_done = False
-        while produced < max_new and not all_done:
+
+        def decode_dispatch(cur_last, cur_valid, budget):
             if tables is not None:
-                out, steps, cur_last, cur_valid, done, self.kv.pools = \
+                out, steps, last, valid, done, self.kv.pools = \
                     self._decode_loop_paged(
                         self.params, self.kv.pools, tables, cur_last,
-                        cur_valid, self._next_key(),
-                        jnp.int32(max_new - produced),
+                        cur_valid, self._next_key(), budget,
                         max_new=DECODE_SEGMENT)
             else:
-                out, steps, cur_last, cur_valid, done, self.kv.layers = \
+                out, steps, last, valid, done, self.kv.layers = \
                     self._decode_loop(
                         self.params, self.kv.layers, slot_idx, cur_last,
-                        cur_valid, self._next_key(),
-                        jnp.int32(max_new - produced), max_new=DECODE_SEGMENT)
-            steps_n = int(steps)  # forces completion of the segment
-            segments.append(np.asarray(out)[:, :steps_n])
-            produced += steps_n
-            all_done = bool(np.all(np.asarray(done)))
-            if time.monotonic() > deadline and not all_done:
-                raise TimeoutError(
-                    f"generation timed out after {timeout_s:.0f}s "
-                    f"({produced}/{max_new} tokens)")
+                        cur_valid, self._next_key(), budget,
+                        max_new=DECODE_SEGMENT)
+            return out, steps, last, valid, done
+
+        out_np = decode_segments(decode_dispatch, first, cur_valid,
+                                 max_new, deadline, timeout_s)
         stats.decode_seconds = time.monotonic() - t1
 
-        out_np = (np.concatenate(segments, axis=1) if segments
-                  else np.zeros((b, 0), np.int32))
-        results = []
-        for i, (name, _) in enumerate(turns):
-            ids = [int(first_np[i])] + [int(x) for x in out_np[i]]
-            if self.tokenizer.eos_id in ids:
-                ids = ids[:ids.index(self.tokenizer.eos_id)]
-            ids = ids[:max_new]
-            stats.decode_tokens += len(ids)
-            # cache now holds prompt + every fed token (= all but the last
-            # sampled one); commit exactly that for next-turn prefix reuse
-            fed = ids[:-1] if ids else []
-            self.kv.commit(name, all_tokens[i] + fed)
-            results.append(self.tokenizer.decode(ids))
+        results = finalize_outputs(
+            turns, first_np, out_np, all_tokens, max_new,
+            self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
+            stats)
         self.last_stats = stats
         return results, stats
 

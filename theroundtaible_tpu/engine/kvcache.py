@@ -32,20 +32,14 @@ class SlotState:
     tokens: list[int] = field(default_factory=list)  # ids baked into cache
 
 
-class KVCache:
-    """num_slots × num_layers of device KV plus slot bookkeeping."""
+class SlotBook:
+    """Host-side slot bookkeeping alone — LRU allocation, LCP reuse
+    planning, donor search. KVCache adds the contiguous device arrays;
+    the pipeline engine (pp_serving.py) uses SlotBook directly with its
+    stage-stacked caches."""
 
-    def __init__(self, cfg: ModelConfig, num_slots: int,
-                 max_seq_len: Optional[int] = None, dtype=jnp.bfloat16,
-                 sharding=None):
-        self.cfg = cfg
+    def __init__(self, num_slots: int):
         self.num_slots = num_slots
-        self.max_seq_len = max_seq_len or cfg.max_seq_len
-        shape = (num_slots, self.max_seq_len, cfg.num_kv_heads, cfg.head_dim)
-        make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
-            (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
-        self.layers: list[tuple[jax.Array, jax.Array]] = [
-            (make(), make()) for _ in range(cfg.num_layers)]
         self._slots: dict[str, SlotState] = {}
         self._free = list(range(num_slots))
 
@@ -136,3 +130,20 @@ class KVCache:
             if n > best_len:
                 best, best_len = state, n
         return best, best_len
+
+
+class KVCache(SlotBook):
+    """num_slots × num_layers of contiguous device KV plus SlotBook's
+    bookkeeping. Layout per layer: [num_slots, max_seq_len, K, D]."""
+
+    def __init__(self, cfg: ModelConfig, num_slots: int,
+                 max_seq_len: Optional[int] = None, dtype=jnp.bfloat16,
+                 sharding=None):
+        super().__init__(num_slots)
+        self.cfg = cfg
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        shape = (num_slots, self.max_seq_len, cfg.num_kv_heads, cfg.head_dim)
+        make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
+            (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
+        self.layers: list[tuple[jax.Array, jax.Array]] = [
+            (make(), make()) for _ in range(cfg.num_layers)]

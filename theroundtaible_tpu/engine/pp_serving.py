@@ -1,0 +1,477 @@
+"""Pipeline-parallel SERVING — stage-local KV caches, prefill + decode.
+
+Completes the PP story pipeline.py opens (VERDICT r1 #7: "wire PP into
+serving"): an engine for checkpoints too large for one chip/TP group,
+reachable from the tpu-llm adapter config as `mesh: {"pipe": N}`. Layers
+split into N contiguous stages (params stacked on a leading stage axis,
+sharded over the "pipe" mesh axis — stack_stage_params); each stage owns
+the KV cache for ITS layers only (`[n_stages, per, slots, S, K, D]`,
+stage-sharded), so no device ever holds the whole model or the whole
+cache — the memory-capacity property PP exists for.
+
+- Prefill: GPipe microbatch schedule (pipeline.py's rotating-buffer
+  design) extended to thread per-layer stage-local caches through the
+  steps; bubble steps compute garbage that is masked out of both the
+  banked logits and the cache writes.
+- Decode: one ppermute hop per stage per token — stages fire in
+  sequence, each applying its layers against its local cache at the
+  row's current position. Inactive stages run masked compute (the
+  static-shape price of SPMD; PP decode is a memory-capacity play, its
+  serial latency is inherent to the layer dependency).
+- Slots: SlotBook (kvcache.py) gives PP the same per-knight LCP delta
+  prefill as the main engine. Cross-knight donor sharing and paged
+  layout are main-engine features not yet wired here (documented in
+  describe()).
+
+The reference has no counterpart (its models fit one GPU via Ollama);
+SURVEY.md §2.3 "PP" row is the requirement this file closes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .engine import GenStats
+from .kvcache import SlotBook
+from .serving_loop import (DECODE_SEGMENT, bucket_for, chunked_prefill,
+                           decode_segments, finalize_outputs)
+from .models.common import (ModelConfig, init_params, make_attention_mask,
+                            param_count, rms_norm, transformer_block)
+from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
+from .sampling import SamplingParams, sample_token
+from .tokenizer import load_tokenizer
+
+
+class PPEngine:
+    """Pipeline-parallel serving engine (stage-local weights AND KV)."""
+
+    def __init__(self, model_cfg: ModelConfig, *, checkpoint: str = "",
+                 n_stages: int = 2, n_micro: int = 2, num_slots: int = 4,
+                 dtype=jnp.bfloat16,
+                 sampling: Optional[SamplingParams] = None, seed: int = 0,
+                 devices: Optional[list[int]] = None):
+        import dataclasses
+
+        from . import enable_compilation_cache
+        enable_compilation_cache()
+        # Dense attention inside the stages: the flash kernels' shard_map
+        # wrapper targets the (data, model) mesh, not the pipe mesh.
+        model_cfg = dataclasses.replace(model_cfg, attn_impl="dense")
+        self.cfg = model_cfg
+        self.max_seq_len = model_cfg.max_seq_len
+        self.sampling = sampling or SamplingParams()
+        self.tokenizer = load_tokenizer(checkpoint or None)
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        device_list = None
+        if devices:
+            all_devices = jax.devices()
+            device_list = [all_devices[i] for i in devices]
+        self.mesh = build_pipe_mesh(n_stages, device_list)
+
+        if checkpoint:
+            from .checkpoint import load_hf_checkpoint
+            params = load_hf_checkpoint(checkpoint, model_cfg, dtype)
+        else:
+            params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
+        self.num_params = param_count(params)
+        self.shared, self.staged = stack_stage_params(
+            params, model_cfg, n_stages, self.mesh)
+
+        per = model_cfg.num_layers // n_stages
+        cache_shape = (n_stages, per, num_slots, self.max_seq_len,
+                       model_cfg.num_kv_heads, model_cfg.head_dim)
+        cache_sharding = NamedSharding(
+            self.mesh, P(PIPE_AXIS, None, None, None, None, None))
+        self.kc = jax.device_put(jnp.zeros(cache_shape, dtype),
+                                 cache_sharding)
+        self.vc = jax.device_put(jnp.zeros(cache_shape, dtype),
+                                 cache_sharding)
+        self.kv = SlotBook(num_slots)
+
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._chars_per_token: Optional[float] = None
+        self.last_stats = GenStats()
+        self._serve_lock = threading.Lock()
+
+        cfg = model_cfg
+        mesh = self.mesh
+        s_len = self.max_seq_len
+
+        def stage_scan(stage_layers, kc_l, vc_l, h, positions, valid,
+                       offsets, slot_idx, write_ok):
+            """This stage's layers over h, threading per-layer caches.
+
+            kc_l/vc_l: [per, slots, S, K, D]. write_ok masks cache writes
+            (False during schedule bubbles / inactive decode hops)."""
+            mask = make_attention_mask(positions, s_len, valid,
+                                       cfg.sliding_window)
+
+            def body(h, xs):
+                layer, kc1, vc1 = xs
+                cache = (kc1[slot_idx], vc1[slot_idx])
+                h, (nk, nv) = transformer_block(
+                    h, layer, cfg, positions, cache, offsets, mask,
+                    kv_valid=valid)
+                kc1 = kc1.at[slot_idx].set(
+                    jnp.where(write_ok, nk, kc1[slot_idx]))
+                vc1 = vc1.at[slot_idx].set(
+                    jnp.where(write_ok, nv, vc1[slot_idx]))
+                return h, (kc1, vc1)
+
+            h, (kc_l, vc_l) = jax.lax.scan(
+                body, h, (stage_layers, kc_l, vc_l))
+            return h, kc_l, vc_l
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def pp_prefill(shared, staged, kc, vc, slot_idx, tokens, offsets,
+                       lengths):
+            b, t = tokens.shape
+            n_mb = self.n_micro if b % self.n_micro == 0 else 1
+            mb = b // n_mb
+            tok_mb = tokens.reshape(n_mb, mb, t)
+            offs_mb = offsets.reshape(n_mb, mb)
+            len_mb = lengths.reshape(n_mb, mb)
+            slot_mb = slot_idx.reshape(n_mb, mb)
+
+            emb = shared["embedding"][tok_mb]
+            if cfg.scale_embeddings:
+                emb = emb * jnp.sqrt(
+                    jnp.float32(cfg.embed_dim)).astype(emb.dtype)
+
+            def per_stage(staged, kc, vc, emb, offs_mb, len_mb, slot_mb):
+                stage_layers = jax.tree_util.tree_map(
+                    lambda x: x[0], staged)
+                kc_l, vc_l = kc[0], vc[0]
+                stage = jax.lax.axis_index(PIPE_AXIS)
+                n_steps = self.n_stages + n_mb - 1
+
+                state = jax.lax.pcast(jnp.zeros_like(emb[0]), (PIPE_AXIS,),
+                                      to="varying")
+                banked = jax.lax.pcast(jnp.zeros_like(emb), (PIPE_AXIS,),
+                                       to="varying")
+                kc_l = jax.lax.pcast(kc_l, (PIPE_AXIS,), to="varying")
+                vc_l = jax.lax.pcast(vc_l, (PIPE_AXIS,), to="varying")
+
+                def step(i, carry):
+                    state, banked, kc_l, vc_l = carry
+                    inject = emb[jnp.clip(i, 0, n_mb - 1)]
+                    x_in = jnp.where(stage == 0,
+                                     jnp.where(i < n_mb, inject, state),
+                                     state)
+                    my = jnp.clip(i - stage, 0, n_mb - 1)
+                    in_sched = (i - stage >= 0) & (i - stage < n_mb)
+                    positions = (offs_mb[my][:, None]
+                                 + jnp.arange(t)[None, :])
+                    valid = offs_mb[my] + len_mb[my]
+                    out, kc_l, vc_l = stage_scan(
+                        stage_layers, kc_l, vc_l, x_in, positions, valid,
+                        offs_mb[my], slot_mb[my], in_sched)
+                    j = i - (self.n_stages - 1)
+                    bank_now = (stage == self.n_stages - 1) & (j >= 0)
+                    banked = jnp.where(
+                        bank_now,
+                        banked.at[jnp.clip(j, 0, n_mb - 1)].set(out),
+                        banked)
+                    state = jax.lax.ppermute(
+                        out, PIPE_AXIS,
+                        [(s, (s + 1) % self.n_stages)
+                         for s in range(self.n_stages)])
+                    return state, banked, kc_l, vc_l
+
+                _s, banked, kc_l, vc_l = jax.lax.fori_loop(
+                    0, n_steps, step, (state, banked, kc_l, vc_l))
+                banked = jax.lax.psum(
+                    jnp.where(stage == self.n_stages - 1, banked, 0.0)
+                    .astype(jnp.float32), PIPE_AXIS).astype(banked.dtype)
+                return banked, kc_l[None], vc_l[None]
+
+            hidden, kc, vc = shard_map(
+                per_stage, mesh=mesh,
+                in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
+                          P(), P(), P(), P()),
+                out_specs=(P(), P(PIPE_AXIS), P(PIPE_AXIS)),
+                check_vma=False,
+            )(staged, kc, vc, emb, offs_mb, len_mb, slot_mb)
+
+            hidden = hidden.reshape(b, t, cfg.embed_dim)
+            hidden = rms_norm(hidden, shared["final_norm"], cfg.norm_eps,
+                              cfg.rmsnorm_unit_offset)
+            head = (shared["embedding"] if cfg.tie_embeddings
+                    else shared["lm_head"])
+            logits = jnp.einsum("bte,ve->btv", hidden, head,
+                                preferred_element_type=jnp.float32)
+            if cfg.final_logit_softcap is not None:
+                logits = cfg.final_logit_softcap * jnp.tanh(
+                    logits / cfg.final_logit_softcap)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return last, kc, vc
+
+        self._pp_prefill = pp_prefill
+
+        @partial(jax.jit, donate_argnums=(2, 3),
+                 static_argnames=("max_new",))
+        def pp_decode(shared, staged, kc, vc, slot_idx, first_token,
+                      start_valid, key, budget, max_new):
+            b = first_token.shape[0]
+            eos = jnp.int32(self.tokenizer.eos_id)
+            head = (shared["embedding"] if cfg.tie_embeddings
+                    else shared["lm_head"])
+
+            def per_stage(staged, kc, vc, first_token, start_valid, key,
+                          budget, slot_idx, embedding, head, final_norm):
+                stage_layers = jax.tree_util.tree_map(
+                    lambda x: x[0], staged)
+                kc_l = jax.lax.pcast(kc[0], (PIPE_AXIS,), to="varying")
+                vc_l = jax.lax.pcast(vc[0], (PIPE_AXIS,), to="varying")
+                stage = jax.lax.axis_index(PIPE_AXIS)
+                out0 = jnp.zeros((b, max_new), jnp.int32)
+                done0 = jnp.zeros((b,), bool)
+
+                def cond(state):
+                    step, _, _, done, _, _, _, _ = state
+                    return ((step < max_new) & (step < budget)
+                            & ~jnp.all(done))
+
+                def tok_body(state):
+                    step, last, valid, done, out, kc_l, vc_l, key = state
+                    h = embedding[last[:, None]]
+                    if cfg.scale_embeddings:
+                        h = h * jnp.sqrt(
+                            jnp.float32(cfg.embed_dim)).astype(h.dtype)
+                    h = jax.lax.pcast(h, (PIPE_AXIS,), to="varying")
+                    positions = valid[:, None]
+
+                    def hop(s, carry):
+                        h, kc_l, vc_l = carry
+                        active = stage == s
+                        h_new, kc_l, vc_l = stage_scan(
+                            stage_layers, kc_l, vc_l, h, positions,
+                            valid + 1, valid, slot_idx, active)
+                        h = jnp.where(active, h_new, h)
+                        h = jax.lax.ppermute(
+                            h, PIPE_AXIS,
+                            [(x, (x + 1) % self.n_stages)
+                             for x in range(self.n_stages)])
+                        return h, kc_l, vc_l
+
+                    h, kc_l, vc_l = jax.lax.fori_loop(
+                        0, self.n_stages, hop, (h, kc_l, vc_l))
+                    # after n_stages hops the final hidden wrapped back to
+                    # stage 0; broadcast it to every stage for sampling
+                    h = jax.lax.psum(
+                        jnp.where(stage == 0, h, 0.0)
+                        .astype(jnp.float32), PIPE_AXIS).astype(h.dtype)
+                    h = rms_norm(h, final_norm, cfg.norm_eps,
+                                 cfg.rmsnorm_unit_offset)
+                    logits = jnp.einsum(
+                        "bte,ve->btv", h, head,
+                        preferred_element_type=jnp.float32)
+                    if cfg.final_logit_softcap is not None:
+                        logits = cfg.final_logit_softcap * jnp.tanh(
+                            logits / cfg.final_logit_softcap)
+                    key, sub = jax.random.split(key)
+                    nxt = sample_token(logits[:, 0], sub, self.sampling) \
+                        .astype(jnp.int32)
+                    nxt = jnp.where(done, eos, nxt)
+                    out = out.at[:, step].set(nxt)
+                    new_done = done | (nxt == eos)
+                    valid = jnp.where(done, valid, valid + 1)
+                    return (step + 1, nxt, valid, new_done, out, kc_l,
+                            vc_l, key)
+
+                state = (jnp.int32(0), first_token, start_valid, done0,
+                         out0, kc_l, vc_l, key)
+                step, last, valid, done, out, kc_l, vc_l, _ = \
+                    jax.lax.while_loop(cond, tok_body, state)
+                return (out, step[None], last, valid, done, kc_l[None],
+                        vc_l[None])
+
+            out, step, last, valid, done, kc, vc = shard_map(
+                per_stage, mesh=mesh,
+                in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
+                          P(), P(), P(), P(), P(), P(), P(), P()),
+                out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
+                           P(PIPE_AXIS), P(PIPE_AXIS)),
+                check_vma=False,
+            )(staged, kc, vc, first_token, start_valid, key, budget,
+              slot_idx, shared["embedding"], head, shared["final_norm"])
+            return out, step[0], last, valid, done, kc, vc
+
+        self._pp_decode = pp_decode
+
+    # --- construction from adapter config ---
+
+    @classmethod
+    def from_config(cls, config: dict[str, Any]) -> "PPEngine":
+        from .models.registry import get_model_config
+        model_name = config.get("model", "tiny-gemma")
+        overrides = {}
+        if config.get("max_seq_len"):
+            overrides["max_seq_len"] = int(config["max_seq_len"])
+        model_cfg = get_model_config(model_name, **overrides)
+        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                 "float16": jnp.float16}[config.get("dtype", "bfloat16")]
+        sampling_cfg = config.get("sampling", {})
+        sampling = SamplingParams(
+            temperature=float(sampling_cfg.get("temperature", 0.7)),
+            top_k=int(sampling_cfg.get("top_k", 0)),
+            top_p=float(sampling_cfg.get("top_p", 1.0)),
+            max_new_tokens=int(sampling_cfg.get("max_new_tokens", 1024)),
+        )
+        mesh = config.get("mesh", {})
+        return cls(
+            model_cfg,
+            checkpoint=config.get("checkpoint", "") or "",
+            n_stages=int(mesh.get("pipe", 2)),
+            n_micro=int(config.get("n_micro", 2)),
+            num_slots=int(config.get("num_slots", 4)),
+            dtype=dtype, sampling=sampling,
+            seed=int(config.get("seed", 0)),
+            devices=config.get("devices"),
+        )
+
+    # --- serving (same surface the adapter uses on InferenceEngine) ---
+
+    def chars_per_token(self) -> float:
+        if self._chars_per_token is None:
+            sample = ("The quick brown fox jumps over the lazy dog. "
+                      "def main(args): return 0  # typical source text\n" * 4)
+            n = len(self.tokenizer.encode(sample, add_bos=False))
+            self._chars_per_token = max(len(sample) / max(n, 1), 0.25)
+        return self._chars_per_token
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def warmup(self, max_prompt_tokens: int = 256,
+               batch_sizes: tuple[int, ...] = (1,)) -> float:
+        t0 = time.monotonic()
+        limit = min(max_prompt_tokens,
+                    self.max_seq_len - DECODE_SEGMENT - 1)
+        for b in batch_sizes:
+            if b > self.kv.num_slots:
+                continue
+            n = min(bucket_for(limit), limit)
+            turns = [(f"__warmup_{i}",
+                      [self.tokenizer.bos_id] + [5 + i] * (n - 1))
+                     for i in range(b)]
+            for _ in range(2):
+                for name, _p in turns:
+                    self.kv.release(name)
+                self.generate_batch(turns, max_new_tokens=1)
+        for i in range(max(batch_sizes)):
+            self.kv.release(f"__warmup_{i}")
+        return time.monotonic() - t0
+
+    def generate(self, prompt, slot_name: str = "default",
+                 max_new_tokens: Optional[int] = None,
+                 timeout_s: float = 600.0) -> str:
+        return self.generate_batch([(slot_name, prompt)],
+                                   max_new_tokens=max_new_tokens,
+                                   timeout_s=timeout_s)[0]
+
+    def generate_batch(self, turns, max_new_tokens=None,
+                       timeout_s: float = 600.0) -> list[str]:
+        return self.generate_batch_with_stats(
+            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s)[0]
+
+    def generate_batch_with_stats(self, turns, max_new_tokens=None,
+                                  timeout_s: float = 600.0):
+        with self._serve_lock:
+            return self._generate_locked(turns, max_new_tokens, timeout_s)
+
+    def _generate_locked(self, turns, max_new_tokens, timeout_s):
+        stats = GenStats()
+        deadline = time.monotonic() + timeout_s
+        max_new = max_new_tokens or self.sampling.max_new_tokens
+        max_new = max(1, min(max_new, self.max_seq_len // 2))
+        max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
+
+        pinned = tuple(name for name, _ in turns)
+        slot_ids, offsets, all_tokens = [], [], []
+        for name, prompt in turns:
+            tokens = (list(prompt) if isinstance(prompt, list)
+                      else self.tokenizer.encode(prompt))
+            budget = self.max_seq_len - max_new_padded - 1
+            if len(tokens) > budget:
+                tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
+            slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
+            slot_ids.append(slot_id)
+            offsets.append(reuse)
+            all_tokens.append(tokens)
+            stats.reused_tokens += reuse
+            stats.prefill_tokens += len(tokens) - reuse
+
+        # Chunked bucketed prefill (shared serving_loop host loop with the
+        # PP step program).
+        t0 = time.monotonic()
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+
+        def prefill_dispatch(chunk, offs, lengths):
+            last, self.kc, self.vc = self._pp_prefill(
+                self.shared, self.staged, self.kc, self.vc, slot_idx,
+                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                jnp.asarray(lengths))
+            return last
+
+        last_logits = chunked_prefill(
+            prefill_dispatch, [t[o:] for t, o in zip(all_tokens, offsets)],
+            offsets, self.max_seq_len, self.tokenizer.pad_id, deadline)
+        float(last_logits[0, 0])
+        stats.prefill_seconds = time.monotonic() - t0
+
+        first = sample_token(last_logits.astype(jnp.float32),
+                             self._next_key(), self.sampling) \
+            .astype(jnp.int32)
+        first_np = np.asarray(first)
+        cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
+
+        t1 = time.monotonic()
+
+        def decode_dispatch(cur_last, valid, budget):
+            out, steps, last, valid, done, self.kc, self.vc = \
+                self._pp_decode(
+                    self.shared, self.staged, self.kc, self.vc, slot_idx,
+                    cur_last, valid, self._next_key(), budget,
+                    max_new=DECODE_SEGMENT)
+            return out, steps, last, valid, done
+
+        out_np = decode_segments(decode_dispatch, first, cur_valid,
+                                 max_new, deadline, timeout_s)
+        stats.decode_seconds = time.monotonic() - t1
+
+        results = finalize_outputs(
+            turns, first_np, out_np, all_tokens, max_new,
+            self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
+            stats)
+        self.last_stats = stats
+        return results, stats
+
+    # --- introspection ---
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "model": self.cfg.name,
+            "params": self.num_params,
+            "max_seq_len": self.max_seq_len,
+            "mesh": {"pipe": self.n_stages},
+            "n_micro": self.n_micro,
+            "num_slots": self.kv.num_slots,
+            "kv_layout": "stage-local contiguous",
+            "scope": "PP serving: prefill + decode with stage-local KV; "
+                     "own-slot LCP reuse; no cross-knight donor sharing "
+                     "or paged layout yet",
+            "devices": [str(d) for d in self.mesh.devices.flatten()],
+        }

@@ -1,0 +1,145 @@
+"""Host-side serving loop pieces shared by the engines.
+
+InferenceEngine (engine.py) and PPEngine (pp_serving.py) dispatch very
+different device programs, but the HOST logic around them — chunked
+bucketed prefill with the cache-end bucket-shrink guard, the decode
+segment loop with deadline checks, and the eos-trim/commit epilogue — is
+identical and subtle enough that two copies WILL drift (round-2 review
+finding). Each engine passes its dispatch closure; everything else lives
+here once.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+MAX_PREFILL_CHUNK = 2048
+DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
+
+
+def bucket_for(n: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return b
+    return MAX_PREFILL_CHUNK
+
+
+def chunked_prefill(
+    dispatch: Callable[[np.ndarray, list[int], np.ndarray], jax.Array],
+    token_lists: list[list[int]],
+    offsets: list[int],
+    max_seq_len: int,
+    pad_id: int,
+    deadline: float = float("inf"),
+) -> jax.Array:
+    """Bucketed multi-chunk prefill. Returns last-token logits [B, V].
+
+    dispatch(chunk [B, bucket], offs, lengths) runs one device program and
+    returns that chunk's last-token logits. Every row writes a bucket-wide
+    block at its offset; near the cache end the bucket shrinks so no row's
+    write overruns the position-aligned layout (dynamic_update_slice would
+    silently clamp the offset and corrupt it). Each row's logits are kept
+    from the chunk where its REAL tokens ended — later pad-only chunks
+    must not clobber them.
+    """
+    b = len(token_lists)
+    offs = list(offsets)
+    remaining = [list(t) for t in token_lists]
+    final_logits: Optional[jax.Array] = None
+    while any(remaining):
+        max_len = min(max(len(r) for r in remaining), MAX_PREFILL_CHUNK)
+        bucket = bucket_for(max_len)
+        allowed = max_seq_len - max(offs)
+        if bucket > allowed:
+            smaller = [x for x in PREFILL_BUCKETS if x <= allowed]
+            bucket = smaller[-1] if smaller else max(allowed, 1)
+        chunk = np.full((b, bucket), pad_id, np.int32)
+        lengths = np.zeros((b,), np.int32)
+        takes = np.zeros((b,), np.int32)
+        for i, r in enumerate(remaining):
+            take = min(len(r), bucket)
+            takes[i] = take
+            if take:
+                chunk[i, :take] = r[:take]
+                del r[:take]
+            # Exhausted rows feed one pad at their current offset; it stays
+            # outside their committed length and decode overwrites that
+            # position with the first real generated token.
+            lengths[i] = max(take, 1)
+        last_logits = dispatch(chunk, offs, lengths)
+        if final_logits is None:
+            final_logits = last_logits
+        else:
+            final_logits = jnp.where(jnp.asarray(takes > 0)[:, None],
+                                     last_logits, final_logits)
+        for i in range(b):
+            offs[i] += int(takes[i])
+        if time.monotonic() > deadline and any(remaining):
+            raise TimeoutError("prefill timed out")
+    return final_logits
+
+
+def decode_segments(
+    dispatch: Callable,
+    first_token: jax.Array,
+    start_valid: jax.Array,
+    max_new: int,
+    deadline: float,
+    timeout_s: float,
+) -> np.ndarray:
+    """Segmented decode: one device program per DECODE_SEGMENT tokens with
+    host-side timeout/early-exit checks in between (a single XLA program
+    cannot be interrupted, so this is how the adapter's per-turn timeout
+    contract is honored). The segment size is ALWAYS DECODE_SEGMENT — a
+    variable tail would compile a fresh program per distinct length.
+
+    dispatch(cur_last, cur_valid, budget) → (out, steps, last, valid,
+    done) runs one segment. Returns the concatenated token matrix
+    [B, produced].
+    """
+    b = first_token.shape[0]
+    cur_last, cur_valid = first_token, start_valid
+    segments: list[np.ndarray] = []
+    produced = 0
+    all_done = False
+    while produced < max_new and not all_done:
+        out, steps, cur_last, cur_valid, done = dispatch(
+            cur_last, cur_valid, jnp.int32(max_new - produced))
+        steps_n = int(steps)  # forces completion of the segment
+        segments.append(np.asarray(out)[:, :steps_n])
+        produced += steps_n
+        all_done = bool(np.all(np.asarray(done)))
+        if time.monotonic() > deadline and not all_done:
+            raise TimeoutError(
+                f"generation timed out after {timeout_s:.0f}s "
+                f"({produced}/{max_new} tokens)")
+    return (np.concatenate(segments, axis=1) if segments
+            else np.zeros((b, 0), np.int32))
+
+
+def finalize_outputs(turns, first_np: np.ndarray, out_np: np.ndarray,
+                     all_tokens: list[list[int]], max_new: int,
+                     eos_id: int, commit: Callable[[str, list[int]], None],
+                     decode: Callable[[list[int]], str],
+                     stats) -> list[str]:
+    """Eos-trim each row, commit prompt+fed ids for next-turn prefix
+    reuse, detokenize, and account decode tokens into stats."""
+    results = []
+    for i, (name, _) in enumerate(turns):
+        ids = [int(first_np[i])] + [int(x) for x in out_np[i]]
+        if eos_id in ids:
+            ids = ids[:ids.index(eos_id)]
+        ids = ids[:max_new]
+        stats.decode_tokens += len(ids)
+        # cache now holds prompt + every fed token (= all but the last
+        # sampled one); commit exactly that for next-turn prefix reuse
+        fed = ids[:-1] if ids else []
+        commit(name, all_tokens[i] + fed)
+        results.append(decode(ids))
+    return results
