@@ -1,0 +1,63 @@
+"""Multi-host init hook (engine/distributed.py): single-process no-op,
+env-gated initialize call, idempotence."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from theroundtaible_tpu.engine import distributed
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    yield
+
+
+def test_noop_without_env(monkeypatch):
+    monkeypatch.delenv("ROUNDTABLE_COORDINATOR", raising=False)
+    assert distributed.maybe_init_distributed() is False
+
+
+def test_initializes_from_env(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw))
+    monkeypatch.setenv("ROUNDTABLE_COORDINATOR", "10.0.0.2:8476")
+    monkeypatch.setenv("ROUNDTABLE_NUM_PROCESSES", "4")
+    monkeypatch.setenv("ROUNDTABLE_PROCESS_ID", "2")
+    assert distributed.maybe_init_distributed() is True
+    assert calls == [{"coordinator_address": "10.0.0.2:8476",
+                      "num_processes": 4, "process_id": 2}]
+    # idempotent: second call must not re-initialize
+    assert distributed.maybe_init_distributed() is True
+    assert len(calls) == 1
+
+
+def test_engine_calls_hook_and_stays_single_process(monkeypatch):
+    """With the hook active (but monkeypatched), the engine still builds
+    and serves — the dryrun-able single-process requirement."""
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw))
+    monkeypatch.setenv("ROUNDTABLE_COORDINATOR", "localhost:9999")
+    monkeypatch.setenv("ROUNDTABLE_NUM_PROCESSES", "1")
+    monkeypatch.setenv("ROUNDTABLE_PROCESS_ID", "0")
+    eng = InferenceEngine(
+        get_model_config("tiny-gemma"), num_slots=2,
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=4))
+    assert calls  # hook fired before device use
+    out = eng.generate("multi host hello", slot_name="m", max_new_tokens=4)
+    assert isinstance(out, str)
+
+
+def test_process_info_single():
+    info = distributed.process_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_devices"] >= 1

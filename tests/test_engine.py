@@ -96,6 +96,42 @@ class TestSampling:
                            SamplingParams(temperature=1.0, top_p=0.5))
         assert set(np.asarray(out).tolist()) == {0}
 
+    def test_batch_per_row_params(self):
+        """sample_token_batch: each row follows ITS OWN params — greedy,
+        top-k, and top-p rows coexist in one call."""
+        from theroundtaible_tpu.engine.sampling import (sample_token_batch,
+                                                        sampling_arrays)
+        logits = jnp.array([[0.1, 3.0, 0.2, 0.0],   # greedy row → 1
+                            [0.0, 1.0, 2.0, 3.0],   # top_k=2 → {2,3}
+                            [10.0, 0.0, 0.0, 0.0]])  # top_p=0.5 → {0}
+        params = [SamplingParams(temperature=0.0),
+                  SamplingParams(temperature=1.0, top_k=2),
+                  SamplingParams(temperature=1.0, top_p=0.5)]
+        results = [[], [], []]
+        for seed in range(32):
+            out = sample_token_batch(logits, jax.random.PRNGKey(seed),
+                                     *sampling_arrays(params))
+            for i, t in enumerate(np.asarray(out).tolist()):
+                results[i].append(t)
+        assert set(results[0]) == {1}
+        assert set(results[1]) <= {2, 3} and len(set(results[1])) == 2
+        assert set(results[2]) == {0}
+
+    def test_batch_matches_static_per_row(self):
+        """A batch where all rows share one config must equal the static
+        sample_token path row for row (same key)."""
+        from theroundtaible_tpu.engine.sampling import (sample_token_batch,
+                                                        sampling_arrays)
+        rng = np.random.default_rng(7)
+        logits = jnp.asarray(rng.normal(size=(4, 16)) * 3, jnp.float32)
+        for p in (SamplingParams(temperature=0.0),
+                  SamplingParams(temperature=0.8, top_k=5),
+                  SamplingParams(temperature=1.2, top_p=0.7)):
+            key = jax.random.PRNGKey(11)
+            a = sample_token(logits, key, p)
+            b = sample_token_batch(logits, key, *sampling_arrays([p] * 4))
+            assert a.tolist() == b.tolist()
+
 
 class TestKVCacheSlots:
     def test_acquire_release(self):
@@ -205,6 +241,24 @@ class TestEngineGenerate:
         assert s.prefill_tokens > 0
         assert s.decode_tokens > 0
         assert s.prefill_tps > 0 and s.decode_tps > 0
+
+    def test_per_turn_sampling_in_one_batch(self, tiny_engine):
+        """A greedy row and a hot row in the same batch: the greedy row's
+        output must equal an all-greedy run (per-row sampling params,
+        VERDICT r1 weak #8)."""
+        greedy = SamplingParams(temperature=0.0, max_new_tokens=8)
+        hot = SamplingParams(temperature=1.5, max_new_tokens=8)
+        prompts = [("pgA", "the deterministic knight speaks"),
+                   ("pgB", "the spicy knight speaks")]
+        for n, _ in prompts:
+            tiny_engine.kv.release(n)
+        mixed = tiny_engine.generate_batch(
+            prompts, max_new_tokens=8, sampling_per_turn=[greedy, hot])
+        for n, _ in prompts:
+            tiny_engine.kv.release(n)
+        all_greedy = tiny_engine.generate_batch(
+            prompts, max_new_tokens=8, sampling_per_turn=[greedy, greedy])
+        assert mixed[0] == all_greedy[0]
 
     def test_bucket_ladder(self):
         assert _bucket(1) == 64

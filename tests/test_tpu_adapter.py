@@ -61,6 +61,35 @@ class TestTpuAdapter:
         assert len(outs) == 2
         assert all(isinstance(o, str) for o in outs)
 
+    def test_per_knight_sampling_config(self):
+        """knight_sampling in the adapter config gives each seat its own
+        SamplingParams inside one batched round (VERDICT r1 weak #8)."""
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        cfg = dict(TPU_CFG)
+        cfg["knight_sampling"] = {"Oracle": {"temperature": 1.5}}
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        # Sage (no override) stays on the engine default (greedy)
+        assert adapter._sampling_for("Sage") is None
+        oracle = adapter._sampling_for("Oracle")
+        assert oracle.temperature == 1.5
+        assert oracle.max_new_tokens == 8  # inherits engine default
+        outs = adapter.execute_round(
+            [KnightTurn("Sage", "a question about sampling"),
+             KnightTurn("Oracle", "another question about sampling")],
+            timeout_ms=600_000)
+        assert len(outs) == 2
+        # the greedy seat's answer matches an all-default round
+        adapter2 = TpuLlmAdapter("tpu-llm", dict(TPU_CFG),
+                                 timeout_ms=600_000)
+        eng = adapter2._get_engine()
+        for n in ("Sage", "Oracle"):
+            eng.kv.release(n)
+        outs2 = adapter2.execute_round(
+            [KnightTurn("Sage", "a question about sampling"),
+             KnightTurn("Oracle", "another question about sampling")],
+            timeout_ms=600_000)
+        assert outs[0] == outs2[0]
+
     def test_discuss_through_orchestrator_serial(self, project_root):
         config = make_config(parallel=False)
         adapter = create_adapter("tpu-llm", config)

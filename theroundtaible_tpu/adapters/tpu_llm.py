@@ -83,15 +83,50 @@ class TpuLlmAdapter(BaseAdapter):
     def supports_batched_rounds(self) -> bool:
         return True
 
+    def _sampling_for(self, knight_name: str):
+        """Per-knight SamplingParams: `knight_sampling: {name: {...}}` in
+        the adapter config overrides the engine default per seat —
+        heterogeneous personas (a hotter skeptic, a greedy pragmatist)
+        sample correctly inside the same batched program."""
+        overrides = self.engine_config.get("knight_sampling", {})
+        cfg = overrides.get(knight_name)
+        if not cfg:
+            return None
+        from ..engine.sampling import SamplingParams
+        base = self._get_engine().sampling
+        return SamplingParams(
+            temperature=float(cfg.get("temperature", base.temperature)),
+            top_k=int(cfg.get("top_k", base.top_k)),
+            top_p=float(cfg.get("top_p", base.top_p)),
+            max_new_tokens=base.max_new_tokens)
+
     def execute_round(self, turns: list[KnightTurn],
                       timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
         """One batched forward pass over N persistent per-knight KV slots."""
         engine = self._get_engine()
         self._last_stats = None  # a failed call must not leave stale stats
+        per_turn = None
+        if self.engine_config.get("knight_sampling"):
+            if hasattr(engine, "n_stages"):
+                # the PP engine doesn't take per-row sampling yet — say so
+                # instead of silently flattening the configured personas
+                if not getattr(self, "_warned_pp_sampling", False):
+                    self._warned_pp_sampling = True
+                    import sys
+                    print("  Warning: knight_sampling is ignored on a "
+                          "pipeline-parallel (mesh {'pipe': N}) engine — "
+                          "all seats use the adapter's default sampling.",
+                          file=sys.stderr)
+            else:
+                per_turn = [self._sampling_for(t.knight_name)
+                            or engine.sampling for t in turns]
         try:
+            kwargs = {"timeout_s": (timeout_ms or self.default_timeout)
+                      / 1000}
+            if per_turn is not None:
+                kwargs["sampling_per_turn"] = per_turn
             responses, stats = engine.generate_batch_with_stats(
-                [(t.knight_name, t.prompt) for t in turns],
-                timeout_s=(timeout_ms or self.default_timeout) / 1000)
+                [(t.knight_name, t.prompt) for t in turns], **kwargs)
         except Exception as e:  # noqa: BLE001
             raise AdapterError(str(e), kind=classify_error(e), cause=e)
         # per-call snapshot, NOT engine.last_stats — adapters sharing one

@@ -69,6 +69,12 @@ def get_engine(config: dict[str, Any]):
     A mesh with a "pipe" axis selects the pipeline-parallel serving
     engine (stage-local weights + KV, engine/pp_serving.py); everything
     else gets the main InferenceEngine."""
+    # Join the multi-host process group BEFORE any backend/device call —
+    # this seam runs ahead of plan_fleet's jax.devices() and every engine
+    # constructor (engine/distributed.py; jax.distributed.initialize must
+    # precede backend init).
+    from .distributed import maybe_init_distributed
+    maybe_init_distributed()
     key = _cache_key(config)
     with _lock:
         if key not in _engines:

@@ -1,0 +1,68 @@
+"""Multi-host process-group initialization (SURVEY.md §5.8).
+
+The reference's "distributed backend" is child-process pipes and HTTP
+fetches (reference src/adapters/*.ts, SURVEY.md §5.8); the TPU-native
+equivalent is `jax.distributed.initialize`: every host in a pod slice (or
+across slices over DCN) starts the same program, the coordinator wires the
+process group, and `jax.devices()` then reports the GLOBAL device set —
+`build_mesh` and every NamedSharding/pjit program in the engine work
+unchanged on top, with XLA routing collectives over ICI within a slice
+and DCN across slices.
+
+Operation (mirrors the standard JAX multi-host recipe):
+
+    ROUNDTABLE_COORDINATOR=10.0.0.2:8476 \\
+    ROUNDTABLE_NUM_PROCESSES=4 ROUNDTABLE_PROCESS_ID=0 \\
+    roundtable discuss "..."
+
+Every process must build identical meshes (deterministic here: meshes are
+derived from config + jax.devices()). Axis-placement guidance for
+multi-slice: keep "model" (TP — latency-critical all-reduces) inside a
+slice on ICI; put "data" (DP — independent slot batches, no per-token
+collectives) across slices so only DCN-tolerant traffic crosses slices.
+
+Unset ROUNDTABLE_COORDINATOR → no-op, single-process behavior identical
+(this is what the driver's dryrun and the test suite exercise).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_init_lock = threading.Lock()
+_initialized = False
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize the JAX process group when ROUNDTABLE_COORDINATOR is
+    set. Returns True when this call (or an earlier one) initialized it,
+    False for single-process runs. Idempotent; never raises for the
+    single-process case."""
+    global _initialized
+    coordinator = os.environ.get("ROUNDTABLE_COORDINATOR")
+    if not coordinator:
+        return False
+    with _init_lock:
+        if _initialized:
+            return True
+        import jax
+        num_processes = int(os.environ.get("ROUNDTABLE_NUM_PROCESSES", "1"))
+        process_id = int(os.environ.get("ROUNDTABLE_PROCESS_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+        _initialized = True
+        return True
+
+
+def process_info() -> dict:
+    """This process's view of the group (for metrics/describe)."""
+    import jax
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

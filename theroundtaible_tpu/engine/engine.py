@@ -30,7 +30,7 @@ from .kvcache import KVCache
 from .models.common import (ModelConfig, forward, init_params, param_count,
                             spmd_mesh)
 from .models.registry import get_model_config
-from .sampling import SamplingParams, sample_token
+from .sampling import SamplingParams, sample_token_batch, sampling_arrays
 from .serving_loop import (DECODE_SEGMENT, MAX_PREFILL_CHUNK,
                            PREFILL_BUCKETS, bucket_for as _bucket,
                            chunked_prefill, decode_segments,
@@ -75,6 +75,11 @@ class InferenceEngine:
                  devices: Optional[list[int]] = None,
                  kv_layout: str = "contiguous", page_size: int = 128,
                  num_pages: Optional[int] = None):
+        # Multi-host: join the process group BEFORE any backend/device
+        # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
+        # jax.devices() below then spans every host's chips.
+        from .distributed import maybe_init_distributed
+        maybe_init_distributed()
         # Persistent XLA compile cache: first-ever run compiles, every
         # later process deserializes (SURVEY.md §7.3 hard part 5).
         from . import enable_compilation_cache
@@ -269,13 +274,20 @@ class InferenceEngine:
         self._prefill_step = prefill_step
 
         @partial(jax.jit, donate_argnums=(1,),
-                 static_argnames=("max_new",))
+                 static_argnames=("max_new", "greedy"))
         def decode_loop(params, cache_layers, slot_idx, first_token,
-                        start_valid, key, budget, max_new):
+                        start_valid, key, budget, temps, top_ks, top_ps,
+                        max_new, greedy):
             # max_new is the STATIC segment size (one compiled program per
             # value — always DECODE_SEGMENT in serving); budget is the
             # DYNAMIC number of tokens actually wanted from this segment,
-            # so short tails exit early without a fresh compile.
+            # so short tails exit early without a fresh compile. Sampling
+            # params are per-ROW dynamic arrays (heterogeneous knight
+            # personas; no recompile per sampling config) — except the
+            # all-greedy common case, where the STATIC greedy flag keeps
+            # the hot path a single argmax instead of two full-vocab
+            # sorts + softmax + cumsum per token (one extra compiled
+            # variant total, not one per config).
             b = first_token.shape[0]
             caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
             out = jnp.zeros((b, max_new), jnp.int32)
@@ -294,8 +306,13 @@ class InferenceEngine:
                     params, cfg, tokens, positions, caches_b, valid,
                     valid + 1)
                 key, sub = jax.random.split(key)
-                nxt = sample_token(logits[:, 0].astype(jnp.float32), sub,
-                                   self.sampling).astype(jnp.int32)
+                row_logits = logits[:, 0].astype(jnp.float32)
+                if greedy:
+                    nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = sample_token_batch(
+                        row_logits, sub, temps, top_ks,
+                        top_ps).astype(jnp.int32)
                 nxt = jnp.where(done, eos, nxt)
                 out = out.at[:, step].set(nxt)
                 new_done = done | (nxt == eos)
@@ -364,9 +381,10 @@ class InferenceEngine:
             self._prefill_step_paged = prefill_step_paged
 
             @partial(jax.jit, donate_argnums=(1,),
-                     static_argnames=("max_new",))
+                     static_argnames=("max_new", "greedy"))
             def decode_loop_paged(params, pools, tables, first_token,
-                                  start_valid, key, budget, max_new):
+                                  start_valid, key, budget, temps, top_ks,
+                                  top_ps, max_new, greedy):
                 b = first_token.shape[0]
                 caches_b = gather_view(pools, tables, b)
                 out = jnp.zeros((b, max_new), jnp.int32)
@@ -384,9 +402,14 @@ class InferenceEngine:
                         params, cfg, last[:, None], valid[:, None],
                         caches_b, valid, valid + 1)
                     key, sub = jax.random.split(key)
-                    nxt = sample_token(
-                        logits[:, 0].astype(jnp.float32), sub,
-                        self.sampling).astype(jnp.int32)
+                    row_logits = logits[:, 0].astype(jnp.float32)
+                    if greedy:
+                        nxt = jnp.argmax(row_logits, axis=-1) \
+                            .astype(jnp.int32)
+                    else:
+                        nxt = sample_token_batch(
+                            row_logits, sub, temps, top_ks,
+                            top_ps).astype(jnp.int32)
                     nxt = jnp.where(done, eos, nxt)
                     out = out.at[:, step].set(nxt)
                     new_done = done | (nxt == eos)
@@ -746,24 +769,32 @@ class InferenceEngine:
 
     def generate_batch(self, turns: list[tuple[str, str]],
                        max_new_tokens: Optional[int] = None,
-                       timeout_s: float = 600.0) -> list[str]:
+                       timeout_s: float = 600.0,
+                       sampling_per_turn: Optional[
+                           list[SamplingParams]] = None) -> list[str]:
         return self.generate_batch_with_stats(
-            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s)[0]
+            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
+            sampling_per_turn=sampling_per_turn)[0]
 
     def generate_batch_with_stats(
             self, turns: list[tuple[str, str]],
             max_new_tokens: Optional[int] = None,
-            timeout_s: float = 600.0) -> tuple[list[str], GenStats]:
+            timeout_s: float = 600.0,
+            sampling_per_turn: Optional[list[SamplingParams]] = None,
+    ) -> tuple[list[str], GenStats]:
         """Serve N (slot_name, prompt) turns as one batched program pair.
 
-        Returns (responses, this call's stats) — callers needing stats must
-        take them from the return value, not from `last_stats`, which is a
+        sampling_per_turn: per-row SamplingParams (heterogeneous knight
+        personas); None = the engine default for every row. Returns
+        (responses, this call's stats) — callers needing stats must take
+        them from the return value, not from `last_stats`, which is a
         convenience field that concurrent callers may overwrite."""
         with self._serve_lock:
             return self._generate_batch_locked(turns, max_new_tokens,
-                                               timeout_s)
+                                               timeout_s, sampling_per_turn)
 
-    def _generate_batch_locked(self, turns, max_new_tokens, timeout_s):
+    def _generate_batch_locked(self, turns, max_new_tokens, timeout_s,
+                               sampling_per_turn=None):
         stats = GenStats()
         deadline = time.monotonic() + timeout_s
         max_new = max_new_tokens or self.sampling.max_new_tokens
@@ -825,9 +856,20 @@ class InferenceEngine:
         float(last_logits[0, 0])
         stats.prefill_seconds = time.monotonic() - t0
 
-        first = sample_token(last_logits.astype(jnp.float32),
-                             self._next_key(), self.sampling) \
-            .astype(jnp.int32)
+        per_row = sampling_per_turn or [self.sampling] * len(turns)
+        if len(per_row) != len(turns):
+            raise ValueError(
+                f"sampling_per_turn has {len(per_row)} entries for "
+                f"{len(turns)} turns")
+        temps, top_ks, top_ps = sampling_arrays(per_row)
+        greedy = all(p.temperature <= 0.0 for p in per_row)
+        if greedy:
+            first = jnp.argmax(last_logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+        else:
+            first = sample_token_batch(last_logits.astype(jnp.float32),
+                                       self._next_key(), temps, top_ks,
+                                       top_ps).astype(jnp.int32)
         first_np = np.asarray(first)
         cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
 
@@ -841,14 +883,16 @@ class InferenceEngine:
                 out, steps, last, valid, done, self.kv.pools = \
                     self._decode_loop_paged(
                         self.params, self.kv.pools, tables, cur_last,
-                        cur_valid, self._next_key(), budget,
-                        max_new=DECODE_SEGMENT)
+                        cur_valid, self._next_key(), budget, temps,
+                        top_ks, top_ps, max_new=DECODE_SEGMENT,
+                        greedy=greedy)
             else:
                 out, steps, last, valid, done, self.kv.layers = \
                     self._decode_loop(
                         self.params, self.kv.layers, slot_idx, cur_last,
-                        cur_valid, self._next_key(), budget,
-                        max_new=DECODE_SEGMENT)
+                        cur_valid, self._next_key(), budget, temps,
+                        top_ks, top_ps, max_new=DECODE_SEGMENT,
+                        greedy=greedy)
             return out, steps, last, valid, done
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,

@@ -97,6 +97,10 @@ def plan_fleet(engine_configs: list[dict[str, Any]],
     configs = [c for c in engine_configs if c is not None]
     if any(c.get("devices") or c.get("mesh") for c in configs):
         return
+    # Multi-host: join the process group before the jax.devices() below
+    # initializes a single-process backend (engine/distributed.py).
+    from .distributed import maybe_init_distributed
+    maybe_init_distributed()
     identities: dict[str, list[dict[str, Any]]] = {}
     for c in configs:
         identities.setdefault(_engine_identity(c), []).append(c)

@@ -62,6 +62,8 @@ class PPEngine:
         import dataclasses
 
         from . import enable_compilation_cache
+        from .distributed import maybe_init_distributed
+        maybe_init_distributed()
         enable_compilation_cache()
         # Dense attention inside the stages: the flash kernels' shard_map
         # wrapper targets the (data, model) mesh, not the pipe mesh.

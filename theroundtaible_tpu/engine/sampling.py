@@ -45,3 +45,46 @@ def sample_token(logits: jax.Array, key: jax.Array,
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
     return jax.random.categorical(key, scaled, axis=-1)
+
+
+def sampling_arrays(params_list: list[SamplingParams]):
+    """Per-row (temps, top_ks, top_ps) f32/i32/f32 arrays for
+    sample_token_batch."""
+    return (jnp.asarray([p.temperature for p in params_list], jnp.float32),
+            jnp.asarray([p.top_k for p in params_list], jnp.int32),
+            jnp.asarray([p.top_p for p in params_list], jnp.float32))
+
+
+def sample_token_batch(logits: jax.Array, key: jax.Array,
+                       temps: jax.Array, top_ks: jax.Array,
+                       top_ps: jax.Array) -> jax.Array:
+    """Per-ROW sampling parameters as dynamic arrays: heterogeneous knight
+    personas (different temperatures per seat) sample correctly inside ONE
+    batched program, and changing a sampling config never recompiles
+    (sample_token's Python branches bake the params into the program).
+
+    Row semantics match sample_token exactly: temperature <= 0 → greedy;
+    top_k == 0 / top_p == 1.0 → disabled; top-k mask applies before the
+    top-p cutoff."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_ks - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    kth = jnp.where((top_ks > 0)[:, None], kth, -jnp.inf)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # re-sort after the top-k mask (-inf entries sink to the tail) so the
+    # cumulative cutoff sees the same distribution sample_token does
+    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.clip(
+        jnp.sum(cumulative < top_ps[:, None], axis=-1), 0, v - 1)
+    cutoff = jnp.take_along_axis(sorted2, cutoff_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled)
