@@ -1,0 +1,121 @@
+"""int8 weight quantization (engine/quant.py): structure, dequant
+accuracy, and end-to-end serving across layouts/meshes."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.models.common import forward, init_params
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.quant import quantize_params
+from theroundtaible_tpu.engine.sampling import SamplingParams
+
+
+class TestQuantizeParams:
+    def test_structure_and_dtypes(self):
+        cfg = get_model_config("tiny-gemma")
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        qp = quantize_params(params, cfg, act_dtype=jnp.float32)
+        layer = qp["layers"][0]
+        assert qp["embedding"]["q"].dtype == jnp.int8
+        assert qp["embedding"]["s"].shape == (cfg.vocab_size,)
+        assert layer["q_proj"]["q"].dtype == jnp.int8
+        assert layer["q_proj"]["s"].shape == (cfg.num_heads, cfg.head_dim)
+        assert layer["o_proj"]["s"].shape == (cfg.embed_dim,)
+        assert layer["gate_proj"]["s"].shape == (cfg.mlp_dim,)
+        # norms pass through untouched
+        assert layer["input_norm"].dtype == jnp.float32
+
+    def test_moe_expert_scales(self):
+        cfg = get_model_config("tiny-mixtral")
+        params = init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+        qp = quantize_params(params, cfg, act_dtype=jnp.float32)
+        experts = qp["layers"][0]["experts"]
+        assert experts["gate_proj"]["q"].dtype == jnp.int8
+        assert experts["gate_proj"]["s"].shape == (cfg.num_experts,
+                                                   cfg.mlp_dim)
+        assert experts["down_proj"]["s"].shape == (cfg.embed_dim,)
+        assert qp["layers"][0]["router"]["s"].shape == (cfg.num_experts,)
+
+    def test_dequantized_weights_close(self):
+        cfg = get_model_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+        qp = quantize_params(params, cfg, act_dtype=jnp.float32)
+        w = np.asarray(params["layers"][0]["q_proj"], np.float32)
+        leaf = qp["layers"][0]["q_proj"]
+        deq = (np.asarray(leaf["q"], np.float32)
+               * np.asarray(leaf["s"], np.float32)[None])
+        # symmetric per-channel int8: error bounded by half a step
+        step = np.asarray(leaf["s"], np.float32)[None]
+        assert np.all(np.abs(deq - w) <= 0.5 * step + 1e-7)
+
+
+@pytest.mark.parametrize("model", ["tiny-gemma", "tiny-llama",
+                                   "tiny-mistral", "tiny-mixtral"])
+def test_forward_logits_close_to_fp(model):
+    """int8 forward tracks the fp32 forward closely on every family —
+    the quant error stays small relative to the logit scale."""
+    cfg = get_model_config(model, max_seq_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    qp = quantize_params(params, cfg, act_dtype=jnp.float32)
+    tokens = jnp.asarray([[1, 9, 4, 7] * 8], jnp.int32)
+    positions = jnp.arange(32)[None, :]
+    valid = jnp.asarray([32], jnp.int32)
+    ref, _ = forward(params, cfg, tokens, positions, None, None, valid)
+    got, _ = forward(qp, cfg, tokens, positions, None, None, valid)
+    ref = np.asarray(ref, np.float32)
+    got = np.asarray(got, np.float32)
+    err = np.abs(got - ref).max()
+    scale = np.abs(ref).max()
+    assert err < 0.05 * scale, f"{model}: err {err} vs scale {scale}"
+
+
+class TestQuantServing:
+    def _build(self, quant, **kw):
+        return InferenceEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            num_slots=4, quant=quant,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8),
+            **kw)
+
+    def test_generate_and_reuse(self):
+        eng = self._build("int8")
+        assert eng.describe()["quant"] == "int8"
+        out = eng.generate("the knights debate quantization",
+                           slot_name="q", max_new_tokens=8)
+        assert isinstance(out, str)
+        out2 = eng.generate("the knights debate quantization further",
+                            slot_name="q", max_new_tokens=8)
+        assert isinstance(out2, str)
+        assert eng.last_stats.reused_tokens > 0
+
+    def test_quant_under_tp_mesh(self):
+        eng = self._build("int8", mesh_shape={"data": 1, "model": 2})
+        outs = eng.generate_batch(
+            [("a", "question one about int8"),
+             ("b", "question two about sharding")], max_new_tokens=8)
+        assert len(outs) == 2
+
+    def test_quant_with_paged_kv(self):
+        eng = self._build("int8", kv_layout="paged", page_size=32)
+        out = eng.generate("paged plus quantized", slot_name="pq",
+                           max_new_tokens=8)
+        assert isinstance(out, str)
+
+    def test_quant_rejects_seq_parallel(self):
+        with pytest.raises(ValueError, match="quant"):
+            self._build("int8", seq_parallel=8)
+
+    def test_param_bytes_shrink(self):
+        fp = self._build("none")
+        q8 = self._build("int8")
+
+        def tree_bytes(t):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree_util.tree_leaves(t))
+
+        # bf16 → int8 weights: close to half the bytes (scales are small)
+        assert tree_bytes(q8.params) < 0.6 * tree_bytes(fp.params)

@@ -59,7 +59,8 @@ def _cache_key(config: dict[str, Any]) -> str:
                 ("model", "checkpoint", "max_seq_len", "dtype", "mesh",
                  "seq_parallel", "long_scheme", "long_threshold",
                  "devices", "attn", "num_slots", "sampling", "seed",
-                 "kv_layout", "page_size", "num_pages", "n_micro")}
+                 "kv_layout", "page_size", "num_pages", "n_micro",
+                 "quant")}
     return json.dumps(relevant, sort_keys=True)
 
 

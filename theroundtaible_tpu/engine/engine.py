@@ -74,7 +74,7 @@ class InferenceEngine:
                  long_scheme: str = "ring", attn: str = "auto",
                  devices: Optional[list[int]] = None,
                  kv_layout: str = "contiguous", page_size: int = 128,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, quant: str = "none"):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -97,12 +97,26 @@ class InferenceEngine:
         self.sampling = sampling or SamplingParams()
         self.tokenizer = load_tokenizer(checkpoint or None)
 
+        if quant not in ("none", "int8"):
+            raise ValueError(f"quant must be none|int8, got {quant!r}")
+        if quant != "none" and seq_parallel and seq_parallel > 1:
+            raise ValueError(
+                "quant='int8' + seq_parallel is not supported yet — the "
+                "ring cores index raw param arrays")
+        self.quant = quant
+
         if checkpoint:
             from .checkpoint import load_hf_checkpoint
             params = load_hf_checkpoint(checkpoint, model_cfg, dtype)
         else:
             params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
         self.params = shard_params(params, model_cfg, self.mesh)
+        if quant == "int8":
+            # AFTER sharding: q/s are jnp ops on the sharded weights, so
+            # XLA propagates the NamedShardings (engine/quant.py).
+            from .quant import quantize_params
+            self.params = quantize_params(self.params, model_cfg,
+                                          act_dtype=dtype)
         self.num_params = param_count(self.params)
 
         if kv_layout not in ("contiguous", "paged"):
@@ -495,6 +509,7 @@ class InferenceEngine:
             page_size=int(config.get("page_size", 128)),
             num_pages=(int(config["num_pages"])
                        if config.get("num_pages") else None),
+            quant=config.get("quant", "none"),
         )
 
     # --- serving ---
@@ -916,6 +931,7 @@ class InferenceEngine:
             "mesh": dict(self.mesh.shape),
             "num_slots": self.kv.num_slots,
             "kv_layout": self.kv_layout,
+            "quant": self.quant,
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
         if self.kv_layout == "paged":

@@ -138,9 +138,27 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
     return cap * jnp.tanh(x / cap)
 
 
-def _einsum(spec: str, a: jax.Array, b: jax.Array) -> jax.Array:
-    # bf16 inputs, f32 accumulation on the MXU.
+def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
+    # bf16 inputs, f32 accumulation on the MXU. An int8-quantized weight
+    # ({"q", "s"} dict, engine/quant.py) streams half the HBM bytes: the
+    # int8→activation-dtype convert fuses into the matmul operand and the
+    # per-output-channel scale applies to the OUTPUT (the scale axes are
+    # the weight's non-contracted axes, which land trailing).
+    if isinstance(b, dict) and "q" in b:
+        y = jnp.einsum(spec, a, b["q"].astype(a.dtype),
+                       preferred_element_type=jnp.float32)
+        s = b["s"].astype(jnp.float32)
+        return y * s.reshape((1,) * (y.ndim - s.ndim) + s.shape)
     return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
+
+
+def embed_tokens(emb, tokens: jax.Array) -> jax.Array:
+    """Embedding lookup; int8 tables dequantize per looked-up row. The
+    result's dtype follows the param dtype (s carries it for quant)."""
+    if isinstance(emb, dict) and "q" in emb:
+        rows = emb["q"][tokens].astype(emb["s"].dtype)
+        return rows * emb["s"][tokens][..., None]
+    return emb[tokens]
 
 
 def project_qkv(
@@ -346,7 +364,7 @@ def forward(
     """Full model forward. Returns (logits [B,T,V], updated caches)."""
     # Activations follow the param dtype: bf16 params (serving) keep the
     # whole network bf16; f32 params (HF logit-parity tests) stay f32.
-    x = params["embedding"][tokens]
+    x = embed_tokens(params["embedding"], tokens)
     if cfg.scale_embeddings:
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
 
