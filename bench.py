@@ -32,7 +32,7 @@ import time
 
 A100_OLLAMA_GEMMA2B_DECODE_TPS = 120.0  # external anchor, see module docstring
 
-ATTEMPT_TIMEOUT_S = 240.0  # cold compile measured ≈70s; generous margin
+ATTEMPT_TIMEOUT_S = 320.0  # two engines (bf16+int8) ≈140s cold; margin
 MAX_ATTEMPTS = 3
 RETRY_DELAY_S = 20.0
 
@@ -71,46 +71,59 @@ def child() -> int:
         cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
         decode_tokens = 256
 
-    t_build = time.monotonic()
-    engine = InferenceEngine(
-        cfg, num_slots=4,
-        sampling=SamplingParams(temperature=0.0,
-                                max_new_tokens=decode_tokens))
-    build_s = time.monotonic() - t_build
+    def measure(quant: str) -> dict:
+        """Build + minimally warm one engine, return its measured run.
 
-    # Minimal warmup: serve the bench prompt itself on a throwaway slot.
-    # This compiles exactly the (batch=1, bucket) prefill programs the
-    # prompt's chunking hits plus the one decode-segment program; the second
-    # pass reaches the donated-buffer layout fixpoint (see
-    # InferenceEngine.warmup docstring). Slot released between passes so
-    # each is an honest full prefill.
-    t_warm = time.monotonic()
-    for _ in range(2):
+        Warmup serves the bench prompt itself on a throwaway slot: this
+        compiles exactly the (batch=1, bucket) prefill programs the
+        prompt's chunking hits plus the one decode-segment program; the
+        second pass reaches the donated-buffer layout fixpoint (see
+        InferenceEngine.warmup docstring). Slot released between passes
+        so each is an honest full prefill."""
+        t_build = time.monotonic()
+        engine = InferenceEngine(
+            cfg, num_slots=4, quant=quant,
+            sampling=SamplingParams(temperature=0.0,
+                                    max_new_tokens=decode_tokens))
+        build_s = time.monotonic() - t_build
+        t_warm = time.monotonic()
+        for _ in range(2):
+            engine.kv.release("__bench_warmup")
+            engine.generate(PROMPT, slot_name="__bench_warmup",
+                            max_new_tokens=decode_tokens)
         engine.kv.release("__bench_warmup")
-        engine.generate(PROMPT, slot_name="__bench_warmup",
+        warmup_s = time.monotonic() - t_warm
+        # Measured run on a fresh slot (no prefix reuse → honest prefill).
+        t0 = time.monotonic()
+        engine.generate(PROMPT, slot_name="bench",
                         max_new_tokens=decode_tokens)
-    engine.kv.release("__bench_warmup")
-    warmup_s = time.monotonic() - t_warm
-
-    # Measured run on a fresh slot (no prefix reuse → honest prefill too).
-    t0 = time.monotonic()
-    engine.generate(PROMPT, slot_name="bench", max_new_tokens=decode_tokens)
-    wall = time.monotonic() - t0
-    s = engine.last_stats
-
-    decode_tps = s.decode_tps
-    result = {
-        "metric": f"decode_tokens_per_sec_per_chip[{cfg.name}]",
-        "value": round(decode_tps, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(decode_tps / A100_OLLAMA_GEMMA2B_DECODE_TPS, 3),
-        "detail": {
+        wall = time.monotonic() - t0
+        s = engine.last_stats
+        return {
+            "quant": quant,
+            "decode_tps": round(s.decode_tps, 2),
             "prefill_tps": round(s.prefill_tps, 1),
             "prefill_tokens": s.prefill_tokens,
             "decode_tokens": s.decode_tokens,
             "wall_s": round(wall, 2),
             "build_s": round(build_s, 1),
             "warmup_s": round(warmup_s, 1),
+        }
+
+    # Measure bf16 and int8 (the reference's llama.cpp baseline serves
+    # quantized weights, so int8 is the apples-to-apples config; bf16 is
+    # reported alongside). Headline = the faster of the two.
+    runs = [measure("none"), measure("int8")]
+    best = max(runs, key=lambda r: r["decode_tps"])
+    decode_tps = best["decode_tps"]
+    result = {
+        "metric": (f"decode_tokens_per_sec_per_chip"
+                   f"[{cfg.name},{'bf16' if best['quant'] == 'none' else best['quant']}]"),
+        "value": decode_tps,
+        "unit": "tokens/s",
+        "vs_baseline": round(decode_tps / A100_OLLAMA_GEMMA2B_DECODE_TPS, 3),
+        "detail": {
+            "runs": runs,
             "devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
         },
