@@ -1,0 +1,45 @@
+"""Shared bench watchdog.
+
+The single-claim TPU tunnel HANGS (not errors) while another process
+holds the chip, and a hung PJRT init cannot be interrupted in-process —
+so every bench runs its measurement in a child process the parent can
+kill and relaunch with backoff. One implementation, used by bench.py,
+bench_discuss.py and bench_suite.py (three copies had already drifted).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def run_watchdogged(script_path: str, child_args: list[str],
+                    timeout_s: float, attempts: int = 3,
+                    retry_delay_s: float = 20.0) -> int:
+    """Run `script_path --child <args>` under a kill-and-retry watchdog.
+
+    The child prints one JSON object per line for its results; the parent
+    forwards exactly those lines to stdout. Returns 0 on the first
+    successful attempt, 1 when every attempt failed."""
+    name = script_path.rsplit("/", 1)[-1]
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, script_path, *child_args, "--child"],
+                capture_output=True, text=True, timeout=timeout_s)
+            out = [line for line in proc.stdout.strip().splitlines()
+                   if line.startswith("{")]
+            if proc.returncode == 0 and out:
+                print("\n".join(out))
+                return 0
+            print(f"{name} attempt {attempt}: rc={proc.returncode} "
+                  f"stderr tail: {proc.stderr[-400:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"{name} attempt {attempt}: timed out after "
+                  f"{timeout_s:.0f}s (TPU claim hang?) — killed",
+                  file=sys.stderr)
+        if attempt < attempts:
+            time.sleep(retry_delay_s)
+    print(f"{name}: all attempts failed", file=sys.stderr)
+    return 1

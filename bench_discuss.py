@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import tempfile
 import time
@@ -147,25 +146,9 @@ def child() -> int:
 
 
 def main() -> int:
-    for attempt in range(1, MAX_ATTEMPTS + 1):
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S)
-            out = proc.stdout.strip().splitlines()
-            if proc.returncode == 0 and out:
-                print(out[-1])
-                return 0
-            print(f"bench_discuss attempt {attempt}: rc={proc.returncode} "
-                  f"stderr tail: {proc.stderr[-400:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"bench_discuss attempt {attempt}: timed out after "
-                  f"{ATTEMPT_TIMEOUT_S:.0f}s (TPU claim hang?) — killed",
-                  file=sys.stderr)
-        if attempt < MAX_ATTEMPTS:
-            time.sleep(RETRY_DELAY_S)
-    print("bench_discuss: all attempts failed", file=sys.stderr)
-    return 1
+    from bench_common import run_watchdogged
+    return run_watchdogged(os.path.abspath(__file__), [],
+                           ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 if __name__ == "__main__":

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -100,22 +99,37 @@ def bench_fleet() -> dict:
 
 
 def bench_summon() -> dict:
-    """Config 4: long-context prefill — the 2k-line git diff that the
-    reference truncates to 3000 chars (orchestrator.ts:406) and we serve
-    whole."""
+    """Config 4: long-context prefill on a git diff sized to FILL the
+    engine's context budget (the reference truncates any diff to 3000
+    chars, orchestrator.ts:406; we serve the whole window)."""
     jax, on_cpu = _setup()
     from theroundtaible_tpu.engine import get_engine, reset_engines
 
-    diff = "\n".join(
-        f"+    line_{i} = compute_{i % 7}(state, {i})  # changed"
-        for i in range(2000))
     reset_engines()
     cfg = {"model": "tiny-gemma" if on_cpu else "gemma-2b-it",
            "max_seq_len": 4096 if on_cpu else 8192, "num_slots": 2,
            "sampling": {"temperature": 0.0, "max_new_tokens": 32}}
     engine = get_engine(cfg)
-    prompt = "Review this diff:\n" + diff
-    engine.generate(prompt[:2048], slot_name="warm", max_new_tokens=8)
+    # Build the diff to the REAL prompt budget (max_seq minus the padded
+    # decode reserve) so nothing is silently head-truncated and the
+    # reported tokens are the tokens actually served.
+    budget_tokens = engine.max_seq_len - 64 - 1
+    budget_chars = int(budget_tokens * engine.chars_per_token() * 0.95)
+    lines, total = [], 0
+    i = 0
+    while total < budget_chars:
+        line = f"+    line_{i} = compute_{i % 7}(state, {i})  # changed"
+        lines.append(line)
+        total += len(line) + 1
+        i += 1
+    prompt = ("Review this diff:\n" + "\n".join(lines))[:budget_chars]
+    # Warm on the FULL prompt (compiles the exact buckets the measured
+    # run hits — bench.py's minimal-warmup discipline), then measure on
+    # a fresh slot.
+    for _ in range(2):
+        engine.kv.release("warm")
+        engine.generate(prompt, slot_name="warm", max_new_tokens=8)
+    engine.kv.release("warm")
     t0 = time.monotonic()
     engine.generate(prompt, slot_name="summon", max_new_tokens=32)
     wall = time.monotonic() - t0
@@ -127,7 +141,7 @@ def bench_summon() -> dict:
         "vs_baseline": round(s.prefill_tps / SUMMON_PREFILL_ANCHOR_TPS, 3),
         "detail": {
             "prefill_tokens": s.prefill_tokens,
-            "diff_lines": 2000,
+            "diff_lines": len(lines),
             "wall_s": round(wall, 2),
             "platform": jax.devices()[0].platform,
         },
@@ -173,33 +187,22 @@ BENCHES = {"fleet": bench_fleet, "summon": bench_summon,
 
 
 def child(which: str) -> int:
-    names = list(BENCHES) if which == "all" else [which]
-    for name in names:
-        print(json.dumps(BENCHES[name]()))
+    print(json.dumps(BENCHES[which]()))
     return 0
 
 
 def main(which: str) -> int:
-    for attempt in range(1, MAX_ATTEMPTS + 1):
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), which,
-                 "--child"],
-                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S)
-            out = [line for line in proc.stdout.strip().splitlines()
-                   if line.startswith("{")]
-            if proc.returncode == 0 and out:
-                print("\n".join(out))
-                return 0
-            print(f"bench_suite attempt {attempt}: rc={proc.returncode} "
-                  f"stderr tail: {proc.stderr[-400:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"bench_suite attempt {attempt}: timed out "
-                  f"(TPU claim hang?) — killed", file=sys.stderr)
-        if attempt < MAX_ATTEMPTS:
-            time.sleep(RETRY_DELAY_S)
-    print("bench_suite: all attempts failed", file=sys.stderr)
-    return 1
+    """One watchdogged child PER bench (a single `all` child would stack
+    5+ engine builds — two of them 7B-class — into one timeout window)."""
+    from bench_common import run_watchdogged
+
+    names = list(BENCHES) if which == "all" else [which]
+    worst = 0
+    for name in names:
+        worst = max(worst, run_watchdogged(
+            os.path.abspath(__file__), [name], ATTEMPT_TIMEOUT_S,
+            MAX_ATTEMPTS, RETRY_DELAY_S))
+    return worst
 
 
 if __name__ == "__main__":

@@ -331,6 +331,11 @@ class PPEngine:
             top_p=float(sampling_cfg.get("top_p", 1.0)),
             max_new_tokens=int(sampling_cfg.get("max_new_tokens", 1024)),
         )
+        if config.get("quant", "none") != "none":
+            raise ValueError(
+                "quant is not supported on the pipeline-parallel engine "
+                "yet (its stage programs index raw param arrays) — drop "
+                "'quant' or use a (data, model) mesh")
         mesh = config.get("mesh", {})
         return cls(
             model_cfg,
