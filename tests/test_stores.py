@@ -125,6 +125,45 @@ class TestChronicle:
     def test_read_missing(self, project_root):
         assert read_chronicle(project_root, "chronicle.md") == ""
 
+    def test_concurrent_appends_never_interleave(self, project_root):
+        """The reference's acknowledged race (its TODO.md:188): two
+        processes appending concurrently must not lose entries. The lock
+        serializes the read-modify-write."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def append(i):
+            append_to_chronicle(project_root, "chronicle.md",
+                                topic=f"T{i}", outcome="o", knights=["A"],
+                                date="2026-01-01")
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(append, range(16)))
+        content = read_chronicle(project_root, "chronicle.md")
+        for i in range(16):
+            assert f"## 2026-01-01 — T{i}" in content
+        # lock file is released afterwards
+        assert not (project_root / "chronicle.md.lock").exists()
+
+
+class TestFileLock:
+    def test_stale_lock_reclaimed(self, tmp_path):
+        """A lock left by a dead PID must not block the next run."""
+        from theroundtaible_tpu.utils.lock import FileLock
+        target = tmp_path / "chronicle.md"
+        # PID 2**22-odd is near-certainly unused; write a stale lock
+        (tmp_path / "chronicle.md.lock").write_text("3999999")
+        with FileLock(target, timeout_s=2.0):
+            pass  # acquired despite the stale holder
+        assert not (tmp_path / "chronicle.md.lock").exists()
+
+    def test_live_lock_times_out(self, tmp_path):
+        from theroundtaible_tpu.utils.lock import FileLock, LockTimeout
+        import os
+        target = tmp_path / "f"
+        (tmp_path / "f.lock").write_text(str(os.getpid()))  # we are alive
+        with pytest.raises(LockTimeout):
+            FileLock(target, timeout_s=0.3).acquire()
+
 
 class TestManifest:
     def entry(self, id_="feat-x", **kw):

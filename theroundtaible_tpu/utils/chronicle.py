@@ -22,13 +22,15 @@ def read_chronicle(project_root: str | Path, chronicle_path: str) -> str:
 def append_to_chronicle(project_root: str | Path, chronicle_path: str, *,
                         topic: str, outcome: str, knights: list[str],
                         date: str) -> None:
-    """Append a `## <date> — <topic>` entry (reference chronicle.ts:21-54)."""
+    """Append a `## <date> — <topic>` entry (reference chronicle.ts:21-54).
+
+    The read-modify-write runs under a PID-stale-aware lock: the
+    reference interleaves concurrent appends (its acknowledged race,
+    SURVEY.md §5.2 / reference TODO.md:188)."""
+    from .lock import FileLock
+
     full_path = Path(project_root) / chronicle_path
     full_path.parent.mkdir(parents=True, exist_ok=True)
-    if full_path.exists():
-        content = full_path.read_text(encoding="utf-8")
-    else:
-        content = CHRONICLE_HEADER
     entry = "\n".join([
         f"## {date} — {topic}",
         "",
@@ -39,4 +41,9 @@ def append_to_chronicle(project_root: str | Path, chronicle_path: str, *,
         "---",
         "",
     ])
-    full_path.write_text(content + entry, encoding="utf-8")
+    with FileLock(full_path):
+        if full_path.exists():
+            content = full_path.read_text(encoding="utf-8")
+        else:
+            content = CHRONICLE_HEADER
+        full_path.write_text(content + entry, encoding="utf-8")

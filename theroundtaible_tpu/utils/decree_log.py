@@ -44,22 +44,26 @@ def _next_decree_id(log: DecreeLog) -> str:
 
 def add_decree_entry(project_root: str | Path, type_: str, session: str,
                      topic: str, reason: Optional[str] = None) -> DecreeEntry:
-    """Append one decree (reference decree-log.ts:48-73)."""
-    log = read_decree_log(project_root)
-    entry = DecreeEntry(
-        id=_next_decree_id(log),
-        type=type_,
-        session=session,
-        topic=topic,
-        reason=(reason or "").strip() or "No reason provided",
-        revoked=False,
-        date=now_iso(),
-    )
-    log.entries.append(entry)
+    """Append one decree (reference decree-log.ts:48-73). The read-
+    modify-write runs under a PID-stale-aware lock (utils/lock.py)."""
+    from .lock import FileLock
+
     log_path = Path(project_root) / DECREE_LOG_RELPATH
     log_path.parent.mkdir(parents=True, exist_ok=True)
-    log_path.write_text(json.dumps(log.to_dict(), indent=2) + "\n",
-                        encoding="utf-8")
+    with FileLock(log_path):
+        log = read_decree_log(project_root)
+        entry = DecreeEntry(
+            id=_next_decree_id(log),
+            type=type_,
+            session=session,
+            topic=topic,
+            reason=(reason or "").strip() or "No reason provided",
+            revoked=False,
+            date=now_iso(),
+        )
+        log.entries.append(entry)
+        log_path.write_text(json.dumps(log.to_dict(), indent=2) + "\n",
+                            encoding="utf-8")
     return entry
 
 

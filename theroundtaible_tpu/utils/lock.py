@@ -1,0 +1,92 @@
+"""PID-stamped advisory file locks for shared .roundtable files.
+
+The reference has NO locking: concurrent `roundtable` invocations in one
+project interleave read-modify-write cycles on chronicle.md / decree-log
+/ manifest (SURVEY.md §5.2), and its own TODO acknowledges the gap as
+future work ("stale lock detection — PID-based check ... so crashed
+sessions don't lock", reference TODO.md:188). This implements exactly
+that: O_CREAT|O_EXCL lock files stamped with the holder's PID; a lock
+whose holder is no longer alive is stale and silently reclaimed, so a
+crashed run can never deadlock the next one.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+
+class LockTimeout(RuntimeError):
+    pass
+
+
+class FileLock:
+    """`with FileLock(path):` — advisory lock at `<path>.lock`."""
+
+    def __init__(self, target: str | Path, timeout_s: float = 10.0,
+                 poll_s: float = 0.05):
+        self.lock_path = Path(str(target) + ".lock")
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        self._held = False
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True  # exists, owned by someone else
+        return True
+
+    def _try_reclaim_stale(self) -> None:
+        try:
+            pid = int(self.lock_path.read_text().strip() or "0")
+        except (OSError, ValueError):
+            return  # holder is mid-write or lock vanished; just retry
+        if pid and not self._pid_alive(pid):
+            # Stale: the holder died without releasing. Remove and let the
+            # normal O_EXCL race decide who gets it next.
+            try:
+                self.lock_path.unlink()
+            except OSError:
+                pass
+
+    def acquire(self) -> None:
+        self.lock_path.parent.mkdir(parents=True, exist_ok=True)
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                fd = os.open(self.lock_path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w") as f:
+                    f.write(str(os.getpid()))
+                self._held = True
+                return
+            except FileExistsError:
+                self._try_reclaim_stale()
+                if time.monotonic() > deadline:
+                    raise LockTimeout(
+                        f"Could not acquire {self.lock_path} within "
+                        f"{self.timeout_s:.0f}s — another roundtable "
+                        "process is writing; retry, or remove the lock "
+                        "file if no other process is running")
+                time.sleep(self.poll_s)
+
+    def release(self) -> None:
+        if self._held:
+            self._held = False
+            try:
+                self.lock_path.unlink()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "FileLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
