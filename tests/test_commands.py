@@ -169,6 +169,46 @@ class TestContinueCommand:
         assert "not resumable" in capsys.readouterr().out
 
 
+class TestWarmupCommand:
+    def test_no_tpu_knights_is_noop(self, project_root, monkeypatch,
+                                    capsys):
+        write_config(project_root)  # fake adapter only
+        monkeypatch.chdir(project_root)
+        assert main(["warmup"]) == 0
+        assert "nothing to warm" in capsys.readouterr().out
+
+    def test_warms_tpu_engine(self, project_root, monkeypatch, capsys):
+        import json as _json
+
+        from theroundtaible_tpu.engine import reset_engines
+
+        cfg = {
+            "version": "1.0", "project": "t", "language": "en",
+            "knights": [
+                {"name": "A", "adapter": "tpu-llm", "capabilities": [],
+                 "priority": 1},
+                {"name": "B", "adapter": "tpu-llm", "capabilities": [],
+                 "priority": 2}],
+            "rules": {"max_rounds": 1, "consensus_threshold": 9,
+                      "timeout_per_turn_seconds": 600,
+                      "escalate_to_user_after": 3, "auto_execute": False,
+                      "ignore": []},
+            "chronicle": "chronicle.md",
+            "adapter_config": {"tpu-llm": {
+                "model": "tiny-gemma", "max_seq_len": 256, "num_slots": 4,
+                "sampling": {"temperature": 0.0, "max_new_tokens": 8}}},
+        }
+        (project_root / ".roundtable" / "config.json").write_text(
+            _json.dumps(cfg))
+        monkeypatch.chdir(project_root)
+        reset_engines()
+        assert main(["warmup"]) == 0
+        out = capsys.readouterr().out
+        assert "batch sizes [1, 2]" in out
+        assert "tiny-gemma" in out
+        reset_engines()
+
+
 class TestAtomicWrites:
     def test_atomic_write_replaces_and_cleans_up(self, tmp_path):
         from theroundtaible_tpu.utils.session import atomic_write_text

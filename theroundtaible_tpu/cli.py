@@ -95,6 +95,10 @@ def build_parser():
     c = sub.add_parser("code-red", help="Diagnostic mode for a bug/incident")
     c.add_argument("description", help="What is broken")
 
+    sub.add_parser("warmup",
+                   help="Pre-compile the TPU serving programs so the "
+                        "first discuss starts hot")
+
     return p
 
 
@@ -148,6 +152,9 @@ def dispatch(args) -> int:
     if args.command == "code-red":
         from .commands.code_red import code_red_command
         return code_red_command(args.description)
+    if args.command == "warmup":
+        from .commands.warmup_cmd import warmup_command
+        return warmup_command()
     raise RoundtableError(f"Unknown command: {args.command}")
 
 

@@ -1,0 +1,58 @@
+"""`roundtable warmup` — pre-compile the TPU serving programs.
+
+No reference counterpart (Ollama keeps a resident server; our engine
+lives in-process). First-ever serving of a config pays XLA compilation;
+with the persistent compilation cache (engine.enable_compilation_cache)
+that cost is paid ONCE per config — this command lets the operator pay
+it up front instead of inside the first `discuss` round. Subsequent
+process starts deserialize from the cache in seconds.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..core.config import load_config
+from ..utils.ui import style
+
+
+def warmup_command(project_root: str | None = None) -> int:
+    project_root = project_root or os.getcwd()
+    config = load_config(project_root)
+
+    tpu_ids = sorted({k.adapter for k in config.knights
+                      if k.adapter.startswith("tpu-llm")})
+    if not tpu_ids:
+        print(style.dim("\n  No tpu-llm knights in this config — "
+                        "nothing to warm.\n"))
+        return 0
+
+    from ..engine import get_engine
+    from ..engine.fleet import plan_fleet
+
+    configs = [dict(config.adapter_config.get(a, {})) for a in tpu_ids]
+    plan_fleet(configs)
+
+    # Batch sizes the orchestrator will actually dispatch: 1 (serial
+    # turns) and the number of knights sharing each adapter (batched
+    # rounds).
+    knights_per_adapter = {
+        a: sum(1 for k in config.knights if k.adapter == a)
+        for a in tpu_ids}
+
+    for adapter_id, engine_cfg in zip(tpu_ids, configs):
+        n = knights_per_adapter[adapter_id]
+        sizes = tuple(sorted({1, n}))
+        print(style.dim(f"  Warming {adapter_id} "
+                        f"(batch sizes {list(sizes)})..."))
+        t0 = time.monotonic()
+        engine = get_engine(engine_cfg)
+        secs = engine.warmup(batch_sizes=sizes)
+        d = engine.describe()
+        print(f"  {style.green('✓')} {d['model']} on mesh {d['mesh']}: "
+              f"built in {time.monotonic() - t0 - secs:.1f}s, "
+              f"warmed in {secs:.1f}s")
+    print(style.dim("\n  Programs are in the persistent compilation "
+                    "cache — the next discuss starts hot.\n"))
+    return 0
