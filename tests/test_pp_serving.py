@@ -77,6 +77,29 @@ class TestPPServingParity:
                 == ref.generate(p, slot_name="x", max_new_tokens=6))
 
 
+class TestPPPerRowSampling:
+    def test_greedy_row_unaffected_by_hot_row(self):
+        pp = build_pp()
+        greedy = SamplingParams(temperature=0.0, max_new_tokens=8)
+        hot = SamplingParams(temperature=1.5, max_new_tokens=8)
+        prompts = [("ga", "the deterministic knight"),
+                   ("gb", "the spicy knight")]
+        mixed = pp.generate_batch(prompts, max_new_tokens=8,
+                                  sampling_per_turn=[greedy, hot])
+        for n, _ in prompts:
+            pp.kv.release(n)
+        all_greedy = pp.generate_batch(prompts, max_new_tokens=8,
+                                       sampling_per_turn=[greedy, greedy])
+        assert mixed[0] == all_greedy[0]
+
+    def test_length_mismatch_raises(self):
+        pp = build_pp()
+        with pytest.raises(ValueError, match="entries"):
+            pp.generate_batch(
+                [("x", "one"), ("y", "two")], max_new_tokens=4,
+                sampling_per_turn=[SamplingParams(temperature=0.0)])
+
+
 class TestPPAdapterConfig:
     def test_reachable_from_adapter_config(self):
         """mesh {'pipe': N} in the tpu-llm adapter config builds a

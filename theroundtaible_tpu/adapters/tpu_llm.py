@@ -107,19 +107,8 @@ class TpuLlmAdapter(BaseAdapter):
         self._last_stats = None  # a failed call must not leave stale stats
         per_turn = None
         if self.engine_config.get("knight_sampling"):
-            if hasattr(engine, "n_stages"):
-                # the PP engine doesn't take per-row sampling yet — say so
-                # instead of silently flattening the configured personas
-                if not getattr(self, "_warned_pp_sampling", False):
-                    self._warned_pp_sampling = True
-                    import sys
-                    print("  Warning: knight_sampling is ignored on a "
-                          "pipeline-parallel (mesh {'pipe': N}) engine — "
-                          "all seats use the adapter's default sampling.",
-                          file=sys.stderr)
-            else:
-                per_turn = [self._sampling_for(t.knight_name)
-                            or engine.sampling for t in turns]
+            per_turn = [self._sampling_for(t.knight_name)
+                        or engine.sampling for t in turns]
         try:
             kwargs = {"timeout_s": (timeout_ms or self.default_timeout)
                       / 1000}

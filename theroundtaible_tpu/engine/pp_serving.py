@@ -19,8 +19,9 @@ cache — the memory-capacity property PP exists for.
   static-shape price of SPMD; PP decode is a memory-capacity play, its
   serial latency is inherent to the layer dependency).
 - Slots: SlotBook (kvcache.py) gives PP the same per-knight LCP delta
-  prefill as the main engine. Cross-knight donor sharing and paged
-  layout are main-engine features not yet wired here (documented in
+  prefill as the main engine; per-row sampling params work as in the
+  main engine. Cross-knight donor sharing, paged layout and int8 quant
+  are main-engine features not yet wired here (documented in
   describe()).
 
 The reference has no counterpart (its models fit one GPU via Ollama);
@@ -47,7 +48,7 @@ from .serving_loop import (DECODE_SEGMENT, bucket_for, chunked_prefill,
 from .models.common import (ModelConfig, init_params, make_attention_mask,
                             param_count, rms_norm, transformer_block)
 from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
-from .sampling import SamplingParams, sample_token
+from .sampling import (SamplingParams, sample_token_batch, sampling_arrays)
 from .tokenizer import load_tokenizer
 
 
@@ -222,16 +223,18 @@ class PPEngine:
         self._pp_prefill = pp_prefill
 
         @partial(jax.jit, donate_argnums=(2, 3),
-                 static_argnames=("max_new",))
+                 static_argnames=("max_new", "greedy"))
         def pp_decode(shared, staged, kc, vc, slot_idx, first_token,
-                      start_valid, key, budget, max_new):
+                      start_valid, key, budget, temps, top_ks, top_ps,
+                      max_new, greedy):
             b = first_token.shape[0]
             eos = jnp.int32(self.tokenizer.eos_id)
             head = (shared["embedding"] if cfg.tie_embeddings
                     else shared["lm_head"])
 
             def per_stage(staged, kc, vc, first_token, start_valid, key,
-                          budget, slot_idx, embedding, head, final_norm):
+                          budget, temps, top_ks, top_ps, slot_idx,
+                          embedding, head, final_norm):
                 stage_layers = jax.tree_util.tree_map(
                     lambda x: x[0], staged)
                 kc_l = jax.lax.pcast(kc[0], (PIPE_AXIS,), to="varying")
@@ -283,8 +286,14 @@ class PPEngine:
                         logits = cfg.final_logit_softcap * jnp.tanh(
                             logits / cfg.final_logit_softcap)
                     key, sub = jax.random.split(key)
-                    nxt = sample_token(logits[:, 0], sub, self.sampling) \
-                        .astype(jnp.int32)
+                    row_logits = logits[:, 0]
+                    if greedy:
+                        nxt = jnp.argmax(row_logits, axis=-1) \
+                            .astype(jnp.int32)
+                    else:
+                        nxt = sample_token_batch(
+                            row_logits, sub, temps, top_ks,
+                            top_ps).astype(jnp.int32)
                     nxt = jnp.where(done, eos, nxt)
                     out = out.at[:, step].set(nxt)
                     new_done = done | (nxt == eos)
@@ -302,12 +311,14 @@ class PPEngine:
             out, step, last, valid, done, kc, vc = shard_map(
                 per_stage, mesh=mesh,
                 in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
-                          P(), P(), P(), P(), P(), P(), P(), P()),
+                          P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                          P(), P()),
                 out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
                            P(PIPE_AXIS), P(PIPE_AXIS)),
                 check_vma=False,
             )(staged, kc, vc, first_token, start_valid, key, budget,
-              slot_idx, shared["embedding"], head, shared["final_norm"])
+              temps, top_ks, top_ps, slot_idx, shared["embedding"], head,
+              shared["final_norm"])
             return out, step[0], last, valid, done, kc, vc
 
         self._pp_decode = pp_decode
@@ -390,16 +401,21 @@ class PPEngine:
                                    timeout_s=timeout_s)[0]
 
     def generate_batch(self, turns, max_new_tokens=None,
-                       timeout_s: float = 600.0) -> list[str]:
+                       timeout_s: float = 600.0,
+                       sampling_per_turn=None) -> list[str]:
         return self.generate_batch_with_stats(
-            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s)[0]
+            turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
+            sampling_per_turn=sampling_per_turn)[0]
 
     def generate_batch_with_stats(self, turns, max_new_tokens=None,
-                                  timeout_s: float = 600.0):
+                                  timeout_s: float = 600.0,
+                                  sampling_per_turn=None):
         with self._serve_lock:
-            return self._generate_locked(turns, max_new_tokens, timeout_s)
+            return self._generate_locked(turns, max_new_tokens, timeout_s,
+                                         sampling_per_turn)
 
-    def _generate_locked(self, turns, max_new_tokens, timeout_s):
+    def _generate_locked(self, turns, max_new_tokens, timeout_s,
+                         sampling_per_turn=None):
         stats = GenStats()
         deadline = time.monotonic() + timeout_s
         max_new = max_new_tokens or self.sampling.max_new_tokens
@@ -439,9 +455,20 @@ class PPEngine:
         float(last_logits[0, 0])
         stats.prefill_seconds = time.monotonic() - t0
 
-        first = sample_token(last_logits.astype(jnp.float32),
-                             self._next_key(), self.sampling) \
-            .astype(jnp.int32)
+        per_row = sampling_per_turn or [self.sampling] * len(turns)
+        if len(per_row) != len(turns):
+            raise ValueError(
+                f"sampling_per_turn has {len(per_row)} entries for "
+                f"{len(turns)} turns")
+        temps, top_ks, top_ps = sampling_arrays(per_row)
+        greedy = all(p.temperature <= 0.0 for p in per_row)
+        if greedy:
+            first = jnp.argmax(last_logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+        else:
+            first = sample_token_batch(last_logits.astype(jnp.float32),
+                                       self._next_key(), temps, top_ks,
+                                       top_ps).astype(jnp.int32)
         first_np = np.asarray(first)
         cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
 
@@ -451,8 +478,8 @@ class PPEngine:
             out, steps, last, valid, done, self.kc, self.vc = \
                 self._pp_decode(
                     self.shared, self.staged, self.kc, self.vc, slot_idx,
-                    cur_last, valid, self._next_key(), budget,
-                    max_new=DECODE_SEGMENT)
+                    cur_last, valid, self._next_key(), budget, temps,
+                    top_ks, top_ps, max_new=DECODE_SEGMENT, greedy=greedy)
             return out, steps, last, valid, done
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,
@@ -478,7 +505,7 @@ class PPEngine:
             "num_slots": self.kv.num_slots,
             "kv_layout": "stage-local contiguous",
             "scope": "PP serving: prefill + decode with stage-local KV; "
-                     "own-slot LCP reuse; no cross-knight donor sharing "
-                     "or paged layout yet",
+                     "own-slot LCP reuse; per-row sampling; no cross-"
+                     "knight donor sharing, paged layout or quant yet",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
