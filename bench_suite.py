@@ -187,7 +187,8 @@ BENCHES = {"fleet": bench_fleet, "summon": bench_summon,
 
 
 def child(which: str) -> int:
-    print(json.dumps(BENCHES[which]()))
+    for name in (list(BENCHES) if which == "all" else [which]):
+        print(json.dumps(BENCHES[name]()))
     return 0
 
 

@@ -21,18 +21,25 @@ def warmup_command(project_root: str | None = None) -> int:
     project_root = project_root or os.getcwd()
     config = load_config(project_root)
 
-    tpu_ids = sorted({k.adapter for k in config.knights
-                      if k.adapter.startswith("tpu-llm")})
+    # KNIGHT order, not sorted: the fleet planner assigns device groups
+    # by list order, and discuss plans through the factory in knight
+    # order — warming a different assignment would compile programs the
+    # first discuss never hits.
+    tpu_ids = list(dict.fromkeys(
+        k.adapter for k in config.knights
+        if k.adapter.startswith("tpu-llm")))
     if not tpu_ids:
         print(style.dim("\n  No tpu-llm knights in this config — "
                         "nothing to warm.\n"))
         return 0
 
+    from ..adapters.factory import _plan_tpu_fleet
     from ..engine import get_engine
-    from ..engine.fleet import plan_fleet
 
-    configs = [dict(config.adapter_config.get(a, {})) for a in tpu_ids]
-    plan_fleet(configs)
+    # The exact planning pass discuss runs (mutates config.adapter_config
+    # in place, so get_engine sees the same device assignments).
+    _plan_tpu_fleet(config, None)
+    configs = [config.adapter_config.get(a, {}) for a in tpu_ids]
 
     # Batch sizes the orchestrator will actually dispatch: 1 (serial
     # turns) and the number of knights sharing each adapter (batched

@@ -41,9 +41,12 @@ def append_to_chronicle(project_root: str | Path, chronicle_path: str, *,
         "---",
         "",
     ])
+    from .session import atomic_write_text
+
     with FileLock(full_path):
         if full_path.exists():
             content = full_path.read_text(encoding="utf-8")
         else:
             content = CHRONICLE_HEADER
-        full_path.write_text(content + entry, encoding="utf-8")
+        # atomic replace: a crash mid-write must not truncate the history
+        atomic_write_text(full_path, content + entry)

@@ -45,8 +45,10 @@ def _next_decree_id(log: DecreeLog) -> str:
 def add_decree_entry(project_root: str | Path, type_: str, session: str,
                      topic: str, reason: Optional[str] = None) -> DecreeEntry:
     """Append one decree (reference decree-log.ts:48-73). The read-
-    modify-write runs under a PID-stale-aware lock (utils/lock.py)."""
+    modify-write runs under a PID-stale-aware lock (utils/lock.py) and
+    the write is atomic (a crash mid-write must not truncate the log)."""
     from .lock import FileLock
+    from .session import atomic_write_text
 
     log_path = Path(project_root) / DECREE_LOG_RELPATH
     log_path.parent.mkdir(parents=True, exist_ok=True)
@@ -62,21 +64,27 @@ def add_decree_entry(project_root: str | Path, type_: str, session: str,
             date=now_iso(),
         )
         log.entries.append(entry)
-        log_path.write_text(json.dumps(log.to_dict(), indent=2) + "\n",
-                            encoding="utf-8")
+        atomic_write_text(log_path,
+                          json.dumps(log.to_dict(), indent=2) + "\n")
     return entry
 
 
 def revoke_decree(project_root: str | Path, decree_id: str) -> bool:
-    """Mark a decree revoked so it stops being injected into prompts."""
-    log = read_decree_log(project_root)
-    for e in log.entries:
-        if e.id == decree_id:
-            e.revoked = True
-            log_path = Path(project_root) / DECREE_LOG_RELPATH
-            log_path.write_text(json.dumps(log.to_dict(), indent=2) + "\n",
-                                encoding="utf-8")
-            return True
+    """Mark a decree revoked so it stops being injected into prompts.
+    Same lock as add_decree_entry — an advisory lock only serializes
+    writers that all take it."""
+    from .lock import FileLock
+    from .session import atomic_write_text
+
+    log_path = Path(project_root) / DECREE_LOG_RELPATH
+    with FileLock(log_path):
+        log = read_decree_log(project_root)
+        for e in log.entries:
+            if e.id == decree_id:
+                e.revoked = True
+                atomic_write_text(
+                    log_path, json.dumps(log.to_dict(), indent=2) + "\n")
+                return True
     return False
 
 

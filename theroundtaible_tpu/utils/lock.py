@@ -42,17 +42,40 @@ class FileLock:
         return True
 
     def _try_reclaim_stale(self) -> None:
+        """Reclaim a lock whose holder died — without the check-then-unlink
+        race: the file is CLAIMED first (atomic rename to a name only we
+        use), then its content re-verified. If the rename grabbed a fresh
+        lock that appeared between our read and the rename, it is restored
+        via os.link (which refuses if a newer lock already took the slot).
+        The remaining window needs three processes interleaving within the
+        same few microseconds twice in a row — vanishingly small next to
+        the 50ms poll cadence this lock operates at."""
         try:
             pid = int(self.lock_path.read_text().strip() or "0")
         except (OSError, ValueError):
             return  # holder is mid-write or lock vanished; just retry
-        if pid and not self._pid_alive(pid):
-            # Stale: the holder died without releasing. Remove and let the
-            # normal O_EXCL race decide who gets it next.
+        if not pid or self._pid_alive(pid):
+            return
+        claimed = Path(f"{self.lock_path}.reap.{os.getpid()}")
+        try:
+            os.rename(self.lock_path, claimed)
+        except OSError:
+            return  # someone else reclaimed (or released) first
+        try:
+            pid2 = int(claimed.read_text().strip() or "0")
+        except (OSError, ValueError):
+            pid2 = 0
+        if pid2 and self._pid_alive(pid2):
+            # We renamed a FRESH lock — put it back unless a newer lock
+            # already occupied the slot.
             try:
-                self.lock_path.unlink()
+                os.link(claimed, self.lock_path)
             except OSError:
                 pass
+        try:
+            claimed.unlink()
+        except OSError:
+            pass
 
     def acquire(self) -> None:
         self.lock_path.parent.mkdir(parents=True, exist_ok=True)
