@@ -186,6 +186,29 @@ class TestPagedEngineParity:
         assert d["kv_layout"] == "paged"
         assert d["kv_hbm_bytes"] > 0
 
+    def test_paged_flash_tp_matches_dense(self):
+        """Paged gather-view + Pallas-under-shard_map together: the
+        kernels must see the same position-aligned view on a TP mesh."""
+        def build(attn):
+            return InferenceEngine(
+                get_model_config("tiny-gemma", max_seq_len=256),
+                mesh_shape={"data": 1, "model": 2}, num_slots=4,
+                kv_layout="paged", page_size=32, attn=attn,
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=8))
+
+        flash_eng, dense_eng = build("flash"), build("dense")
+        assert flash_eng.cfg.attn_impl == "flash"
+        shared = ("a long enough shared preamble that the aliasing path "
+                  "fires for every knight in the batch today. ")
+        prompts = [(f"pf{i}", shared + f"knight {i}") for i in range(2)]
+        out_f, stats_f = flash_eng.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        out_d, stats_d = dense_eng.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        assert out_f == out_d
+        assert stats_f.reused_tokens == stats_d.reused_tokens > 0
+
     def test_paged_rejects_seq_parallel(self):
         with pytest.raises(ValueError, match="paged"):
             InferenceEngine(
