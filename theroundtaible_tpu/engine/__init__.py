@@ -1,8 +1,13 @@
 """The in-tree JAX/XLA inference engine (tpu-llm backend).
 
 `get_engine(config)` is the single construction seam used by
-adapters/tpu_llm.py. Engines are cached per (model, checkpoint, mesh) so
-several knights share one resident model (SURVEY.md §7.1).
+adapters/tpu_llm.py: it joins the multi-host process group (distributed),
+routes pipe meshes to the pipeline engine (pp_serving) and everything
+else to InferenceEngine (engine), and caches engines by every
+serving-relevant config key so knights with identical configs share one
+resident model while differing ones never silently collide (SURVEY.md
+§7.1; per-call settings like knight_sampling are deliberately NOT in the
+key).
 """
 
 from __future__ import annotations
