@@ -41,6 +41,9 @@ TOPIC = ("Should the session store move to an append-only event log "
 
 
 def child() -> int:
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
     import jax
 
     if os.environ.get("ROUNDTABLE_BENCH_CPU"):
@@ -141,7 +144,9 @@ def child() -> int:
             "platform": jax.devices()[0].platform,
         },
     }
-    print(json.dumps(result_line))
+    # flush=True: the watchdog salvages a timeout-killed child's stdout,
+    # which only works if the line left this process's buffer.
+    print(json.dumps(result_line), flush=True)
     return 0
 
 

@@ -187,8 +187,23 @@ BENCHES = {"fleet": bench_fleet, "summon": bench_summon,
 
 
 def child(which: str) -> int:
+    # NOT install_sigterm_exit: the fleet bench runs engine.generate on
+    # ThreadPoolExecutor workers, and a SystemExit in the main thread
+    # would block interpreter shutdown on joining workers stuck in JAX
+    # C++ until the watchdog's grace expires into SIGKILL. Flush what
+    # we have and exit promptly instead — process death closes the
+    # relay socket, which is the claim-release path that matters.
+    import signal
+
+    def _term(*_):
+        sys.stdout.flush()
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _term)
     for name in (list(BENCHES) if which == "all" else [which]):
-        print(json.dumps(BENCHES[name]()))
+        # flush=True: the watchdog salvages a timeout-killed child's
+        # stdout, which only works if the line left this buffer.
+        print(json.dumps(BENCHES[name]()), flush=True)
     return 0
 
 
