@@ -100,6 +100,77 @@ class TestPPPerRowSampling:
                 sampling_per_turn=[SamplingParams(temperature=0.0)])
 
 
+class TestPPInt8:
+    """int8 w8a16 under PP (VERDICT r2 #5): quantized {"q","s"} leaves
+    stack per stage and must serve token-for-token like the main engine
+    quantized the same way. f32 activations/scales for tie-stability
+    (same discipline as the parity tests above)."""
+
+    def test_int8_matches_main_engine_int8(self):
+        pp = build_pp(quant="int8")
+        ref = InferenceEngine(
+            get_model_config("tiny-llama", max_seq_len=256),
+            mesh_shape={"data": 1, "model": 1}, num_slots=4,
+            dtype=jnp.float32, quant="int8",
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        p = "the quantized knights deliberate over streamed bytes"
+        assert (pp.generate(p, slot_name="q", max_new_tokens=8)
+                == ref.generate(p, slot_name="q", max_new_tokens=8))
+
+    def test_int8_batch_with_slot_reuse(self):
+        pp = build_pp(quant="int8")
+        base = "first round establishes the premise."
+        ext = base + " second round refines it."
+        pp.generate(base, slot_name="k", max_new_tokens=8)
+        out_reused = pp.generate(ext, slot_name="k", max_new_tokens=8)
+        assert pp.last_stats.reused_tokens > 0
+        out_fresh = build_pp(quant="int8").generate(
+            ext, slot_name="f", max_new_tokens=8)
+        assert out_reused == out_fresh
+
+    def test_int8_actually_quantized(self):
+        pp = build_pp(quant="int8")
+        leaves = jax.tree_util.tree_leaves(pp.staged)
+        assert any(x.dtype == jnp.int8 for x in leaves)
+        assert pp.describe()["quant"] == "int8"
+
+    def test_from_config_accepts_int8(self):
+        eng = PPEngine.from_config({
+            "model": "tiny-llama", "max_seq_len": 256,
+            "mesh": {"pipe": 2}, "quant": "int8", "num_slots": 2,
+            "dtype": "float32",
+            "sampling": {"temperature": 0.0, "max_new_tokens": 4}})
+        out = eng.generate("hello there", slot_name="c", max_new_tokens=4)
+        assert isinstance(out, str)
+
+
+class TestPPConfigValidation:
+    """from_config must refuse (not silently drop) settings the PP
+    engine does not implement (advisor r2 finding)."""
+
+    def _cfg(self, **extra):
+        return {"model": "tiny-llama", "max_seq_len": 256,
+                "mesh": {"pipe": 2}, **extra}
+
+    def test_extra_mesh_axes_raise(self):
+        with pytest.raises(ValueError, match="mesh axes"):
+            PPEngine.from_config(
+                self._cfg(mesh={"pipe": 2, "model": 2}))
+
+    def test_paged_kv_raises(self):
+        with pytest.raises(ValueError, match="kv_layout"):
+            PPEngine.from_config(self._cfg(kv_layout="paged"))
+
+    def test_seq_parallel_raises(self):
+        with pytest.raises(ValueError, match="seq_parallel"):
+            PPEngine.from_config(self._cfg(seq_parallel=4))
+
+    def test_flash_attn_warns_and_serves_dense(self):
+        with pytest.warns(UserWarning, match="dense attention"):
+            eng = PPEngine.from_config(self._cfg(attn="flash"))
+        assert eng.cfg.attn_impl == "dense"
+
+
 class TestPPAdapterConfig:
     def test_reachable_from_adapter_config(self):
         """mesh {'pipe': N} in the tpu-llm adapter config builds a

@@ -164,6 +164,46 @@ class TestFileLock:
         with pytest.raises(LockTimeout):
             FileLock(target, timeout_s=0.3).acquire()
 
+    def test_live_hostpid_stamp_times_out(self, tmp_path):
+        """New-format hostname:pid stamp of a live local holder blocks."""
+        import os
+        import socket
+        from theroundtaible_tpu.utils.lock import FileLock, LockTimeout
+        target = tmp_path / "f"
+        (tmp_path / "f.lock").write_text(
+            f"{socket.gethostname()}:{os.getpid()}")
+        with pytest.raises(LockTimeout):
+            FileLock(target, timeout_s=0.3).acquire()
+
+    def test_cross_host_fresh_lock_not_reclaimed(self, tmp_path):
+        """A lock stamped by ANOTHER host must not be PID-reclaimed (the
+        holder may be alive there even if the PID is free here): fresh
+        cross-host locks ride the timeout path (advisor r2 finding)."""
+        from theroundtaible_tpu.utils.lock import FileLock, LockTimeout
+        target = tmp_path / "f"
+        # PID 1 is always alive locally as well, so this also guards
+        # against accidentally consulting the local process table; use a
+        # near-certainly-free PID to prove hostname alone protects it.
+        (tmp_path / "f.lock").write_text("some-other-host:3999999")
+        with pytest.raises(LockTimeout):
+            FileLock(target, timeout_s=0.3).acquire()
+
+    def test_cross_host_stale_lock_reclaimed_by_age(self, tmp_path):
+        """A cross-host lock older than CROSS_HOST_STALE_S is presumed
+        crashed and reclaimed — no permanent multi-host deadlock."""
+        import os
+        import time
+        from theroundtaible_tpu.utils.lock import (CROSS_HOST_STALE_S,
+                                                   FileLock)
+        target = tmp_path / "f"
+        lock = tmp_path / "f.lock"
+        lock.write_text("some-other-host:3999999")
+        old = time.time() - CROSS_HOST_STALE_S - 5
+        os.utime(lock, (old, old))
+        with FileLock(target, timeout_s=2.0):
+            pass
+        assert not lock.exists()
+
 
 class TestManifest:
     def entry(self, id_="feat-x", **kw):

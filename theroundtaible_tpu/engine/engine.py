@@ -178,6 +178,15 @@ class InferenceEngine:
             # pool is replicated over the data axis (pages are dynamically
             # owned, so they cannot shard the way contiguous slots do),
             # hence the per-device budget divides by the data-axis size.
+            # Worst case that FITS the default: ceil(num_slots/2/data)
+            # sequences simultaneously resident at full max_seq_len (plus
+            # one partially-filled sequence's worth of pages from the +1
+            # and integer division slack). A batch pinning MORE slots than
+            # that, all near max_seq_len, exhausts the pool mid-serve with
+            # an actionable RuntimeError ("raise num_pages / lower
+            # max_new_tokens") — set num_pages explicitly (up to
+            # num_slots*max_seq_len/page_size + 1 for contiguous-equal
+            # capacity) when every knight runs long.
             data_size = dict(self.mesh.shape).get("data", 1)
             if num_pages is None:
                 pages_per_seq = self.max_seq_len // page_size

@@ -19,10 +19,11 @@ cache — the memory-capacity property PP exists for.
   static-shape price of SPMD; PP decode is a memory-capacity play, its
   serial latency is inherent to the layer dependency).
 - Slots: SlotBook (kvcache.py) gives PP the same per-knight LCP delta
-  prefill as the main engine; per-row sampling params work as in the
-  main engine. Cross-knight donor sharing, paged layout and int8 quant
-  are main-engine features not yet wired here (documented in
-  describe()).
+  prefill as the main engine; per-row sampling params and int8 w8a16
+  quant work as in the main engine (quantized {"q","s"} leaves stack
+  and stage-shard like any other layer leaf). Cross-knight donor
+  sharing and the paged layout are main-engine features not yet wired
+  here (documented in describe()).
 
 The reference has no counterpart (its models fit one GPU via Ollama);
 SURVEY.md §2.3 "PP" row is the requirement this file closes.
@@ -43,10 +44,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .engine import GenStats
 from .kvcache import SlotBook
-from .serving_loop import (DECODE_SEGMENT, bucket_for, chunked_prefill,
-                           decode_segments, finalize_outputs)
-from .models.common import (ModelConfig, init_params, make_attention_mask,
-                            param_count, rms_norm, transformer_block)
+from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
+                           chunked_prefill, decode_segments,
+                           finalize_outputs)
+from .models.common import (ModelConfig, _einsum, embed_tokens, init_params,
+                            make_attention_mask, param_count, rms_norm,
+                            transformer_block)
 from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
 from .sampling import (SamplingParams, sample_token_batch, sampling_arrays)
 from .tokenizer import load_tokenizer
@@ -57,10 +60,13 @@ class PPEngine:
 
     def __init__(self, model_cfg: ModelConfig, *, checkpoint: str = "",
                  n_stages: int = 2, n_micro: int = 2, num_slots: int = 4,
-                 dtype=jnp.bfloat16,
+                 dtype=jnp.bfloat16, quant: str = "none",
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
                  devices: Optional[list[int]] = None):
         import dataclasses
+
+        if quant not in ("none", "int8"):
+            raise ValueError(f"unknown quant mode {quant!r}")
 
         from . import enable_compilation_cache
         from .distributed import maybe_init_distributed
@@ -87,6 +93,16 @@ class PPEngine:
         else:
             params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
         self.num_params = param_count(params)
+        self.quant = quant
+        if quant == "int8":
+            # PP is the engine for checkpoints too big for one chip —
+            # exactly where halving streamed weight bytes matters most.
+            # Quantize BEFORE stacking: the {"q","s"} dict leaves stack and
+            # shard like any other layer leaf, and the stage programs reach
+            # them only through _einsum/embed_tokens (which dequantize on
+            # the matmul OUTPUT, see engine/quant.py).
+            from .quant import quantize_params
+            params = quantize_params(params, model_cfg, act_dtype=dtype)
         self.shared, self.staged = stack_stage_params(
             params, model_cfg, n_stages, self.mesh)
 
@@ -146,7 +162,7 @@ class PPEngine:
             len_mb = lengths.reshape(n_mb, mb)
             slot_mb = slot_idx.reshape(n_mb, mb)
 
-            emb = shared["embedding"][tok_mb]
+            emb = embed_tokens(shared["embedding"], tok_mb)
             if cfg.scale_embeddings:
                 emb = emb * jnp.sqrt(
                     jnp.float32(cfg.embed_dim)).astype(emb.dtype)
@@ -211,8 +227,7 @@ class PPEngine:
                               cfg.rmsnorm_unit_offset)
             head = (shared["embedding"] if cfg.tie_embeddings
                     else shared["lm_head"])
-            logits = jnp.einsum("bte,ve->btv", hidden, head,
-                                preferred_element_type=jnp.float32)
+            logits = _einsum("bte,ve->btv", hidden, head)
             if cfg.final_logit_softcap is not None:
                 logits = cfg.final_logit_softcap * jnp.tanh(
                     logits / cfg.final_logit_softcap)
@@ -250,7 +265,7 @@ class PPEngine:
 
                 def tok_body(state):
                     step, last, valid, done, out, kc_l, vc_l, key = state
-                    h = embedding[last[:, None]]
+                    h = embed_tokens(embedding, last[:, None])
                     if cfg.scale_embeddings:
                         h = h * jnp.sqrt(
                             jnp.float32(cfg.embed_dim)).astype(h.dtype)
@@ -279,9 +294,7 @@ class PPEngine:
                         .astype(jnp.float32), PIPE_AXIS).astype(h.dtype)
                     h = rms_norm(h, final_norm, cfg.norm_eps,
                                  cfg.rmsnorm_unit_offset)
-                    logits = jnp.einsum(
-                        "bte,ve->btv", h, head,
-                        preferred_element_type=jnp.float32)
+                    logits = _einsum("bte,ve->btv", h, head)
                     if cfg.final_logit_softcap is not None:
                         logits = cfg.final_logit_softcap * jnp.tanh(
                             logits / cfg.final_logit_softcap)
@@ -342,19 +355,40 @@ class PPEngine:
             top_p=float(sampling_cfg.get("top_p", 1.0)),
             max_new_tokens=int(sampling_cfg.get("max_new_tokens", 1024)),
         )
-        if config.get("quant", "none") != "none":
-            raise ValueError(
-                "quant is not supported on the pipeline-parallel engine "
-                "yet (its stage programs index raw param arrays) — drop "
-                "'quant' or use a (data, model) mesh")
         mesh = config.get("mesh", {})
+        # Refuse configs this engine would otherwise silently serve
+        # differently than asked (the "silent config drop" class): extra
+        # mesh axes mean no TP/DP inside stages, and paged KV /
+        # seq-parallel are main-engine features.
+        extra_axes = sorted(set(mesh) - {"pipe"})
+        if extra_axes:
+            raise ValueError(
+                f"mesh axes {extra_axes} are not supported alongside "
+                "'pipe' — the PP engine runs no TP/DP inside stages yet; "
+                "use mesh={'pipe': N} alone or a (data, model) mesh")
+        if config.get("kv_layout", "contiguous") != "contiguous":
+            raise ValueError(
+                "kv_layout='paged' is not supported on the PP engine "
+                "(stage-local KV is contiguous) — drop kv_layout or use "
+                "a (data, model) mesh")
+        if config.get("seq_parallel"):
+            raise ValueError(
+                "seq_parallel is not supported on the PP engine — use a "
+                "(data, model) mesh for ring/Ulysses long-context")
+        if config.get("attn") not in (None, "", "dense"):
+            import warnings
+            warnings.warn(
+                f"PP engine serves dense attention; ignoring "
+                f"attn={config['attn']!r} (the flash kernels' shard_map "
+                "wrapper targets the (data, model) mesh)", stacklevel=2)
         return cls(
             model_cfg,
             checkpoint=config.get("checkpoint", "") or "",
             n_stages=int(mesh.get("pipe", 2)),
             n_micro=int(config.get("n_micro", 2)),
             num_slots=int(config.get("num_slots", 4)),
-            dtype=dtype, sampling=sampling,
+            dtype=dtype, quant=config.get("quant", "none"),
+            sampling=sampling,
             seed=int(config.get("seed", 0)),
             devices=config.get("devices"),
         )
@@ -375,20 +409,27 @@ class PPEngine:
 
     def warmup(self, max_prompt_tokens: int = 256,
                batch_sizes: tuple[int, ...] = (1,)) -> float:
+        """Compile every (batch, bucket) prefill program ≤ the prompt
+        limit plus the decode segment, twice each for the donated-buffer
+        layout fixpoint — same discipline as InferenceEngine.warmup, so
+        real prompts hitting smaller buckets (or multi-chunk prefills)
+        never compile mid-serve on a cold cache."""
         t0 = time.monotonic()
         limit = min(max_prompt_tokens,
                     self.max_seq_len - DECODE_SEGMENT - 1)
+        buckets = [x for x in PREFILL_BUCKETS if x <= bucket_for(limit)]
         for b in batch_sizes:
             if b > self.kv.num_slots:
                 continue
-            n = min(bucket_for(limit), limit)
-            turns = [(f"__warmup_{i}",
-                      [self.tokenizer.bos_id] + [5 + i] * (n - 1))
-                     for i in range(b)]
-            for _ in range(2):
-                for name, _p in turns:
-                    self.kv.release(name)
-                self.generate_batch(turns, max_new_tokens=1)
+            for bucket in buckets:
+                n = min(bucket, limit)
+                turns = [(f"__warmup_{i}",
+                          [self.tokenizer.bos_id] + [5 + i] * (n - 1))
+                         for i in range(b)]
+                for _ in range(2):
+                    for name, _p in turns:
+                        self.kv.release(name)
+                    self.generate_batch(turns, max_new_tokens=1)
         for i in range(max(batch_sizes)):
             self.kv.release(f"__warmup_{i}")
         return time.monotonic() - t0
@@ -504,8 +545,9 @@ class PPEngine:
             "n_micro": self.n_micro,
             "num_slots": self.kv.num_slots,
             "kv_layout": "stage-local contiguous",
+            "quant": self.quant,
             "scope": "PP serving: prefill + decode with stage-local KV; "
-                     "own-slot LCP reuse; per-row sampling; no cross-"
-                     "knight donor sharing, paged layout or quant yet",
+                     "own-slot LCP reuse; per-row sampling; int8 w8a16; "
+                     "no cross-knight donor sharing or paged layout yet",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }

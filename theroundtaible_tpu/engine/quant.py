@@ -25,8 +25,10 @@ is needed. Absmax over a sharded contracted axis costs one all-reduce at
 load time.
 
 Scope: the main InferenceEngine paths (dense + flash attention,
-contiguous + paged KV, MoE). The ring/Ulysses and pipeline engines index
-raw param arrays and gate quant off for v1.
+contiguous + paged KV, MoE) and the pipeline engine (quantized leaves
+stack per stage; pp_serving.py routes all weight access through
+_einsum/embed_tokens). The ring/Ulysses cores index raw param arrays
+and gate quant off for v1.
 """
 
 from __future__ import annotations

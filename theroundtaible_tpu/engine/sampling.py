@@ -84,6 +84,10 @@ def sample_token_batch(logits: jax.Array, key: jax.Array,
     cutoff_idx = jnp.clip(
         jnp.sum(cumulative < top_ps[:, None], axis=-1), 0, v - 1)
     cutoff = jnp.take_along_axis(sorted2, cutoff_idx[:, None], axis=-1)
+    # top_p == 1.0 means DISABLED (matching sample_token, which skips the
+    # cutoff entirely): the f32 cumsum can saturate at 1.0 before the last
+    # element, which would otherwise mask far-tail tokens.
+    cutoff = jnp.where((top_ps < 1.0)[:, None], cutoff, -jnp.inf)
     scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
     sampled = jax.random.categorical(key, scaled, axis=-1)

@@ -1,4 +1,4 @@
-"""PID-stamped advisory file locks for shared .roundtable files.
+"""Hostname:PID-stamped advisory file locks for shared .roundtable files.
 
 The reference has NO locking: concurrent `roundtable` invocations in one
 project interleave read-modify-write cycles on chronicle.md / decree-log
@@ -13,12 +13,39 @@ crashed run can never deadlock the next one.
 from __future__ import annotations
 
 import os
+import socket
 import time
 from pathlib import Path
 
 
 class LockTimeout(RuntimeError):
     pass
+
+
+# A lock from ANOTHER host cannot be PID-checked; it is presumed crashed
+# (and reclaimed) once its file is this old. Roundtable store writes hold
+# locks for milliseconds, so minutes of age means a dead holder — this
+# keeps the module's no-deadlock guarantee in the multi-host case at the
+# cost of a cross-host reclaim being slow instead of instant.
+CROSS_HOST_STALE_S = 300.0
+
+
+def _stamp() -> str:
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def _parse_stamp(text: str) -> tuple[str | None, int]:
+    """(hostname|None, pid) from a lock file's content. Legacy pid-only
+    stamps (pre-multi-host) parse as (None, pid)."""
+    text = text.strip()
+    if ":" in text:
+        host, _, pid_s = text.rpartition(":")
+    else:
+        host, pid_s = None, text
+    try:
+        return host or None, int(pid_s or "0")
+    except ValueError:
+        return host or None, 0
 
 
 class FileLock:
@@ -49,12 +76,27 @@ class FileLock:
         via os.link (which refuses if a newer lock already took the slot).
         The remaining window needs three processes interleaving within the
         same few microseconds twice in a row — vanishingly small next to
-        the 50ms poll cadence this lock operates at."""
+        the 50ms poll cadence this lock operates at.
+
+        Multi-host (shared filesystem): a PID is only meaningful on the
+        host that wrote it — a live holder on another host would look
+        dead to our local process table. Stamps carry hostname:pid; a
+        stamp from a DIFFERENT hostname is reclaimed only once the lock
+        file is CROSS_HOST_STALE_S old (age replaces the PID liveness
+        check), so a crashed remote holder cannot deadlock this host
+        forever and a live one is never raced."""
         try:
-            pid = int(self.lock_path.read_text().strip() or "0")
-        except (OSError, ValueError):
+            host, pid = _parse_stamp(self.lock_path.read_text())
+        except OSError:
             return  # holder is mid-write or lock vanished; just retry
-        if not pid or self._pid_alive(pid):
+        if host is not None and host != socket.gethostname():
+            try:
+                age = time.time() - self.lock_path.stat().st_mtime
+            except OSError:
+                return  # vanished between read and stat; just retry
+            if age < CROSS_HOST_STALE_S:
+                return  # possibly-live cross-host holder: wait it out
+        elif not pid or self._pid_alive(pid):
             return
         claimed = Path(f"{self.lock_path}.reap.{os.getpid()}")
         try:
@@ -62,10 +104,16 @@ class FileLock:
         except OSError:
             return  # someone else reclaimed (or released) first
         try:
-            pid2 = int(claimed.read_text().strip() or "0")
-        except (OSError, ValueError):
-            pid2 = 0
-        if pid2 and self._pid_alive(pid2):
+            host2, pid2 = _parse_stamp(claimed.read_text())
+        except OSError:
+            host2, pid2 = None, 0
+        fresh = (host2, pid2) != (host, pid) or (
+            # same stamp, but the holder may have released and re-acquired
+            # between our read and the rename — alive means fresh (only
+            # checkable locally; the cross-host case was age-gated above)
+            (host2 is None or host2 == socket.gethostname())
+            and pid2 and self._pid_alive(pid2))
+        if fresh:
             # We renamed a FRESH lock — put it back unless a newer lock
             # already occupied the slot.
             try:
@@ -85,7 +133,7 @@ class FileLock:
                 fd = os.open(self.lock_path,
                              os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 with os.fdopen(fd, "w") as f:
-                    f.write(str(os.getpid()))
+                    f.write(_stamp())
                 self._held = True
                 return
             except FileExistsError:
