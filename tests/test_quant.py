@@ -40,6 +40,22 @@ class TestQuantizeParams:
         assert experts["down_proj"]["s"].shape == (cfg.embed_dim,)
         assert qp["layers"][0]["router"]["s"].shape == (cfg.num_experts,)
 
+    def test_free_source_deletes_quantized_leaves_only(self):
+        """free_source=True frees each source weight as its int8
+        replacement lands (7B-class builds then peak near bf16-total,
+        not bf16+int8) — but never a pass-through leaf (norms)."""
+        cfg = get_model_config("tiny-gemma")
+        params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+        emb, norm = params["embedding"], params["layers"][0]["input_norm"]
+        qp = quantize_params(params, cfg, act_dtype=jnp.float32,
+                             free_source=True)
+        assert emb.is_deleted()
+        assert params["layers"][0]["q_proj"].is_deleted()
+        assert not norm.is_deleted()  # reused in the output tree
+        assert qp["layers"][0]["input_norm"] is norm
+        # the quantized tree is fully usable
+        jax.block_until_ready(jax.tree_util.tree_leaves(qp))
+
     def test_dequantized_weights_close(self):
         cfg = get_model_config("tiny-llama")
         params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)

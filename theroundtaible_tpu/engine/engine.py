@@ -111,12 +111,22 @@ class InferenceEngine:
         else:
             params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
         self.params = shard_params(params, model_cfg, self.mesh)
+        # Drop the pre-shard reference NOW: on multi-device meshes the
+        # unsharded tree is a distinct full copy on the default device,
+        # and holding it through quantization would keep peak memory at
+        # full-bf16 + int8 (on one device shard_params may alias, and
+        # free_source below then deletes those same buffers).
+        params = None
         if quant == "int8":
             # AFTER sharding: q/s are jnp ops on the sharded weights, so
             # XLA propagates the NamedShardings (engine/quant.py).
+            # free_source: nothing references the bf16 tree after this, so
+            # each source leaf is freed as its q lands — 7B-class int8
+            # builds peak near bf16-total instead of bf16+int8.
             from .quant import quantize_params
             self.params = quantize_params(self.params, model_cfg,
-                                          act_dtype=dtype)
+                                          act_dtype=dtype,
+                                          free_source=True)
         self.num_params = param_count(self.params)
 
         if kv_layout not in ("contiguous", "paged"):

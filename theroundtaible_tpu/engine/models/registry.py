@@ -38,6 +38,12 @@ LLAMA3_8B = register(ModelConfig(
     mlp_dim=14_336, max_seq_len=8192, rope_theta=500_000.0,
     norm_eps=1e-5, tie_embeddings=False))
 
+LLAMA32_1B = register(ModelConfig(
+    name="llama-3.2-1b-instruct", vocab_size=128_256, num_layers=16,
+    embed_dim=2048, num_heads=32, num_kv_heads=8, head_dim=64,
+    mlp_dim=8192, max_seq_len=8192, rope_theta=500_000.0,
+    norm_eps=1e-5, tie_embeddings=True))
+
 # --- Mistral (SiLU, GQA, sliding window) ---
 
 MISTRAL_7B = register(ModelConfig(

@@ -102,7 +102,8 @@ class PPEngine:
             # them only through _einsum/embed_tokens (which dequantize on
             # the matmul OUTPUT, see engine/quant.py).
             from .quant import quantize_params
-            params = quantize_params(params, model_cfg, act_dtype=dtype)
+            params = quantize_params(params, model_cfg, act_dtype=dtype,
+                                     free_source=True)
         self.shared, self.staged = stack_stage_params(
             params, model_cfg, n_stages, self.mesh)
 
@@ -375,7 +376,7 @@ class PPEngine:
             raise ValueError(
                 "seq_parallel is not supported on the PP engine — use a "
                 "(data, model) mesh for ring/Ulysses long-context")
-        if config.get("attn") not in (None, "", "dense"):
+        if config.get("attn") not in (None, "", "auto", "dense"):
             import warnings
             warnings.warn(
                 f"PP engine serves dense attention; ignoring "

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 
 from .models.common import ModelConfig, Params
@@ -64,8 +65,8 @@ def quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
 
 
-def _quantize_leaf(w, scale_axes: tuple[int, ...],
-                   act_dtype) -> dict[str, Any]:
+def _quantize_leaf(w, scale_axes: tuple[int, ...], act_dtype,
+                   free_source: bool) -> dict[str, Any]:
     scale_axes = tuple(a % w.ndim for a in scale_axes)
     reduce_axes = tuple(a for a in range(w.ndim) if a not in scale_axes)
     w32 = w.astype(jnp.float32)
@@ -73,34 +74,51 @@ def _quantize_leaf(w, scale_axes: tuple[int, ...],
     s = jnp.maximum(absmax, 1e-8) / 127.0
     s_full = jnp.expand_dims(s, reduce_axes)
     q = jnp.clip(jnp.round(w32 / s_full), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": s.astype(act_dtype)}
+    out = {"q": q, "s": s.astype(act_dtype)}
+    if free_source and isinstance(w, jax.Array):
+        # Free each source leaf the moment its int8 replacement exists:
+        # quantizing a 7B-class model then peaks at bf16-total + ONE
+        # leaf's q instead of bf16-total + int8-total — the difference
+        # between fitting and OOMing a 16 GB chip during engine build.
+        jax.block_until_ready(out)
+        w.delete()
+    return out
 
 
 def quantize_params(params: Params, cfg: ModelConfig,
-                    act_dtype=jnp.bfloat16) -> Params:
+                    act_dtype=jnp.bfloat16,
+                    free_source: bool = False) -> Params:
     """Quantize the big matmul weights; returns a new tree (norms and any
-    unrecognized leaves pass through untouched)."""
+    unrecognized leaves pass through untouched).
+
+    free_source=True deletes each source weight buffer as soon as its
+    quantized replacement is materialized — the caller must own `params`
+    (every serving engine does: the init/load tree is not referenced
+    after quantization). Pass-through leaves are never deleted."""
     out: Params = {}
     for key, value in params.items():
         if key in ("embedding", "lm_head"):
-            out[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype)
+            out[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype,
+                                      free_source)
         elif key == "layers":
-            out[key] = [_quantize_layer(layer, act_dtype)
+            out[key] = [_quantize_layer(layer, act_dtype, free_source)
                         for layer in value]
         else:
             out[key] = value
     return out
 
 
-def _quantize_layer(layer: dict[str, Any], act_dtype) -> dict[str, Any]:
+def _quantize_layer(layer: dict[str, Any], act_dtype,
+                    free_source: bool) -> dict[str, Any]:
     new: dict[str, Any] = {}
     for key, value in layer.items():
         if key == "experts":
             new[key] = {k: _quantize_leaf(v, _EXPERT_SCALE_AXES[k],
-                                          act_dtype)
+                                          act_dtype, free_source)
                         for k, v in value.items()}
         elif key in _SCALE_AXES and "norm" not in key:
-            new[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype)
+            new[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype,
+                                      free_source)
         else:
             new[key] = value
     return new
