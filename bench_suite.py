@@ -57,18 +57,18 @@ def bench_fleet() -> dict:
     from theroundtaible_tpu.engine import get_engine, reset_engines
     from theroundtaible_tpu.engine.fleet import plan_fleet
 
-    # Real-chip trio sized to FIT one v5e-1 (16 GB): three distinct
-    # families, all int8 ≈ 2.9 + 1.8 + 8.6 GiB estimated resident
-    # (fleet.estimate_engine_hbm_bytes) — plan_fleet's HBM check
-    # validates this at plan time instead of OOMing mid-build
-    # (VERDICT r2 weak #3). On one chip the submeshes share device 0
-    # (time-multiplexed residency); on a v5e-8 they get disjoint chips
-    # and the round truly runs concurrently.
-    # Largest first: engine builds peak above their resident size
-    # (quantization holds bf16 + one leaf), so the 7B builds while the
-    # chip is emptiest.
+    # Real-chip trio sized to FIT one v5e-1: three distinct models, all
+    # int8, ~8.2 GiB estimated resident (fleet.estimate_engine_hbm_bytes)
+    # vs the ~12 GiB plannable budget — plan_fleet's HBM check validates
+    # this at plan time instead of OOMing mid-serve (VERDICT r2 weak #3;
+    # a mistral-7b + gemma-2b + llama-1b trio at ~13 GiB estimated did
+    # OOM at concurrent prefill, which set _HBM_UTILIZATION). The full
+    # 3-family 7B-class trio is the v5e-8 configuration, where each
+    # model gets a disjoint submesh. On one chip the submeshes share
+    # device 0 (time-multiplexed residency); largest builds first while
+    # the chip is emptiest (quantization peaks above resident size).
     models = (["tiny-gemma", "tiny-llama", "tiny-mistral"] if on_cpu
-              else ["mistral-7b-instruct", "gemma-2b-it",
+              else ["llama-3.2-3b-instruct", "gemma-2b-it",
                     "llama-3.2-1b-instruct"])
     max_new = 32 if on_cpu else 160
     configs = [{"model": m, "max_seq_len": 512 if on_cpu else 2048,
@@ -88,9 +88,18 @@ def bench_fleet() -> dict:
         return engine.generate(prompt, slot_name=f"knight-{i}",
                                max_new_tokens=max_new)
 
-    # warm each engine once (compile), then the measured concurrent round
+    # Warm each engine TWICE (bench.py's discipline): the first pass
+    # compiles, but its donated KV buffers come back in XLA's preferred
+    # layout so the next dispatch would recompile; the second pass
+    # reaches the layout fixpoint. One warm pass here measured 26s for a
+    # 2s round — all recompiles.
     with ThreadPoolExecutor(max_workers=3) as pool:
-        list(pool.map(turn, enumerate(engines)))
+        for _ in range(2):
+            for i, e in enumerate(engines):
+                e.kv.release(f"knight-{i}")
+            list(pool.map(turn, enumerate(engines)))
+        for i, e in enumerate(engines):
+            e.kv.release(f"knight-{i}")
         t0 = time.monotonic()
         outs = list(pool.map(turn, enumerate(engines)))
         wall = time.monotonic() - t0

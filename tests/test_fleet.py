@@ -137,13 +137,29 @@ class TestHbmFits:
 
     def test_bench_suite_real_chip_trio_fits_one_v5e(self):
         """The exact trio bench_suite.py serves on hardware must pass the
-        check for a single 16 GiB chip (the round-2 trio OOM'd)."""
+        check at a v5e's PLANNABLE budget (the round-2 trio OOM'd, and
+        round 3's first mistral-7b trio OOM'd at concurrent prefill
+        despite fitting raw capacity — hence the utilization factor)."""
+        from theroundtaible_tpu.engine.fleet import _HBM_UTILIZATION
+        budget = int(16 * self.GIB * _HBM_UTILIZATION)
         cfgs = [{"model": m, "max_seq_len": 2048, "num_slots": 2,
                  "quant": "int8"}
-                for m in ("gemma-2b-it", "llama-3.2-1b-instruct",
-                          "mistral-7b-instruct")]
-        plan_fleet(cfgs, n_devices=1, budget_bytes=16 * self.GIB)
+                for m in ("llama-3.2-3b-instruct", "gemma-2b-it",
+                          "llama-3.2-1b-instruct")]
+        plan_fleet(cfgs, n_devices=1, budget_bytes=budget)
         assert all(c["devices"] == [0] for c in cfgs)
+
+    def test_rejected_trio_mistral7b_on_one_v5e(self):
+        """The trio that actually OOM'd on hardware must now be caught at
+        plan time (explicit quant → no degrade left → clear error)."""
+        from theroundtaible_tpu.engine.fleet import _HBM_UTILIZATION
+        budget = int(16 * self.GIB * _HBM_UTILIZATION)
+        cfgs = [{"model": m, "max_seq_len": 2048, "num_slots": 2,
+                 "quant": "int8"}
+                for m in ("mistral-7b-instruct", "gemma-2b-it",
+                          "llama-3.2-1b-instruct")]
+        with pytest.raises(ValueError, match="does not fit"):
+            plan_fleet(cfgs, n_devices=1, budget_bytes=budget)
 
     def test_no_budget_no_check(self):
         # CPU backends report no bytes_limit: planning proceeds unchecked.

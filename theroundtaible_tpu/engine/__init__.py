@@ -65,7 +65,7 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "seq_parallel", "long_scheme", "long_threshold",
                  "devices", "attn", "num_slots", "sampling", "seed",
                  "kv_layout", "page_size", "num_pages", "n_micro",
-                 "quant")}
+                 "quant", "dcn_axis")}
     return json.dumps(relevant, sort_keys=True)
 
 

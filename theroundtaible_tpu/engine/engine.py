@@ -74,7 +74,8 @@ class InferenceEngine:
                  long_scheme: str = "ring", attn: str = "auto",
                  devices: Optional[list[int]] = None,
                  kv_layout: str = "contiguous", page_size: int = 128,
-                 num_pages: Optional[int] = None, quant: str = "none"):
+                 num_pages: Optional[int] = None, quant: str = "none",
+                 dcn_axis: Optional[str] = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -90,7 +91,7 @@ class InferenceEngine:
         if devices:
             all_devices = jax.devices()
             device_list = [all_devices[i] for i in devices]
-        self.mesh = build_mesh(mesh_shape, device_list)
+        self.mesh = build_mesh(mesh_shape, device_list, dcn_axis=dcn_axis)
         model_cfg = self._resolve_attn(model_cfg, attn, self.mesh)
         self.cfg = model_cfg
         self.max_seq_len = model_cfg.max_seq_len
@@ -529,6 +530,7 @@ class InferenceEngine:
             num_pages=(int(config["num_pages"])
                        if config.get("num_pages") else None),
             quant=config.get("quant", "none"),
+            dcn_axis=config.get("dcn_axis"),
         )
 
     # --- serving ---

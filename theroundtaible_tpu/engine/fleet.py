@@ -68,17 +68,45 @@ def estimate_engine_hbm_bytes(engine_cfg: dict[str, Any],
     return w_bytes + kv_bytes + margin
 
 
+# HBM per chip by device_kind, for backends that don't report
+# memory_stats (the axon TPU plugin returns None). Public TPU specs.
+_DEVICE_KIND_HBM = {
+    "TPU v5 lite": 16 << 30,
+    "TPU v5e": 16 << 30,
+    "TPU v5": 95 << 30,         # v5p
+    "TPU v5p": 95 << 30,
+    "TPU v4": 32 << 30,
+    "TPU v6 lite": 32 << 30,    # Trillium
+    "TPU v3": 16 << 30,
+    "TPU v2": 8 << 30,
+}
+# Fraction of raw capacity treated as plannable: the runtime reserves a
+# slice and serving needs workspace for concurrently-dispatched prefill
+# programs. Calibrated against a real failure: a trio estimated at
+# 12.4 GiB resident OOM'd at concurrent prefill on a 16 GiB v5e, so
+# plannable is set below that observed ceiling.
+_HBM_UTILIZATION = 0.75
+
+
 def device_memory_bytes() -> Optional[int]:
-    """Per-device HBM capacity, where the backend reports it (TPU
-    memory_stats carries bytes_limit; CPU returns None → no check)."""
+    """Plannable per-device HBM bytes: memory_stats' bytes_limit where
+    the backend reports it, else a device_kind table — both scaled by
+    _HBM_UTILIZATION. None (no check) when neither source knows."""
     import jax
     try:
-        stats = jax.devices()[0].memory_stats()
+        dev = jax.devices()[0]
     except Exception:
         return None
-    if not stats:
-        return None
-    return stats.get("bytes_limit") or None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        # A plugin whose memory_stats RAISES (vs axon's None) still gets
+        # the device_kind fallback below.
+        stats = None
+    raw = (stats or {}).get("bytes_limit")
+    if not raw:
+        raw = _DEVICE_KIND_HBM.get(getattr(dev, "device_kind", ""))
+    return int(raw * _HBM_UTILIZATION) if raw else None
 
 
 def partition_devices(weights: list[int], n_devices: int) -> list[list[int]]:
@@ -146,15 +174,23 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
     items = list(identities.items())
 
     def per_device_totals():
+        from . import _cache_key
         totals: dict[int, float] = {}
         contrib = []  # (ident, cfgs, group, per_dev_bytes)
         for (ident, cfgs), group in zip(items, groups):
-            try:
-                per_dev = (estimate_engine_hbm_bytes(cfgs[0])
-                           / max(len(group), 1))
-            except ValueError:
-                per_dev = 0.0  # unknown model: same tolerance as the
-                # weights loop — plan proceeds, XLA is the backstop
+            # One identity can still build SEVERAL resident engines: the
+            # engine cache keys on more than (model, checkpoint) — e.g.
+            # two knights with different max_seq_len — so charge each
+            # distinct engine config, not the identity once.
+            distinct = {_cache_key(c): c for c in cfgs}
+            per_dev = 0.0
+            for c in distinct.values():
+                try:
+                    per_dev += (estimate_engine_hbm_bytes(c)
+                                / max(len(group), 1))
+                except ValueError:
+                    pass  # unknown model: same tolerance as the weights
+                    # loop — plan proceeds, XLA is the backstop
             contrib.append((ident, cfgs, group, per_dev))
             for dev in group:
                 totals[dev] = totals.get(dev, 0.0) + per_dev
@@ -169,11 +205,16 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
         flippable = [(ident, cfgs, per_dev)
                      for ident, cfgs, group, per_dev in contrib
                      if worst_dev in group
-                     and "quant" not in cfgs[0]
-                     and cfgs[0].get("dtype", "bfloat16") != "float32"
-                     # int8 + seq_parallel is rejected by the engine:
-                     # degrading would turn a maybe-fit into a hard error
-                     and not cfgs[0].get("seq_parallel")]
+                     # EVERY config in the group must be unpinned — the
+                     # flip rewrites them all, and an explicit
+                     # quant/float32 choice is the operator's to keep
+                     and all("quant" not in c
+                             and c.get("dtype", "bfloat16") != "float32"
+                             # int8 + seq_parallel is rejected by the
+                             # engine: degrading would turn a maybe-fit
+                             # into a hard error
+                             and not c.get("seq_parallel")
+                             for c in cfgs)]
         if not flippable:
             def gib(x): return f"{x / (1 << 30):.1f} GiB"
             lines = "; ".join(

@@ -44,6 +44,12 @@ LLAMA32_1B = register(ModelConfig(
     mlp_dim=8192, max_seq_len=8192, rope_theta=500_000.0,
     norm_eps=1e-5, tie_embeddings=True))
 
+LLAMA32_3B = register(ModelConfig(
+    name="llama-3.2-3b-instruct", vocab_size=128_256, num_layers=28,
+    embed_dim=3072, num_heads=24, num_kv_heads=8, head_dim=128,
+    mlp_dim=8192, max_seq_len=8192, rope_theta=500_000.0,
+    norm_eps=1e-5, tie_embeddings=True))
+
 # --- Mistral (SiLU, GQA, sliding window) ---
 
 MISTRAL_7B = register(ModelConfig(

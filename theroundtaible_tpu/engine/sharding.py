@@ -28,10 +28,21 @@ MODEL_AXIS = "model"
 
 
 def build_mesh(mesh_shape: Optional[dict[str, int]] = None,
-               devices: Optional[list] = None) -> Mesh:
+               devices: Optional[list] = None,
+               dcn_axis: Optional[str] = None) -> Mesh:
     """Build a (data, model) mesh. mesh_shape like {"data": 1, "model": 8};
     -1 means "all remaining devices". Default: all devices on the model
-    axis (TP-first serving — weights are the big thing to split)."""
+    axis (TP-first serving — weights are the big thing to split).
+
+    dcn_axis (multi-slice/multi-host): which mesh axis spans the DCN
+    granules — slices when the backend reports them, else processes. The
+    device array then comes from mesh_utils.create_hybrid_device_mesh,
+    so the OTHER axis stays inside a granule on ICI. Put "data" across
+    DCN (DP exchanges nothing per token) and keep "model" inside a slice
+    (TP all-reduces every layer) — the module-docstring guidance, now a
+    config surface. Ignored (with identical single-granule behavior)
+    when there is only one granule, so the same config dryruns
+    single-process."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     shape = dict(mesh_shape or {})
@@ -44,11 +55,52 @@ def build_mesh(mesh_shape: Optional[dict[str, int]] = None,
     if data * model > n:
         raise ValueError(
             f"mesh {data}x{model} needs {data * model} devices, have {n}")
+    if dcn_axis:
+        if dcn_axis not in (DATA_AXIS, MODEL_AXIS):
+            raise ValueError(
+                f"dcn_axis must be {DATA_AXIS!r} or {MODEL_AXIS!r}, "
+                f"got {dcn_axis!r}")
+        dev_array = _hybrid_device_array(devices[:data * model],
+                                         data, model, dcn_axis)
+        if dev_array is not None:
+            return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
     # A strict subset is allowed — heterogeneous serving partitions the pod
     # into per-model submeshes (SURVEY.md §2.3 "heterogeneous multi-model
     # scheduler"); callers pass disjoint device lists.
     dev_array = np.array(devices[:data * model]).reshape(data, model)
     return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def _hybrid_device_array(devices: list, data: int, model: int,
+                         dcn_axis: str):
+    """Device array for a DCN-aware mesh, or None when a single granule
+    makes the plain contiguous reshape equivalent.
+
+    Granule = slice where devices report distinct slice_index values
+    (real multi-slice TPU), else process (multi-host CPU/TPU pods where
+    every host is its own DCN island)."""
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) > 1:
+        n_granules, process_is_granule = len(slice_ids), False
+    else:
+        n_granules = len({d.process_index for d in devices})
+        process_is_granule = True
+    if n_granules <= 1:
+        return None
+    sizes = {DATA_AXIS: data, MODEL_AXIS: model}
+    if sizes[dcn_axis] % n_granules:
+        raise ValueError(
+            f"dcn_axis={dcn_axis!r} size {sizes[dcn_axis]} must divide "
+            f"into the {n_granules} DCN granules (slices/processes)")
+    per = dict(sizes)
+    per[dcn_axis] //= n_granules
+    dcn = {a: (n_granules if a == dcn_axis else 1)
+           for a in (DATA_AXIS, MODEL_AXIS)}
+    from jax.experimental import mesh_utils
+    return mesh_utils.create_hybrid_device_mesh(
+        (per[DATA_AXIS], per[MODEL_AXIS]),
+        (dcn[DATA_AXIS], dcn[MODEL_AXIS]),
+        devices=devices, process_is_granule=process_is_granule)
 
 
 def param_specs(cfg: ModelConfig) -> Params:
