@@ -43,7 +43,9 @@ import time
 
 A100_OLLAMA_GEMMA2B_DECODE_TPS = 120.0  # external anchor, see module docstring
 
-ATTEMPT_TIMEOUT_S = 320.0  # two engines (bf16+int8) ≈140s cold; margin
+ATTEMPT_TIMEOUT_S = 600.0  # three engines (bf16, int8, int8+paged) cold;
+                           # per-run lines flush as they land, so even a
+                           # timeout salvages the finished configs
 MAX_ATTEMPTS = 2
 RETRY_DELAY_S = 20.0
 
@@ -98,10 +100,11 @@ def child() -> int:
         Only the headline line carries the STABLE metric key (exactly
         one such line per successful run, so per-key summing / take-
         first / take-last parsers all agree); per-run lines get a
-        quant-suffixed key and exist so a child killed mid-int8 has
-        already landed a complete, unambiguous bf16 record."""
+        config-suffixed key and exist so a child killed mid-run has
+        already landed complete, unambiguous records for the finished
+        configs."""
         decode_tps = run["decode_tps"]
-        label = "bf16" if run["quant"] == "none" else run["quant"]
+        label = run["label"]
         base_key = f"decode_tokens_per_sec_per_chip[{cfg.name}]"
         detail = {
             "headline": headline,
@@ -110,9 +113,7 @@ def child() -> int:
             "platform": platform,
         }
         if headline:
-            detail["winning_quant"] = label  # winner of all runs
-        else:
-            detail["quant"] = label  # this run only; winner not yet known
+            detail["winning_config"] = label  # winner of all runs
         rec = {
             "metric": base_key if headline else f"{base_key}[{label}]",
             "value": decode_tps,
@@ -123,7 +124,7 @@ def child() -> int:
         }
         print(json.dumps(rec), flush=True)
 
-    def measure(quant: str) -> dict:
+    def measure(quant: str, kv_layout: str = "contiguous") -> dict:
         """Build + minimally warm one engine, return its measured run.
 
         Warmup serves the bench prompt itself on a throwaway slot: this
@@ -134,7 +135,7 @@ def child() -> int:
         so each is an honest full prefill."""
         t_build = time.monotonic()
         engine = InferenceEngine(
-            cfg, num_slots=4, quant=quant,
+            cfg, num_slots=4, quant=quant, kv_layout=kv_layout,
             sampling=SamplingParams(temperature=0.0,
                                     max_new_tokens=decode_tokens))
         build_s = time.monotonic() - t_build
@@ -154,8 +155,13 @@ def child() -> int:
                         max_new_tokens=decode_tokens)
         wall = time.monotonic() - t0
         s = engine.last_stats
+        label = "bf16" if quant == "none" else quant
+        if kv_layout == "paged":
+            label += "-paged"
         run = {
+            "label": label,
             "quant": quant,
+            "kv_layout": kv_layout,
             "decode_tps": round(s.decode_tps, 2),
             "prefill_tps": round(s.prefill_tps, 1),
             "prefill_tokens": s.prefill_tokens,
@@ -183,14 +189,18 @@ def child() -> int:
             }
         return run
 
-    # Measure bf16 and int8 (the reference's llama.cpp baseline serves
-    # quantized weights, so int8 is the apples-to-apples config; bf16 is
-    # reported alongside). Each run's record is printed the moment it
-    # lands; the headline (faster of the two) is printed LAST under the
-    # same STABLE metric key (round-over-round comparisons track the key).
+    # Measure bf16, int8 (the reference's llama.cpp baseline serves
+    # quantized weights, so int8 is the apples-to-apples config) and
+    # int8+paged (the pool-direct decode kernel vs the contiguous layout
+    # — the paged-vs-contiguous delta VERDICT r2 #7 asks for). Each
+    # run's record is printed the moment it lands; the headline (fastest)
+    # is printed LAST under the same STABLE metric key (round-over-round
+    # comparisons track the key).
     runs: list[dict] = []
-    for quant in ("none", "int8"):
-        run = measure(quant)
+    for quant, kv_layout in (("none", "contiguous"),
+                             ("int8", "contiguous"),
+                             ("int8", "paged")):
+        run = measure(quant, kv_layout)
         runs.append(run)
         emit(run, headline=False)
     emit(max(runs, key=lambda r: r["decode_tps"]), headline=True)

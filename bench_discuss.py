@@ -65,12 +65,39 @@ def child() -> int:
     max_new = 48 if on_cpu else 160
     rounds = 5
 
+    real_parse = {"count": 0, "ok": 0, "seconds": 0.0}
+
     class ScriptedConsensusAdapter(TpuLlmAdapter):
-        """Real engine serving; consensus scores scripted per round so the
-        discussion terminates at exactly `rounds` rounds."""
+        """Real engine serving; consensus SCORES scripted per round so the
+        discussion terminates at exactly `rounds` rounds — but the real
+        parse path is wall-clocked on every turn (VERDICT r2 weak #6):
+        the model's raw output gets a canonical consensus JSON appended
+        (the forced continuation a real checkpoint would emit) and runs
+        through parse_consensus_from_response → ConsensusBlock
+        validation, so extraction + repair + validation cost is INSIDE
+        the measured wall. Only the resulting score is then overridden."""
 
         def parse_consensus(self, response, round_num):
             score = 9.5 if round_num >= rounds else 6.0
+            forced = response + (
+                '\n```json\n{"consensus_score": %s, "agrees_with": '
+                '["Knight-A"], "pending_issues": [], "proposal": '
+                '"benchmark proposal", "files_to_modify": %s}\n```\n'
+                % (score, '["bench.md"]' if score >= 9 else "[]"))
+            t0 = time.monotonic()
+            parsed = super().parse_consensus(forced, round_num)
+            real_parse["seconds"] += time.monotonic() - t0
+            real_parse["count"] += 1
+            if parsed is not None:
+                real_parse["ok"] += 1
+                # The scripted score ALWAYS wins (termination guarantee):
+                # should the model's raw output ever contain its own
+                # parseable consensus block, that block parses first and
+                # its arbitrary score must not end the discussion early.
+                parsed.consensus_score = score
+                parsed.files_to_modify = (["bench.md"] if score >= 9
+                                          else [])
+                return parsed
             return ConsensusBlock(
                 knight=self.name, round=round_num, consensus_score=score,
                 agrees_with=[], pending_issues=[],
@@ -142,6 +169,15 @@ def child() -> int:
             "warmup_s": round(warmup_s, 1),
             "engine_wall_s": totals.get("wall_s"),
             "platform": jax.devices()[0].platform,
+            # Scores are scripted (random weights can't emit the JSON
+            # block) but the full parse→validate path ran inside the
+            # wall on every turn via a forced continuation:
+            "consensus": {
+                "scripted_scores": True,
+                "real_parse_turns": real_parse["count"],
+                "real_parse_ok": real_parse["ok"],
+                "real_parse_s": round(real_parse["seconds"], 4),
+            },
         },
     }
     # flush=True: the watchdog salvages a timeout-killed child's stdout,

@@ -134,10 +134,11 @@ class TestPagedEngineParity:
     """The paged engine must produce byte-identical greedy output to the
     contiguous engine — same model, same seed, every serving feature."""
 
-    def _engines(self, **kw):
+    def _engines(self, mesh=None, **kw):
         def build(layout):
             return InferenceEngine(
                 get_model_config("tiny-gemma", max_seq_len=256),
+                mesh_shape=mesh,
                 num_slots=4, kv_layout=layout, page_size=32,
                 sampling=SamplingParams(temperature=0.0, max_new_tokens=8),
                 **kw)
@@ -185,6 +186,41 @@ class TestPagedEngineParity:
         d = paged.describe()
         assert d["kv_layout"] == "paged"
         assert d["kv_hbm_bytes"] > 0
+
+    def test_single_device_uses_pool_direct_decode(self):
+        """On a 1-device mesh the decode segment must run the page-table-
+        aware kernel (no [B,S,K,D] gather view) and stay token-identical
+        to the contiguous engine — incl. multi-turn delta prefill and a
+        batch, so frontier-page writes and table-following reads are both
+        proven. (The suite's other parity tests run the default 8-device
+        mesh = the gather-view path.)"""
+        one_dev = {"data": 1, "model": 1}
+        paged, dense = self._engines(mesh=one_dev)
+        assert paged.paged_direct is True
+        assert paged.describe()["paged_decode"] == "pool-direct"
+        base = "the pool direct decode must follow the page table exactly."
+        ext = base + " a second turn extends across a page boundary here."
+        for eng in (paged, dense):
+            eng.generate(base, slot_name="k", max_new_tokens=8)
+        assert (paged.generate(ext, slot_name="k", max_new_tokens=8)
+                == dense.generate(ext, slot_name="k", max_new_tokens=8))
+        assert paged.last_stats.reused_tokens > 0
+        prompts = [(f"kn{i}", base + f" knight {i} speaks.")
+                   for i in range(3)]
+        assert (paged.generate_batch(prompts, max_new_tokens=8)
+                == dense.generate_batch(prompts, max_new_tokens=8))
+
+    def test_multi_device_falls_back_to_gather_view(self):
+        eng = InferenceEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            mesh_shape={"data": 1, "model": 2}, num_slots=4,
+            kv_layout="paged", page_size=32,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        assert eng.paged_direct is False
+        assert eng.describe()["paged_decode"] == "gather-view"
+        out = eng.generate("fallback still serves", slot_name="f",
+                           max_new_tokens=8)
+        assert isinstance(out, str)
 
     def test_paged_flash_tp_matches_dense(self):
         """Paged gather-view + Pallas-under-shard_map together: the

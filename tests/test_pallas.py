@@ -103,6 +103,72 @@ def test_decode_single_query_group():
                                atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("window,softcap", [
+    (None, None), (48, None), (None, 30.0), (700, None)])
+def test_paged_decode_matches_dense(window, softcap):
+    """paged_decode_attention off a SHUFFLED page pool must match the
+    dense reference on the position-aligned view — the kv index map must
+    follow the table, not the position."""
+    from theroundtaible_tpu.engine.pallas.attention import (
+        paged_decode_attention)
+    B, S, K, D, ps = 3, 1024, 2, 32, 64
+    n_pages = S // ps
+    rng = np.random.default_rng(3)
+    qd = jnp.asarray(rng.normal(size=(B, 1, 8, D)), jnp.float32)
+    kv_view = jnp.asarray(rng.normal(size=(B, S, K, D)), jnp.float32)
+    vv_view = jnp.asarray(rng.normal(size=(B, S, K, D)), jnp.float32)
+    # Scatter each row's view into a pool at shuffled page ids (page 0
+    # reserved scratch, like the real allocator).
+    pool_pages = 1 + B * n_pages
+    perm = rng.permutation(B * n_pages) + 1
+    table = jnp.asarray(perm.reshape(B, n_pages), jnp.int32)
+    k_pool = jnp.zeros((pool_pages, ps, K, D), jnp.float32)
+    v_pool = jnp.zeros((pool_pages, ps, K, D), jnp.float32)
+    k_pool = k_pool.at[table.reshape(-1)].set(
+        kv_view.reshape(B * n_pages, ps, K, D))
+    v_pool = v_pool.at[table.reshape(-1)].set(
+        vv_view.reshape(B * n_pages, ps, K, D))
+    valid = jnp.asarray([1, 512, 1024], jnp.int32)
+    out = paged_decode_attention(qd, k_pool, v_pool, table, valid,
+                                 sliding_window=window, softcap=softcap,
+                                 interpret=True)
+    ref = dense_ref(qd, kv_view, vv_view, valid - 1, valid, window,
+                    softcap)
+    assert out.shape == qd.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_paged_decode_never_reads_beyond_frontier():
+    """Pages past a row's frontier hold garbage (NaN) in the pool; the
+    clamped index map + mask must keep them out of the result."""
+    from theroundtaible_tpu.engine.pallas.attention import (
+        paged_decode_attention)
+    B, S, K, D, ps = 2, 512, 1, 32, 64
+    n_pages = S // ps
+    rng = np.random.default_rng(4)
+    qd = jnp.asarray(rng.normal(size=(B, 1, 4, D)), jnp.float32)
+    view = jnp.asarray(rng.normal(size=(B, S, K, D)), jnp.float32)
+    valid = jnp.asarray([70, 300], jnp.int32)
+    table = jnp.arange(1, 1 + B * n_pages, dtype=jnp.int32) \
+        .reshape(B, n_pages)
+    k_pool = jnp.full((1 + B * n_pages, ps, K, D), jnp.nan, jnp.float32)
+    v_pool = jnp.full((1 + B * n_pages, ps, K, D), jnp.nan, jnp.float32)
+    k_pool = k_pool.at[table.reshape(-1)].set(
+        view.reshape(B * n_pages, ps, K, D))
+    v_pool = v_pool.at[table.reshape(-1)].set(
+        view.reshape(B * n_pages, ps, K, D))
+    # poison every page at-or-past each row's frontier page boundary
+    for b in range(B):
+        first_bad = (int(valid[b]) - 1) // ps + 1
+        for j in range(first_bad, n_pages):
+            k_pool = k_pool.at[table[b, j]].set(jnp.nan)
+            v_pool = v_pool.at[table[b, j]].set(jnp.nan)
+    out = paged_decode_attention(qd, k_pool, v_pool, table, valid,
+                                 interpret=True)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_supported_shapes():
     assert supported(64, 512, 16)          # interpret mode: any D
     assert supported(1, 2048, 128)

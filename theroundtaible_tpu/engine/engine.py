@@ -307,23 +307,22 @@ class InferenceEngine:
 
         self._prefill_step = prefill_step
 
-        @partial(jax.jit, donate_argnums=(1,),
-                 static_argnames=("max_new", "greedy"))
-        def decode_loop(params, cache_layers, slot_idx, first_token,
-                        start_valid, key, budget, temps, top_ks, top_ps,
-                        max_new, greedy):
-            # max_new is the STATIC segment size (one compiled program per
-            # value — always DECODE_SEGMENT in serving); budget is the
-            # DYNAMIC number of tokens actually wanted from this segment,
-            # so short tails exit early without a fresh compile. Sampling
-            # params are per-ROW dynamic arrays (heterogeneous knight
-            # personas; no recompile per sampling config) — except the
-            # all-greedy common case, where the STATIC greedy flag keeps
-            # the hot path a single argmax instead of two full-vocab
-            # sorts + softmax + cumsum per token (one extra compiled
-            # variant total, not one per config).
+        def decode_while(step_fn, caches, first_token, start_valid, key,
+                         budget, temps, top_ks, top_ps, max_new, greedy):
+            """The decode while_loop, ONCE for all three cache layouts
+            (contiguous, paged gather-view, paged pool-direct) —
+            `step_fn(last, valid, caches) -> (logits [B,1,V], caches)` is
+            the only layout-specific piece. max_new is the STATIC segment
+            size (one compiled program per value — always DECODE_SEGMENT
+            in serving); budget is the DYNAMIC number of tokens actually
+            wanted from this segment, so short tails exit early without a
+            fresh compile. Sampling params are per-ROW dynamic arrays
+            (heterogeneous knight personas; no recompile per sampling
+            config) — except the all-greedy common case, where the STATIC
+            greedy flag keeps the hot path a single argmax instead of two
+            full-vocab sorts + softmax + cumsum per token (one extra
+            compiled variant total, not one per config)."""
             b = first_token.shape[0]
-            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
             out = jnp.zeros((b, max_new), jnp.int32)
             done = jnp.zeros((b,), bool)
             eos = jnp.int32(self.tokenizer.eos_id)
@@ -333,12 +332,8 @@ class InferenceEngine:
                 return (step < max_new) & (step < budget) & ~jnp.all(done)
 
             def body(state):
-                step, last, valid, done, out, caches_b, key = state
-                tokens = last[:, None]
-                positions = valid[:, None]
-                logits, caches_b = forward(
-                    params, cfg, tokens, positions, caches_b, valid,
-                    valid + 1)
+                step, last, valid, done, out, caches, key = state
+                logits, caches = step_fn(last, valid, caches)
                 key, sub = jax.random.split(key)
                 row_logits = logits[:, 0].astype(jnp.float32)
                 if greedy:
@@ -351,13 +346,31 @@ class InferenceEngine:
                 out = out.at[:, step].set(nxt)
                 new_done = done | (nxt == eos)
                 valid = jnp.where(done, valid, valid + 1)
-                return step + 1, nxt, valid, new_done, out, caches_b, key
+                return step + 1, nxt, valid, new_done, out, caches, key
 
             state = (jnp.int32(0), first_token, start_valid, done, out,
-                     caches_b, key)
+                     caches, key)
             with spmd_mesh(mesh):
-                step, last, valid, done, out, caches_b, _ = \
+                step, last, valid, done, out, caches, _ = \
                     jax.lax.while_loop(cond, body, state)
+            return out, step, last, valid, done, caches
+
+        def cached_step(params):
+            """step_fn for the position-aligned [B, S, K, D] layouts."""
+            def step(last, valid, caches_b):
+                return forward(params, cfg, last[:, None], valid[:, None],
+                               caches_b, valid, valid + 1)
+            return step
+
+        @partial(jax.jit, donate_argnums=(1,),
+                 static_argnames=("max_new", "greedy"))
+        def decode_loop(params, cache_layers, slot_idx, first_token,
+                        start_valid, key, budget, temps, top_ks, top_ps,
+                        max_new, greedy):
+            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
+            out, step, last, valid, done, caches_b = decode_while(
+                cached_step(params), caches_b, first_token, start_valid,
+                key, budget, temps, top_ks, top_ps, max_new, greedy)
             new_layers = [
                 (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
                 for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
@@ -365,15 +378,32 @@ class InferenceEngine:
 
         self._decode_loop = decode_loop
 
-        # --- paged variants: identical math on a table-gathered view ---
-        # pool[table] materializes the SAME position-aligned [B, S, K, D]
-        # view the contiguous path gathers per slot, so forward() and the
-        # Pallas kernels are layout-agnostic; the updated view scatters
-        # back through the same table. Aliased (shared-prefix) pages are
-        # never in any row's write range (ensure_capacity copy-on-writes
-        # them), so duplicate-index scatters only ever rewrite identical
-        # bytes.
+        # --- paged variants ---
+        # Prefill: pool[table] materializes the SAME position-aligned
+        # [B, S, K, D] view the contiguous path gathers per slot, so
+        # forward() and the Pallas kernels are layout-agnostic; the
+        # updated view scatters back through the same table. Aliased
+        # (shared-prefix) pages are never in any row's write range
+        # (ensure_capacity copy-on-writes them), so duplicate-index
+        # scatters only ever rewrite identical bytes.
+        # Decode: POOL-DIRECT where supported (single-device mesh +
+        # kernel-legal pool shape) — the page-table-aware kernel reads
+        # only pages below each row's frontier and the gather view (which
+        # would temporarily recreate the full contiguous HBM budget) is
+        # never built (engine/paged_forward.py). Multi-device paged
+        # decode keeps the gather view.
         if kv_layout == "paged":
+            from .pallas.attention import paged_decode_supported
+            # attn="dense" is an explicit opt-out of every Pallas kernel
+            # (the _resolve_attn contract) — the pool-direct decode IS a
+            # Pallas kernel, so it honors the same switch. "auto" still
+            # takes pool-direct even where auto resolves the view path to
+            # dense (CPU): there is no dense pool-direct equivalent, and
+            # the kernel runs in interpret mode there.
+            self.paged_direct = (
+                attn != "dense"
+                and self.mesh.devices.size == 1
+                and paged_decode_supported(page_size, model_cfg.head_dim))
             n_pages_seq = self.max_seq_len // page_size
 
             def gather_view(pools, tables, b):
@@ -421,45 +451,32 @@ class InferenceEngine:
                                   top_ps, max_new, greedy):
                 b = first_token.shape[0]
                 caches_b = gather_view(pools, tables, b)
-                out = jnp.zeros((b, max_new), jnp.int32)
-                done = jnp.zeros((b,), bool)
-                eos = jnp.int32(self.tokenizer.eos_id)
-
-                def cond(state):
-                    step, _, _, done, _, _, _ = state
-                    return ((step < max_new) & (step < budget)
-                            & ~jnp.all(done))
-
-                def body(state):
-                    step, last, valid, done, out, caches_b, key = state
-                    logits, caches_b = forward(
-                        params, cfg, last[:, None], valid[:, None],
-                        caches_b, valid, valid + 1)
-                    key, sub = jax.random.split(key)
-                    row_logits = logits[:, 0].astype(jnp.float32)
-                    if greedy:
-                        nxt = jnp.argmax(row_logits, axis=-1) \
-                            .astype(jnp.int32)
-                    else:
-                        nxt = sample_token_batch(
-                            row_logits, sub, temps, top_ks,
-                            top_ps).astype(jnp.int32)
-                    nxt = jnp.where(done, eos, nxt)
-                    out = out.at[:, step].set(nxt)
-                    new_done = done | (nxt == eos)
-                    valid = jnp.where(done, valid, valid + 1)
-                    return (step + 1, nxt, valid, new_done, out, caches_b,
-                            key)
-
-                state = (jnp.int32(0), first_token, start_valid, done, out,
-                         caches_b, key)
-                with spmd_mesh(mesh):
-                    step, last, valid, done, out, caches_b, _ = \
-                        jax.lax.while_loop(cond, body, state)
+                out, step, last, valid, done, caches_b = decode_while(
+                    cached_step(params), caches_b, first_token,
+                    start_valid, key, budget, temps, top_ks, top_ps,
+                    max_new, greedy)
                 new_pools = scatter_view(pools, tables, caches_b, b)
                 return out, step, last, valid, done, new_pools
 
-            self._decode_loop_paged = decode_loop_paged
+            @partial(jax.jit, donate_argnums=(1,),
+                     static_argnames=("max_new", "greedy"))
+            def decode_loop_paged_direct(params, pools, tables, first_token,
+                                         start_valid, key, budget, temps,
+                                         top_ks, top_ps, max_new, greedy):
+                from .paged_forward import forward_paged_decode
+
+                def step_fn(last, valid, pools):
+                    return forward_paged_decode(
+                        params, cfg, last[:, None], valid[:, None], pools,
+                        tables, valid + 1)
+
+                return decode_while(
+                    step_fn, pools, first_token, start_valid, key, budget,
+                    temps, top_ks, top_ps, max_new, greedy)
+
+            self._decode_loop_paged = (decode_loop_paged_direct
+                                       if self.paged_direct
+                                       else decode_loop_paged)
 
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
@@ -959,4 +976,6 @@ class InferenceEngine:
             info["page_size"] = self.kv.page_size
             info["num_pages"] = self.kv.num_pages
             info["kv_hbm_bytes"] = self.kv.hbm_bytes()
+            info["paged_decode"] = ("pool-direct" if self.paged_direct
+                                    else "gather-view")
         return info

@@ -14,6 +14,13 @@ src/adapters/local-llm.ts):
   cache. Rows with valid=600 in an S=8192 cache read 600 tokens of KV, not
   8192: the kv-block index map clamps to the row's frontier, and Pallas
   elides the DMA when consecutive grid steps map to the same block.
+- paged_decode_attention: the same ragged decode DIRECTLY against the page
+  POOL [P, page_size, K, D] (engine/paging.py): the kv-block index map
+  reads the scalar-prefetched page TABLE, so decode never materializes the
+  position-aligned [B, S, K, D] gather view — during decode the paged
+  layout keeps its whole resident-memory advantage (the gather view
+  temporarily recreated the full contiguous budget) and reads only the
+  pages below each row's frontier.
 
 Both kernels handle GQA natively (kv head = q head // group) so the
 [B, S, K, D] cache is never repeated to [B, S, H, D] in HBM, and support
@@ -361,6 +368,153 @@ def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
     def _finish():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_supported(page_size: int, d: int) -> bool:
+    """Can paged_decode_attention serve this pool shape? The page is the
+    kv block, so page_size must be a legal block; TPU wants lane-aligned
+    D (any shape goes in interpret mode)."""
+    if page_size not in (512, 256, 128, 64, 32, 16, 8):
+        return False
+    return _interpret() or d % 128 == 0
+
+
+def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size: int,
+                         num_page_blocks: int, group: int,
+                         sliding_window: Optional[int],
+                         softcap: Optional[float]):
+    # Identical online-softmax math to _decode_kernel; the only paged
+    # difference lives in the INDEX MAP (the kv block for grid step sb is
+    # pool page table[b, sb], not cache row sb). valid INCLUDES the
+    # current step's entry, which the caller has already written into the
+    # pool (q position = valid - 1).
+    b = pl.program_id(0)
+    sb = pl.program_id(2)
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    valid = valid_ref[b]
+    hi = (valid - 1) // page_size
+    if sliding_window is None:
+        lo = jnp.int32(0)
+    else:
+        lo = jnp.maximum(0, (valid - sliding_window) // page_size)
+
+    @pl.when((sb >= lo) & (sb <= hi))
+    def _compute():
+        q = q_ref[0, 0]                                    # [G, D]
+        k = k_ref[0, :, 0, :]                              # [ps, D]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [G, ps]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        kv_pos = sb * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (group, page_size), 1)
+        mask = kv_pos < valid
+        if sliding_window is not None:
+            mask &= kv_pos > (valid - 1) - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(sb == num_page_blocks - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,                 # [B, 1, H, D] this step's query
+    k_pool: jax.Array,            # [P, page_size, K, D] page pool
+    v_pool: jax.Array,            # [P, page_size, K, D]
+    table: jax.Array,             # [B, pages_per_seq] int32 page table
+    kv_valid: jax.Array,          # [B] valid entries INCLUDING this step
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-position decode attention straight off the page pool.
+
+    The caller must have written this step's K/V into each row's frontier
+    page already (a [B]-row scatter — engine/paged_forward.py). The kv
+    block index map reads the page table, so only pages holding each
+    row's valid prefix are ever DMA'd, and the [B, S, K, D] gather view
+    the engine's fallback path materializes is never built. The pool
+    keeps its prefill-friendly [P, ps, K, D] layout; the kernel's page
+    blocks are sublane-strided (1, ps, 1, D) slices — the DMA still
+    moves only page_size × D elements per (row, kv head, page).
+    Returns [B, 1, H, D].
+    """
+    b, t, h, d = q.shape
+    assert t == 1, "decode kernel serves exactly one position"
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    group = h // kh
+    pages_per_seq = table.shape[1]
+    if not paged_decode_supported(page_size, d):
+        raise ValueError(f"unsupported pool shape ps={page_size} D={d}")
+    interpret = _interpret() if interpret is None else interpret
+
+    qt = q[:, 0].reshape(b, kh, group, d)
+
+    def kv_index(bi, khi, sb, table_ref, valid_ref):
+        hi_blk = (valid_ref[bi] - 1) // page_size
+        if sliding_window is None:
+            lo_blk = jnp.int32(0)
+        else:
+            lo_blk = jnp.maximum(
+                0, (valid_ref[bi] - sliding_window) // page_size)
+        sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
+        return (table_ref[bi, sb], 0, khi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bi, khi, sb, t_, v_: (bi, khi, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, d),
+            lambda bi, khi, sb, t_, v_: (bi, khi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, page_size=page_size,
+        num_page_blocks=pages_per_seq, group=group,
+        sliding_window=sliding_window, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(table.astype(jnp.int32), kv_valid.astype(jnp.int32),
+      qt, k_pool, v_pool)
+    return out.reshape(b, 1, h, d)
 
 
 def ragged_decode_attention(
