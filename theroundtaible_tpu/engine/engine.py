@@ -100,10 +100,6 @@ class InferenceEngine:
 
         if quant not in ("none", "int8"):
             raise ValueError(f"quant must be none|int8, got {quant!r}")
-        if quant != "none" and seq_parallel and seq_parallel > 1:
-            raise ValueError(
-                "quant='int8' + seq_parallel is not supported yet — the "
-                "ring cores index raw param arrays")
         self.quant = quant
 
         if checkpoint:
@@ -392,6 +388,7 @@ class InferenceEngine:
         # would temporarily recreate the full contiguous HBM budget) is
         # never built (engine/paged_forward.py). Multi-device paged
         # decode keeps the gather view.
+        self.paged_direct = False
         if kv_layout == "paged":
             from .pallas.attention import paged_decode_supported
             # attn="dense" is an explicit opt-out of every Pallas kernel

@@ -210,10 +210,6 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
                      # quant/float32 choice is the operator's to keep
                      and all("quant" not in c
                              and c.get("dtype", "bfloat16") != "float32"
-                             # int8 + seq_parallel is rejected by the
-                             # engine: degrading would turn a maybe-fit
-                             # into a hard error
-                             and not c.get("seq_parallel")
                              for c in cfgs)]
         if not flippable:
             def gib(x): return f"{x / (1 << 30):.1f} GiB"

@@ -47,6 +47,7 @@ from .models.common import (
     Params,
     _einsum,
     _softcap,
+    embed_tokens,
     project_qkv,
     rms_norm,
     transformer_block,
@@ -205,8 +206,9 @@ def make_ring_prefill(cfg: ModelConfig, mesh: Mesh, scheme: str = "ring"):
 
     def shard_fn(params, tokens, positions, lengths):
         # follows the param dtype (bf16 serving, f32 parity tests) — same
-        # rule as models/common.py forward
-        x = params["embedding"][tokens]
+        # rule as models/common.py forward; embed_tokens/_einsum handle
+        # int8 {"q","s"} leaves, so quant composes with seq parallelism
+        x = embed_tokens(params["embedding"], tokens)
         if cfg.scale_embeddings:
             x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
         q_pos = positions
@@ -234,7 +236,7 @@ def make_ring_prefill(cfg: ModelConfig, mesh: Mesh, scheme: str = "ring"):
         last_h = jnp.einsum("bt,bte->be", hit, x.astype(jnp.float32))
         last_h = jax.lax.psum(last_h, SEQ_AXIS)
         head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
-        logits = jnp.einsum("be,ve->bv", last_h, head.astype(jnp.float32))
+        logits = _einsum("be,ve->bv", last_h, head)
         logits = _softcap(logits, cfg.final_logit_softcap)
         return logits, caches
 

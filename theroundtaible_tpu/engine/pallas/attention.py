@@ -313,6 +313,41 @@ def flash_attention_spmd(
               kv_valid.astype(jnp.int32))
 
 
+def _decode_accumulate(q, k, v, kv_start, valid, m_scr, l_scr, acc_scr,
+                       *, group: int, block_kv: int,
+                       sliding_window: Optional[int],
+                       softcap: Optional[float]):
+    """One online-softmax accumulation of a single-position query group
+    [G, D] against one kv block [bkv, D] whose first entry holds absolute
+    position kv_start. Shared by the contiguous (_decode_kernel) and
+    paged (_paged_decode_kernel) decode kernels — the two differ ONLY in
+    how the kv block is addressed, so the math lives here once."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [G, bkv]
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+    kv_pos = kv_start + jax.lax.broadcasted_iota(
+        jnp.int32, (group, block_kv), 1)
+    mask = kv_pos < valid
+    if sliding_window is not None:
+        mask &= kv_pos > (valid - 1) - sliding_window
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev, l_prev = m_scr[:], l_scr[:]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+    acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+
 def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, block_kv: int,
                    num_kv_blocks: int, group: int,
@@ -336,33 +371,10 @@ def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        q = q_ref[0, 0]                                    # [G, D]
-        k = k_ref[0, 0]                                    # [bkv, D]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [G, bkv]
-        if softcap is not None:
-            s = softcap * jnp.tanh(s / softcap)
-        kv_pos = sb * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (group, block_kv), 1)
-        mask = kv_pos < valid
-        if sliding_window is not None:
-            mask &= kv_pos > (valid - 1) - sliding_window
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev, l_prev = m_scr[:], l_scr[:]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
-        l_scr[:] = l_new
-        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+        _decode_accumulate(
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], sb * block_kv, valid,
+            m_scr, l_scr, acc_scr, group=group, block_kv=block_kv,
+            sliding_window=sliding_window, softcap=softcap)
 
     @pl.when(sb == num_kv_blocks - 1)
     def _finish():
@@ -407,33 +419,11 @@ def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        q = q_ref[0, 0]                                    # [G, D]
-        k = k_ref[0, :, 0, :]                              # [ps, D]
-        v = v_ref[0, :, 0, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [G, ps]
-        if softcap is not None:
-            s = softcap * jnp.tanh(s / softcap)
-        kv_pos = sb * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (group, page_size), 1)
-        mask = kv_pos < valid
-        if sliding_window is not None:
-            mask &= kv_pos > (valid - 1) - sliding_window
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev, l_prev = m_scr[:], l_scr[:]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
-        l_scr[:] = l_new
-        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+        _decode_accumulate(
+            q_ref[0, 0], k_ref[0, :, 0, :], v_ref[0, :, 0, :],
+            sb * page_size, valid, m_scr, l_scr, acc_scr, group=group,
+            block_kv=page_size, sliding_window=sliding_window,
+            softcap=softcap)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():

@@ -24,11 +24,11 @@ inherits the weight's, s keeps the kept axes') and no separate spec tree
 is needed. Absmax over a sharded contracted axis costs one all-reduce at
 load time.
 
-Scope: the main InferenceEngine paths (dense + flash attention,
-contiguous + paged KV, MoE) and the pipeline engine (quantized leaves
-stack per stage; pp_serving.py routes all weight access through
-_einsum/embed_tokens). The ring/Ulysses cores index raw param arrays
-and gate quant off for v1.
+Scope: every serving path — the main InferenceEngine (dense + flash
+attention, contiguous + paged KV, MoE), the pipeline engine (quantized
+leaves stack per stage), and the ring/Ulysses sequence-parallel prefill
+— all of which reach weights exclusively through the quant-aware
+_einsum/embed_tokens accessors.
 """
 
 from __future__ import annotations
