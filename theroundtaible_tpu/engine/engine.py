@@ -129,10 +129,6 @@ class InferenceEngine:
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(
                 f"kv_layout must be contiguous|paged, got {kv_layout!r}")
-        if kv_layout == "paged" and seq_parallel and seq_parallel > 1:
-            raise ValueError(
-                "kv_layout='paged' + seq_parallel is not supported yet — "
-                "the ring scatter writes whole contiguous sequences")
         self.kv_layout = kv_layout
 
         if kv_layout == "paged":
@@ -475,6 +471,30 @@ class InferenceEngine:
                                        if self.paged_direct
                                        else decode_loop_paged)
 
+            @partial(jax.jit, donate_argnums=(0,))
+            def scatter_kv_paged(pools, tables, new_layers):
+                # Ring-prefill writeback: whole-sequence K/V [B, Tp, K, D]
+                # (Tp a multiple of page_size — _prefill enforces it)
+                # scattered through each row's page table. Rows' pages are
+                # write-exclusive (ensure_capacity COW'd the offset-0
+                # write range); table entries past a row's allocation are
+                # the scratch page, which absorbs the pad-tail garbage and
+                # is never read — same contract as scatter_view.
+                out = []
+                for (k_pool, v_pool), (nk, nv) in zip(pools, new_layers):
+                    b, t = nk.shape[0], nk.shape[1]
+                    n = t // page_size
+                    tail = k_pool.shape[2:]
+                    nk5 = nk.reshape(b, n, page_size, *tail) \
+                        .astype(k_pool.dtype)
+                    nv5 = nv.reshape(b, n, page_size, *tail) \
+                        .astype(v_pool.dtype)
+                    out.append((k_pool.at[tables[:, :n]].set(nk5),
+                                v_pool.at[tables[:, :n]].set(nv5)))
+                return out
+
+            self._scatter_kv_paged = scatter_kv_paged
+
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
                       mesh) -> ModelConfig:
@@ -649,16 +669,25 @@ class InferenceEngine:
             n_seq = self.seq_mesh.shape[SEQ_AXIS]
             tpad = pad_to_ring(max(len(t) for t in token_lists), n_seq,
                                self.kv.max_seq_len)
-            if tpad:
-                return self._prefill_ring(slot_ids, token_lists, tpad)
+            # Paged writeback scatters whole pages, so the padded length
+            # must also land on a page boundary — when the bucket doesn't
+            # (tpad below page_size for near-threshold prompts, or the
+            # cache-cap clamp), chunked prefill is the correct fallback,
+            # not an error.
+            if tpad and (self.kv_layout != "paged"
+                         or tpad % self.kv.page_size == 0):
+                return self._prefill_ring(slot_ids, token_lists, tpad,
+                                          names)
         return self._prefill_chunked(slot_ids, token_lists, offsets,
                                      deadline, names)
 
     def _prefill_ring(self, slot_ids: list[int],
-                      token_lists: list[list[int]], tpad: int) -> jax.Array:
+                      token_lists: list[list[int]], tpad: int,
+                      names: Optional[list[str]] = None) -> jax.Array:
         """One sequence-parallel program prefills the whole batch; the
-        full-sequence K/V is scattered into the slot cache so decode and
-        later delta-prefills continue on the normal path."""
+        full-sequence K/V is scattered into the slot cache (or through
+        the page tables) so decode and later delta-prefills continue on
+        the normal path."""
         b = len(slot_ids)
         tokens = np.full((b, tpad), self.tokenizer.pad_id, np.int32)
         for i, t in enumerate(token_lists):
@@ -669,8 +698,14 @@ class InferenceEngine:
         logits, caches = self._ring_prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(lengths))
-        slot_idx = jnp.asarray(slot_ids, jnp.int32)
-        self.kv.layers = self._scatter_kv(self.kv.layers, slot_idx, caches)
+        if self.kv_layout == "paged":
+            tables = jnp.asarray(self.kv.table_for(names))
+            self.kv.pools = self._scatter_kv_paged(self.kv.pools, tables,
+                                                   caches)
+        else:
+            slot_idx = jnp.asarray(slot_ids, jnp.int32)
+            self.kv.layers = self._scatter_kv(self.kv.layers, slot_idx,
+                                              caches)
         return logits
 
     def _prefill_chunked(self, slot_ids: list[int],
