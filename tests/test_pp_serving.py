@@ -100,6 +100,41 @@ class TestPPPerRowSampling:
                 sampling_per_turn=[SamplingParams(temperature=0.0)])
 
 
+class TestPPPrefixSharing:
+    """Cross-knight shared-prefix reuse on the stage-local caches (the
+    main engine's donor + leader passes, PP edition)."""
+
+    # ByteTokenizer ≈ 1 token/char and build_pp's budget is ~191 tokens:
+    # the shared span must clear MIN_SHARED_PREFIX (64) while the whole
+    # prompt stays under budget (truncation would destroy the prefix).
+    SHARED = ("the common context paragraph that every knight receives "
+              "before their personal instructions begin. ")
+
+    def test_donor_copy_matches_fresh(self):
+        pp = build_pp()
+        a = self.SHARED + "You are knight Alpha."
+        b = self.SHARED + "You are knight Beta."
+        pp.generate(a, slot_name="alpha", max_new_tokens=8)
+        out_shared = pp.generate(b, slot_name="beta", max_new_tokens=8)
+        assert pp.last_stats.reused_tokens > 0  # donor span copied
+        out_fresh = build_pp().generate(b, slot_name="solo",
+                                        max_new_tokens=8)
+        assert out_shared == out_fresh
+
+    def test_leader_pass_batch_matches_reference(self):
+        pp, ref = build_pp(), build_ref()
+        prompts = [(f"kn{i}", self.SHARED + f"You are knight {i}.")
+                   for i in range(3)]
+        out_pp, stats_pp = pp.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        out_ref, stats_ref = ref.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        assert out_pp == out_ref
+        # both engines shared the batch-wide prefix, same token accounting
+        assert stats_pp.reused_tokens == stats_ref.reused_tokens > 0
+        assert stats_pp.prefill_tokens == stats_ref.prefill_tokens
+
+
 class TestPPInt8:
     """int8 w8a16 under PP (VERDICT r2 #5): quantized {"q","s"} leaves
     stack per stage and must serve token-for-token like the main engine
@@ -196,4 +231,4 @@ class TestPPAdapterConfig:
     def test_describe_scope_is_honest(self):
         d = build_pp().describe()
         assert d["kv_layout"] == "stage-local contiguous"
-        assert "no cross-knight donor sharing" in d["scope"]
+        assert "no paged layout yet" in d["scope"]

@@ -780,70 +780,39 @@ class InferenceEngine:
         Returns (updated offsets, leader-prefilled token count). Prefill
         FLOPs for the shared span are paid once instead of N times; HBM
         still holds per-slot copies (true page-level dedup is the paged-KV
-        allocator's job)."""
-        b = len(names)
+        allocator's job). The pass structure itself lives in
+        kvcache.share_prefixes (shared with the PP engine); this method
+        provides the device mechanics: paged caches ALIAS the donor's
+        whole pages (refcount, zero copy; partial boundary pages are
+        device-copied), contiguous caches queue K/V span copies, and the
+        leader span prefills via _prefill so a fresh long shared span
+        takes the ring path on sequence-parallel engines."""
+        from .kvcache import share_prefixes
         paged = self.kv_layout == "paged"
         pinned = tuple(names)
-        offsets = list(offsets)
-        extra_prefill = 0
+        copies: list[tuple[int, int, int, int]] = []
 
-        # (a) donors from earlier calls — apply before the leader pass so
-        # leader-sourced copies below never read a pending span. Paged
-        # caches ALIAS the donor's whole pages (refcount, zero copy) and
-        # device-copy only the partial boundary pages.
-        copies = []
-        for i in range(b):
-            cap = len(all_tokens[i]) - 1
-            donor, dlen = self.kv.best_donor(names[i], all_tokens[i])
-            dlen = min(dlen, cap)
-            if donor is not None and dlen - offsets[i] >= MIN_SHARED_PREFIX:
-                if paged:
-                    self.kv.alias_span(donor.name, names[i], offsets[i],
-                                       dlen, pinned)
-                else:
-                    copies.append((donor.slot_id, slot_ids[i], offsets[i],
-                                   dlen))
-                offsets[i] = dlen
-        self._apply_copies(copies)
-
-        if b < 2:
-            return offsets, extra_prefill
-
-        # (b) batch-wide common prefix, leader prefills it once.
-        shared = all_tokens[0]
-        for t in all_tokens[1:]:
-            n = self.kv.common_prefix_len(shared, t)
-            shared = shared[:n]
-        l_shared = min(len(shared),
-                       min(len(t) for t in all_tokens) - 1)
-        m = max(range(b), key=lambda i: offsets[i])
-        laggards = [i for i in range(b)
-                    if i != m and l_shared - offsets[i] >= MIN_SHARED_PREFIX]
-        if not laggards:
-            return offsets, extra_prefill
-        if offsets[m] < l_shared:
+        def add_share(donor, i, lo, hi):
             if paged:
-                self.kv.ensure_capacity(names[m], l_shared,
-                                        write_from=offsets[m],
-                                        pinned=pinned)
-            # _prefill (not _prefill_chunked): a fresh long shared span
-            # takes the ring path on sequence-parallel engines
-            self._prefill([slot_ids[m]],
-                          [all_tokens[m][offsets[m]:l_shared]],
-                          [offsets[m]], deadline, names=[names[m]])
-            extra_prefill += l_shared - offsets[m]
-            offsets[m] = l_shared
-        copies = []
-        for i in laggards:
-            if paged:
-                self.kv.alias_span(names[m], names[i], offsets[i],
-                                   l_shared, pinned)
+                self.kv.alias_span(donor.name, names[i], lo, hi, pinned)
             else:
-                copies.append((slot_ids[m], slot_ids[i], offsets[i],
-                               l_shared))
-            offsets[i] = l_shared
-        self._apply_copies(copies)
-        return offsets, extra_prefill
+                copies.append((donor.slot_id, slot_ids[i], lo, hi))
+
+        def flush_shares():
+            self._apply_copies(copies)
+            copies.clear()
+
+        def prefill_span(m, lo, hi):
+            if paged:
+                self.kv.ensure_capacity(names[m], hi, write_from=lo,
+                                        pinned=pinned)
+            self._prefill([slot_ids[m]], [all_tokens[m][lo:hi]], [lo],
+                          deadline, names=[names[m]])
+
+        return share_prefixes(
+            self.kv, names, all_tokens, offsets,
+            min_shared=MIN_SHARED_PREFIX, add_share=add_share,
+            flush_shares=flush_shares, prefill_span=prefill_span)
 
     def generate(self, prompt: str, slot_name: str = "default",
                  max_new_tokens: Optional[int] = None,

@@ -132,6 +132,66 @@ class SlotBook:
         return best, best_len
 
 
+def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
+                   add_share, flush_shares,
+                   prefill_span) -> tuple[list[int], int]:
+    """Two-pass cross-knight shared-prefix reuse — THE algorithm, used by
+    both serving engines so the donor cap, batch-common-prefix fold,
+    l_shared clamp, laggard threshold and extra_prefill accounting cannot
+    drift between them (SURVEY.md §7.3 hard part 2).
+
+    (a) donor pass — a slot committed by an earlier call that shares a
+        longer token prefix than a row's own history donates its span;
+    (b) leader pass — within one batch, the row with the most cache
+        coverage prefills the batch-wide common span ONCE and the
+        laggards copy it.
+
+    Callbacks own the device mechanics:
+      add_share(donor_state, row_i, lo, hi) — queue/apply one span share
+        (contiguous: K/V copy; paged: page aliasing);
+      flush_shares() — dispatch queued shares (called after each pass so
+        leader-sourced copies never read a pending span);
+      prefill_span(row_i, lo, hi) — prefill that row's token span
+        (ring-eligible on the main engine, chunked on PP).
+
+    Returns (updated offsets, leader-prefilled token count)."""
+    b = len(names)
+    pinned = tuple(names)
+    offsets = list(offsets)
+    extra_prefill = 0
+
+    for i in range(b):
+        cap = len(all_tokens[i]) - 1
+        donor, dlen = kv.best_donor(names[i], all_tokens[i])
+        dlen = min(dlen, cap)
+        if donor is not None and dlen - offsets[i] >= min_shared:
+            add_share(donor, i, offsets[i], dlen)
+            offsets[i] = dlen
+    flush_shares()
+
+    if b < 2:
+        return offsets, extra_prefill
+    shared = all_tokens[0]
+    for t in all_tokens[1:]:
+        shared = shared[:kv.common_prefix_len(shared, t)]
+    l_shared = min(len(shared), min(len(t) for t in all_tokens) - 1)
+    m = max(range(b), key=lambda i: offsets[i])
+    laggards = [i for i in range(b)
+                if i != m and l_shared - offsets[i] >= min_shared]
+    if not laggards:
+        return offsets, extra_prefill
+    if offsets[m] < l_shared:
+        prefill_span(m, offsets[m], l_shared)
+        extra_prefill += l_shared - offsets[m]
+        offsets[m] = l_shared
+    leader = kv.acquire(names[m], pinned)
+    for i in laggards:
+        add_share(leader, i, offsets[i], l_shared)
+        offsets[i] = l_shared
+    flush_shares()
+    return offsets, extra_prefill
+
+
 class KVCache(SlotBook):
     """num_slots × num_layers of contiguous device KV plus SlotBook's
     bookkeeping. Layout per layer: [num_slots, max_seq_len, K, D]."""

@@ -21,9 +21,11 @@ cache — the memory-capacity property PP exists for.
 - Slots: SlotBook (kvcache.py) gives PP the same per-knight LCP delta
   prefill as the main engine; per-row sampling params and int8 w8a16
   quant work as in the main engine (quantized {"q","s"} leaves stack
-  and stage-shard like any other layer leaf). Cross-knight donor
-  sharing and the paged layout are main-engine features not yet wired
-  here (documented in describe()).
+  and stage-shard like any other layer leaf). Cross-knight prefix
+  sharing (donor + leader passes) copies spans on the stage-sharded
+  caches — the slot axis is unsharded, so each stage copies its own
+  layers' span with no cross-stage traffic. The paged layout is the one
+  main-engine feature not wired here (documented in describe()).
 
 The reference has no counterpart (its models fit one GPU via Ollama);
 SURVEY.md §2.3 "PP" row is the requirement this file closes.
@@ -337,6 +339,25 @@ class PPEngine:
 
         self._pp_decode = pp_decode
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def pp_copy_spans(kc, vc, src_idx, dst_idx, lo, hi):
+            # Cross-knight prefix sharing, stage-sharded edition: copy K/V
+            # positions [lo_i, hi_i) from slot src_idx[i] into dst_idx[i]
+            # across EVERY stage's layer range. The slot axis (dim 2) is
+            # unsharded, so the gather/scatter stays stage-local — no
+            # cross-stage traffic (each stage copies its own layers' span).
+            s_len = kc.shape[3]
+            pos = jnp.arange(s_len).reshape(1, 1, 1, s_len, 1, 1)
+            lo_b = lo.reshape(1, 1, -1, 1, 1, 1)
+            hi_b = hi.reshape(1, 1, -1, 1, 1, 1)
+            span = (pos >= lo_b) & (pos < hi_b)
+            nk = jnp.where(span, kc[:, :, src_idx], kc[:, :, dst_idx])
+            nv = jnp.where(span, vc[:, :, src_idx], vc[:, :, dst_idx])
+            return kc.at[:, :, dst_idx].set(nk), \
+                vc.at[:, :, dst_idx].set(nv)
+
+        self._pp_copy_spans = pp_copy_spans
+
     # --- construction from adapter config ---
 
     @classmethod
@@ -431,7 +452,21 @@ class PPEngine:
                     for name, _p in turns:
                         self.kv.release(name)
                     self.generate_batch(turns, max_new_tokens=1)
-        for i in range(max(batch_sizes)):
+        # Warm the shared-prefix copy program (ONE shape thanks to
+        # _apply_copies' padding) and the layout fixpoint of the programs
+        # that consume the copied kc/vc — otherwise the first real round
+        # with a shared preamble compiles mid-serve (same discipline as
+        # InferenceEngine.warmup).
+        from .engine import MIN_SHARED_PREFIX
+        if self.kv.num_slots >= 2 and limit > MIN_SHARED_PREFIX + 8:
+            shared = [self.tokenizer.bos_id] + [7] * (MIN_SHARED_PREFIX + 4)
+            turns = [(f"__warmup_{i}", shared + [9 + i] * 4)
+                     for i in range(2)]
+            for _ in range(2):
+                for name, _p in turns:
+                    self.kv.release(name)
+                self.generate_batch(turns, max_new_tokens=1)
+        for i in range(max(max(batch_sizes), 2)):
             self.kv.release(f"__warmup_{i}")
         return time.monotonic() - t0
 
@@ -456,6 +491,69 @@ class PPEngine:
             return self._generate_locked(turns, max_new_tokens, timeout_s,
                                          sampling_per_turn)
 
+    def _chunked_rows(self, slot_ids, token_lists, offsets,
+                      deadline) -> jax.Array:
+        """Chunked bucketed prefill of the given rows through the PP step
+        program; returns last-token logits [B, V]."""
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+
+        def prefill_dispatch(chunk, offs, lengths):
+            last, self.kc, self.vc = self._pp_prefill(
+                self.shared, self.staged, self.kc, self.vc, slot_idx,
+                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                jnp.asarray(lengths))
+            return last
+
+        return chunked_prefill(prefill_dispatch, token_lists, offsets,
+                               self.max_seq_len, self.tokenizer.pad_id,
+                               deadline)
+
+    def _apply_copies(self, copies) -> None:
+        """Dispatch queued (src_slot, dst_slot, lo, hi) span copies —
+        padded to num_slots rows so pp_copy_spans compiles exactly ONE
+        shape (same recompile guard as InferenceEngine._apply_copies);
+        pad rows self-copy an empty span of a non-destination slot (dst
+        indices stay distinct: scatter order among duplicates is
+        unspecified)."""
+        if not copies:
+            return
+        width = self.kv.num_slots
+        if len(copies) < width:
+            used = {c[1] for c in copies}
+            pad_dst = next(i for i in range(width) if i not in used)
+            copies = copies + [(pad_dst, pad_dst, 0, 0)] * (width -
+                                                            len(copies))
+        src, dst, lo, hi = (jnp.asarray(x, jnp.int32)
+                            for x in zip(*copies))
+        self.kc, self.vc = self._pp_copy_spans(self.kc, self.vc, src, dst,
+                                               lo, hi)
+
+    def _share_prefixes(self, names, slot_ids, all_tokens, offsets,
+                        deadline):
+        """Cross-knight shared-prefix reuse on the stage-local caches —
+        kvcache.share_prefixes (the same two-pass algorithm the main
+        engine runs) with PP device mechanics: stage-sharded span copies
+        and chunked leader prefill."""
+        from .engine import MIN_SHARED_PREFIX
+        from .kvcache import share_prefixes
+        copies: list[tuple[int, int, int, int]] = []
+
+        def add_share(donor, i, lo, hi):
+            copies.append((donor.slot_id, slot_ids[i], lo, hi))
+
+        def flush_shares():
+            self._apply_copies(copies)
+            copies.clear()
+
+        def prefill_span(m, lo, hi):
+            self._chunked_rows([slot_ids[m]], [all_tokens[m][lo:hi]],
+                               [lo], deadline)
+
+        return share_prefixes(
+            self.kv, names, all_tokens, offsets,
+            min_shared=MIN_SHARED_PREFIX, add_share=add_share,
+            flush_shares=flush_shares, prefill_span=prefill_span)
+
     def _generate_locked(self, turns, max_new_tokens, timeout_s,
                          sampling_per_turn=None):
         stats = GenStats()
@@ -476,26 +574,24 @@ class PPEngine:
             slot_ids.append(slot_id)
             offsets.append(reuse)
             all_tokens.append(tokens)
-            stats.reused_tokens += reuse
-            stats.prefill_tokens += len(tokens) - reuse
+
+        offsets, extra_prefill = self._share_prefixes(
+            list(pinned), slot_ids, all_tokens, offsets, deadline)
+        # Copied donor spans count as reused (same accounting as the main
+        # engine); the leader's extra span was genuinely prefilled.
+        stats.reused_tokens = sum(offsets) - extra_prefill
+        stats.prefill_tokens = extra_prefill + sum(
+            len(t) - o for t, o in zip(all_tokens, offsets))
 
         # Chunked bucketed prefill (shared serving_loop host loop with the
         # PP step program).
         t0 = time.monotonic()
-        slot_idx = jnp.asarray(slot_ids, jnp.int32)
-
-        def prefill_dispatch(chunk, offs, lengths):
-            last, self.kc, self.vc = self._pp_prefill(
-                self.shared, self.staged, self.kc, self.vc, slot_idx,
-                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                jnp.asarray(lengths))
-            return last
-
-        last_logits = chunked_prefill(
-            prefill_dispatch, [t[o:] for t, o in zip(all_tokens, offsets)],
-            offsets, self.max_seq_len, self.tokenizer.pad_id, deadline)
+        last_logits = self._chunked_rows(
+            slot_ids, [t[o:] for t, o in zip(all_tokens, offsets)],
+            offsets, deadline)
         float(last_logits[0, 0])
         stats.prefill_seconds = time.monotonic() - t0
+        slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
         per_row = sampling_per_turn or [self.sampling] * len(turns)
         if len(per_row) != len(turns):
@@ -548,7 +644,8 @@ class PPEngine:
             "kv_layout": "stage-local contiguous",
             "quant": self.quant,
             "scope": "PP serving: prefill + decode with stage-local KV; "
-                     "own-slot LCP reuse; per-row sampling; int8 w8a16; "
-                     "no cross-knight donor sharing or paged layout yet",
+                     "own-slot LCP reuse; cross-knight donor + leader "
+                     "prefix sharing; per-row sampling; int8 w8a16; "
+                     "no paged layout yet",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
