@@ -69,8 +69,32 @@ class TestModelCore:
     def test_registry_contains_baseline_families(self):
         models = list_models()
         for required in ("gemma-2b-it", "gemma-7b-it", "llama-3-8b-instruct",
-                         "mistral-7b-instruct"):
+                         "llama-3.2-1b-instruct", "llama-3.2-3b-instruct",
+                         "mistral-7b-instruct", "mixtral-8x7b-instruct",
+                         "qwen2.5-1.5b-instruct"):
             assert required in models
+
+    def test_qwen_family_serves_end_to_end(self):
+        """Qwen2 (attention bias) through the full serving engine: cached
+        decode must equal a cache-free greedy recompute — the bias path
+        has to behave identically under prefill and per-token decode."""
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        eng = InferenceEngine(
+            get_model_config("tiny-qwen", max_seq_len=256), num_slots=2,
+            dtype=jnp.float32,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        out = eng.generate("the quick brown fox", slot_name="q",
+                           max_new_tokens=8)
+        assert isinstance(out, str) and len(out) > 0
+        follow = "the quick brown fox" + out
+        out2 = eng.generate(follow, slot_name="q", max_new_tokens=8)
+        assert eng.last_stats.reused_tokens > 0
+        fresh = InferenceEngine(
+            get_model_config("tiny-qwen", max_seq_len=256), num_slots=2,
+            dtype=jnp.float32,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        assert out2 == fresh.generate(follow, slot_name="f",
+                                      max_new_tokens=8)
 
     def test_registry_unknown_raises(self):
         with pytest.raises(ValueError, match="Unknown model"):

@@ -9,8 +9,8 @@ synthetic test and produce garbage on real weights.
 Strategy: build a tiny random HF model per family on CPU, save_pretrained
 (safetensors), load through load_hf_checkpoint, and assert (a) full-prompt
 logits match to ~1e-3 in f32 and (b) a 10-token greedy decode produces the
-identical token sequence. Covers Llama, Gemma, Mistral (sliding window) and
-Mixtral (MoE router + experts).
+identical token sequence. Covers Llama, Gemma, Mistral (sliding window),
+Qwen2 (attention bias) and Mixtral (MoE router + experts).
 """
 
 import dataclasses
@@ -116,6 +116,23 @@ def test_mistral_parity(tmp_path):
         name="parity-mistral", vocab_size=128, num_layers=2, embed_dim=64,
         num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
         max_seq_len=256, sliding_window=8, tie_embeddings=False)
+    check_family(tmp_path, hf, cfg)
+
+
+def test_qwen2_parity(tmp_path):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(4)
+    hf = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10_000.0,
+        tie_word_embeddings=False, use_sliding_window=False,
+        attn_implementation="eager"))
+    cfg = ModelConfig(
+        name="parity-qwen", vocab_size=128, num_layers=2, embed_dim=64,
+        num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+        max_seq_len=256, attn_bias=True, tie_embeddings=False)
     check_family(tmp_path, hf, cfg)
 
 

@@ -70,7 +70,8 @@ class TestQuantizeParams:
 
 
 @pytest.mark.parametrize("model", ["tiny-gemma", "tiny-llama",
-                                   "tiny-mistral", "tiny-mixtral"])
+                                   "tiny-mistral", "tiny-mixtral",
+                                   "tiny-qwen"])
 def test_forward_logits_close_to_fp(model):
     """int8 forward tracks the fp32 forward closely on every family —
     the quant error stays small relative to the logit scale."""

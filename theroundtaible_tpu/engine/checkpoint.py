@@ -98,7 +98,15 @@ def load_hf_checkpoint(ckpt_dir: str | Path, cfg: ModelConfig,
                 continue
             if parts[3] == "self_attn":
                 key = parts[4]
-                if key in placers:
+                if len(parts) > 5 and parts[5] == "bias":
+                    # Qwen2 q/k/v bias: HF [H*D] → ours [H, D]
+                    if key == "q_proj":
+                        layers[idx]["q_bias"] = as_jnp(tensor.reshape(h, d))
+                    elif key == "k_proj":
+                        layers[idx]["k_bias"] = as_jnp(tensor.reshape(k, d))
+                    elif key == "v_proj":
+                        layers[idx]["v_bias"] = as_jnp(tensor.reshape(k, d))
+                elif key in placers:
                     layers[idx][key] = placers[key](tensor)
             elif parts[3] == "mlp":
                 key = parts[4]
@@ -158,6 +166,8 @@ def _validate_loaded(params: Params, cfg: ModelConfig) -> None:
                 "pre_mlp_norm"}
     required |= ({"router", "experts"} if cfg.num_experts
                  else {"gate_proj", "up_proj", "down_proj"})
+    if cfg.attn_bias:
+        required |= {"q_bias", "k_bias", "v_bias"}
     for i, layer in enumerate(params["layers"]):
         lacking = required - set(layer)
         if lacking:

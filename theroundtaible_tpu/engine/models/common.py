@@ -91,6 +91,7 @@ class ModelConfig:
     final_logit_softcap: Optional[float] = None  # Gemma2: 30.0
     sliding_window: Optional[int] = None         # Mistral: 4096
     query_pre_attn_scalar: Optional[float] = None  # Gemma: head_dim**-0.5 default
+    attn_bias: bool = False           # Qwen2: bias on q/k/v projections
     tie_embeddings: bool = True       # output head = embedding table
     # MoE (Mixtral): None = dense MLP; X experts, top-k routed
     num_experts: Optional[int] = None
@@ -174,6 +175,11 @@ def project_qkv(
     q = _einsum("bte,ehd->bthd", x, layer["q_proj"])     # [B,T,H,D]
     k = _einsum("bte,ekd->btkd", x, layer["k_proj"])     # [B,T,K,D]
     v = _einsum("bte,ekd->btkd", x, layer["v_proj"])
+
+    if cfg.attn_bias:  # Qwen2: linear bias applied BEFORE rotary (HF order)
+        q = q + layer["q_bias"].astype(jnp.float32)
+        k = k + layer["k_bias"].astype(jnp.float32)
+        v = v + layer["v_bias"].astype(jnp.float32)
 
     q = rope(q.astype(x.dtype), positions, cfg.rope_theta)
     k = rope(k.astype(x.dtype), positions, cfg.rope_theta)
@@ -431,6 +437,14 @@ def init_params(cfg: ModelConfig, key: jax.Array,
                 "up_proj": dense(ks[5], (e, f), e),
                 "down_proj": dense(ks[6], (f, e), f),
             })
+        if cfg.attn_bias:
+            bks = jax.random.split(jax.random.fold_in(lk, 9), 3)
+            layer["q_bias"] = (jax.random.normal(bks[0], (h, d), jnp.float32)
+                               * 0.02).astype(dtype)
+            layer["k_bias"] = (jax.random.normal(bks[1], (k_, d), jnp.float32)
+                               * 0.02).astype(dtype)
+            layer["v_bias"] = (jax.random.normal(bks[2], (k_, d), jnp.float32)
+                               * 0.02).astype(dtype)
         if cfg.post_attn_norm:
             layer["post_attn_norm"] = layer["input_norm"]
         if cfg.post_mlp_norm:

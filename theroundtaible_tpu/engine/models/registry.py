@@ -1,7 +1,8 @@
-"""Model registry — the three reference-targeted open-weight families
-(BASELINE.md configs: Gemma-2B/7B, Llama-3-8B, Mistral-7B) plus tiny test
-presets. Architecture behavior lives in ModelConfig flags (common.py); a
-family here is a named hyperparameter set.
+"""Model registry — the reference-targeted open-weight families
+(BASELINE.md configs: Gemma-2B/7B, Llama-3-8B/3.2, Mistral-7B) plus
+Mixtral (MoE), Qwen2.5 (attention bias) and tiny test presets.
+Architecture behavior lives in ModelConfig flags (common.py); a family
+here is a named hyperparameter set.
 """
 
 from __future__ import annotations
@@ -58,6 +59,14 @@ MISTRAL_7B = register(ModelConfig(
     mlp_dim=14_336, max_seq_len=8192, rope_theta=1_000_000.0,
     norm_eps=1e-5, sliding_window=4096, tie_embeddings=False))
 
+# --- Qwen2.5 (SiLU, GQA, attention bias, tied head at small sizes) ---
+
+QWEN25_1_5B = register(ModelConfig(
+    name="qwen2.5-1.5b-instruct", vocab_size=151_936, num_layers=28,
+    embed_dim=1536, num_heads=12, num_kv_heads=2, head_dim=128,
+    mlp_dim=8960, max_seq_len=8192, rope_theta=1_000_000.0,
+    norm_eps=1e-6, attn_bias=True, tie_embeddings=True))
+
 # --- Mixtral (SiLU, GQA, sparse MoE, sliding window in v0.1 only) ---
 
 MIXTRAL_8X7B = register(ModelConfig(
@@ -84,6 +93,11 @@ TINY_MISTRAL = register(ModelConfig(
     name="tiny-mistral", vocab_size=512, num_layers=2, embed_dim=64,
     num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
     max_seq_len=512, sliding_window=64, tie_embeddings=False))
+
+TINY_QWEN = register(ModelConfig(
+    name="tiny-qwen", vocab_size=512, num_layers=2, embed_dim=64,
+    num_heads=4, num_kv_heads=2, head_dim=16, mlp_dim=128,
+    max_seq_len=512, attn_bias=True, tie_embeddings=True))
 
 TINY_MIXTRAL = register(ModelConfig(
     name="tiny-mixtral", vocab_size=512, num_layers=2, embed_dim=64,

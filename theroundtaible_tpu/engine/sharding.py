@@ -117,6 +117,10 @@ def param_specs(cfg: ModelConfig) -> Params:
         "input_norm": P(None),
         "pre_mlp_norm": P(None),
     }
+    if cfg.attn_bias:
+        layer["q_bias"] = P(MODEL_AXIS, None)   # [H, D] heads sharded
+        layer["k_bias"] = P(MODEL_AXIS, None)   # [K, D]
+        layer["v_bias"] = P(MODEL_AXIS, None)
     if cfg.num_experts:
         # EP: experts ride the model axis — each device computes its local
         # experts for all tokens; the combine contraction over the sharded
