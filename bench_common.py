@@ -18,13 +18,15 @@ discarded. This version fixes all three compounding flaws:
    exits, releasing it within milliseconds. The heavy attempt only
    starts after a probe succeeds, so the watchdog never kills a
    claim-holding child on a tunnel a probe would have proven dead.
-2. SALVAGE PARTIAL OUTPUT. Heavy children print one JSON object per
-   line, flushed, as each sub-measurement lands; on timeout the parent
-   reads the killed child's partial stdout and keeps every complete
-   JSON line. A child that measured bf16 and died in int8 still lands
-   a number. Only ONE attempt's lines ever reach stdout (the first
-   fully successful attempt, else the best salvage) so retries cannot
-   emit duplicate records.
+2. STREAM PARTIAL OUTPUT LIVE. Heavy children print one JSON object per
+   line, flushed, as each sub-measurement lands; the parent FORWARDS
+   each line the moment it arrives (round-3 lesson: holding lines until
+   the child finished meant an EXTERNAL kill of the parent — the
+   driver's own capture window — lost measurements that had already
+   completed). A child that measured bf16 and died in int8 still lands
+   a number, even if the parent dies next. Duplicate protection is
+   per metric key: a retry's records are forwarded only for keys no
+   earlier attempt already emitted.
 3. GENTLE TERMINATION. Timed-out heavy children get SIGTERM and a
    grace period before SIGKILL; children call
    ``install_sigterm_exit()`` so SIGTERM raises SystemExit and the
@@ -71,24 +73,6 @@ def install_sigterm_exit() -> None:
     claim release) run during the watchdog's grace period. Call first
     thing in every bench child()."""
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(1))
-
-
-def _json_lines(text: str | bytes | None) -> list[str]:
-    """Every complete JSON-object line found in `text`, in order."""
-    if not text:
-        return []
-    if isinstance(text, bytes):
-        text = text.decode("utf-8", errors="replace")
-    lines = []
-    for line in text.splitlines():
-        line = line.strip()
-        if line.startswith("{") and line.endswith("}"):
-            try:
-                json.loads(line)
-            except ValueError:
-                continue
-            lines.append(line)
-    return lines
 
 
 def _run_child(cmd: list[str], timeout_s: float, *,
@@ -158,56 +142,110 @@ def _tunnel_vouched() -> bool:
             and time.monotonic() - _tunnel_ok_at < PROBE_MEMO_S)
 
 
+def _stream_child(cmd: list[str], timeout_s: float,
+                  emitted_keys: set[str]):
+    """Run `cmd`, FORWARDING each JSON line to stdout the moment it
+    arrives (deduplicated by metric key across attempts). Returns
+    (rc|None, n_forwarded, stderr, timed_out). Timed-out children get
+    SIGTERM + grace, then SIGKILL."""
+    import threading
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    forwarded = 0
+    err_chunks: list[str] = []
+
+    def reader():
+        nonlocal forwarded
+        for line in proc.stdout:
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                key = json.loads(line).get("metric")
+            except ValueError:
+                continue
+            # Lines without a metric field (metadata/context records)
+            # are forwarded unconditionally; dedup applies per KEY.
+            if key is not None:
+                if key in emitted_keys:
+                    continue
+                emitted_keys.add(key)
+            forwarded += 1
+            print(line, flush=True)
+
+    def drain_err():
+        # A chatty child (JAX/PJRT warnings) fills the ~64KB pipe buffer
+        # and blocks forever if nobody reads — which the parent would
+        # then kill as a false timeout. Drain continuously.
+        for line in proc.stderr:
+            err_chunks.append(line)
+
+    t = threading.Thread(target=reader, daemon=True)
+    te = threading.Thread(target=drain_err, daemon=True)
+    t.start()
+    te.start()
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.terminate()
+        try:
+            proc.wait(timeout=TERM_GRACE_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    t.join(timeout=5.0)
+    te.join(timeout=5.0)
+    return (None if timed_out else proc.returncode, forwarded,
+            "".join(err_chunks), timed_out)
+
+
 def run_watchdogged(script_path: str, child_args: list[str],
                     timeout_s: float, attempts: int = 2,
                     retry_delay_s: float = 20.0) -> int:
     """Run `script_path --child <args>` probe-first under a watchdog.
 
     The child prints one flushed JSON object per line as each
-    sub-measurement completes (headline line LAST); the parent forwards
-    to stdout exactly the lines of ONE attempt — the first fully
-    successful one, or (when every attempt failed) the failed attempt
-    that salvaged the most lines — so retries can never emit duplicate
-    records under the same metric key. Returns 0 if at least one JSON
-    line was emitted, 1 otherwise."""
+    sub-measurement completes (headline line LAST); the parent STREAMS
+    each line through the moment it lands, so a measurement survives
+    the child dying afterwards AND the parent itself being killed by an
+    external capture window. Retries forward only metric keys no
+    earlier attempt emitted — per-key summing / take-first / take-last
+    parsers all agree. Returns 0 if at least one JSON line was emitted,
+    1 otherwise."""
     global _tunnel_ok_at
     name = script_path.rsplit("/", 1)[-1]
-    best_salvage: list[str] = []
-
-    def flush_salvage() -> int:
-        if best_salvage:
-            print("\n".join(best_salvage), flush=True)
-            print(f"{name}: no attempt fully succeeded — emitted "
-                  f"{len(best_salvage)} salvaged partial line(s)",
-                  file=sys.stderr)
-            return 0
-        print(f"{name}: all attempts failed", file=sys.stderr)
-        return 1
+    emitted_keys: set[str] = set()
 
     for attempt in range(1, attempts + 1):
         if not _tunnel_vouched() and not probe_tunnel():
             print(f"{name}: tunnel probe failed — not starting the heavy "
                   "child (nothing to measure, nothing to wedge)",
                   file=sys.stderr)
-            return flush_salvage()
-        rc, out, err, timed_out = _run_child(
+            break
+        rc, forwarded, err, timed_out = _stream_child(
             [sys.executable, script_path, *child_args, "--child"],
-            timeout_s)
-        lines = _json_lines(out)
-        if rc == 0 and lines:
+            timeout_s, emitted_keys)
+        if rc == 0 and (emitted_keys or forwarded):
             _tunnel_ok_at = time.monotonic()
-            print("\n".join(lines), flush=True)
             return 0
         # Any failure invalidates the memo: the next attempt re-probes.
         _tunnel_ok_at = None
-        best_salvage = max(best_salvage, lines, key=len)
         if timed_out:
             print(f"{name} attempt {attempt}: timed out after "
-                  f"{timeout_s:.0f}s — terminated; salvaged "
-                  f"{len(lines)} partial JSON line(s)", file=sys.stderr)
+                  f"{timeout_s:.0f}s — terminated; {forwarded} line(s) "
+                  "already forwarded", file=sys.stderr)
         else:
             print(f"{name} attempt {attempt}: rc={rc} "
                   f"stderr tail: {err[-400:]}", file=sys.stderr)
         if attempt < attempts:
             time.sleep(retry_delay_s)
-    return flush_salvage()
+    if emitted_keys:
+        print(f"{name}: no attempt fully succeeded — "
+              f"{len(emitted_keys)} record(s) were forwarded live",
+              file=sys.stderr)
+        return 0
+    print(f"{name}: all attempts failed", file=sys.stderr)
+    return 1

@@ -15,22 +15,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench_common
 
 
-def test_json_lines_filters_and_orders():
-    text = "\n".join([
-        "noise",
-        '{"a": 1}',
-        '{"broken": ',
-        '  {"b": 2}  ',
-        "{not json}",
-    ])
-    assert bench_common._json_lines(text) == ['{"a": 1}', '{"b": 2}']
-
-
-def test_json_lines_handles_bytes_and_none():
-    assert bench_common._json_lines(None) == []
-    assert bench_common._json_lines(b'{"x": 3}\n') == ['{"x": 3}']
-
-
 @pytest.fixture(autouse=True)
 def _reset_probe_memo():
     bench_common._tunnel_ok_at = None
@@ -105,11 +89,13 @@ def test_watchdog_happy_path_forwards_all_lines(
     assert [json.loads(l)["metric"] for l in out] == ["bf16", "best"]
 
 
-def test_watchdog_retry_after_partial_emits_no_duplicates(
+def test_watchdog_retry_forwards_only_new_keys(
         tmp_path, monkeypatch, capsys):
-    """Attempt 1 lands a partial line then dies; attempt 2 fully
-    succeeds: only attempt 2's lines reach stdout — a driver summing
-    per-metric lines must not double-count (code-review finding)."""
+    """Attempt 1 lands key "a" live then dies; attempt 2 re-measures "a"
+    (suppressed — a driver summing per-metric lines must not
+    double-count) and adds "b" (forwarded). Streaming-first: the line
+    that already reached stdout wins (r3 lesson: holding lines until
+    child exit lost completed measurements to external kills)."""
     _patch_probe(monkeypatch)
     marker = tmp_path / "attempt1_done"
     script = _fake_child(tmp_path, f"""
@@ -117,23 +103,23 @@ def test_watchdog_retry_after_partial_emits_no_duplicates(
         marker = pathlib.Path({str(marker)!r})
         if not marker.exists():
             marker.write_text("x")
-            print('{{"metric": "m", "value": 1, "partial": true}}',
-                  flush=True)
+            print('{{"metric": "a", "value": 1}}', flush=True)
             sys.exit(3)
-        print('{{"metric": "m", "value": 1}}', flush=True)
-        print('{{"metric": "m", "value": 2}}', flush=True)
+        print('{{"metric": "a", "value": 9}}', flush=True)
+        print('{{"metric": "b", "value": 2}}', flush=True)
     """)
     rc = bench_common.run_watchdogged(script, [], timeout_s=30.0,
                                       attempts=2, retry_delay_s=0.0)
     out = [json.loads(l) for l in
            capsys.readouterr().out.strip().splitlines()]
     assert rc == 0
-    assert out == [{"metric": "m", "value": 1}, {"metric": "m", "value": 2}]
+    assert out == [{"metric": "a", "value": 1}, {"metric": "b", "value": 2}]
 
 
-def test_watchdog_all_attempts_fail_emits_best_salvage_once(
+def test_watchdog_all_attempts_fail_still_streams_once(
         tmp_path, monkeypatch, capsys):
-    """Every attempt fails → the single best salvage is emitted, once."""
+    """Every attempt fails → each record still reached stdout exactly
+    once (streamed live, duplicate keys suppressed across retries)."""
     _patch_probe(monkeypatch)
     script = _fake_child(tmp_path, """
         import sys
@@ -145,6 +131,61 @@ def test_watchdog_all_attempts_fail_emits_best_salvage_once(
     out = capsys.readouterr().out.strip().splitlines()
     assert rc == 0
     assert [json.loads(l) for l in out] == [{"metric": "m", "value": 1}]
+
+
+def test_watchdog_chatty_stderr_child_not_falsely_timed_out(
+        tmp_path, monkeypatch, capsys):
+    """A child writing >64KB to stderr must not deadlock on a full pipe
+    and get killed as a fake timeout (review finding: stderr drained
+    continuously, not after exit)."""
+    _patch_probe(monkeypatch)
+    script = _fake_child(tmp_path, """
+        import sys
+        for _ in range(4000):
+            print("W0000 some very chatty PJRT warning line" * 2,
+                  file=sys.stderr)
+        print('{"metric": "m", "value": 1}', flush=True)
+    """)
+    rc = bench_common.run_watchdogged(script, [], timeout_s=20.0,
+                                      attempts=1, retry_delay_s=0.0)
+    out = capsys.readouterr().out.strip()
+    assert rc == 0
+    assert json.loads(out) == {"metric": "m", "value": 1}
+
+
+def test_watchdog_exit0_without_records_is_failure(
+        tmp_path, monkeypatch, capsys):
+    """rc=0 with zero JSON records must NOT count as success (review
+    finding: a silently no-op'ing child would otherwise be recorded as
+    a passed bench with no metrics)."""
+    _patch_probe(monkeypatch)
+    script = _fake_child(tmp_path, """
+        print("usage: oops, wrong args")
+    """)
+    rc = bench_common.run_watchdogged(script, [], timeout_s=20.0,
+                                      attempts=2, retry_delay_s=0.0)
+    assert rc == 1
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_watchdog_metricless_json_lines_all_forwarded(
+        tmp_path, monkeypatch, capsys):
+    """JSON lines without a 'metric' field (metadata records) are all
+    forwarded — they must not dedup against each other under key None
+    (review finding)."""
+    _patch_probe(monkeypatch)
+    script = _fake_child(tmp_path, """
+        print('{"context": "env"}', flush=True)
+        print('{"context": "roofline"}', flush=True)
+        print('{"metric": "m", "value": 1}', flush=True)
+    """)
+    rc = bench_common.run_watchdogged(script, [], timeout_s=20.0,
+                                      attempts=1, retry_delay_s=0.0)
+    out = [json.loads(l) for l in
+           capsys.readouterr().out.strip().splitlines()]
+    assert rc == 0
+    assert out == [{"context": "env"}, {"context": "roofline"},
+                   {"metric": "m", "value": 1}]
 
 
 def test_watchdog_failed_child_reprobes_before_retry(
