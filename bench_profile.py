@@ -1,0 +1,155 @@
+"""Decode profile — where the 6.8ms/token actually goes.
+
+BENCH_NOTES.md round 3: int8 decode measures ~148 tok/s against a
+~326 tok/s weight-streaming ceiling (45% of roofline). Closing that gap
+needs evidence, not guesses: this harness wraps a steady-state decode
+run in jax.profiler.trace and emits the top device ops by total time as
+JSON — the data that says whether the missing milliseconds are in the
+int8 dequant (unfused convert materializing bf16 weights), the
+attention kernel, the sampling epilogue, or dispatch gaps.
+
+Usage: python bench_profile.py          (real chip; gemma-2b int8)
+       ROUNDTABLE_BENCH_CPU=1 ...       (tiny model smoke)
+Same probe-first watchdog as every bench (bench_common).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+ATTEMPT_TIMEOUT_S = 420.0
+MAX_ATTEMPTS = 2
+RETRY_DELAY_S = 20.0
+
+PROMPT = ("You are taking part in a TheRoundtAIble discussion. Topic: "
+          "should we refactor the session store before the apply "
+          "pipeline? Answer carefully. " * 8)
+
+
+def _top_device_ops(trace_dir: str, top_n: int = 14) -> list[dict]:
+    """Aggregate per-op durations from the profiler's chrome trace.
+
+    Prefers device pids (named like '/device:TPU:0'); host-only traces
+    (CPU smoke) fall back to all pids minus Python-frame noise."""
+    from collections import defaultdict
+
+    files = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+    if not files:
+        return []
+    t = json.loads(gzip.open(files[0]).read())
+    events = t.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "args" in e}
+    device_pids = {p for p, n in pid_names.items()
+                   if "device" in n.lower() or "tpu" in n.lower()}
+
+    agg = defaultdict(lambda: [0.0, 0])
+    for e in events:
+        if e.get("ph") != "X" or not e.get("dur"):
+            continue
+        name = e.get("name", "")
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        if not device_pids and (name.startswith("$")
+                                or ".py:" in name
+                                or name.startswith("<")):
+            continue
+        agg[name][0] += e["dur"]
+        agg[name][1] += 1
+    total = sum(v[0] for v in agg.values()) or 1.0
+    out = []
+    for name, (dur, count) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        out.append({"op": name[:120], "total_ms": round(dur / 1e3, 2),
+                    "count": count, "pct": round(100.0 * dur / total, 1)})
+        if len(out) >= top_n:
+            break
+    return out
+
+
+def child() -> int:
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg, decode_tokens, quant = get_model_config("tiny-gemma"), 64, "none"
+    else:
+        cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
+        decode_tokens, quant = 192, "int8"
+
+    engine = InferenceEngine(
+        cfg, num_slots=2, quant=quant,
+        sampling=SamplingParams(temperature=0.0,
+                                max_new_tokens=decode_tokens))
+    # Two warm passes: the profiled run must be pure steady state, no
+    # compiles in the trace.
+    for _ in range(2):
+        engine.kv.release("warm")
+        engine.generate(PROMPT, slot_name="warm",
+                        max_new_tokens=decode_tokens)
+    engine.kv.release("warm")
+
+    # Prefill OUTSIDE the trace (prime the slot), so the profiled call
+    # reuses all but one prompt token and the trace is ≥99% decode —
+    # otherwise prefill matmuls merge into the same op buckets and
+    # contaminate the attribution this harness exists to produce.
+    engine.generate(PROMPT, slot_name="prof", max_new_tokens=1)
+
+    trace_dir = tempfile.mkdtemp(prefix="rt_profile_")
+    t0 = time.monotonic()
+    with jax.profiler.trace(trace_dir):
+        engine.generate(PROMPT, slot_name="prof",
+                        max_new_tokens=decode_tokens)
+    wall = time.monotonic() - t0
+    s = engine.last_stats
+
+    rec = {
+        "metric": f"decode_profile[{cfg.name}]",
+        "value": round(s.decode_tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # diagnostic record, not a headline
+        "detail": {
+            "quant": quant,
+            "decode_tokens": s.decode_tokens,
+            "decode_seconds": round(s.decode_seconds, 3),
+            "prefill_tokens": s.prefill_tokens,
+            "wall_s": round(wall, 2),
+            "platform": jax.devices()[0].platform,
+            # kept on disk for TensorBoard/Perfetto deep dives
+            "trace_dir": trace_dir,
+            "top_ops": _top_device_ops(trace_dir),
+        },
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+def main() -> int:
+    from bench_common import run_watchdogged
+    return run_watchdogged(os.path.abspath(__file__), [],
+                           ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS, RETRY_DELAY_S)
+
+
+if __name__ == "__main__":
+    sys.exit(child() if "--child" in sys.argv else main())
