@@ -192,10 +192,6 @@ class TestPPConfigValidation:
             PPEngine.from_config(
                 self._cfg(mesh={"pipe": 2, "model": 2}))
 
-    def test_paged_kv_raises(self):
-        with pytest.raises(ValueError, match="kv_layout"):
-            PPEngine.from_config(self._cfg(kv_layout="paged"))
-
     def test_seq_parallel_raises(self):
         with pytest.raises(ValueError, match="seq_parallel"):
             PPEngine.from_config(self._cfg(seq_parallel=4))
@@ -204,6 +200,66 @@ class TestPPConfigValidation:
         with pytest.warns(UserWarning, match="dense attention"):
             eng = PPEngine.from_config(self._cfg(attn="flash"))
         assert eng.cfg.attn_impl == "dense"
+
+
+class TestPPPaged:
+    """Paged KV under pipeline parallelism: the stage-stacked page pool
+    must serve token-identically to the contiguous PP engine, with HBM
+    scaling by pages used and prefix sharing via page aliasing."""
+
+    def test_generate_and_reuse_parity(self):
+        paged = build_pp(kv_layout="paged", page_size=32)
+        dense = build_pp()
+        base = "the paged pipeline debates its own page tables at length."
+        ext = base + " a second turn crosses a page boundary here."
+        for eng in (paged, dense):
+            eng.generate(base, slot_name="k", max_new_tokens=8)
+        out_p = paged.generate(ext, slot_name="k", max_new_tokens=8)
+        out_d = dense.generate(ext, slot_name="k", max_new_tokens=8)
+        assert paged.last_stats.reused_tokens > 0
+        assert out_p == out_d
+
+    def test_batch_shared_prefix_aliases_pages(self):
+        paged = build_pp(kv_layout="paged", page_size=32)
+        dense = build_pp()
+        shared = ("the common context paragraph that every knight "
+                  "receives before personal instructions begin. ")
+        prompts = [(f"kn{i}", shared + f"knight {i} speaks")
+                   for i in range(3)]
+        out_p, stats_p = paged.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        out_d, stats_d = dense.generate_batch_with_stats(
+            prompts, max_new_tokens=8)
+        assert out_p == out_d
+        assert stats_p.reused_tokens == stats_d.reused_tokens > 0
+
+    def test_pages_scale_with_use_and_describe(self):
+        paged = build_pp(kv_layout="paged", page_size=32)
+        paged.generate("short", slot_name="s", max_new_tokens=8)
+        used_short = paged.kv.pages_in_use()
+        paged.generate("a much longer prompt " * 6, slot_name="l",
+                       max_new_tokens=8)
+        assert paged.kv.pages_in_use() > used_short
+        d = paged.describe()
+        assert d["kv_layout"] == "stage-local paged"
+        assert paged.kv.hbm_bytes() > 0
+
+    def test_int8_paged_pp_serves(self):
+        paged = build_pp(kv_layout="paged", page_size=32, quant="int8")
+        out = paged.generate("every axis at once", slot_name="q",
+                             max_new_tokens=8)
+        assert isinstance(out, str)
+        assert build_pp(quant="int8").generate(
+            "every axis at once", slot_name="q", max_new_tokens=8) == out
+
+    def test_reachable_from_adapter_config(self):
+        eng = PPEngine.from_config({
+            "model": "tiny-llama", "max_seq_len": 256,
+            "mesh": {"pipe": 2}, "kv_layout": "paged", "page_size": 32,
+            "num_slots": 4, "dtype": "float32",
+            "sampling": {"temperature": 0.0, "max_new_tokens": 4}})
+        out = eng.generate("hello pages", slot_name="c", max_new_tokens=4)
+        assert isinstance(out, str)
 
 
 class TestPPAdapterConfig:
@@ -231,4 +287,5 @@ class TestPPAdapterConfig:
     def test_describe_scope_is_honest(self):
         d = build_pp().describe()
         assert d["kv_layout"] == "stage-local contiguous"
-        assert "no paged layout yet" in d["scope"]
+        assert "prefix sharing" in d["scope"]
+        assert d["quant"] == "none"

@@ -156,26 +156,8 @@ class InferenceEngine:
                                 v.at[dst_ids].set(v[src_ids])))
                 return out
 
-            # Fixed copy width: COW/boundary copies are typically 1-2
-            # pages, so chunking at 8 keeps padding waste small, compiles
-            # exactly ONE program shape, and bounds per-dispatch traffic
-            # (vs padding to pages_per_seq, which would move a whole
-            # sequence's worth of pages for a 1-page copy).
-            copy_width = 8
-
-            def copy_pages_padded(pools, src_ids, dst_ids):
-                n = int(src_ids.shape[0])
-                for start in range(0, n, copy_width):
-                    s = src_ids[start:start + copy_width]
-                    d = dst_ids[start:start + copy_width]
-                    pad = copy_width - int(s.shape[0])
-                    if pad:
-                        s = jnp.concatenate(
-                            [s, jnp.zeros((pad,), jnp.int32)])
-                        d = jnp.concatenate(
-                            [d, jnp.zeros((pad,), jnp.int32)])
-                    pools = copy_pages(pools, s, d)
-                return pools
+            from .paging import make_padded_copier
+            copy_pages_padded = make_padded_copier(copy_pages)
 
             # Default pool HALVES the contiguous HBM budget per device. The
             # pool is replicated over the data axis (pages are dynamically

@@ -49,6 +49,34 @@ import numpy as np
 from .models.common import ModelConfig
 
 
+def make_padded_copier(copy_fn: Callable, width: int = 8) -> Callable:
+    """Wrap a jit'd whole-page copy `copy_fn(pools, src_ids, dst_ids)`
+    so it compiles exactly ONE shape: copies run in fixed-width chunks,
+    short chunks zero-padded (pad rows copy the scratch page onto
+    itself — identical bytes, any scatter order). COW/boundary copies
+    are typically 1-2 pages, so width=8 keeps padding waste small and
+    bounds per-dispatch traffic (vs padding to pages_per_seq, which
+    would move a whole sequence's worth of pages for a 1-page copy).
+    Shared by both engines' paged layouts — the driver is layout-
+    agnostic, only the jit'd copy differs."""
+
+    def padded(pools, src_ids, dst_ids):
+        n = int(src_ids.shape[0])
+        for start in range(0, n, width):
+            s_ids = src_ids[start:start + width]
+            d_ids = dst_ids[start:start + width]
+            pad = width - int(s_ids.shape[0])
+            if pad:
+                s_ids = jnp.concatenate(
+                    [s_ids, jnp.zeros((pad,), jnp.int32)])
+                d_ids = jnp.concatenate(
+                    [d_ids, jnp.zeros((pad,), jnp.int32)])
+            pools = copy_fn(pools, s_ids, d_ids)
+        return pools
+
+    return padded
+
+
 @dataclass
 class PagedSlot:
     """Host-side bookkeeping for one knight's slot."""
@@ -70,7 +98,8 @@ class PagedKVCache:
                  max_seq_len: Optional[int] = None, dtype=jnp.bfloat16,
                  sharding=None, page_size: int = 128,
                  num_pages: Optional[int] = None,
-                 copy_pages_fn: Optional[Callable] = None):
+                 copy_pages_fn: Optional[Callable] = None,
+                 pool_factory: Optional[Callable] = None):
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
@@ -90,11 +119,19 @@ class PagedKVCache:
             raise ValueError(
                 f"num_pages {self.num_pages} cannot hold even one full "
                 f"sequence ({self.pages_per_seq} pages + scratch)")
-        shape = (self.num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
-        make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
-            (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
-        self.pools: list[tuple[jax.Array, jax.Array]] = [
-            (make(), make()) for _ in range(cfg.num_layers)]
+        if pool_factory is not None:
+            # Custom pool layout (the PP engine stacks every stage's
+            # layer range into ONE stage-sharded pool pair whose page
+            # axis this allocator still manages; copy_pages_fn must
+            # address pages in that layout).
+            self.pools = pool_factory(self.num_pages)
+        else:
+            shape = (self.num_pages, page_size, cfg.num_kv_heads,
+                     cfg.head_dim)
+            make = (lambda: jnp.zeros(shape, dtype)) if sharding is None \
+                else (lambda: jax.device_put(jnp.zeros(shape, dtype),
+                                             sharding))
+            self.pools = [(make(), make()) for _ in range(cfg.num_layers)]
         self._copy_pages_fn = copy_pages_fn
         self._slots: dict[str, PagedSlot] = {}
         self._free: list[int] = list(range(1, self.num_pages))  # 0 = scratch

@@ -24,8 +24,13 @@ cache — the memory-capacity property PP exists for.
   and stage-shard like any other layer leaf). Cross-knight prefix
   sharing (donor + leader passes) copies spans on the stage-sharded
   caches — the slot axis is unsharded, so each stage copies its own
-  layers' span with no cross-stage traffic. The paged layout is the one
-  main-engine feature not wired here (documented in describe()).
+  layers' span with no cross-stage traffic.
+- kv_layout="paged": a stage-stacked page pool [st, per, P, ps, K, D]
+  managed by the main engine's PagedKVCache allocator (one page table
+  for every layer; page aliasing replaces span copies for prefix
+  sharing), gathered per serving call into the same position-aligned
+  view the contiguous programs use — HBM scales with tokens cached
+  even for the models PP exists for.
 
 The reference has no counterpart (its models fit one GPU via Ollama);
 SURVEY.md §2.3 "PP" row is the requirement this file closes.
@@ -63,12 +68,17 @@ class PPEngine:
     def __init__(self, model_cfg: ModelConfig, *, checkpoint: str = "",
                  n_stages: int = 2, n_micro: int = 2, num_slots: int = 4,
                  dtype=jnp.bfloat16, quant: str = "none",
+                 kv_layout: str = "contiguous", page_size: int = 128,
+                 num_pages: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
                  devices: Optional[list[int]] = None):
         import dataclasses
 
         if quant not in ("none", "int8"):
             raise ValueError(f"unknown quant mode {quant!r}")
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be contiguous|paged, got {kv_layout!r}")
 
         from . import enable_compilation_cache
         from .distributed import maybe_init_distributed
@@ -110,15 +120,78 @@ class PPEngine:
             params, model_cfg, n_stages, self.mesh)
 
         per = model_cfg.num_layers // n_stages
-        cache_shape = (n_stages, per, num_slots, self.max_seq_len,
-                       model_cfg.num_kv_heads, model_cfg.head_dim)
         cache_sharding = NamedSharding(
             self.mesh, P(PIPE_AXIS, None, None, None, None, None))
-        self.kc = jax.device_put(jnp.zeros(cache_shape, dtype),
-                                 cache_sharding)
-        self.vc = jax.device_put(jnp.zeros(cache_shape, dtype),
-                                 cache_sharding)
-        self.kv = SlotBook(num_slots)
+        self.kv_layout = kv_layout
+        kd = (model_cfg.num_kv_heads, model_cfg.head_dim)
+        if kv_layout == "paged":
+            # Stage-stacked page pool [st, per, P, ps, K, D]: ONE
+            # allocator manages the page axis (a slot's page mapping is
+            # identical for every layer, exactly like the main engine's
+            # per-layer pools sharing one table), while the leading stage
+            # axis shards so each pipe device holds only its own layers'
+            # pages. Serving gathers pool[table] into the same
+            # [st, per, B, S, K, D] view the contiguous programs use —
+            # the stage programs are layout-agnostic.
+            from .paging import PagedKVCache
+
+            def pool_factory(n_pages):
+                shape = (n_stages, per, n_pages, page_size) + kd
+                return [(jax.device_put(jnp.zeros(shape, dtype),
+                                        cache_sharding),
+                         jax.device_put(jnp.zeros(shape, dtype),
+                                        cache_sharding))]
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def copy_pages(pools, src_ids, dst_ids):
+                k6, v6 = pools[0]
+                return [(k6.at[:, :, dst_ids].set(k6[:, :, src_ids]),
+                         v6.at[:, :, dst_ids].set(v6[:, :, src_ids]))]
+
+            from .paging import make_padded_copier
+            self.kv = PagedKVCache(
+                model_cfg, num_slots, self.max_seq_len, dtype,
+                page_size=page_size, num_pages=num_pages,
+                copy_pages_fn=make_padded_copier(copy_pages),
+                pool_factory=pool_factory)
+            self.kc = self.vc = None
+            n_pages_seq = self.max_seq_len // page_size
+
+            @jax.jit
+            def gather_view(pools, tables):
+                k6, v6 = pools[0]
+                b = tables.shape[0]
+                kc = k6[:, :, tables].reshape(
+                    n_stages, per, b, self.max_seq_len, *kd)
+                vc = v6[:, :, tables].reshape(
+                    n_stages, per, b, self.max_seq_len, *kd)
+                return kc, vc
+
+            @partial(jax.jit, donate_argnums=(0, 2, 3))
+            def scatter_view(pools, tables, kc, vc):
+                # Duplicate table entries (pages aliased across rows)
+                # only ever carry identical bytes: aliased pages sit
+                # below every row's COW'd write range, so the rows' view
+                # contents agree there (engine.py scatter_view contract).
+                k6, v6 = pools[0]
+                b = tables.shape[0]
+                k7 = kc.reshape(n_stages, per, b, n_pages_seq,
+                                page_size, *kd)
+                v7 = vc.reshape(n_stages, per, b, n_pages_seq,
+                                page_size, *kd)
+                return [(k6.at[:, :, tables].set(k7),
+                         v6.at[:, :, tables].set(v7))]
+
+            self._gather_view = gather_view
+            self._scatter_view = scatter_view
+        else:
+            cache_shape = (n_stages, per, num_slots,
+                           self.max_seq_len) + kd
+            self.kc = jax.device_put(jnp.zeros(cache_shape, dtype),
+                                     cache_sharding)
+            self.vc = jax.device_put(jnp.zeros(cache_shape, dtype),
+                                     cache_sharding)
+            self.kv = SlotBook(num_slots)
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
@@ -388,11 +461,6 @@ class PPEngine:
                 f"mesh axes {extra_axes} are not supported alongside "
                 "'pipe' — the PP engine runs no TP/DP inside stages yet; "
                 "use mesh={'pipe': N} alone or a (data, model) mesh")
-        if config.get("kv_layout", "contiguous") != "contiguous":
-            raise ValueError(
-                "kv_layout='paged' is not supported on the PP engine "
-                "(stage-local KV is contiguous) — drop kv_layout or use "
-                "a (data, model) mesh")
         if config.get("seq_parallel"):
             raise ValueError(
                 "seq_parallel is not supported on the PP engine — use a "
@@ -410,6 +478,10 @@ class PPEngine:
             n_micro=int(config.get("n_micro", 2)),
             num_slots=int(config.get("num_slots", 4)),
             dtype=dtype, quant=config.get("quant", "none"),
+            kv_layout=config.get("kv_layout", "contiguous"),
+            page_size=int(config.get("page_size", 128)),
+            num_pages=(int(config["num_pages"])
+                       if config.get("num_pages") else None),
             sampling=sampling,
             seed=int(config.get("seed", 0)),
             devices=config.get("devices"),
@@ -528,26 +600,55 @@ class PPEngine:
         self.kc, self.vc = self._pp_copy_spans(self.kc, self.vc, src, dst,
                                                lo, hi)
 
+    def _prefill_rows_paged(self, names_sub, token_spans, offsets_sub,
+                            deadline, pinned) -> None:
+        """Prefill rows straight against the pool (its own mini
+        gather→chunked-prefill→scatter cycle) — the paged leader pass
+        must land in the pool BEFORE laggards alias its pages."""
+        for name, toks, off in zip(names_sub, token_spans, offsets_sub):
+            self.kv.ensure_capacity(name, off + len(toks), write_from=off,
+                                    pinned=pinned)
+        tables = jnp.asarray(self.kv.table_for(list(names_sub)))
+        self.kc, self.vc = self._gather_view(self.kv.pools, tables)
+        try:
+            self._chunked_rows(list(range(len(names_sub))), token_spans,
+                               offsets_sub, deadline)
+        finally:
+            self.kv.pools = self._scatter_view(self.kv.pools, tables,
+                                               self.kc, self.vc)
+            self.kc = self.vc = None
+
     def _share_prefixes(self, names, slot_ids, all_tokens, offsets,
                         deadline):
         """Cross-knight shared-prefix reuse on the stage-local caches —
         kvcache.share_prefixes (the same two-pass algorithm the main
         engine runs) with PP device mechanics: stage-sharded span copies
-        and chunked leader prefill."""
+        (contiguous) or page aliasing (paged), and chunked leader
+        prefill."""
         from .engine import MIN_SHARED_PREFIX
         from .kvcache import share_prefixes
+        paged = self.kv_layout == "paged"
+        pinned = tuple(names)
         copies: list[tuple[int, int, int, int]] = []
 
         def add_share(donor, i, lo, hi):
-            copies.append((donor.slot_id, slot_ids[i], lo, hi))
+            if paged:
+                self.kv.alias_span(donor.name, names[i], lo, hi, pinned)
+            else:
+                copies.append((donor.slot_id, slot_ids[i], lo, hi))
 
         def flush_shares():
             self._apply_copies(copies)
             copies.clear()
 
         def prefill_span(m, lo, hi):
-            self._chunked_rows([slot_ids[m]], [all_tokens[m][lo:hi]],
-                               [lo], deadline)
+            if paged:
+                self._prefill_rows_paged(
+                    [names[m]], [all_tokens[m][lo:hi]], [lo], deadline,
+                    pinned)
+            else:
+                self._chunked_rows([slot_ids[m]], [all_tokens[m][lo:hi]],
+                                   [lo], deadline)
 
         return share_prefixes(
             self.kv, names, all_tokens, offsets,
@@ -583,46 +684,73 @@ class PPEngine:
         stats.prefill_tokens = extra_prefill + sum(
             len(t) - o for t, o in zip(all_tokens, offsets))
 
-        # Chunked bucketed prefill (shared serving_loop host loop with the
-        # PP step program).
-        t0 = time.monotonic()
-        last_logits = self._chunked_rows(
-            slot_ids, [t[o:] for t, o in zip(all_tokens, offsets)],
-            offsets, deadline)
-        float(last_logits[0, 0])
-        stats.prefill_seconds = time.monotonic() - t0
-        slot_idx = jnp.asarray(slot_ids, jnp.int32)
+        tables = None
+        if self.kv_layout == "paged":
+            # Allocate pages for the whole call (prompt + padded decode),
+            # COW any shared page in the write range, then gather the
+            # stage-stacked pool into the position-aligned view every PP
+            # program uses; the view's row index IS the batch index.
+            for i, name in enumerate(pinned):
+                self.kv.ensure_capacity(
+                    name, len(all_tokens[i]) + max_new_padded,
+                    write_from=offsets[i], pinned=pinned)
+            tables = jnp.asarray(self.kv.table_for(list(pinned)))
+            self.kc, self.vc = self._gather_view(self.kv.pools, tables)
+            slot_ids = list(range(len(turns)))
 
-        per_row = sampling_per_turn or [self.sampling] * len(turns)
-        if len(per_row) != len(turns):
-            raise ValueError(
-                f"sampling_per_turn has {len(per_row)} entries for "
-                f"{len(turns)} turns")
-        temps, top_ks, top_ps = sampling_arrays(per_row)
-        greedy = all(p.temperature <= 0.0 for p in per_row)
-        if greedy:
-            first = jnp.argmax(last_logits.astype(jnp.float32),
-                               axis=-1).astype(jnp.int32)
-        else:
-            first = sample_token_batch(last_logits.astype(jnp.float32),
-                                       self._next_key(), temps, top_ks,
-                                       top_ps).astype(jnp.int32)
-        first_np = np.asarray(first)
-        cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
+        try:
+            # Chunked bucketed prefill (shared serving_loop host loop
+            # with the PP step program).
+            t0 = time.monotonic()
+            last_logits = self._chunked_rows(
+                slot_ids, [t[o:] for t, o in zip(all_tokens, offsets)],
+                offsets, deadline)
+            float(last_logits[0, 0])
+            stats.prefill_seconds = time.monotonic() - t0
+            slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
-        t1 = time.monotonic()
+            per_row = sampling_per_turn or [self.sampling] * len(turns)
+            if len(per_row) != len(turns):
+                raise ValueError(
+                    f"sampling_per_turn has {len(per_row)} entries for "
+                    f"{len(turns)} turns")
+            temps, top_ks, top_ps = sampling_arrays(per_row)
+            greedy = all(p.temperature <= 0.0 for p in per_row)
+            if greedy:
+                first = jnp.argmax(last_logits.astype(jnp.float32),
+                                   axis=-1).astype(jnp.int32)
+            else:
+                first = sample_token_batch(
+                    last_logits.astype(jnp.float32), self._next_key(),
+                    temps, top_ks, top_ps).astype(jnp.int32)
+            first_np = np.asarray(first)
+            cur_valid = jnp.asarray([len(t) for t in all_tokens],
+                                    jnp.int32)
 
-        def decode_dispatch(cur_last, valid, budget):
-            out, steps, last, valid, done, self.kc, self.vc = \
-                self._pp_decode(
-                    self.shared, self.staged, self.kc, self.vc, slot_idx,
-                    cur_last, valid, self._next_key(), budget, temps,
-                    top_ks, top_ps, max_new=DECODE_SEGMENT, greedy=greedy)
-            return out, steps, last, valid, done
+            t1 = time.monotonic()
 
-        out_np = decode_segments(decode_dispatch, first, cur_valid,
-                                 max_new, deadline, timeout_s)
-        stats.decode_seconds = time.monotonic() - t1
+            def decode_dispatch(cur_last, valid, budget):
+                out, steps, last, valid, done, self.kc, self.vc = \
+                    self._pp_decode(
+                        self.shared, self.staged, self.kc, self.vc,
+                        slot_idx, cur_last, valid, self._next_key(),
+                        budget, temps, top_ks, top_ps,
+                        max_new=DECODE_SEGMENT, greedy=greedy)
+                return out, steps, last, valid, done
+
+            out_np = decode_segments(decode_dispatch, first, cur_valid,
+                                     max_new, deadline, timeout_s)
+            stats.decode_seconds = time.monotonic() - t1
+        finally:
+            # Scatter back even on a mid-serve timeout: otherwise the
+            # gathered view (the full contiguous-size budget paging
+            # avoids) stays resident and every prefilled token is lost.
+            # Slot records stay truncated until commit, so a partial
+            # scatter only under-claims.
+            if tables is not None:
+                self.kv.pools = self._scatter_view(self.kv.pools, tables,
+                                                   self.kc, self.vc)
+                self.kc = self.vc = None
 
         results = finalize_outputs(
             turns, first_np, out_np, all_tokens, max_new,
@@ -641,11 +769,11 @@ class PPEngine:
             "mesh": {"pipe": self.n_stages},
             "n_micro": self.n_micro,
             "num_slots": self.kv.num_slots,
-            "kv_layout": "stage-local contiguous",
+            "kv_layout": f"stage-local {self.kv_layout}",
             "quant": self.quant,
-            "scope": "PP serving: prefill + decode with stage-local KV; "
-                     "own-slot LCP reuse; cross-knight donor + leader "
-                     "prefix sharing; per-row sampling; int8 w8a16; "
-                     "no paged layout yet",
+            "scope": "PP serving: prefill + decode with stage-local KV "
+                     "(contiguous or paged pool); own-slot LCP reuse; "
+                     "cross-knight donor + leader prefix sharing (page "
+                     "aliasing when paged); per-row sampling; int8 w8a16",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
