@@ -210,10 +210,48 @@ class TestPagedEngineParity:
         assert (paged.generate_batch(prompts, max_new_tokens=8)
                 == dense.generate_batch(prompts, max_new_tokens=8))
 
-    def test_multi_device_falls_back_to_gather_view(self):
+    def test_tp_mesh_pool_direct_matches_contiguous(self):
+        """Multi-device pool-direct (paged_decode_spmd: kv heads on the
+        model axis, matching the pool's sharding) must stay token-
+        identical to the contiguous engine on the same TP mesh."""
+        mesh = {"data": 1, "model": 2}
+        paged, dense = self._engines(mesh=mesh)
+        assert paged.paged_direct is True
+        base = "the sharded pool direct decode follows its page table."
+        ext = base + " the second turn crosses a page boundary again."
+        for eng in (paged, dense):
+            eng.generate(base, slot_name="k", max_new_tokens=8)
+        assert (paged.generate(ext, slot_name="k", max_new_tokens=8)
+                == dense.generate(ext, slot_name="k", max_new_tokens=8))
+        assert paged.last_stats.reused_tokens > 0
+
+    def test_tp_mesh_pool_direct_mqa_replicated_kv(self):
+        """MQA (1 kv head — the gemma-2b layout): the single kv head
+        replicates, only q heads shard; pool-direct must still match."""
+        cfg = get_model_config("tiny-gemma", max_seq_len=256,
+                               num_kv_heads=1)
+        mesh = {"data": 1, "model": 2}
+
+        def build(layout):
+            return InferenceEngine(
+                cfg, mesh_shape=mesh, num_slots=2, kv_layout=layout,
+                page_size=32,
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=8))
+
+        paged, dense = build("paged"), build("contiguous")
+        assert paged.paged_direct is True
+        p = "one kv head shared by every query head across two devices"
+        assert (paged.generate(p, slot_name="m", max_new_tokens=8)
+                == dense.generate(p, slot_name="m", max_new_tokens=8))
+
+    def test_nonpartitionable_heads_fall_back_to_gather_view(self):
+        # 4 q heads on a 3-way model axis cannot partition: the engine
+        # must route paged decode through the gather view, not the
+        # shard_map'd kernel.
         eng = InferenceEngine(
             get_model_config("tiny-gemma", max_seq_len=256),
-            mesh_shape={"data": 1, "model": 2}, num_slots=4,
+            mesh_shape={"data": 1, "model": 3}, num_slots=4,
             kv_layout="paged", page_size=32,
             sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
         assert eng.paged_direct is False

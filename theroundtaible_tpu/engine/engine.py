@@ -360,25 +360,32 @@ class InferenceEngine:
         # (shared-prefix) pages are never in any row's write range
         # (ensure_capacity copy-on-writes them), so duplicate-index
         # scatters only ever rewrite identical bytes.
-        # Decode: POOL-DIRECT where supported (single-device mesh +
-        # kernel-legal pool shape) — the page-table-aware kernel reads
-        # only pages below each row's frontier and the gather view (which
-        # would temporarily recreate the full contiguous HBM budget) is
-        # never built (engine/paged_forward.py). Multi-device paged
-        # decode keeps the gather view.
+        # Decode: POOL-DIRECT where supported — the page-table-aware
+        # kernel reads only pages below each row's frontier and the
+        # gather view (which would temporarily recreate the full
+        # contiguous HBM budget) is never built
+        # (engine/paged_forward.py). On multi-device meshes the kernel
+        # runs under shard_map (kv heads on "model", matching the pool's
+        # sharding; pallas.paged_decode_spmd); head layouts that don't
+        # partition keep the gather view.
         self.paged_direct = False
         if kv_layout == "paged":
-            from .pallas.attention import paged_decode_supported
+            from .pallas.attention import (paged_decode_supported,
+                                           spmd_partitionable)
             # attn="dense" is an explicit opt-out of every Pallas kernel
             # (the _resolve_attn contract) — the pool-direct decode IS a
             # Pallas kernel, so it honors the same switch. "auto" still
             # takes pool-direct even where auto resolves the view path to
             # dense (CPU): there is no dense pool-direct equivalent, and
             # the kernel runs in interpret mode there.
+            n_model = dict(self.mesh.shape).get("model", 1)
             self.paged_direct = (
                 attn != "dense"
-                and self.mesh.devices.size == 1
-                and paged_decode_supported(page_size, model_cfg.head_dim))
+                and paged_decode_supported(page_size, model_cfg.head_dim)
+                and (self.mesh.devices.size == 1
+                     or spmd_partitionable(model_cfg.num_heads,
+                                           model_cfg.num_kv_heads,
+                                           n_model)))
             n_pages_seq = self.max_seq_len // page_size
 
             def gather_view(pools, tables, b):

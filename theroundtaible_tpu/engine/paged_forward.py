@@ -21,10 +21,12 @@ any shared page in a row's write range before dispatch, and distinct
 batch rows are distinct slots owning their frontier pages exclusively,
 so the per-step scatter never touches an aliased page.
 
-Scope: single-device meshes (the multi-device paged path keeps the
-gather view — its pool shards kv heads on "model", and a shard_map
-wrapper for the paged kernel is future work, mirroring
-flash_attention_spmd).
+Multi-device: the kernel runs under shard_map via paged_decode_spmd
+(kv heads on "model" — matching the engine's pool sharding — batch
+rows on "data" when divisible); head layouts that don't partition fall
+back to the engine's gather-view decode at build time
+(engine.paged_direct), so this module never traces an unpartitionable
+kernel.
 """
 
 from __future__ import annotations
@@ -33,8 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from .models.common import (ModelConfig, Params, _einsum, _softcap,
-                            embed_tokens, project_qkv, rms_norm,
-                            transformer_block)
+                            current_spmd_mesh, embed_tokens, project_qkv,
+                            rms_norm, transformer_block)
 from .pallas import attention as pattn
 
 
@@ -69,10 +71,24 @@ def forward_paged_decode(
             # docstring), BEFORE the kernel reads the pool.
             k_pool2 = k_pool.at[pages, offs].set(k[:, 0])
             v_pool2 = v_pool.at[pages, offs].set(v[:, 0])
-            out = pattn.paged_decode_attention(
-                q, k_pool2, v_pool2, table, kv_valid_len,
-                sliding_window=cfg.sliding_window,
-                softcap=cfg.attn_logit_softcap)
+            mesh = current_spmd_mesh()
+            if mesh is not None and mesh.devices.size > 1:
+                out = pattn.paged_decode_spmd(
+                    mesh, q, k_pool2, v_pool2, table, kv_valid_len,
+                    sliding_window=cfg.sliding_window,
+                    softcap=cfg.attn_logit_softcap)
+                if out is None:
+                    # engine.paged_direct gates on spmd_partitionable,
+                    # so this cannot happen in serving — fail loudly for
+                    # direct misuse rather than silently going dense.
+                    raise ValueError(
+                        "paged pool-direct decode requires a head layout "
+                        "that partitions over the model axis")
+            else:
+                out = pattn.paged_decode_attention(
+                    q, k_pool2, v_pool2, table, kv_valid_len,
+                    sliding_window=cfg.sliding_window,
+                    softcap=cfg.attn_logit_softcap)
             out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
                 .astype(h.dtype)
             return out, (k_pool2, v_pool2)

@@ -240,6 +240,29 @@ def flash_prefill_attention(
 # --- decode kernel ---
 
 
+def _spmd_axes(mesh, h: int, kh: int, b: int):
+    """(batch_ax, head_ax, kv_head_ax) for partitioning attention over a
+    (data, model) mesh, or None when the head layout can't partition —
+    the ONE derivation shared by the contiguous and paged SPMD wrappers.
+
+    kv-head rule: when kh divides the model axis, each device's
+    contiguous q-head slice maps exactly onto its kv-head slice (q head
+    j ↔ kv head j // group), so both shard on "model". MQA (kh == 1)
+    replicates the single kv head — matching _fallback_replicated's
+    cache/pool layout — and shards only q heads. Any other non-dividing
+    kh would scramble the q↔kv grouping per device
+    (spmd_partitionable rejects it)."""
+    axes = dict(mesh.shape)
+    n_model = axes.get("model", 1)
+    n_data = axes.get("data", 1)
+    if not spmd_partitionable(h, kh, n_model):
+        return None
+    kv_head_ax = ("model" if n_model > 1 and kh % n_model == 0 else None)
+    batch_ax = "data" if (n_data > 1 and b % n_data == 0) else None
+    head_ax = "model" if n_model > 1 else None
+    return batch_ax, head_ax, kv_head_ax
+
+
 def flash_attention_spmd(
     mesh,
     q: jax.Array,                 # [B, T, H, D] (T==1 → decode)
@@ -272,23 +295,10 @@ def flash_attention_spmd(
 
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
-    axes = dict(mesh.shape)
-    n_model = axes.get("model", 1)
-    n_data = axes.get("data", 1)
-    if not spmd_partitionable(h, kh, n_model):
+    axes_t = _spmd_axes(mesh, h, kh, b)
+    if axes_t is None or not supported(t, s, d):
         return None
-    # kv-head partitioning: when kh divides, each device's contiguous q-head
-    # slice maps exactly onto its kv-head slice (q head j ↔ kv head
-    # j // group), so both shard on "model". MQA (kh == 1) replicates the
-    # single kv head — matching _fallback_replicated's cache layout — and
-    # shards only q heads (this is the gemma-2b-on-TP case). Any other
-    # non-dividing kh would scramble the q↔kv grouping per device: dense
-    # (spmd_partitionable rejects it above).
-    kv_head_ax = ("model" if n_model > 1 and kh % n_model == 0 else None)
-    if not supported(t, s, d):
-        return None
-    batch_ax = "data" if (n_data > 1 and b % n_data == 0) else None
-    head_ax = "model" if n_model > 1 else None
+    batch_ax, head_ax, kv_head_ax = axes_t
 
     q_spec = P(batch_ax, None, head_ax, None)
     kv_spec = P(batch_ax, None, kv_head_ax, None)
@@ -429,6 +439,54 @@ def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
     def _finish():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_spmd(
+    mesh,
+    q: jax.Array,                 # [B, 1, H, D]
+    k_pool: jax.Array,            # [P, page_size, K, D]
+    v_pool: jax.Array,            # [P, page_size, K, D]
+    table: jax.Array,             # [B, pages_per_seq]
+    kv_valid: jax.Array,          # [B]
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> Optional[jax.Array]:
+    """paged_decode_attention under a multi-device (data, model) mesh.
+
+    Same partitioning as flash_attention_spmd: kv heads ride "model"
+    (each device's pool slice holds its heads' pages — the engine's
+    paged pool sharding), batch rows ride "data" when divisible, and
+    the page table + valid lengths replicate (they are tiny). MQA
+    replicates the single kv head and shards only q heads. Returns None
+    when the head layout doesn't partition — the engine then serves
+    paged decode through the gather view instead.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    b, t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    axes_t = _spmd_axes(mesh, h, kh, b)
+    if axes_t is None or not paged_decode_supported(page_size, d):
+        return None
+    batch_ax, head_ax, kv_head_ax = axes_t
+
+    q_spec = P(batch_ax, None, head_ax, None)
+    pool_spec = P(None, None, kv_head_ax, None)
+
+    def body(ql, kp, vp, tl, vl):
+        return paged_decode_attention(
+            ql, kp, vp, tl, vl, sliding_window=sliding_window,
+            softcap=softcap, interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(q_spec, pool_spec, pool_spec,
+                             P(batch_ax, None), P(batch_ax)),
+                   out_specs=q_spec, check_vma=False)
+    return fn(q, k_pool, v_pool, table.astype(jnp.int32),
+              kv_valid.astype(jnp.int32))
 
 
 def paged_decode_attention(
