@@ -64,12 +64,18 @@ def test_process_info_single():
 
 
 # Child for the REAL two-process group below: runs the actual
-# maybe_init_distributed (no monkeypatch), asserts the group formed, and
+# maybe_init_distributed (no monkeypatch), asserts the group formed,
 # proves a collective crosses process boundaries (psum over the 2-device
-# global mesh = 1+2 = 3 on BOTH processes).
+# global mesh = 1+2 = 3 on BOTH processes), and then runs a REAL
+# tensor-parallel model forward over the global mesh — params sharded
+# with the production PartitionSpecs, the model axis spanning the two
+# processes, so the per-layer all-reduces ride the process boundary.
+# The logits checksum (a replicated scalar, addressable everywhere)
+# must agree across processes.
 _CHILD_SRC = """
 import json, os, sys
 import jax
+import jax.numpy as jnp
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {repo!r})
 from theroundtaible_tpu.engine.distributed import (maybe_init_distributed,
@@ -80,6 +86,26 @@ pid = info["process_index"]
 out = jax.pmap(lambda x: jax.lax.psum(x, "p"), axis_name="p")(
     jax.numpy.ones((jax.local_device_count(),)) * (pid + 1))
 info["psum"] = float(out[0])
+
+from theroundtaible_tpu.engine.models.common import forward, init_params
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.sharding import build_mesh, shard_params
+
+cfg = get_model_config("tiny-llama", max_seq_len=64)
+mesh = build_mesh({{"data": 1, "model": 2}})  # model axis SPANS processes
+params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+params = shard_params(params, cfg, mesh)
+tokens = jnp.arange(8, dtype=jnp.int32)[None, :] % cfg.vocab_size
+positions = jnp.arange(8)[None, :]
+valid = jnp.asarray([8], jnp.int32)
+
+@jax.jit
+def step(p, t, pos, v):
+    logits, _ = forward(p, cfg, t, pos, None, None, v)
+    return jnp.sum(jnp.abs(logits.astype(jnp.float32)))
+
+info["forward_checksum"] = round(float(step(params, tokens, positions,
+                                            valid)), 4)
 print(json.dumps(info), flush=True)
 """
 
@@ -128,3 +154,7 @@ def test_two_process_group_real_initialize(tmp_path):
         assert r["global_devices"] == 2
         assert r["local_devices"] == 1
         assert r["psum"] == 3.0
+    # the TP forward's all-reduces crossed the process boundary and both
+    # processes computed the same logits
+    checks = [r["forward_checksum"] for r in results]
+    assert checks[0] == checks[1] > 0.0

@@ -457,11 +457,12 @@ def paged_decode_spmd(
 
     Same partitioning as flash_attention_spmd: kv heads ride "model"
     (each device's pool slice holds its heads' pages — the engine's
-    paged pool sharding), batch rows ride "data" when divisible, and
-    the page table + valid lengths replicate (they are tiny). MQA
-    replicates the single kv head and shards only q heads. Returns None
-    when the head layout doesn't partition — the engine then serves
-    paged decode through the gather view instead.
+    paged pool sharding), and batch rows ride "data" when divisible —
+    the page table and valid lengths shard row-aligned with the batch
+    (replicated when the batch doesn't divide). MQA replicates the
+    single kv head and shards only q heads. Returns None when the head
+    layout doesn't partition — the engine then serves paged decode
+    through the gather view instead.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
