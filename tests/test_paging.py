@@ -245,6 +245,19 @@ class TestPagedEngineParity:
         assert (paged.generate(p, slot_name="m", max_new_tokens=8)
                 == dense.generate(p, slot_name="m", max_new_tokens=8))
 
+    def test_timeout_mid_serve_leaves_engine_serviceable(self):
+        """A deadline hit mid-call must leave the pool/allocator in a
+        state where the next call serves normally (slot records are
+        truncated first, so interrupted turns only under-claim)."""
+        paged, _ = self._engines(mesh={"data": 1, "model": 1})
+        with pytest.raises(TimeoutError):
+            paged.generate("never finishes", slot_name="t",
+                           max_new_tokens=8, timeout_s=0.0)
+        p = "recovery prompt after the timeout"
+        out = paged.generate(p, slot_name="t", max_new_tokens=8)
+        fresh, _ = self._engines(mesh={"data": 1, "model": 1})
+        assert out == fresh.generate(p, slot_name="f", max_new_tokens=8)
+
     def test_nonpartitionable_heads_fall_back_to_gather_view(self):
         # 4 q heads on a 3-way model axis cannot partition: the engine
         # must route paged decode through the gather view, not the

@@ -261,6 +261,21 @@ class TestPPPaged:
         out = eng.generate("hello pages", slot_name="c", max_new_tokens=4)
         assert isinstance(out, str)
 
+    def test_timeout_mid_serve_leaves_engine_serviceable(self):
+        """A deadline hit inside the gather→serve→scatter window must
+        not strand the view or corrupt the pool (the try/finally): the
+        next call serves normally and matches a fresh engine."""
+        paged = build_pp(kv_layout="paged", page_size=32)
+        with pytest.raises(TimeoutError):
+            paged.generate("a prompt that will never finish",
+                           slot_name="t", max_new_tokens=8,
+                           timeout_s=0.0)
+        assert paged.kc is None and paged.vc is None  # view released
+        p = "recovery prompt after the timeout"
+        out = paged.generate(p, slot_name="t", max_new_tokens=8)
+        fresh = build_pp(kv_layout="paged", page_size=32)
+        assert out == fresh.generate(p, slot_name="f", max_new_tokens=8)
+
 
 class TestPPAdapterConfig:
     def test_reachable_from_adapter_config(self):
