@@ -74,6 +74,18 @@ class TestModelCore:
                          "qwen2.5-1.5b-instruct"):
             assert required in models
 
+    def test_cache_too_small_for_decode_reserve_raises(self):
+        """max_seq_len ≤ the padded decode reserve used to silently
+        truncate every prompt to [bos]; it must be a clear config
+        error instead."""
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        eng = InferenceEngine(
+            get_model_config("tiny-llama", max_seq_len=64), num_slots=2,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=6))
+        with pytest.raises(ValueError, match="decode\\s+reserve"):
+            eng.generate("any prompt at all", slot_name="x",
+                         max_new_tokens=6)
+
     def test_qwen_family_serves_end_to_end(self):
         """Qwen2 (attention bias) through the full serving engine: cached
         decode must equal a cache-free greedy recompute — the bias path

@@ -34,7 +34,7 @@ from .sampling import SamplingParams, sample_token_batch, sampling_arrays
 from .serving_loop import (DECODE_SEGMENT, MAX_PREFILL_CHUNK,
                            PREFILL_BUCKETS, bucket_for as _bucket,
                            chunked_prefill, decode_segments,
-                           finalize_outputs)
+                           finalize_outputs, prompt_budget)
 from .sharding import build_mesh, kv_cache_spec, shard_params
 from .tokenizer import load_tokenizer
 
@@ -259,6 +259,20 @@ class InferenceEngine:
 
         mesh = self.mesh
 
+        # Small program outputs the HOST loop reads (logits rows, token
+        # ids, flags) are pinned REPLICATED: on a multi-host mesh every
+        # process can then np.asarray its addressable copy and all
+        # processes' host loops stay in lockstep — without this, GSPMD
+        # may shard an output across hosts and the read raises. On one
+        # process the constraint is a no-op.
+        from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
+        _rep = _NS(mesh, _P())
+
+        def host_read(*xs):
+            out = tuple(jax.lax.with_sharding_constraint(x, _rep)
+                        for x in xs)
+            return out if len(out) > 1 else out[0]
+
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache_layers, slot_idx, tokens, offsets,
                          lengths):
@@ -277,7 +291,7 @@ class InferenceEngine:
                     for (k, v), (nk, nv) in zip(cache_layers, new_b)]
                 last = jnp.take_along_axis(
                     logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                return last, new_layers
+                return host_read(last), new_layers
 
         self._prefill_step = prefill_step
 
@@ -327,6 +341,8 @@ class InferenceEngine:
             with spmd_mesh(mesh):
                 step, last, valid, done, out, caches, _ = \
                     jax.lax.while_loop(cond, body, state)
+            step, last, valid, done, out = host_read(
+                step, last, valid, done, out)
             return out, step, last, valid, done, caches
 
         def cached_step(params):
@@ -422,7 +438,7 @@ class InferenceEngine:
                     new_pools = scatter_view(pools, tables, new_b, b)
                     last = jnp.take_along_axis(
                         logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                    return last, new_pools
+                    return host_read(last), new_pools
 
             self._prefill_step_paged = prefill_step_paged
 
@@ -860,7 +876,7 @@ class InferenceEngine:
             # uses this to hit exact bucket shapes).
             tokens = (list(prompt) if isinstance(prompt, list)
                       else self.tokenizer.encode(prompt))
-            budget = self.max_seq_len - max_new_padded - 1
+            budget = prompt_budget(self.max_seq_len, max_new_padded)
             if len(tokens) > budget:
                 # Keep the tail — the turn ask and latest transcript live
                 # there (head truncation mirrors context budgeting intent).

@@ -53,7 +53,7 @@ from .engine import GenStats
 from .kvcache import SlotBook
 from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
                            chunked_prefill, decode_segments,
-                           finalize_outputs)
+                           finalize_outputs, prompt_budget)
 from .models.common import (ModelConfig, _einsum, embed_tokens, init_params,
                             make_attention_mask, param_count, rms_norm,
                             transformer_block)
@@ -668,7 +668,7 @@ class PPEngine:
         for name, prompt in turns:
             tokens = (list(prompt) if isinstance(prompt, list)
                       else self.tokenizer.encode(prompt))
-            budget = self.max_seq_len - max_new_padded - 1
+            budget = prompt_budget(self.max_seq_len, max_new_padded)
             if len(tokens) > budget:
                 tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
             slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)

@@ -23,6 +23,23 @@ MAX_PREFILL_CHUNK = 2048
 DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
 
 
+def prompt_budget(max_seq_len: int, max_new_padded: int) -> int:
+    """Prompt-token budget once the padded decode reserve is set aside.
+
+    Raises when fewer than 2 tokens remain — head-truncation keeps
+    [bos] + the last (budget-1) tokens, so budget ≤ 1 would silently
+    collapse every prompt to [bos]: a config error, not a serving
+    condition. One definition for both engines."""
+    budget = max_seq_len - max_new_padded - 1
+    if budget < 2:
+        raise ValueError(
+            f"max_seq_len {max_seq_len} leaves no prompt room after the "
+            f"{max_new_padded}-token decode reserve (segments pad to "
+            f"{DECODE_SEGMENT}) — use max_seq_len > {max_new_padded + 2} "
+            "or lower max_new_tokens")
+    return budget
+
+
 def bucket_for(n: int) -> int:
     for b in PREFILL_BUCKETS:
         if n <= b:
