@@ -23,6 +23,13 @@ collectives) across slices so only DCN-tolerant traffic crosses slices.
 
 Unset ROUNDTABLE_COORDINATOR → no-op, single-process behavior identical
 (this is what the driver's dryrun and the test suite exercise).
+
+Executed, not just hooked: tests/test_distributed.py spawns two real
+processes that form the group, run a TP forward whose model axis spans
+the process boundary, and serve the production engine end to end with
+identical generations on both hosts (host-read program outputs are
+pinned replicated — engine.py host_read — so every process's host loop
+stays in lockstep).
 """
 
 from __future__ import annotations
