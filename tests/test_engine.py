@@ -74,6 +74,34 @@ class TestModelCore:
                          "qwen2.5-1.5b-instruct"):
             assert required in models
 
+    def test_per_row_max_new_tokens(self):
+        """knight_sampling max_new_tokens is a PER-ROW budget: the terse
+        row stops at its own cap (same text as a solo run with that
+        cap), the hungry row keeps decoding past it."""
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        cfg = get_model_config("tiny-llama", max_seq_len=256)
+
+        def build():
+            return InferenceEngine(
+                cfg, num_slots=4, dtype=jnp.float32,
+                sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=12))
+
+        eng = build()
+        terse = SamplingParams(temperature=0.0, max_new_tokens=3)
+        hungry = SamplingParams(temperature=0.0, max_new_tokens=12)
+        outs = eng.generate_batch(
+            [("a", "the quick brown fox"), ("b", "the lazy dog waits")],
+            max_new_tokens=12, sampling_per_turn=[terse, hungry])
+        solo = build()
+        a_solo = solo.generate("the quick brown fox", slot_name="s",
+                               max_new_tokens=3)
+        b_solo = solo.generate("the lazy dog waits", slot_name="s2",
+                               max_new_tokens=12)
+        assert outs[0] == a_solo
+        assert outs[1] == b_solo
+        assert len(outs[1]) > len(outs[0])
+
     def test_cache_too_small_for_decode_reserve_raises(self):
         """max_seq_len ≤ the padded decode reserve used to silently
         truncate every prompt to [bos]; it must be a clear config

@@ -250,9 +250,12 @@ class TestPagedEngineParity:
         state where the next call serves normally (slot records are
         truncated first, so interrupted turns only under-claim)."""
         paged, _ = self._engines(mesh={"data": 1, "model": 1})
+        # >1 decode segment so work is genuinely unfinished at the
+        # deadline check (a single-segment run that completes its whole
+        # budget goes all-done and rightly does NOT time out)
         with pytest.raises(TimeoutError):
             paged.generate("never finishes", slot_name="t",
-                           max_new_tokens=8, timeout_s=0.0)
+                           max_new_tokens=120, timeout_s=0.0)
         p = "recovery prompt after the timeout"
         out = paged.generate(p, slot_name="t", max_new_tokens=8)
         fresh, _ = self._engines(mesh={"data": 1, "model": 1})

@@ -266,9 +266,12 @@ class TestPPPaged:
         not strand the view or corrupt the pool (the try/finally): the
         next call serves normally and matches a fresh engine."""
         paged = build_pp(kv_layout="paged", page_size=32)
+        # >1 decode segment so work is genuinely unfinished at the
+        # deadline check (a completed single-segment run goes all-done
+        # and rightly does not time out)
         with pytest.raises(TimeoutError):
             paged.generate("a prompt that will never finish",
-                           slot_name="t", max_new_tokens=8,
+                           slot_name="t", max_new_tokens=120,
                            timeout_s=0.0)
         assert paged.kc is None and paged.vc is None  # view released
         p = "recovery prompt after the timeout"

@@ -90,6 +90,27 @@ class TestTpuAdapter:
             timeout_ms=600_000)
         assert outs[0] == outs2[0]
 
+    def test_per_knight_max_new_tokens_budget(self):
+        """knight_sampling max_new_tokens is a PER-ROW budget: a terse
+        knight stops at its own cap inside the shared batched round, and
+        a knight configured ABOVE the engine default is not clamped."""
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        cfg = dict(TPU_CFG)
+        cfg["knight_sampling"] = {"Terse": {"max_new_tokens": 2},
+                                  "Epic": {"max_new_tokens": 16}}
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        assert adapter._sampling_for("Terse").max_new_tokens == 2
+        assert adapter._sampling_for("Epic").max_new_tokens == 16
+        outs = adapter.execute_round(
+            [KnightTurn("Terse", "the quick brown fox"),
+             KnightTurn("Epic", "the quick brown fox")],
+            timeout_ms=600_000)
+        # identical prompts, budgets 2 vs 16 (engine default is 8): the
+        # epic knight decodes past both the terse cap AND the default
+        assert len(outs[1]) > len(outs[0])
+        stats = adapter.last_stats()
+        assert stats["decode_tokens"] > 8 + 2  # epic exceeded default
+
     def test_discuss_through_orchestrator_serial(self, project_root):
         config = make_config(parallel=False)
         adapter = create_adapter("tpu-llm", config)

@@ -98,7 +98,10 @@ class TpuLlmAdapter(BaseAdapter):
             temperature=float(cfg.get("temperature", base.temperature)),
             top_k=int(cfg.get("top_k", base.top_k)),
             top_p=float(cfg.get("top_p", base.top_p)),
-            max_new_tokens=base.max_new_tokens)
+            # per-row decode budgets: a terse knight stops at its own
+            # cap while the batch keeps decoding (engine decode_while)
+            max_new_tokens=int(cfg.get("max_new_tokens",
+                                       base.max_new_tokens)))
 
     def execute_round(self, turns: list[KnightTurn],
                       timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
@@ -114,6 +117,11 @@ class TpuLlmAdapter(BaseAdapter):
                       / 1000}
             if per_turn is not None:
                 kwargs["sampling_per_turn"] = per_turn
+                # call-level cap = the LARGEST per-knight budget, so a
+                # knight configured above the engine default isn't
+                # silently clamped (row budgets bound each row below it)
+                kwargs["max_new_tokens"] = max(
+                    p.max_new_tokens for p in per_turn)
             responses, stats = engine.generate_batch_with_stats(
                 [(t.knight_name, t.prompt) for t in turns], **kwargs)
         except Exception as e:  # noqa: BLE001

@@ -296,7 +296,8 @@ class InferenceEngine:
         self._prefill_step = prefill_step
 
         def decode_while(step_fn, caches, first_token, start_valid, key,
-                         budget, temps, top_ks, top_ps, max_new, greedy):
+                         budget, temps, top_ks, top_ps, row_budgets,
+                         max_new, greedy):
             """The decode while_loop, ONCE for all three cache layouts
             (contiguous, paged gather-view, paged pool-direct) —
             `step_fn(last, valid, caches) -> (logits [B,1,V], caches)` is
@@ -304,12 +305,16 @@ class InferenceEngine:
             size (one compiled program per value — always DECODE_SEGMENT
             in serving); budget is the DYNAMIC number of tokens actually
             wanted from this segment, so short tails exit early without a
-            fresh compile. Sampling params are per-ROW dynamic arrays
-            (heterogeneous knight personas; no recompile per sampling
-            config) — except the all-greedy common case, where the STATIC
-            greedy flag keeps the hot path a single argmax instead of two
-            full-vocab sorts + softmax + cumsum per token (one extra
-            compiled variant total, not one per config)."""
+            fresh compile. Sampling params AND per-row token budgets are
+            per-ROW dynamic arrays (heterogeneous knight personas: a row
+            whose own max_new_tokens is exhausted goes done — emitting
+            eos — while hungrier rows keep decoding; no recompile per
+            config) — except the all-greedy common case, where the
+            STATIC greedy flag keeps the hot path a single argmax
+            instead of two full-vocab sorts + softmax + cumsum per token
+            (one extra compiled variant total, not one per config).
+            row_budgets count REMAINING tokens at this segment's start
+            (the host loop decrements across segments)."""
             b = first_token.shape[0]
             out = jnp.zeros((b, max_new), jnp.int32)
             done = jnp.zeros((b,), bool)
@@ -330,7 +335,7 @@ class InferenceEngine:
                     nxt = sample_token_batch(
                         row_logits, sub, temps, top_ks,
                         top_ps).astype(jnp.int32)
-                nxt = jnp.where(done, eos, nxt)
+                nxt = jnp.where(done | (step >= row_budgets), eos, nxt)
                 out = out.at[:, step].set(nxt)
                 new_done = done | (nxt == eos)
                 valid = jnp.where(done, valid, valid + 1)
@@ -356,11 +361,12 @@ class InferenceEngine:
                  static_argnames=("max_new", "greedy"))
         def decode_loop(params, cache_layers, slot_idx, first_token,
                         start_valid, key, budget, temps, top_ks, top_ps,
-                        max_new, greedy):
+                        row_budgets, max_new, greedy):
             caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
             out, step, last, valid, done, caches_b = decode_while(
                 cached_step(params), caches_b, first_token, start_valid,
-                key, budget, temps, top_ks, top_ps, max_new, greedy)
+                key, budget, temps, top_ks, top_ps, row_budgets, max_new,
+                greedy)
             new_layers = [
                 (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
                 for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
@@ -446,13 +452,13 @@ class InferenceEngine:
                      static_argnames=("max_new", "greedy"))
             def decode_loop_paged(params, pools, tables, first_token,
                                   start_valid, key, budget, temps, top_ks,
-                                  top_ps, max_new, greedy):
+                                  top_ps, row_budgets, max_new, greedy):
                 b = first_token.shape[0]
                 caches_b = gather_view(pools, tables, b)
                 out, step, last, valid, done, caches_b = decode_while(
                     cached_step(params), caches_b, first_token,
                     start_valid, key, budget, temps, top_ks, top_ps,
-                    max_new, greedy)
+                    row_budgets, max_new, greedy)
                 new_pools = scatter_view(pools, tables, caches_b, b)
                 return out, step, last, valid, done, new_pools
 
@@ -460,7 +466,8 @@ class InferenceEngine:
                      static_argnames=("max_new", "greedy"))
             def decode_loop_paged_direct(params, pools, tables, first_token,
                                          start_valid, key, budget, temps,
-                                         top_ks, top_ps, max_new, greedy):
+                                         top_ks, top_ps, row_budgets,
+                                         max_new, greedy):
                 from .paged_forward import forward_paged_decode
 
                 def step_fn(last, valid, pools):
@@ -470,7 +477,7 @@ class InferenceEngine:
 
                 return decode_while(
                     step_fn, pools, first_token, start_valid, key, budget,
-                    temps, top_ks, top_ps, max_new, greedy)
+                    temps, top_ks, top_ps, row_budgets, max_new, greedy)
 
             self._decode_loop_paged = (decode_loop_paged_direct
                                        if self.paged_direct
@@ -936,22 +943,29 @@ class InferenceEngine:
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
         tables = (jnp.asarray(self.kv.table_for(names))
                   if self.kv_layout == "paged" else None)
+        # Per-row decode budgets (knight_sampling max_new_tokens): a row
+        # whose own budget is smaller than the batch's stops early (goes
+        # done, emits eos) while the rest keep decoding
+        # (serving_loop.row_budget_fn — one definition for both engines).
+        from .serving_loop import row_budget_fn
+        row_remaining = row_budget_fn(per_row, sampling_per_turn, max_new)
 
         def decode_dispatch(cur_last, cur_valid, budget):
+            row_budgets = row_remaining(budget)
             if tables is not None:
                 out, steps, last, valid, done, self.kv.pools = \
                     self._decode_loop_paged(
                         self.params, self.kv.pools, tables, cur_last,
                         cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, max_new=DECODE_SEGMENT,
-                        greedy=greedy)
+                        top_ks, top_ps, row_budgets,
+                        max_new=DECODE_SEGMENT, greedy=greedy)
             else:
                 out, steps, last, valid, done, self.kv.layers = \
                     self._decode_loop(
                         self.params, self.kv.layers, slot_idx, cur_last,
                         cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, max_new=DECODE_SEGMENT,
-                        greedy=greedy)
+                        top_ks, top_ps, row_budgets,
+                        max_new=DECODE_SEGMENT, greedy=greedy)
             return out, steps, last, valid, done
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,

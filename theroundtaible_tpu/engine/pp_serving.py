@@ -317,15 +317,15 @@ class PPEngine:
                  static_argnames=("max_new", "greedy"))
         def pp_decode(shared, staged, kc, vc, slot_idx, first_token,
                       start_valid, key, budget, temps, top_ks, top_ps,
-                      max_new, greedy):
+                      row_budgets, max_new, greedy):
             b = first_token.shape[0]
             eos = jnp.int32(self.tokenizer.eos_id)
             head = (shared["embedding"] if cfg.tie_embeddings
                     else shared["lm_head"])
 
             def per_stage(staged, kc, vc, first_token, start_valid, key,
-                          budget, temps, top_ks, top_ps, slot_idx,
-                          embedding, head, final_norm):
+                          budget, temps, top_ks, top_ps, row_budgets,
+                          slot_idx, embedding, head, final_norm):
                 stage_layers = jax.tree_util.tree_map(
                     lambda x: x[0], staged)
                 kc_l = jax.lax.pcast(kc[0], (PIPE_AXIS,), to="varying")
@@ -383,7 +383,8 @@ class PPEngine:
                         nxt = sample_token_batch(
                             row_logits, sub, temps, top_ks,
                             top_ps).astype(jnp.int32)
-                    nxt = jnp.where(done, eos, nxt)
+                    nxt = jnp.where(done | (step >= row_budgets), eos,
+                                    nxt)
                     out = out.at[:, step].set(nxt)
                     new_done = done | (nxt == eos)
                     valid = jnp.where(done, valid, valid + 1)
@@ -401,13 +402,13 @@ class PPEngine:
                 per_stage, mesh=mesh,
                 in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
                           P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                          P(), P()),
+                          P(), P(), P()),
                 out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
                            P(PIPE_AXIS), P(PIPE_AXIS)),
                 check_vma=False,
             )(staged, kc, vc, first_token, start_valid, key, budget,
-              temps, top_ks, top_ps, slot_idx, shared["embedding"], head,
-              shared["final_norm"])
+              temps, top_ks, top_ps, row_budgets, slot_idx,
+              shared["embedding"], head, shared["final_norm"])
             return out, step[0], last, valid, done, kc, vc
 
         self._pp_decode = pp_decode
@@ -728,13 +729,19 @@ class PPEngine:
                                     jnp.int32)
 
             t1 = time.monotonic()
+            # Per-row decode budgets (knight_sampling max_new_tokens) —
+            # serving_loop.row_budget_fn, one definition for both engines.
+            from .serving_loop import row_budget_fn
+            row_remaining = row_budget_fn(per_row, sampling_per_turn,
+                                          max_new)
 
             def decode_dispatch(cur_last, valid, budget):
+                row_budgets = row_remaining(budget)
                 out, steps, last, valid, done, self.kc, self.vc = \
                     self._pp_decode(
                         self.shared, self.staged, self.kc, self.vc,
                         slot_idx, cur_last, valid, self._next_key(),
-                        budget, temps, top_ks, top_ps,
+                        budget, temps, top_ks, top_ps, row_budgets,
                         max_new=DECODE_SEGMENT, greedy=greedy)
                 return out, steps, last, valid, done
 

@@ -102,6 +102,29 @@ def chunked_prefill(
     return final_logits
 
 
+def row_budget_fn(per_row, sampling_per_turn, max_new: int) -> Callable:
+    """Per-segment remaining-row-budget closure, shared by both engines.
+
+    Only an EXPLICIT sampling_per_turn carries per-row max_new_tokens
+    budgets (capped by the call-level max_new) — otherwise the call
+    level wins uniformly: the engine-default sampling's budget must not
+    silently cap an explicit call request. The prefill-sampled first
+    token has already consumed one token of every row's budget, hence
+    the -1; `budget` is decode_segments' remaining-global count."""
+    if sampling_per_turn:
+        totals = np.asarray(
+            [min(p.max_new_tokens, max_new) for p in per_row], np.int32)
+    else:
+        totals = np.full(len(per_row), max_new, np.int32)
+
+    def remaining(budget) -> jax.Array:
+        consumed = max_new - int(budget)
+        return jnp.asarray(np.maximum(totals - 1 - consumed, 0),
+                           jnp.int32)
+
+    return remaining
+
+
 def decode_segments(
     dispatch: Callable,
     first_token: jax.Array,
