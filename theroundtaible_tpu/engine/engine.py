@@ -446,7 +446,24 @@ class InferenceEngine:
                         logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
                     return host_read(last), new_pools
 
-            self._prefill_step_paged = prefill_step_paged
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_step_paged_direct(params, pools, tables, tokens,
+                                          offsets, lengths):
+                from .paged_forward import forward_paged
+                with spmd_mesh(mesh):
+                    t = tokens.shape[1]
+                    positions = offsets[:, None] + jnp.arange(t)[None, :]
+                    valid = offsets + lengths
+                    logits, new_pools = forward_paged(
+                        params, cfg, tokens, positions, pools, tables,
+                        valid)
+                    last = jnp.take_along_axis(
+                        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                    return host_read(last), new_pools
+
+            self._prefill_step_paged = (prefill_step_paged_direct
+                                        if self.paged_direct
+                                        else prefill_step_paged)
 
             @partial(jax.jit, donate_argnums=(1,),
                      static_argnames=("max_new", "greedy"))
@@ -468,10 +485,10 @@ class InferenceEngine:
                                          start_valid, key, budget, temps,
                                          top_ks, top_ps, row_budgets,
                                          max_new, greedy):
-                from .paged_forward import forward_paged_decode
+                from .paged_forward import forward_paged
 
                 def step_fn(last, valid, pools):
-                    return forward_paged_decode(
+                    return forward_paged(
                         params, cfg, last[:, None], valid[:, None], pools,
                         tables, valid + 1)
 

@@ -1,20 +1,21 @@
-"""Pool-direct paged decode forward (VERDICT r2 weak #7).
+"""Pool-direct paged serving forward (VERDICT r2 weak #7).
 
-The engine's fallback paged decode gathers `pool[table]` into the same
-position-aligned `[B, S, K, D]` view the contiguous layout uses — layout-
-agnostic and correct, but during a decode segment that view exists
-ALONGSIDE the pool, temporarily recreating the full contiguous HBM
-budget paging exists to avoid, and the gather/scatter traffic scales
-with max_seq_len rather than tokens cached.
+The engine's fallback paged paths gather `pool[table]` into the same
+position-aligned `[B, S, K, D]` view the contiguous layout uses —
+layout-agnostic and correct, but the view exists ALONGSIDE the pool
+(temporarily recreating the full contiguous HBM budget paging exists to
+avoid) and the gather/scatter traffic scales with max_seq_len rather
+than tokens cached, per prefill chunk and per decode segment.
 
-This module serves decode STRAIGHT off the pools: each step scatters the
-new K/V row into its frontier page (`table[b, pos // ps]`, offset
-`pos % ps` — a [B]-row `.at[].set`), then runs
-pallas.paged_decode_attention, whose kv-block index map reads the page
-table and fetches only pages below each row's frontier. All block wiring
-(norms, residuals, MLP, every family flag) comes from
-models/common.transformer_block via its attn_fn hook — the same seam the
-ring/Ulysses cores use — so the math is defined in exactly one place.
+This module serves STRAIGHT off the pools — decode steps AND prefill
+chunks: each layer scatters its K/V into the rows' pages (a [B, T]
+position-indexed `.at[].set`), then attends through the page-table-
+aware kernels (pallas paged_decode_attention / paged_prefill_attention)
+whose kv block index maps read the table and fetch only pages inside
+each row's causal/valid frontier. All block wiring (norms, residuals,
+MLP, every family flag) comes from models/common.transformer_block via
+its attn_fn hook — the same seam the ring/Ulysses cores use — so the
+math is defined in exactly one place.
 
 Write-exclusivity invariant: the engine's ensure_capacity copy-on-writes
 any shared page in a row's write range before dispatch, and distinct
@@ -40,23 +41,27 @@ from .models.common import (ModelConfig, Params, _einsum, _softcap,
 from .pallas import attention as pattn
 
 
-def forward_paged_decode(
+def forward_paged(
     params: Params, cfg: ModelConfig,
-    tokens: jax.Array,            # [B, 1] this step's token ids
-    positions: jax.Array,         # [B, 1] absolute positions (== valid)
+    tokens: jax.Array,            # [B, T] token ids (T==1: decode step)
+    positions: jax.Array,         # [B, T] absolute positions
     pools: list,                  # per-layer (k_pool, v_pool) [P,ps,K,D]
     table: jax.Array,             # [B, pages_per_seq] int32
-    kv_valid_len: jax.Array,      # [B] valid entries AFTER this step
+    kv_valid_len: jax.Array,      # [B] valid entries AFTER this call
 ) -> tuple[jax.Array, list]:
-    """One decode step off the page pools; returns (logits [B,1,V],
-    new_pools). Mirrors models/common.forward, with attention + cache
-    update replaced by the pool-direct path."""
+    """One serving step off the page pools — decode (T==1) or a prefill
+    chunk (T==bucket); returns (logits [B,T,V], new_pools). Mirrors
+    models/common.forward, with attention + cache update replaced by the
+    pool-direct path: each layer scatters its K/V into the rows' pages
+    ([B,T] position-indexed — pad-tail cells land on real decode-reserve
+    pages or the scratch page, both overwritten/ignored before any
+    read, same contract as the gather view) and attends through the
+    page-table-aware kernel."""
     page_size = pools[0][0].shape[1]
-    b = tokens.shape[0]
-    pos = positions[:, 0]                       # [B] write position
-    rows = jnp.arange(b)
-    pages = table[rows, pos // page_size]       # [B] frontier page ids
-    offs = pos % page_size
+    b, t = tokens.shape
+    pages = table[jnp.arange(b)[:, None],
+                  positions // page_size]       # [B, T] page ids
+    offs = positions % page_size
 
     x = embed_tokens(params["embedding"], tokens)
     if cfg.scale_embeddings:
@@ -66,29 +71,47 @@ def forward_paged_decode(
     for layer, (k_pool, v_pool) in zip(params["layers"], pools):
         def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool):
             q, k, v = project_qkv(h, layer, cfg, positions)
-            # [B]-row scatter of this step's K/V into the frontier pages
-            # (each row owns its write page exclusively, see module
-            # docstring), BEFORE the kernel reads the pool.
-            k_pool2 = k_pool.at[pages, offs].set(k[:, 0])
-            v_pool2 = v_pool.at[pages, offs].set(v[:, 0])
+            # Scatter this call's K/V into the rows' pages (write ranges
+            # are exclusive after COW, see module docstring) BEFORE the
+            # kernel reads the pool.
+            k_pool2 = k_pool.at[pages, offs].set(k)
+            v_pool2 = v_pool.at[pages, offs].set(v)
             mesh = current_spmd_mesh()
-            if mesh is not None and mesh.devices.size > 1:
-                out = pattn.paged_decode_spmd(
-                    mesh, q, k_pool2, v_pool2, table, kv_valid_len,
-                    sliding_window=cfg.sliding_window,
-                    softcap=cfg.attn_logit_softcap)
-                if out is None:
-                    # engine.paged_direct gates on spmd_partitionable,
-                    # so this cannot happen in serving — fail loudly for
-                    # direct misuse rather than silently going dense.
-                    raise ValueError(
-                        "paged pool-direct decode requires a head layout "
-                        "that partitions over the model axis")
+            multi = mesh is not None and mesh.devices.size > 1
+            if t == 1:
+                if multi:
+                    out = pattn.paged_decode_spmd(
+                        mesh, q, k_pool2, v_pool2, table, kv_valid_len,
+                        sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
+                else:
+                    out = pattn.paged_decode_attention(
+                        q, k_pool2, v_pool2, table, kv_valid_len,
+                        sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
             else:
-                out = pattn.paged_decode_attention(
-                    q, k_pool2, v_pool2, table, kv_valid_len,
-                    sliding_window=cfg.sliding_window,
-                    softcap=cfg.attn_logit_softcap)
+                if multi:
+                    out = pattn.paged_prefill_spmd(
+                        mesh, q, k_pool2, v_pool2, table,
+                        positions[:, 0], kv_valid_len,
+                        sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
+                else:
+                    out = pattn.paged_prefill_attention(
+                        q, k_pool2, v_pool2, table, positions[:, 0],
+                        kv_valid_len,
+                        sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
+            if out is None:
+                # engine.paged_direct gates on spmd_partitionable and
+                # serving buckets always satisfy the block check, so
+                # this cannot happen in serving — fail loudly for direct
+                # misuse rather than silently going dense.
+                raise ValueError(
+                    "paged pool-direct serving under a multi-device "
+                    "mesh needs a head layout that partitions over the "
+                    f"model axis AND a block-legal chunk (T={t}, "
+                    f"ps={page_size})")
             out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
                 .astype(h.dtype)
             return out, (k_pool2, v_pool2)

@@ -83,6 +83,62 @@ def supported(t: int, s: int, d: int) -> bool:
 # --- prefill kernel ---
 
 
+def _prefill_accumulate(q, k, v, q_start, kv_start, valid, m_scr, l_scr,
+                        acc_scr, *, group: int, block_q: int,
+                        block_kv: int, sliding_window: Optional[int],
+                        softcap: Optional[float]):
+    """One online-softmax accumulation of a q block [G*bq, D] against one
+    kv block [bkv, D] whose first entry holds absolute position kv_start.
+    Shared by the contiguous (_prefill_kernel) and paged
+    (_paged_prefill_kernel) prefill kernels — the two differ ONLY in how
+    the kv block is addressed, so the math lives here once."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [G*bq, bkv]
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
+
+    # positions only depend on the q row WITHIN the block, identical
+    # across the group; build [bq, bkv] then tile over the group rows
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    kv_pos = kv_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    mask = (kv_pos <= q_pos) & (kv_pos < valid)
+    if sliding_window is not None:
+        mask &= kv_pos > q_pos - sliding_window
+    mask = jnp.broadcast_to(mask[None], (group, block_q, block_kv)) \
+        .reshape(group * block_q, block_kv)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:]                                  # [G*bq, LANES]
+    l_prev = l_scr[:]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [G*bq, D]
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+    acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+
+def _prefill_blk_bounds(q_start, valid, block_q: int, block_kv: int,
+                        sliding_window: Optional[int]):
+    """(lo, hi) kv-block bounds for one q block — shared by the kernels
+    and their index maps so the skip logic cannot drift."""
+    hi = jnp.minimum((q_start + block_q - 1) // block_kv,
+                     (valid - 1) // block_kv)
+    if sliding_window is None:
+        lo = jnp.int32(0)
+    else:
+        lo = jnp.maximum(0, (q_start - sliding_window + 1) // block_kv)
+    return lo, hi
+
+
 def _prefill_kernel(offs_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
                     m_scr, l_scr, acc_scr, *, block_q: int, block_kv: int,
                     num_kv_blocks: int, group: int,
@@ -107,50 +163,16 @@ def _prefill_kernel(offs_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
     offs = offs_ref[b]
     valid = valid_ref[b]
     q_start = offs + tb * block_q
-    hi = jnp.minimum((q_start + block_q - 1) // block_kv,
-                     (valid - 1) // block_kv)
-    if sliding_window is None:
-        lo = jnp.int32(0)
-    else:
-        lo = jnp.maximum(0, (q_start - sliding_window + 1) // block_kv)
+    lo, hi = _prefill_blk_bounds(q_start, valid, block_q, block_kv,
+                                 sliding_window)
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        q = q_ref[0, 0].reshape(group * block_q, -1)       # [G*bq, D]
-        k = k_ref[0, 0]                                    # [bkv, D]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [G*bq, bkv]
-        if softcap is not None:
-            s = softcap * jnp.tanh(s / softcap)
-
-        # positions only depend on the q row WITHIN the block, identical
-        # across the group; build [bq, bkv] then tile over the group rows
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0)
-        kv_pos = sb * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1)
-        mask = (kv_pos <= q_pos) & (kv_pos < valid)
-        if sliding_window is not None:
-            mask &= kv_pos > q_pos - sliding_window
-        mask = jnp.broadcast_to(mask[None], (group, block_q, block_kv)) \
-            .reshape(group * block_q, block_kv)
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev = m_scr[:]                                  # [G*bq, LANES]
-        l_prev = l_scr[:]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [G*bq, D]
-        m_scr[:] = m_new
-        l_scr[:] = l_new
-        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+        _prefill_accumulate(
+            q_ref[0, 0].reshape(group * block_q, -1), k_ref[0, 0],
+            v_ref[0, 0], q_start, sb * block_kv, valid, m_scr, l_scr,
+            acc_scr, group=group, block_q=block_q, block_kv=block_kv,
+            sliding_window=sliding_window, softcap=softcap)
 
     @pl.when(sb == num_kv_blocks - 1)
     def _finish():
@@ -195,13 +217,8 @@ def flash_prefill_attention(
 
     def kv_index(bi, khi, tb, sb, offs_ref, valid_ref):
         q_start = offs_ref[bi] + tb * block_q
-        hi_blk = jnp.minimum((q_start + block_q - 1) // block_kv,
-                             (valid_ref[bi] - 1) // block_kv)
-        if sliding_window is None:
-            lo_blk = jnp.int32(0)
-        else:
-            lo_blk = jnp.maximum(
-                0, (q_start - sliding_window + 1) // block_kv)
+        lo_blk, hi_blk = _prefill_blk_bounds(
+            q_start, valid_ref[bi], block_q, block_kv, sliding_window)
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
         return (bi, khi, sb, 0)
 
@@ -235,6 +252,164 @@ def flash_prefill_attention(
         interpret=interpret,
     )(offsets.astype(jnp.int32), kv_valid.astype(jnp.int32), qt, kt, vt)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _paged_prefill_kernel(table_ref, offs_ref, valid_ref, q_ref, k_ref,
+                          v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                          block_q: int, page_size: int,
+                          num_page_blocks: int, group: int,
+                          sliding_window: Optional[int],
+                          softcap: Optional[float]):
+    # Identical math to _prefill_kernel (shared _prefill_accumulate); the
+    # only paged difference lives in the INDEX MAP — the kv block for
+    # grid step sb is pool page table[b, sb].
+    b = pl.program_id(0)
+    tb = pl.program_id(2)
+    sb = pl.program_id(3)
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    offs = offs_ref[b]
+    valid = valid_ref[b]
+    q_start = offs + tb * block_q
+    lo, hi = _prefill_blk_bounds(q_start, valid, block_q, page_size,
+                                 sliding_window)
+
+    @pl.when((sb >= lo) & (sb <= hi))
+    def _compute():
+        _prefill_accumulate(
+            q_ref[0, 0].reshape(group * block_q, -1), k_ref[0, :, 0, :],
+            v_ref[0, :, 0, :], q_start, sb * page_size, valid, m_scr,
+            l_scr, acc_scr, group=group, block_q=block_q,
+            block_kv=page_size, sliding_window=sliding_window,
+            softcap=softcap)
+
+    @pl.when(sb == num_page_blocks - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        d = o_ref.shape[-1]
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype) \
+            .reshape(group, block_q, d)
+
+
+def paged_prefill_supported(t: int, page_size: int, d: int) -> bool:
+    """Can paged_prefill_attention serve this chunk/pool shape?"""
+    if _pick_block(t, (128, 64, 32, 16, 8)) is None:
+        return False
+    return paged_decode_supported(page_size, d)
+
+
+def paged_prefill_attention(
+    q: jax.Array,                 # [B, T, H, D] (pre-scaled, rope'd)
+    k_pool: jax.Array,            # [P, page_size, K, D] page pool
+    v_pool: jax.Array,            # [P, page_size, K, D]
+    table: jax.Array,             # [B, pages_per_seq] int32 page table
+    offsets: jax.Array,           # [B] absolute position of q row start
+    kv_valid: jax.Array,          # [B] valid cache entries per row
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise causal prefill attention straight off the page pool.
+
+    The caller must have scattered this chunk's K/V into the rows'
+    pages already (engine/paged_forward.py); pages below a row's offset
+    may be ALIASED donor pages — the kernel only reads. The kv block
+    index map reads the page table, so only pages inside each q block's
+    causal/window frontier are DMA'd and the [B, S, K, D] gather view is
+    never built. Returns [B, T, H, D] in q's dtype."""
+    b, t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    group = h // kh
+    pages_per_seq = table.shape[1]
+    block_q = _pick_block(t, (128, 64, 32, 16, 8))
+    if block_q is None or not paged_decode_supported(page_size, d):
+        raise ValueError(f"unsupported shapes T={t} ps={page_size} D={d}")
+    interpret = _interpret() if interpret is None else interpret
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b, kh, group, t, d)
+
+    def kv_index(bi, khi, tb, sb, table_ref, offs_ref, valid_ref):
+        q_start = offs_ref[bi] + tb * block_q
+        lo_blk, hi_blk = _prefill_blk_bounds(
+            q_start, valid_ref[bi], block_q, page_size, sliding_window)
+        sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
+        return (table_ref[bi, sb], 0, khi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, kh, t // block_q, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, block_q, d),
+                         lambda bi, khi, tb, sb, t_, o_, v_:
+                         (bi, khi, 0, tb, 0)),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+            pl.BlockSpec((1, page_size, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, block_q, d),
+            lambda bi, khi, tb, sb, t_, o_, v_: (bi, khi, 0, tb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((group * block_q, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_prefill_kernel, block_q=block_q, page_size=page_size,
+        num_page_blocks=pages_per_seq, group=group,
+        sliding_window=sliding_window, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(table.astype(jnp.int32), offsets.astype(jnp.int32),
+      kv_valid.astype(jnp.int32), qt, k_pool, v_pool)
+    return out.reshape(b, kh * group, t, d).transpose(0, 2, 1, 3)
+
+
+def paged_prefill_spmd(
+    mesh,
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+    table: jax.Array, offsets: jax.Array, kv_valid: jax.Array,
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> Optional[jax.Array]:
+    """paged_prefill_attention under a (data, model) mesh — the same
+    partitioning as paged_decode_spmd (kv heads on "model" matching the
+    pool's sharding; table/offsets/valid row-aligned with the batch)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    b, t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    axes_t = _spmd_axes(mesh, h, kh, b)
+    if axes_t is None or not paged_prefill_supported(t, page_size, d):
+        return None
+    batch_ax, head_ax, kv_head_ax = axes_t
+
+    q_spec = P(batch_ax, None, head_ax, None)
+    pool_spec = P(None, None, kv_head_ax, None)
+
+    def body(ql, kp, vp, tl, ol, vl):
+        return paged_prefill_attention(
+            ql, kp, vp, tl, ol, vl, sliding_window=sliding_window,
+            softcap=softcap, interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(q_spec, pool_spec, pool_spec,
+                             P(batch_ax, None), P(batch_ax), P(batch_ax)),
+                   out_specs=q_spec, check_vma=False)
+    return fn(q, k_pool, v_pool, table.astype(jnp.int32),
+              offsets.astype(jnp.int32), kv_valid.astype(jnp.int32))
 
 
 # --- decode kernel ---
