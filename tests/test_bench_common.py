@@ -47,9 +47,12 @@ def test_watchdog_salvages_partial_output_on_timeout(
     script = _fake_child(tmp_path, """
         import sys, time
         print('{"metric": "m", "value": 1}', flush=True)
-        time.sleep(60)
+        time.sleep(300)
     """)
-    rc = bench_common.run_watchdogged(script, [], timeout_s=3.0,
+    # timeout must leave room for interpreter start under full-suite
+    # load (3s flaked when the machine was saturated) while still
+    # expiring long before the child's sleep
+    rc = bench_common.run_watchdogged(script, [], timeout_s=15.0,
                                       attempts=1, retry_delay_s=0.0)
     out = capsys.readouterr().out.strip()
     assert rc == 0
