@@ -297,7 +297,7 @@ class InferenceEngine:
 
         def decode_while(step_fn, caches, first_token, start_valid, key,
                          budget, temps, top_ks, top_ps, row_budgets,
-                         max_new, greedy):
+                         done0, max_new, greedy):
             """The decode while_loop, ONCE for all three cache layouts
             (contiguous, paged gather-view, paged pool-direct) —
             `step_fn(last, valid, caches) -> (logits [B,1,V], caches)` is
@@ -317,7 +317,12 @@ class InferenceEngine:
             (the host loop decrements across segments)."""
             b = first_token.shape[0]
             out = jnp.zeros((b, max_new), jnp.int32)
-            done = jnp.zeros((b,), bool)
+            # done carries ACROSS segments (decode_segments threads it):
+            # rows already at eos / their row budget skip the whole
+            # segment (cond false when all are), instead of decoding
+            # trimmed-away garbage — and the pipelined speculative
+            # segment after an all-done one costs microseconds.
+            done = done0
             eos = jnp.int32(self.tokenizer.eos_id)
 
             def cond(state):
@@ -361,16 +366,29 @@ class InferenceEngine:
                  static_argnames=("max_new", "greedy"))
         def decode_loop(params, cache_layers, slot_idx, first_token,
                         start_valid, key, budget, temps, top_ks, top_ps,
-                        row_budgets, max_new, greedy):
-            caches_b = [(k[slot_idx], v[slot_idx]) for k, v in cache_layers]
-            out, step, last, valid, done, caches_b = decode_while(
-                cached_step(params), caches_b, first_token, start_valid,
-                key, budget, temps, top_ks, top_ps, row_budgets, max_new,
-                greedy)
-            new_layers = [
-                (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
-                for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
-            return out, step, last, valid, done, new_layers
+                        row_budgets, done0, max_new, greedy):
+            # The all-done guard skips the per-layer slot gather/scatter
+            # too (not just the while_loop) — an all-done segment (the
+            # pipelined speculative dispatch's discard case) would
+            # otherwise still copy the batch's whole KV.
+            def run(cache_layers):
+                caches_b = [(k[slot_idx], v[slot_idx])
+                            for k, v in cache_layers]
+                out, step, last, valid, done, caches_b = decode_while(
+                    cached_step(params), caches_b, first_token,
+                    start_valid, key, budget, temps, top_ks, top_ps,
+                    row_budgets, done0, max_new, greedy)
+                new_layers = [
+                    (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
+                    for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
+                return out, step, last, valid, done, new_layers
+
+            def skip(cache_layers):
+                b = first_token.shape[0]
+                return (jnp.zeros((b, max_new), jnp.int32), jnp.int32(0),
+                        first_token, start_valid, done0, cache_layers)
+
+            return jax.lax.cond(jnp.all(done0), skip, run, cache_layers)
 
         self._decode_loop = decode_loop
 
@@ -469,22 +487,35 @@ class InferenceEngine:
                      static_argnames=("max_new", "greedy"))
             def decode_loop_paged(params, pools, tables, first_token,
                                   start_valid, key, budget, temps, top_ks,
-                                  top_ps, row_budgets, max_new, greedy):
+                                  top_ps, row_budgets, done0, max_new,
+                                  greedy):
                 b = first_token.shape[0]
-                caches_b = gather_view(pools, tables, b)
-                out, step, last, valid, done, caches_b = decode_while(
-                    cached_step(params), caches_b, first_token,
-                    start_valid, key, budget, temps, top_ks, top_ps,
-                    row_budgets, max_new, greedy)
-                new_pools = scatter_view(pools, tables, caches_b, b)
-                return out, step, last, valid, done, new_pools
+
+                # All-done guard: skip the full gather view + scatter
+                # (the paged layout's whole-cache copy), not just the
+                # while_loop — see decode_loop.
+                def run(pools):
+                    caches_b = gather_view(pools, tables, b)
+                    out, step, last, valid, done, caches_b = decode_while(
+                        cached_step(params), caches_b, first_token,
+                        start_valid, key, budget, temps, top_ks, top_ps,
+                        row_budgets, done0, max_new, greedy)
+                    new_pools = scatter_view(pools, tables, caches_b, b)
+                    return out, step, last, valid, done, new_pools
+
+                def skip(pools):
+                    return (jnp.zeros((b, max_new), jnp.int32),
+                            jnp.int32(0), first_token, start_valid,
+                            done0, pools)
+
+                return jax.lax.cond(jnp.all(done0), skip, run, pools)
 
             @partial(jax.jit, donate_argnums=(1,),
                      static_argnames=("max_new", "greedy"))
             def decode_loop_paged_direct(params, pools, tables, first_token,
                                          start_valid, key, budget, temps,
                                          top_ks, top_ps, row_budgets,
-                                         max_new, greedy):
+                                         done0, max_new, greedy):
                 from .paged_forward import forward_paged
 
                 def step_fn(last, valid, pools):
@@ -494,7 +525,8 @@ class InferenceEngine:
 
                 return decode_while(
                     step_fn, pools, first_token, start_valid, key, budget,
-                    temps, top_ks, top_ps, row_budgets, max_new, greedy)
+                    temps, top_ks, top_ps, row_budgets, done0, max_new,
+                    greedy)
 
             self._decode_loop_paged = (decode_loop_paged_direct
                                        if self.paged_direct
@@ -967,26 +999,27 @@ class InferenceEngine:
         from .serving_loop import row_budget_fn
         row_remaining = row_budget_fn(per_row, sampling_per_turn, max_new)
 
-        def decode_dispatch(cur_last, cur_valid, budget):
+        def decode_dispatch(cur_last, cur_valid, budget, done0):
             row_budgets = row_remaining(budget)
             if tables is not None:
                 out, steps, last, valid, done, self.kv.pools = \
                     self._decode_loop_paged(
                         self.params, self.kv.pools, tables, cur_last,
                         cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, row_budgets,
+                        top_ks, top_ps, row_budgets, done0,
                         max_new=DECODE_SEGMENT, greedy=greedy)
             else:
                 out, steps, last, valid, done, self.kv.layers = \
                     self._decode_loop(
                         self.params, self.kv.layers, slot_idx, cur_last,
                         cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, row_budgets,
+                        top_ks, top_ps, row_budgets, done0,
                         max_new=DECODE_SEGMENT, greedy=greedy)
             return out, steps, last, valid, done
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,
-                                 max_new, deadline, timeout_s)
+                                 self.tokenizer.eos_id, max_new, deadline,
+                                 timeout_s)
         stats.decode_seconds = time.monotonic() - t1
 
         results = finalize_outputs(

@@ -317,7 +317,7 @@ class PPEngine:
                  static_argnames=("max_new", "greedy"))
         def pp_decode(shared, staged, kc, vc, slot_idx, first_token,
                       start_valid, key, budget, temps, top_ks, top_ps,
-                      row_budgets, max_new, greedy):
+                      row_budgets, done_in, max_new, greedy):
             b = first_token.shape[0]
             eos = jnp.int32(self.tokenizer.eos_id)
             head = (shared["embedding"] if cfg.tie_embeddings
@@ -325,14 +325,16 @@ class PPEngine:
 
             def per_stage(staged, kc, vc, first_token, start_valid, key,
                           budget, temps, top_ks, top_ps, row_budgets,
-                          slot_idx, embedding, head, final_norm):
+                          done_in, slot_idx, embedding, head, final_norm):
                 stage_layers = jax.tree_util.tree_map(
                     lambda x: x[0], staged)
                 kc_l = jax.lax.pcast(kc[0], (PIPE_AXIS,), to="varying")
                 vc_l = jax.lax.pcast(vc[0], (PIPE_AXIS,), to="varying")
                 stage = jax.lax.axis_index(PIPE_AXIS)
                 out0 = jnp.zeros((b, max_new), jnp.int32)
-                done0 = jnp.zeros((b,), bool)
+                # done carries ACROSS segments (decode_segments threads
+                # it) — all-done speculative segments exit at the cond
+                done0 = done_in
 
                 def cond(state):
                     step, _, _, done, _, _, _, _ = state
@@ -402,12 +404,12 @@ class PPEngine:
                 per_stage, mesh=mesh,
                 in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
                           P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                          P(), P(), P()),
+                          P(), P(), P(), P()),
                 out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
                            P(PIPE_AXIS), P(PIPE_AXIS)),
                 check_vma=False,
             )(staged, kc, vc, first_token, start_valid, key, budget,
-              temps, top_ks, top_ps, row_budgets, slot_idx,
+              temps, top_ks, top_ps, row_budgets, done_in, slot_idx,
               shared["embedding"], head, shared["final_norm"])
             return out, step[0], last, valid, done, kc, vc
 
@@ -735,18 +737,19 @@ class PPEngine:
             row_remaining = row_budget_fn(per_row, sampling_per_turn,
                                           max_new)
 
-            def decode_dispatch(cur_last, valid, budget):
+            def decode_dispatch(cur_last, valid, budget, done0):
                 row_budgets = row_remaining(budget)
                 out, steps, last, valid, done, self.kc, self.vc = \
                     self._pp_decode(
                         self.shared, self.staged, self.kc, self.vc,
                         slot_idx, cur_last, valid, self._next_key(),
                         budget, temps, top_ks, top_ps, row_budgets,
-                        max_new=DECODE_SEGMENT, greedy=greedy)
+                        done0, max_new=DECODE_SEGMENT, greedy=greedy)
                 return out, steps, last, valid, done
 
             out_np = decode_segments(decode_dispatch, first, cur_valid,
-                                     max_new, deadline, timeout_s)
+                                     self.tokenizer.eos_id, max_new,
+                                     deadline, timeout_s)
             stats.decode_seconds = time.monotonic() - t1
         finally:
             # Scatter back even on a mid-serve timeout: otherwise the

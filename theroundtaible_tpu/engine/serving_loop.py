@@ -110,17 +110,19 @@ def row_budget_fn(per_row, sampling_per_turn, max_new: int) -> Callable:
     level wins uniformly: the engine-default sampling's budget must not
     silently cap an explicit call request. The prefill-sampled first
     token has already consumed one token of every row's budget, hence
-    the -1; `budget` is decode_segments' remaining-global count."""
+    the -1; `budget` is decode_segments' remaining-global count — kept
+    as DEVICE arithmetic so the pipelined segment queue never forces a
+    host sync."""
     if sampling_per_turn:
         totals = np.asarray(
             [min(p.max_new_tokens, max_new) for p in per_row], np.int32)
     else:
         totals = np.full(len(per_row), max_new, np.int32)
+    totals_dev = jnp.asarray(totals, jnp.int32)
 
     def remaining(budget) -> jax.Array:
-        consumed = max_new - int(budget)
-        return jnp.asarray(np.maximum(totals - 1 - consumed, 0),
-                           jnp.int32)
+        consumed = jnp.int32(max_new) - jnp.asarray(budget, jnp.int32)
+        return jnp.maximum(totals_dev - 1 - consumed, 0)
 
     return remaining
 
@@ -129,6 +131,7 @@ def decode_segments(
     dispatch: Callable,
     first_token: jax.Array,
     start_valid: jax.Array,
+    eos_id: int,
     max_new: int,
     deadline: float,
     timeout_s: float,
@@ -139,26 +142,53 @@ def decode_segments(
     contract is honored). The segment size is ALWAYS DECODE_SEGMENT — a
     variable tail would compile a fresh program per distinct length.
 
-    dispatch(cur_last, cur_valid, budget) → (out, steps, last, valid,
-    done) runs one segment. Returns the concatenated token matrix
-    [B, produced].
+    dispatch(cur_last, cur_valid, budget, done0) → (out, steps, last,
+    valid, done) runs one segment; budget may be a DEVICE scalar, done0
+    is the [B] done mask carried ACROSS segments (rows at eos / their
+    row budget skip further decode). Returns the concatenated token
+    matrix [B, produced].
+
+    PIPELINED: the next segment is queued from the previous segment's
+    DEVICE outputs (budget decremented and done carried with device
+    arithmetic) BEFORE the host reads steps/out/done — so the device
+    never idles for the host round-trips between segments (material on
+    a high-RTT tunnel). When the just-read segment turns out to have
+    finished the generation, the speculative segment's while_loop
+    condition is false on entry and it costs microseconds; its results
+    are discarded.
     """
     b = first_token.shape[0]
-    cur_last, cur_valid = first_token, start_valid
     segments: list[np.ndarray] = []
     produced = 0
-    all_done = False
-    while produced < max_new and not all_done:
-        out, steps, cur_last, cur_valid, done = dispatch(
-            cur_last, cur_valid, jnp.int32(max_new - produced))
+    budget_dev = jnp.int32(max_new)
+    first_done = first_token == jnp.int32(eos_id)
+    cur = dispatch(first_token, start_valid, budget_dev, first_done)
+    while True:
+        out, steps, last, valid, done = cur
+        budget_dev = budget_dev - steps
+        # Speculative queue while the device results are still in flight
+        # — but never past the deadline (the host clock is already known;
+        # queuing after it would run a whole wasted segment the timeout
+        # then waits on). `produced` lags the just-computed segment, so
+        # the bound is an upper bound on "more work possible"; the
+        # discard case skips the loop body via the carried done mask
+        # (and the gather/scatter around it via the engines' all-done
+        # cond), costing microseconds.
+        timed_out = time.monotonic() > deadline
+        nxt = (dispatch(last, valid, budget_dev, done)
+               if produced + DECODE_SEGMENT < max_new and not timed_out
+               else None)
         steps_n = int(steps)  # forces completion of the segment
         segments.append(np.asarray(out)[:, :steps_n])
         produced += steps_n
         all_done = bool(np.all(np.asarray(done)))
-        if time.monotonic() > deadline and not all_done:
+        if produced >= max_new or all_done:
+            break
+        if timed_out:
             raise TimeoutError(
                 f"generation timed out after {timeout_s:.0f}s "
                 f"({produced}/{max_new} tokens)")
+        cur = nxt
     return (np.concatenate(segments, axis=1) if segments
             else np.zeros((b, 0), np.int32))
 
