@@ -37,10 +37,12 @@ class TestPartitionDevices:
 
 
 class TestEstimateParams:
-    def test_matches_real_count(self):
+    @pytest.mark.parametrize("model", ["tiny-llama", "tiny-qwen",
+                                       "tiny-mixtral"])
+    def test_matches_real_count(self, model):
         from theroundtaible_tpu.engine.models.common import (
             init_params, param_count)
-        cfg = get_model_config("tiny-llama")
+        cfg = get_model_config(model)
         est = estimate_param_count(cfg)
         real = param_count(init_params(cfg, jax.random.PRNGKey(0)))
         assert abs(est - real) / real < 0.01

@@ -34,6 +34,8 @@ def estimate_param_count(cfg: ModelConfig) -> int:
     if cfg.num_experts:
         mlp = cfg.num_experts * 3 * e * f + e * cfg.num_experts  # + router
     per_layer = 2 * e * h * d + 2 * e * k * d + mlp + 2 * e
+    if cfg.attn_bias:  # Qwen2: q/k/v projection biases
+        per_layer += h * d + 2 * k * d
     total = cfg.num_layers * per_layer + cfg.vocab_size * e + e
     if not cfg.tie_embeddings:
         total += cfg.vocab_size * e
