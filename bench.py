@@ -149,11 +149,22 @@ def child() -> int:
                             max_new_tokens=decode_tokens)
         engine.kv.release("__bench_warmup")
         warmup_s = time.monotonic() - t_warm
-        # Measured run on a fresh slot (no prefix reuse → honest prefill).
-        t0 = time.monotonic()
-        engine.generate(PROMPT, slot_name="bench",
-                        max_new_tokens=decode_tokens)
-        wall = time.monotonic() - t0
+        # Median-of-3 measured runs, each on a freshly released slot (no
+        # prefix reuse → honest prefill every repeat). Warmup dominates
+        # cold-start cost; the extra two timed runs add only seconds.
+        from bench_common import timed_repeats
+
+        def run_once() -> dict:
+            engine.kv.release("bench")
+            t0 = time.monotonic()
+            engine.generate(PROMPT, slot_name="bench",
+                            max_new_tokens=decode_tokens)
+            wall = time.monotonic() - t0
+            s = engine.last_stats
+            return {"decode_tps": s.decode_tps,
+                    "prefill_tps": s.prefill_tps, "wall_s": wall}
+
+        med, spread, repeats = timed_repeats(run_once)
         s = engine.last_stats
         label = "bf16" if quant == "none" else quant
         if kv_layout == "paged":
@@ -162,14 +173,21 @@ def child() -> int:
             "label": label,
             "quant": quant,
             "kv_layout": kv_layout,
-            "decode_tps": round(s.decode_tps, 2),
-            "prefill_tps": round(s.prefill_tps, 1),
+            "decode_tps": round(med["decode_tps"], 2),
+            "prefill_tps": round(med["prefill_tps"], 1),
             "prefill_tokens": s.prefill_tokens,
             "decode_tokens": s.decode_tokens,
-            "wall_s": round(wall, 2),
+            "wall_s": round(med["wall_s"], 2),
             "build_s": round(build_s, 1),
             "warmup_s": round(warmup_s, 1),
             "param_bytes": param_bytes,
+            "repeats": repeats,
+            "spread": {
+                "decode_tps": [round(spread["decode_tps"][0], 2),
+                               round(spread["decode_tps"][1], 2)],
+                "prefill_tps": [round(spread["prefill_tps"][0], 1),
+                                round(spread["prefill_tps"][1], 1)],
+            },
         }
         if not on_cpu:
             # Aggregate ceilings: with TP over n chips each chip streams
@@ -181,8 +199,10 @@ def child() -> int:
                                 / (2.0 * engine.num_params))
             run["roofline"] = {
                 "decode_ceiling_tps": round(decode_ceiling_tps, 1),
-                "decode_frac": round(s.decode_tps / decode_ceiling_tps, 3),
-                "prefill_mfu": round(s.prefill_tps / prefill_peak_tps, 3),
+                "decode_frac": round(
+                    run["decode_tps"] / decode_ceiling_tps, 3),
+                "prefill_mfu": round(
+                    run["prefill_tps"] / prefill_peak_tps, 3),
                 "assumptions": "decode: HBM 819 GB/s / streamed param "
                                "bytes (KV traffic excluded); prefill: "
                                "2·params FLOPs/token vs 197 bf16 TFLOP/s",

@@ -68,6 +68,27 @@ print(json.dumps({"probe": "ok", "platform": ds[0].platform,
 """
 
 
+def timed_repeats(run_once, n: int = 3):
+    """Median-of-n measurement with spread (VERDICT r3 weak #3: the same
+    bf16 program measured 100.7 then 79.0 tok/s across tunnel sessions,
+    so a single shot cannot separate a real ~10% change from noise).
+
+    ``run_once()`` performs one fully timed measurement and returns a
+    flat dict of float samples (e.g. ``{"decode_tps": ..., "wall_s":
+    ...}``). Returns ``(medians, spread, n)`` where ``medians`` maps each
+    key to its median across the n runs and ``spread`` maps each key to
+    ``[min, max]``. Call sites own rounding and any per-run warmup or
+    slot-release discipline inside ``run_once``."""
+    import statistics
+
+    samples = [run_once() for _ in range(n)]
+    keys = samples[0].keys()
+    medians = {k: statistics.median(s[k] for s in samples) for k in keys}
+    spread = {k: [min(s[k] for s in samples), max(s[k] for s in samples)]
+              for k in keys}
+    return medians, spread, n
+
+
 def install_sigterm_exit() -> None:
     """Make SIGTERM exit via SystemExit so finally/atexit (and the PJRT
     claim release) run during the watchdog's grace period. Call first
@@ -143,9 +164,13 @@ def _tunnel_vouched() -> bool:
 
 
 def _stream_child(cmd: list[str], timeout_s: float,
-                  emitted_keys: set[str]):
+                  emitted_keys: set[str], attempt: int = 1):
     """Run `cmd`, FORWARDING each JSON line to stdout the moment it
-    arrives (deduplicated by metric key across attempts). Returns
+    arrives (deduplicated by metric key across attempts). Each record is
+    stamped with the attempt number that produced it, so downstream
+    analysis can spot a value that landed just before a failed attempt
+    died (first-emitted-wins dedup would otherwise hide that a clean
+    retry never got to re-measure the key). Returns
     (rc|None, n_forwarded, stderr, timed_out). Timed-out children get
     SIGTERM + grace, then SIGKILL."""
     import threading
@@ -162,7 +187,8 @@ def _stream_child(cmd: list[str], timeout_s: float,
             if not (line.startswith("{") and line.endswith("}")):
                 continue
             try:
-                key = json.loads(line).get("metric")
+                rec = json.loads(line)
+                key = rec.get("metric")
             except ValueError:
                 continue
             # Lines without a metric field (metadata/context records)
@@ -171,6 +197,9 @@ def _stream_child(cmd: list[str], timeout_s: float,
                 if key in emitted_keys:
                     continue
                 emitted_keys.add(key)
+            if isinstance(rec, dict) and key is not None:
+                rec["attempt"] = attempt
+                line = json.dumps(rec)
             forwarded += 1
             print(line, flush=True)
 
@@ -217,29 +246,43 @@ def run_watchdogged(script_path: str, child_args: list[str],
     1 otherwise."""
     global _tunnel_ok_at
     name = script_path.rsplit("/", 1)[-1]
+    # bench_suite runs one watchdogged child per sub-bench; the status
+    # key must distinguish them or two failing sub-benches collide on
+    # one metric key under per-key parsers.
+    bench_id = name if not child_args else f"{name} {' '.join(child_args)}"
     emitted_keys: set[str] = set()
+    failure_reason = "bench_failed"
+    last_err_tail = ""
 
     for attempt in range(1, attempts + 1):
         if not _tunnel_vouched() and not probe_tunnel():
             print(f"{name}: tunnel probe failed — not starting the heavy "
                   "child (nothing to measure, nothing to wedge)",
                   file=sys.stderr)
+            failure_reason = "tunnel_dead"
+            # Any stderr remembered from an earlier attempt's child
+            # belongs to that child, not to this probe failure.
+            last_err_tail = ""
             break
         rc, forwarded, err, timed_out = _stream_child(
             [sys.executable, script_path, *child_args, "--child"],
-            timeout_s, emitted_keys)
+            timeout_s, emitted_keys, attempt)
         if rc == 0 and (emitted_keys or forwarded):
             _tunnel_ok_at = time.monotonic()
             return 0
         # Any failure invalidates the memo: the next attempt re-probes.
         _tunnel_ok_at = None
         if timed_out:
+            failure_reason = "bench_timeout"
+            last_err_tail = err[-400:]
             print(f"{name} attempt {attempt}: timed out after "
                   f"{timeout_s:.0f}s — terminated; {forwarded} line(s) "
                   "already forwarded", file=sys.stderr)
         else:
+            failure_reason = "bench_error" if rc != 0 else "bench_no_records"
+            last_err_tail = err[-400:]
             print(f"{name} attempt {attempt}: rc={rc} "
-                  f"stderr tail: {err[-400:]}", file=sys.stderr)
+                  f"stderr tail: {last_err_tail}", file=sys.stderr)
         if attempt < attempts:
             time.sleep(retry_delay_s)
     if emitted_keys:
@@ -247,5 +290,35 @@ def run_watchdogged(script_path: str, child_args: list[str],
               f"{len(emitted_keys)} record(s) were forwarded live",
               file=sys.stderr)
         return 0
-    print(f"{name}: all attempts failed", file=sys.stderr)
+    # A dead tunnel must still produce a parseable record (VERDICT r3
+    # missing #2: three rounds of `parsed: null` left the driver artifact
+    # unable to distinguish "tunnel dead" from "bench broken"). This is a
+    # status record, not a measurement — value 0.0, vs_baseline null —
+    # but it carries machine-readable cause so the capture is never empty.
+    print(json.dumps({
+        "metric": f"bench_status[{bench_id}]",
+        "value": 0.0,
+        "unit": "status",
+        "vs_baseline": None,
+        "status": failure_reason,
+        "detail": {
+            "bench": bench_id,
+            "reason": failure_reason,
+            "explanation": {
+                "tunnel_dead": "device-liveness probe (import jax; "
+                               "jax.devices()) hung or failed — the "
+                               "heavy bench child was never started",
+                "bench_timeout": "tunnel probe succeeded but the bench "
+                                 "child exceeded its timeout",
+                "bench_error": "tunnel probe succeeded but the bench "
+                               "child exited nonzero",
+                "bench_no_records": "bench child exited 0 without "
+                                    "emitting any JSON record",
+                "bench_failed": "no attempt ran",
+            }[failure_reason],
+            "stderr_tail": last_err_tail,
+        },
+    }), flush=True)
+    print(f"{name}: all attempts failed ({failure_reason})",
+          file=sys.stderr)
     return 1

@@ -93,17 +93,23 @@ def bench_fleet() -> dict:
     # layout so the next dispatch would recompile; the second pass
     # reaches the layout fixpoint. One warm pass here measured 26s for a
     # 2s round — all recompiles.
+    from bench_common import timed_repeats
     with ThreadPoolExecutor(max_workers=3) as pool:
         for _ in range(2):
             for i, e in enumerate(engines):
                 e.kv.release(f"knight-{i}")
             list(pool.map(turn, enumerate(engines)))
-        for i, e in enumerate(engines):
-            e.kv.release(f"knight-{i}")
-        t0 = time.monotonic()
-        outs = list(pool.map(turn, enumerate(engines)))
-        wall = time.monotonic() - t0
-    assert len(outs) == 3
+
+        def run_once() -> dict:
+            for i, e in enumerate(engines):
+                e.kv.release(f"knight-{i}")
+            t0 = time.monotonic()
+            outs = list(pool.map(turn, enumerate(engines)))
+            assert len(outs) == 3
+            return {"wall_s": time.monotonic() - t0}
+
+        med, spread, repeats = timed_repeats(run_once)
+    wall = med["wall_s"]
     decode_tokens = sum(e.last_stats.decode_tokens for e in engines)
     return {
         "metric": "fleet_round_wall_clock_3models",
@@ -114,6 +120,9 @@ def bench_fleet() -> dict:
             "models": models,
             "submeshes": [c.get("devices") for c in configs],
             "decode_tokens": decode_tokens,
+            "repeats": repeats,
+            "spread": {"wall_s": [round(spread["wall_s"][0], 3),
+                                  round(spread["wall_s"][1], 3)]},
             "platform": jax.devices()[0].platform,
         },
     }
@@ -147,23 +156,37 @@ def bench_summon() -> dict:
     # Warm on the FULL prompt (compiles the exact buckets the measured
     # run hits — bench.py's minimal-warmup discipline), then measure on
     # a fresh slot.
+    from bench_common import timed_repeats
     for _ in range(2):
         engine.kv.release("warm")
         engine.generate(prompt, slot_name="warm", max_new_tokens=8)
+
+    # Without this release the resident warm slot donates its prefix
+    # (share_prefixes) and the "measured" prefill is one token.
     engine.kv.release("warm")
-    t0 = time.monotonic()
-    engine.generate(prompt, slot_name="summon", max_new_tokens=32)
-    wall = time.monotonic() - t0
+
+    def run_once() -> dict:
+        engine.kv.release("summon")
+        t0 = time.monotonic()
+        engine.generate(prompt, slot_name="summon", max_new_tokens=32)
+        return {"prefill_tps": engine.last_stats.prefill_tps,
+                "wall_s": time.monotonic() - t0}
+
+    med, spread, repeats = timed_repeats(run_once)
     s = engine.last_stats
+    prefill_tps = med["prefill_tps"]
     return {
         "metric": "summon_long_prefill_tokens_per_sec",
-        "value": round(s.prefill_tps, 1),
+        "value": round(prefill_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(s.prefill_tps / SUMMON_PREFILL_ANCHOR_TPS, 3),
+        "vs_baseline": round(prefill_tps / SUMMON_PREFILL_ANCHOR_TPS, 3),
         "detail": {
             "prefill_tokens": s.prefill_tokens,
             "diff_lines": len(lines),
-            "wall_s": round(wall, 2),
+            "wall_s": round(med["wall_s"], 2),
+            "repeats": repeats,
+            "spread": {"prefill_tps": [round(spread["prefill_tps"][0], 1),
+                                       round(spread["prefill_tps"][1], 1)]},
             "platform": jax.devices()[0].platform,
         },
     }
@@ -184,19 +207,32 @@ def bench_apply() -> dict:
     prompt = ("Consensus decision: rewrite the session store as an "
               "append-only event log. Emit the full RTDIFF/1 patch for "
               "every file in scope. " * 4)
-    engine.generate(prompt, slot_name="warm", max_new_tokens=max_new)
-    t0 = time.monotonic()
-    engine.generate(prompt, slot_name="apply", max_new_tokens=max_new)
-    wall = time.monotonic() - t0
+    from bench_common import timed_repeats
+    for _ in range(2):
+        engine.kv.release("warm")
+        engine.generate(prompt, slot_name="warm", max_new_tokens=max_new)
+
+    def run_once() -> dict:
+        engine.kv.release("apply")
+        t0 = time.monotonic()
+        engine.generate(prompt, slot_name="apply", max_new_tokens=max_new)
+        return {"decode_tps": engine.last_stats.decode_tps,
+                "wall_s": time.monotonic() - t0}
+
+    med, spread, repeats = timed_repeats(run_once)
     s = engine.last_stats
+    decode_tps = med["decode_tps"]
     return {
         "metric": "apply_long_decode_tokens_per_sec",
-        "value": round(s.decode_tps, 2),
+        "value": round(decode_tps, 2),
         "unit": "tokens/s",
-        "vs_baseline": round(s.decode_tps / APPLY_DECODE_ANCHOR_TPS, 3),
+        "vs_baseline": round(decode_tps / APPLY_DECODE_ANCHOR_TPS, 3),
         "detail": {
             "decode_tokens": s.decode_tokens,
-            "wall_s": round(wall, 2),
+            "wall_s": round(med["wall_s"], 2),
+            "repeats": repeats,
+            "spread": {"decode_tps": [round(spread["decode_tps"][0], 2),
+                                      round(spread["decode_tps"][1], 2)]},
             "quant": cfg["quant"],
             "platform": jax.devices()[0].platform,
         },
