@@ -190,7 +190,7 @@ class TestPPConfigValidation:
     def test_extra_mesh_axes_raise(self):
         with pytest.raises(ValueError, match="mesh axes"):
             PPEngine.from_config(
-                self._cfg(mesh={"pipe": 2, "model": 2}))
+                self._cfg(mesh={"pipe": 2, "data": 2}))
 
     def test_seq_parallel_raises(self):
         with pytest.raises(ValueError, match="seq_parallel"):
@@ -200,6 +200,82 @@ class TestPPConfigValidation:
         with pytest.warns(UserWarning, match="dense attention"):
             eng = PPEngine.from_config(self._cfg(attn="flash"))
         assert eng.cfg.attn_impl == "dense"
+
+
+class TestPPTensorParallel:
+    """mesh={"pipe": N, "model": M} — TP inside each pipeline stage
+    (SURVEY §2.3's (pipeline, tensor, data) split; VERDICT r3 missing
+    #3). The PP programs stay shard_map-manual over "pipe" while "model"
+    is an auto axis: staged leaves carry param_specs' TP shardings
+    shifted past the two stacking dims, and XLA inserts the in-stage TP
+    collectives — so serving must stay token-identical to both the
+    pipe-only PP engine and the main engine."""
+
+    PROMPTS = [("a", "the knights debate tensor parallel stages today"),
+               ("b", "a second, longer question about memory layouts")]
+
+    def _pp(self, **kw):
+        return PPEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            n_stages=2, n_model=2, n_micro=2, num_slots=4,
+            dtype=jnp.float32, seed=3,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12),
+            **kw)
+
+    def _ref(self, **kw):
+        return InferenceEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            mesh_shape={"data": 1, "model": 1}, num_slots=4,
+            dtype=jnp.float32, seed=3,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12),
+            **kw)
+
+    def test_batch_matches_reference(self):
+        pp, ref = self._pp(), self._ref()
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == ref.generate_batch(self.PROMPTS, max_new_tokens=12))
+        assert pp.last_stats.decode_tokens > 0  # non-trivial decode
+
+    def test_staged_leaves_are_tp_sharded(self):
+        """The memory property PP x TP exists for: a stage's weight leaf
+        is additionally split over the model axis (not replicated)."""
+        pp = self._pp()
+        specs = [x.sharding.spec for x in
+                 jax.tree_util.tree_leaves(pp.staged)]
+        assert any("model" in [a for a in spec if isinstance(a, str)]
+                   for spec in specs)
+        # kv-head dim of the cache shards over model too (2 kv heads / 2)
+        kc_spec = tuple(pp.kc.sharding.spec)
+        assert kc_spec[0] == "pipe" and kc_spec[4] == "model"
+
+    def test_int8_matches_reference(self):
+        pp, ref = self._pp(quant="int8"), self._ref(quant="int8")
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == ref.generate_batch(self.PROMPTS, max_new_tokens=12))
+
+    def test_paged_matches_reference(self):
+        pp, ref = self._pp(kv_layout="paged"), self._ref()
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == ref.generate_batch(self.PROMPTS, max_new_tokens=12))
+
+    def test_slot_reuse_across_turns(self):
+        pp = self._pp()
+        base = "round one says the store needs an event log."
+        pp.generate(base, slot_name="k", max_new_tokens=8)
+        pp.generate(base + " round two asks for sizing.", slot_name="k",
+                    max_new_tokens=8)
+        assert pp.last_stats.reused_tokens > 0
+
+    def test_from_config_and_describe(self):
+        eng = PPEngine.from_config(
+            {"model": "tiny-gemma", "max_seq_len": 256,
+             "mesh": {"pipe": 2, "model": 2}, "dtype": "float32",
+             "sampling": {"temperature": 0.0, "max_new_tokens": 4}})
+        d = eng.describe()
+        assert d["mesh"] == {"pipe": 2, "model": 2}
+        assert len(d["devices"]) == 4
+        assert eng.generate("hello", slot_name="s", max_new_tokens=4) \
+            is not None
 
 
 class TestPPPaged:

@@ -36,12 +36,25 @@ from .models.common import (
 PIPE_AXIS = "pipe"
 
 
-def build_pipe_mesh(n_stages: int, devices: Optional[list] = None) -> Mesh:
+def build_pipe_mesh(n_stages: int, devices: Optional[list] = None,
+                    n_model: int = 1) -> Mesh:
+    """(pipe,) mesh, or a (pipe, model) mesh when n_model > 1 — each
+    stage's weights then shard over a TP group of n_model devices (the
+    SURVEY §2.3 "(pipeline, tensor, data)" axis split; PP programs stay
+    manual over "pipe" and leave "model" to the compiler, so the same
+    stage code serves both shapes)."""
     import numpy as np
     devices = devices if devices is not None else jax.devices()
-    if len(devices) < n_stages:
-        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
-    return Mesh(np.array(devices[:n_stages]), (PIPE_AXIS,))
+    need = n_stages * n_model
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices "
+                         f"(pipe {n_stages} x model {n_model}), "
+                         f"have {len(devices)}")
+    if n_model == 1:
+        return Mesh(np.array(devices[:n_stages]), (PIPE_AXIS,))
+    from .sharding import MODEL_AXIS
+    return Mesh(np.array(devices[:need]).reshape(n_stages, n_model),
+                (PIPE_AXIS, MODEL_AXIS))
 
 
 def stack_stage_params(params: Params, cfg: ModelConfig, n_stages: int,
@@ -49,30 +62,54 @@ def stack_stage_params(params: Params, cfg: ModelConfig, n_stages: int,
     """Split the per-layer param list into n_stages contiguous stages.
 
     Returns (shared, staged): `shared` = embedding/final_norm/lm_head
-    replicated on every stage; `staged` = each layer tensor stacked to
-    [n_stages, layers_per_stage, ...] and sharded on the leading stage
-    axis, so each pipe device holds exactly its own layers.
+    (replicated over the pipe axis; sharded over the model axis per
+    sharding.param_specs when the mesh has one); `staged` = each layer
+    tensor stacked to [n_stages, layers_per_stage, ...], sharded on the
+    leading stage axis so each pipe device holds exactly its own layers
+    — and, on a (pipe, model) mesh, TP-sharded inside the stage on the
+    same dims the main engine shards (param_specs shifted by the two
+    stacking dims). Quantized {"q","s"} leaves place via
+    quant.quantized_specs. Any dim that doesn't divide its mesh axis
+    falls back to replication (sharding._fallback_replicated).
     """
     if cfg.num_layers % n_stages != 0:
         raise ValueError(
             f"{cfg.num_layers} layers do not split into {n_stages} stages")
     per = cfg.num_layers // n_stages
 
+    from .quant import quantized, quantized_specs
+    from .sharding import _fallback_replicated, param_specs
+    specs = param_specs(cfg)
+    if any(quantized(l) for l in
+           jax.tree_util.tree_leaves(params, is_leaf=quantized)):
+        specs = quantized_specs(specs)
+    has_model = len(mesh.axis_names) > 1
+
     stacked = jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves).reshape(
             (n_stages, per) + leaves[0].shape),
         *params["layers"])
+
+    def stage_place(x, spec):
+        tp = tuple(spec) if has_model else ()
+        full = P(PIPE_AXIS, None, *tp)
+        return NamedSharding(mesh,
+                             _fallback_replicated(full, x.shape, mesh))
+
     staged = jax.device_put(
         stacked,
-        jax.tree_util.tree_map(
-            lambda x: NamedSharding(
-                mesh, P(PIPE_AXIS, *(None,) * (x.ndim - 1))),
-            stacked))
+        jax.tree_util.tree_map(stage_place, stacked, specs["layers"][0]))
+
+    def shared_place(x, spec):
+        full = spec if has_model else P()
+        return NamedSharding(mesh,
+                             _fallback_replicated(full, x.shape, mesh))
 
     shared = {k: v for k, v in params.items() if k != "layers"}
+    shared_specs = {k: specs.get(k, jax.tree_util.tree_map(
+        lambda _: P(), v)) for k, v in shared.items()}
     shared = jax.device_put(
-        shared, jax.tree_util.tree_map(
-            lambda x: NamedSharding(mesh, P()), shared))
+        shared, jax.tree_util.tree_map(shared_place, shared, shared_specs))
     return shared, staged
 
 

@@ -66,7 +66,8 @@ class PPEngine:
     """Pipeline-parallel serving engine (stage-local weights AND KV)."""
 
     def __init__(self, model_cfg: ModelConfig, *, checkpoint: str = "",
-                 n_stages: int = 2, n_micro: int = 2, num_slots: int = 4,
+                 n_stages: int = 2, n_model: int = 1, n_micro: int = 2,
+                 num_slots: int = 4,
                  dtype=jnp.bfloat16, quant: str = "none",
                  kv_layout: str = "contiguous", page_size: int = 128,
                  num_pages: Optional[int] = None,
@@ -92,12 +93,19 @@ class PPEngine:
         self.sampling = sampling or SamplingParams()
         self.tokenizer = load_tokenizer(checkpoint or None)
         self.n_stages = n_stages
+        self.n_model = n_model
         self.n_micro = n_micro
         device_list = None
         if devices:
             all_devices = jax.devices()
             device_list = [all_devices[i] for i in devices]
-        self.mesh = build_pipe_mesh(n_stages, device_list)
+        # n_model > 1: a (pipe, model) mesh — each stage's weights/KV
+        # shard over a TP group. The PP programs are shard_map-manual
+        # over "pipe" only (axis_names below); "model" stays an auto
+        # axis, so XLA inserts the same TP collectives inside each stage
+        # that the main engine's jit path gets from param PartitionSpecs
+        # (SURVEY §2.3's (pipeline, tensor, data) requirement).
+        self.mesh = build_pipe_mesh(n_stages, device_list, n_model)
 
         if checkpoint:
             from .checkpoint import load_hf_checkpoint
@@ -120,8 +128,18 @@ class PPEngine:
             params, model_cfg, n_stages, self.mesh)
 
         per = model_cfg.num_layers // n_stages
-        cache_sharding = NamedSharding(
-            self.mesh, P(PIPE_AXIS, None, None, None, None, None))
+        # Caches [st, per, slots|pages, S|ps, K, D]: stage axis over
+        # "pipe"; on a (pipe, model) mesh the KV-head dim additionally
+        # shards over "model" (falling back to replicated when K doesn't
+        # divide, e.g. MQA) — same layout rule as kv_cache_spec.
+        from .sharding import MODEL_AXIS, _fallback_replicated
+        kv_spec = P(PIPE_AXIS, None, None, None,
+                    MODEL_AXIS if n_model > 1 else None, None)
+
+        def cache_sharding_for(shape):
+            return NamedSharding(
+                self.mesh, _fallback_replicated(kv_spec, shape, self.mesh))
+
         self.kv_layout = kv_layout
         kd = (model_cfg.num_kv_heads, model_cfg.head_dim)
         if kv_layout == "paged":
@@ -137,10 +155,9 @@ class PPEngine:
 
             def pool_factory(n_pages):
                 shape = (n_stages, per, n_pages, page_size) + kd
-                return [(jax.device_put(jnp.zeros(shape, dtype),
-                                        cache_sharding),
-                         jax.device_put(jnp.zeros(shape, dtype),
-                                        cache_sharding))]
+                sh = cache_sharding_for(shape)
+                return [(jax.device_put(jnp.zeros(shape, dtype), sh),
+                         jax.device_put(jnp.zeros(shape, dtype), sh))]
 
             @partial(jax.jit, donate_argnums=(0,))
             def copy_pages(pools, src_ids, dst_ids):
@@ -187,10 +204,9 @@ class PPEngine:
         else:
             cache_shape = (n_stages, per, num_slots,
                            self.max_seq_len) + kd
-            self.kc = jax.device_put(jnp.zeros(cache_shape, dtype),
-                                     cache_sharding)
-            self.vc = jax.device_put(jnp.zeros(cache_shape, dtype),
-                                     cache_sharding)
+            sh = cache_sharding_for(cache_shape)
+            self.kc = jax.device_put(jnp.zeros(cache_shape, dtype), sh)
+            self.vc = jax.device_put(jnp.zeros(cache_shape, dtype), sh)
             self.kv = SlotBook(num_slots)
 
         self._key = jax.random.PRNGKey(seed + 1)
@@ -295,6 +311,9 @@ class PPEngine:
                 in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
                           P(), P(), P(), P()),
                 out_specs=(P(), P(PIPE_AXIS), P(PIPE_AXIS)),
+                # Manual over "pipe" only; any "model" axis stays auto so
+                # XLA inserts the in-stage TP collectives itself.
+                axis_names={PIPE_AXIS},
                 check_vma=False,
             )(staged, kc, vc, emb, offs_mb, len_mb, slot_mb)
 
@@ -407,6 +426,7 @@ class PPEngine:
                           P(), P(), P(), P()),
                 out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
                            P(PIPE_AXIS), P(PIPE_AXIS)),
+                axis_names={PIPE_AXIS},
                 check_vma=False,
             )(staged, kc, vc, first_token, start_valid, key, budget,
               temps, top_ks, top_ps, row_budgets, done_in, slot_idx,
@@ -455,15 +475,17 @@ class PPEngine:
         )
         mesh = config.get("mesh", {})
         # Refuse configs this engine would otherwise silently serve
-        # differently than asked (the "silent config drop" class): extra
-        # mesh axes mean no TP/DP inside stages, and paged KV /
-        # seq-parallel are main-engine features.
-        extra_axes = sorted(set(mesh) - {"pipe"})
+        # differently than asked (the "silent config drop" class): a
+        # data axis means DP inside stages (unimplemented), and
+        # seq-parallel is a main-engine feature. "model" composes:
+        # mesh={"pipe": N, "model": M} runs TP inside each stage.
+        extra_axes = sorted(set(mesh) - {"pipe", "model"})
         if extra_axes:
             raise ValueError(
                 f"mesh axes {extra_axes} are not supported alongside "
-                "'pipe' — the PP engine runs no TP/DP inside stages yet; "
-                "use mesh={'pipe': N} alone or a (data, model) mesh")
+                "'pipe' — the PP engine supports mesh={'pipe': N} or "
+                "mesh={'pipe': N, 'model': M} (TP inside stages); use a "
+                "(data, model) mesh on the main engine for DP")
         if config.get("seq_parallel"):
             raise ValueError(
                 "seq_parallel is not supported on the PP engine — use a "
@@ -478,6 +500,7 @@ class PPEngine:
             model_cfg,
             checkpoint=config.get("checkpoint", "") or "",
             n_stages=int(mesh.get("pipe", 2)),
+            n_model=int(mesh.get("model", 1)),
             n_micro=int(config.get("n_micro", 2)),
             num_slots=int(config.get("num_slots", 4)),
             dtype=dtype, quant=config.get("quant", "none"),
@@ -776,7 +799,8 @@ class PPEngine:
             "model": self.cfg.name,
             "params": self.num_params,
             "max_seq_len": self.max_seq_len,
-            "mesh": {"pipe": self.n_stages},
+            "mesh": ({"pipe": self.n_stages, "model": self.n_model}
+                     if self.n_model > 1 else {"pipe": self.n_stages}),
             "n_micro": self.n_micro,
             "num_slots": self.kv.num_slots,
             "kv_layout": f"stage-local {self.kv_layout}",

@@ -122,3 +122,49 @@ def _quantize_layer(layer: dict[str, Any], act_dtype,
         else:
             new[key] = value
     return new
+
+
+def _spec_for_scale(spec, scale_axes: tuple[int, ...]):
+    """PartitionSpec for a scale leaf: `s` keeps exactly `scale_axes` of
+    the weight, so its spec keeps those axes' entries (a spec shorter
+    than the weight's rank means trailing dims are unsharded)."""
+    from jax.sharding import PartitionSpec as P
+    entries = tuple(spec) if spec is not None else ()
+    return P(*(entries[a] if a < len(entries) else None
+               for a in scale_axes))
+
+
+def quantized_specs(specs: Params) -> Params:
+    """Transform a param PartitionSpec tree (sharding.param_specs) into
+    the spec tree matching quantize_params' OUTPUT structure: each
+    quantized weight spec becomes {"q": spec, "s": kept-axes spec}, so a
+    quantized tree can be explicitly placed (the PP engine stacks leaves
+    itself and cannot rely on jit sharding propagation).
+
+    Mirrors quantize_params/_quantize_layer key-for-key; keep the two in
+    sync when a new weight becomes quantizable."""
+    out: Params = {}
+    for key, value in specs.items():
+        if key in ("embedding", "lm_head"):
+            out[key] = {"q": value,
+                        "s": _spec_for_scale(value, _SCALE_AXES[key])}
+        elif key == "layers":
+            out[key] = [_quantized_layer_specs(layer) for layer in value]
+        else:
+            out[key] = value
+    return out
+
+
+def _quantized_layer_specs(layer: dict[str, Any]) -> dict[str, Any]:
+    new: dict[str, Any] = {}
+    for key, value in layer.items():
+        if key == "experts":
+            new[key] = {k: {"q": v,
+                            "s": _spec_for_scale(v, _EXPERT_SCALE_AXES[k])}
+                        for k, v in value.items()}
+        elif key in _SCALE_AXES and "norm" not in key:
+            new[key] = {"q": value,
+                        "s": _spec_for_scale(value, _SCALE_AXES[key])}
+        else:
+            new[key] = value
+    return new
