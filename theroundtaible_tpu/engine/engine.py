@@ -607,7 +607,7 @@ class InferenceEngine:
             top_p=float(sampling_cfg.get("top_p", 1.0)),
             max_new_tokens=int(sampling_cfg.get("max_new_tokens", 1024)),
         )
-        return cls(
+        engine = cls(
             model_cfg,
             checkpoint=config.get("checkpoint", "") or "",
             mesh_shape=config.get("mesh"),
@@ -627,6 +627,12 @@ class InferenceEngine:
             quant=config.get("quant", "none"),
             dcn_axis=config.get("dcn_axis"),
         )
+        # Set by fleet.check_fleet_fits when it flips an unpinned config
+        # to int8: surfaced via describe() so the degrade is visible
+        # after the fact, not only in the warning stream (advisor r3).
+        engine.quant_auto_degraded = bool(
+            config.get("_quant_auto_degraded"))
+        return engine
 
     # --- serving ---
 
@@ -1039,7 +1045,9 @@ class InferenceEngine:
             "mesh": dict(self.mesh.shape),
             "num_slots": self.kv.num_slots,
             "kv_layout": self.kv_layout,
-            "quant": self.quant,
+            "quant": (self.quant + " (auto-degraded)"
+                      if getattr(self, "quant_auto_degraded", False)
+                      else self.quant),
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
         if self.kv_layout == "paged":

@@ -232,6 +232,11 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
             "quant explicitly to override", stacklevel=3)
         for c in cfgs:
             c["quant"] = "int8"
+            # Surfaced in the engine's describe() as "int8
+            # (auto-degraded)" — a non-interactive/driver run can easily
+            # miss the warning stream, and the serving numerics silently
+            # differ from what the operator configured (advisor r3).
+            c["_quant_auto_degraded"] = True
 
 
 def plan_fleet(engine_configs: list[dict[str, Any]],
