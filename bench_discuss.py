@@ -177,6 +177,13 @@ def child() -> int:
                 "real_parse_turns": real_parse["count"],
                 "real_parse_ok": real_parse["ok"],
                 "real_parse_s": round(real_parse["seconds"], 4),
+                # Emergent (unscripted) termination is proven hermetically
+                # by tests/test_emergent_consensus.py: a constructed
+                # checkpoint's DECODED output carries the consensus JSON
+                # and the unmodified adapter+orchestrator terminate on the
+                # parsed scores. Scripting here is purely a wall-clock
+                # termination guarantee for random bench weights.
+                "emergent_consensus_test": "tests/test_emergent_consensus.py",
             },
         },
     }

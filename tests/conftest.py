@@ -30,3 +30,57 @@ def project_root(tmp_path):
     """A scratch project dir with a .roundtable skeleton."""
     (tmp_path / ".roundtable" / "sessions").mkdir(parents=True)
     return tmp_path
+
+
+# Real-checkpoint recipe shared by test_e2e_checkpoint (HF-parity serving)
+# and test_emergent_consensus (constructed-weights discuss): one place
+# owns the tokenizer training + transformers-Llama save layout.
+
+CKPT_CORPUS = [
+    "the knights debate the session store design at the roundtable",
+    "caching and consensus and chronicles and decrees",
+    "a verify command runs in the sandbox with a timeout"] * 50
+
+
+def save_trained_tokenizer(d, vocab_size=300, extra_tokens=()):
+    """Train a real BPE tokenizer on CKPT_CORPUS and save it to `d` in HF
+    layout (pad/bos/eos/unk = 0/1/2/3). `extra_tokens` are added as
+    NON-special tokens (their content survives decode). Returns the
+    PreTrainedTokenizerFast."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.train_from_iterator(CKPT_CORPUS, trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<pad>", "<bos>", "<eos>", "<unk>"]))
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token="<bos>", eos_token="<eos>",
+        pad_token="<pad>", unk_token="<unk>")
+    if extra_tokens:
+        assert fast.add_tokens(list(extra_tokens)) == len(extra_tokens)
+    fast.save_pretrained(d)
+    return fast
+
+
+def make_tiny_hf_llama(vocab_size, *, hidden_size=64, seed=None,
+                       max_position_embeddings=256):
+    """A transformers LlamaForCausalLM in the tiny-llama shape family
+    (2 layers, 4 heads / 2 kv, mlp 128) — the real HF modeling code the
+    checkpoint loader and tokenizer pipeline are tested against."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    if seed is not None:
+        torch.manual_seed(seed)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=vocab_size, hidden_size=hidden_size,
+        intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=max_position_embeddings,
+        rms_norm_eps=1e-6, rope_theta=10_000.0, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False,
+        bos_token_id=1, eos_token_id=2, pad_token_id=0))
+    hf.eval()
+    return hf

@@ -33,39 +33,17 @@ from theroundtaible_tpu.engine.sampling import SamplingParams
 VOCAB = 300
 DECODE_STEPS = 12
 
-CORPUS = ["the knights debate the session store design at the roundtable",
-          "caching and consensus and chronicles and decrees",
-          "a verify command runs in the sandbox with a timeout"] * 50
-
 
 @pytest.fixture(scope="module")
 def real_ckpt(tmp_path_factory):
     """One directory holding BOTH real assets: trained-BPE tokenizer in
-    HF layout and a transformers Llama saved as safetensors."""
-    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
-    from transformers import (LlamaConfig, LlamaForCausalLM,
-                              PreTrainedTokenizerFast)
+    HF layout and a transformers Llama saved as safetensors (shared
+    conftest recipe; test_emergent_consensus builds on the same one)."""
+    from conftest import make_tiny_hf_llama, save_trained_tokenizer
 
     d = tmp_path_factory.mktemp("real_ckpt")
-    tok = Tokenizer(models.BPE(unk_token="<unk>"))
-    tok.pre_tokenizer = pre_tokenizers.Whitespace()
-    tok.train_from_iterator(CORPUS, trainers.BpeTrainer(
-        vocab_size=VOCAB,
-        special_tokens=["<pad>", "<bos>", "<eos>", "<unk>"]))
-    fast = PreTrainedTokenizerFast(
-        tokenizer_object=tok, bos_token="<bos>", eos_token="<eos>",
-        pad_token="<pad>", unk_token="<unk>")
-    fast.save_pretrained(d)
-
-    torch.manual_seed(11)
-    hf = LlamaForCausalLM(LlamaConfig(
-        vocab_size=VOCAB, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        max_position_embeddings=256, rms_norm_eps=1e-6,
-        rope_theta=10_000.0, tie_word_embeddings=False,
-        attention_bias=False, mlp_bias=False,
-        bos_token_id=1, eos_token_id=2, pad_token_id=0))
-    hf.eval()
+    fast = save_trained_tokenizer(d, vocab_size=VOCAB)
+    hf = make_tiny_hf_llama(VOCAB, seed=11)
     hf.save_pretrained(d, safe_serialization=True)
     return d, fast, hf
 

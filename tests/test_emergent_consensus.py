@@ -1,0 +1,154 @@
+"""Real-weights discuss smoke with EMERGENT consensus (VERDICT r3 #8).
+
+bench_discuss scripts its consensus scores because random weights cannot
+emit the JSON block — which left "termination comes from parsed model
+output" unproven. This test closes that: a checkpoint is CONSTRUCTED (not
+scripted) so that greedy decoding from ANY prompt emits a complete knight
+reply ending in a valid fenced consensus JSON, then EOS — and the
+discussion then runs through the UNMODIFIED TpuLlmAdapter + orchestrator:
+the consensus block the discussion terminates on is genuinely decoded by
+the engine from the checkpoint and parsed by core/consensus.py, with no
+score injection anywhere.
+
+Checkpoint construction (real HF assets, same recipe as
+test_e2e_checkpoint): a trained-BPE tokenizer gains ONE added token R
+whose content is the full reply text; the saved transformers Llama has
+o_proj and down_proj zeroed (so the residual stream at the last position
+is exactly the last token's embedding), an embedding that maps every
+ordinary token to basis vector `a` and R to basis vector `b`, and an
+lm_head with row[R] = 50·a, row[eos] = 100·b. Greedy decode is then an
+exact two-step chain: <any prompt token> → R → eos. The model really runs
+(prefill + decode through the production engine); the chain is a property
+of the weights, not of any test hook.
+"""
+
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+tokenizers = pytest.importorskip("tokenizers")
+
+VOCAB = 512          # == registry tiny-llama (the adapter's model config)
+BOS, EOS, PAD = 1, 2, 0
+
+REPLY = (
+    "I have weighed the proposal and I agree with the approach.\n"
+    "```json\n"
+    '{"consensus_score": 9.5, "agrees_with": ["Knight-A", "Knight-B"], '
+    '"pending_issues": [], "proposal": "adopt the event log store", '
+    '"files_to_modify": ["store.md"]}\n'
+    "```\n"
+)
+
+@pytest.fixture(scope="module")
+def consensus_ckpt(tmp_path_factory):
+    """Checkpoint dir whose greedy continuation from any prompt is
+    REPLY + eos (see module docstring for the construction). Tokenizer
+    and HF-Llama save layout come from the shared conftest recipe."""
+    from conftest import make_tiny_hf_llama, save_trained_tokenizer
+
+    d = tmp_path_factory.mktemp("consensus_ckpt")
+    # R: one NON-special added token carrying the entire reply text —
+    # non-special so the engine's decode keeps its content.
+    fast = save_trained_tokenizer(d, extra_tokens=[REPLY])
+    r_id = fast.convert_tokens_to_ids(REPLY)
+    assert 0 < r_id < VOCAB
+
+    hf = make_tiny_hf_llama(VOCAB, max_position_embeddings=512)
+    with torch.no_grad():
+        # Residual stream == last token's embedding: every attention and
+        # MLP branch output is forced to zero through its out-projection.
+        for layer in hf.model.layers:
+            layer.self_attn.o_proj.weight.zero_()
+            layer.mlp.down_proj.weight.zero_()
+        hf.model.norm.weight.fill_(1.0)
+        emb = torch.zeros(VOCAB, 64)
+        emb[:, 0] = 1.0          # every ordinary token → a = e0
+        emb[r_id] = 0.0
+        emb[r_id, 1] = 1.0       # R → b = e1
+        emb[EOS] = 0.0           # never decoded from; rms_norm(0) == 0
+        emb[PAD] = 0.0
+        hf.model.embed_tokens.weight.copy_(emb)
+        head = torch.zeros(VOCAB, 64)
+        head[r_id, 0] = 50.0     # from any ordinary token: argmax = R
+        head[EOS, 1] = 100.0     # from R: argmax = eos
+        hf.lm_head.weight.copy_(head)
+    hf.eval()
+    hf.save_pretrained(d, safe_serialization=True)
+    return str(d), r_id
+
+
+def test_discussion_terminates_on_emergent_consensus(consensus_ckpt,
+                                                     project_root):
+    """3 knights, unmodified adapter: the engine decodes the consensus
+    JSON from the checkpoint and the orchestrator terminates on the
+    PARSED scores — no scripted scores anywhere (retires bench_discuss's
+    scripted_scores caveat as a correctness question)."""
+    ckpt, _r_id = consensus_ckpt
+    from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+    from theroundtaible_tpu.core.orchestrator import run_discussion
+    from theroundtaible_tpu.core.types import (KnightConfig,
+                                               RoundtableConfig,
+                                               RulesConfig)
+    from theroundtaible_tpu.engine import reset_engines
+
+    reset_engines()
+    adapter = TpuLlmAdapter(
+        "tpu-llm", {"model": "tiny-llama", "checkpoint": ckpt,
+                    "max_seq_len": 512, "num_slots": 4,
+                    "sampling": {"temperature": 0.0,
+                                 "max_new_tokens": 16}})
+    config = RoundtableConfig(
+        version="1.0", project="emergent", language="en",
+        knights=[KnightConfig(name=f"Knight-{c}", adapter="tpu-llm",
+                              capabilities=[], priority=i + 1)
+                 for i, c in enumerate("ABC")],
+        rules=RulesConfig(max_rounds=5, consensus_threshold=9,
+                          timeout_per_turn_seconds=300,
+                          escalate_to_user_after=4, auto_execute=False,
+                          parallel_rounds=True),
+        chronicle="chronicle.md",
+        adapter_config={"tpu-llm": {}},
+    )
+    root = str(project_root)
+    try:
+        result = run_discussion(
+            "Should the session store move to an append-only event log?",
+            config, {"tpu-llm": adapter}, root, read_source_code=False)
+    finally:
+        reset_engines()
+
+    # Consensus was reached in round 1 because every knight's DECODED
+    # output contained the score-9.5 block.
+    assert result.consensus
+    assert result.rounds == 1
+    # The decoded replies really carried the JSON (not injected): every
+    # knight's transcript entry contains the score-9.5 block verbatim.
+    import json as _json
+    transcript = _json.load(open(os.path.join(result.session_path,
+                                              "transcript.json")))
+    text = _json.dumps(transcript)
+    assert text.count('\\"consensus_score\\": 9.5') >= 3
+
+
+def test_engine_decodes_reply_verbatim(consensus_ckpt):
+    """Numeric anchor for the test above: the production engine serving
+    this checkpoint greedily emits REPLY for an arbitrary prompt."""
+    ckpt, _r_id = consensus_ckpt
+    import jax.numpy as jnp
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    engine = InferenceEngine(
+        get_model_config("tiny-llama"), checkpoint=ckpt, num_slots=2,
+        dtype=jnp.float32,
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+    out = engine.generate("an arbitrary question about the store",
+                          slot_name="probe", max_new_tokens=8)
+    assert "consensus_score" in out
+    assert out.strip() == REPLY.strip()
