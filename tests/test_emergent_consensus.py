@@ -129,8 +129,8 @@ def test_discussion_terminates_on_emergent_consensus(consensus_ckpt,
     # The decoded replies really carried the JSON (not injected): every
     # knight's transcript entry contains the score-9.5 block verbatim.
     import json as _json
-    transcript = _json.load(open(os.path.join(result.session_path,
-                                              "transcript.json")))
+    with open(os.path.join(result.session_path, "transcript.json")) as f:
+        transcript = _json.load(f)
     text = _json.dumps(transcript)
     assert text.count('\\"consensus_score\\": 9.5') >= 3
 

@@ -204,6 +204,30 @@ class TestFileLock:
             pass
         assert not lock.exists()
 
+    def test_heartbeat_keeps_long_hold_fresh(self, tmp_path, monkeypatch):
+        """A LIVE holder keeping the lock past CROSS_HOST_STALE_S must
+        not lose mutual exclusion to the age-gated cross-host reclaim:
+        the holder's heartbeat touches mtime while held (advisor r3)."""
+        import os
+        import time
+        from theroundtaible_tpu.utils import lock as lock_mod
+        monkeypatch.setattr(lock_mod, "CROSS_HOST_STALE_S", 0.3)
+        target = tmp_path / "f"
+        lk = lock_mod.FileLock(target, timeout_s=1.0)
+        lk.acquire()
+        try:
+            # Backdate, then wait past a heartbeat interval (0.1s): the
+            # heartbeat must have re-touched the file, so its age stays
+            # below the (patched) cross-host stale ceiling.
+            old = time.time() - 10
+            os.utime(lk.lock_path, (old, old))
+            time.sleep(0.5)
+            age = time.time() - lk.lock_path.stat().st_mtime
+            assert age < 0.3
+        finally:
+            lk.release()
+        assert not lk.lock_path.exists()
+
 
 class TestManifest:
     def entry(self, id_="feat-x", **kw):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import socket
+import threading
 import time
 from pathlib import Path
 
@@ -26,7 +27,10 @@ class LockTimeout(RuntimeError):
 # (and reclaimed) once its file is this old. Roundtable store writes hold
 # locks for milliseconds, so minutes of age means a dead holder — this
 # keeps the module's no-deadlock guarantee in the multi-host case at the
-# cost of a cross-host reclaim being slow instead of instant.
+# cost of a cross-host reclaim being slow instead of instant. Live
+# holders are protected past this ceiling by the heartbeat below
+# (advisor r3: a generic utility must not lose mutual exclusion just
+# because one call site holds long).
 CROSS_HOST_STALE_S = 300.0
 
 
@@ -48,8 +52,68 @@ def _parse_stamp(text: str) -> tuple[str | None, int]:
         return host or None, 0
 
 
+# Heartbeat: ONE shared daemon thread (started lazily on the first
+# acquire in the process) touches every currently-held lock file's mtime
+# every CROSS_HOST_STALE_S/3, so a LIVE holder is never mistaken for a
+# crashed one by the age-gated cross-host reclaim — without paying a
+# thread spawn on the millisecond-hold hot path. Before each touch the
+# stamp is re-read: if it is no longer ours (another host age-reclaimed
+# while this whole process was stalled), the entry is dropped so we never
+# keep refreshing a lock that now belongs — or belonged — to someone
+# else. Transient I/O errors (NFS hiccups) skip one beat and retry.
+_hb_mutex = threading.Lock()
+_hb_held: dict[str, str] = {}  # lock-file path -> stamp we wrote
+_hb_started = False
+_hb_wake = threading.Event()
+
+
+def _hb_register(path: Path, stamp: str) -> None:
+    global _hb_started
+    with _hb_mutex:
+        _hb_held[str(path)] = stamp
+        if not _hb_started:
+            _hb_started = True
+            threading.Thread(target=_hb_loop, daemon=True).start()
+    _hb_wake.set()  # interrupt a possibly-long wait so the new interval
+    #                 (tests patch CROSS_HOST_STALE_S) takes effect now
+
+
+def _hb_unregister(path: Path) -> None:
+    with _hb_mutex:
+        _hb_held.pop(str(path), None)
+
+
+def _hb_loop() -> None:
+    while True:
+        _hb_wake.wait(CROSS_HOST_STALE_S / 3.0)
+        _hb_wake.clear()
+        with _hb_mutex:
+            items = list(_hb_held.items())
+        for path, stamp in items:
+            try:
+                content = Path(path).read_text().strip()
+            except FileNotFoundError:
+                _hb_unregister(Path(path))  # released/reclaimed
+                continue
+            except OSError:
+                continue  # transient: retry next beat
+            if content != stamp:
+                _hb_unregister(Path(path))  # not ours anymore
+                continue
+            try:
+                os.utime(path)
+            except OSError:
+                pass  # transient: retry next beat
+
+
 class FileLock:
-    """`with FileLock(path):` — advisory lock at `<path>.lock`."""
+    """`with FileLock(path):` — advisory lock at `<path>.lock`.
+
+    Holds of any length are safe: while held, the module's shared
+    heartbeat keeps the lock file's mtime fresh, so a LIVE holder on
+    another host is never mistaken for a crashed one by the age-gated
+    cross-host reclaim (roundtable's millisecond store writes release
+    long before the first beat ever fires)."""
 
     def __init__(self, target: str | Path, timeout_s: float = 10.0,
                  poll_s: float = 0.05):
@@ -132,9 +196,11 @@ class FileLock:
             try:
                 fd = os.open(self.lock_path,
                              os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                stamp = _stamp()
                 with os.fdopen(fd, "w") as f:
-                    f.write(_stamp())
+                    f.write(stamp)
                 self._held = True
+                _hb_register(self.lock_path, stamp)
                 return
             except FileExistsError:
                 self._try_reclaim_stale()
@@ -149,6 +215,7 @@ class FileLock:
     def release(self) -> None:
         if self._held:
             self._held = False
+            _hb_unregister(self.lock_path)
             try:
                 self.lock_path.unlink()
             except OSError:
