@@ -134,12 +134,19 @@ class InferenceEngine:
         if kv_layout == "paged":
             from jax.sharding import NamedSharding, PartitionSpec as P
             from .paging import PagedKVCache
-            from .sharding import MODEL_AXIS, _fallback_replicated
+            from .sharding import DATA_AXIS, MODEL_AXIS, _fallback_replicated
+            data_size = dict(self.mesh.shape).get("data", 1)
             pool_sharding = None
             if self.mesh.devices.size > 1:
+                # Per-replica pools (VERDICT r3 #7): the PAGE axis shards
+                # over "data" (the allocator rounds num_pages to a
+                # multiple of data_size and keeps every slot's pages on
+                # one replica), kv heads over "model" — each device holds
+                # pages/data x heads/model, not a full replicated pool.
                 spec = _fallback_replicated(
-                    P(None, None, MODEL_AXIS, None),
-                    (1, page_size, model_cfg.num_kv_heads,
+                    P(DATA_AXIS if data_size > 1 else None, None,
+                      MODEL_AXIS, None),
+                    (data_size, page_size, model_cfg.num_kv_heads,
                      model_cfg.head_dim),
                     self.mesh)
                 pool_sharding = NamedSharding(self.mesh, spec)
@@ -159,29 +166,22 @@ class InferenceEngine:
             from .paging import make_padded_copier
             copy_pages_padded = make_padded_copier(copy_pages)
 
-            # Default pool HALVES the contiguous HBM budget per device. The
-            # pool is replicated over the data axis (pages are dynamically
-            # owned, so they cannot shard the way contiguous slots do),
-            # hence the per-device budget divides by the data-axis size.
-            # Worst case that FITS the default: ceil(num_slots/2/data)
-            # sequences simultaneously resident at full max_seq_len (plus
-            # one partially-filled sequence's worth of pages from the +1
-            # and integer division slack). A batch pinning MORE slots than
-            # that, all near max_seq_len, exhausts the pool mid-serve with
-            # an actionable RuntimeError ("raise num_pages / lower
-            # max_new_tokens") — set num_pages explicitly (up to
-            # num_slots*max_seq_len/page_size + 1 for contiguous-equal
-            # capacity) when every knight runs long.
-            data_size = dict(self.mesh.shape).get("data", 1)
-            if num_pages is None:
-                pages_per_seq = self.max_seq_len // page_size
-                num_pages = max(
-                    num_slots * pages_per_seq // (2 * data_size),
-                    pages_per_seq) + 1
+            # Default pool HALVES the contiguous HBM budget — and since
+            # the page axis shards over "data", that is the TOTAL across
+            # replicas (each device holds total/data), not a replicated
+            # per-device cost. Worst case that FITS the default:
+            # ceil(num_slots/2) sequences simultaneously resident at full
+            # max_seq_len, spread over the replicas their slots pin to. A
+            # batch pinning MORE than that, all near max_seq_len, exhausts
+            # a replica's range mid-serve with an actionable RuntimeError
+            # ("raise num_pages / lower max_new_tokens") — set num_pages
+            # explicitly (up to num_slots*max_seq_len/page_size +
+            # data_size for contiguous-equal capacity) when every knight
+            # runs long.
             self.kv = PagedKVCache(
                 model_cfg, num_slots, self.max_seq_len, dtype,
                 pool_sharding, page_size=page_size, num_pages=num_pages,
-                copy_pages_fn=copy_pages_padded)
+                copy_pages_fn=copy_pages_padded, data_size=data_size)
         else:
             cache_sharding = None
             if self.mesh.devices.size > 1:
@@ -419,9 +419,16 @@ class InferenceEngine:
             # dense (CPU): there is no dense pool-direct equivalent, and
             # the kernel runs in interpret mode there.
             n_model = dict(self.mesh.shape).get("model", 1)
+            # data > 1: the pool's page axis is data-sharded, but the
+            # pool-direct spmd kernel shards BATCH rows over "data" and a
+            # row's pages live on its slot's replica, not its batch
+            # position's — serving would need rows grouped by replica.
+            # Until then data>1 keeps the gather-view programs, where
+            # XLA inserts the cross-replica collectives itself.
             self.paged_direct = (
                 attn != "dense"
                 and paged_decode_supported(page_size, model_cfg.head_dim)
+                and data_size == 1
                 and (self.mesh.devices.size == 1
                      or spmd_partitionable(model_cfg.num_heads,
                                            model_cfg.num_kv_heads,
