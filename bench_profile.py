@@ -110,11 +110,19 @@ def child() -> int:
                         max_new_tokens=decode_tokens)
     engine.kv.release("warm")
 
-    # Prefill OUTSIDE the trace (prime the slot), so the profiled call
-    # reuses all but one prompt token and the trace is ≥99% decode —
-    # otherwise prefill matmuls merge into the same op buckets and
-    # contaminate the attribution this harness exists to produce.
+    # Prime the slot OUTSIDE the trace, so the profiled call reuses all
+    # but one prompt token and the trace is ≥99% decode — otherwise
+    # prefill matmuls merge into the same op buckets and contaminate the
+    # attribution this harness exists to produce.
     engine.generate(PROMPT, slot_name="prof", max_new_tokens=1)
+    # Rehearse the EXACT profiled call once: the 1-token delta prefill
+    # hits the smallest bucket program, which the full-prompt warm passes
+    # never compiled — without this rehearsal that compile (and the
+    # donated-buffer layout settling) lands INSIDE the trace (caught by
+    # the CPU smoke: backend_compile dominated the trace). After it the
+    # slot's cached tokens still share the whole prompt prefix, so the
+    # profiled call repeats the identical 1-token-delta + decode shape.
+    engine.generate(PROMPT, slot_name="prof", max_new_tokens=decode_tokens)
 
     trace_dir = tempfile.mkdtemp(prefix="rt_profile_")
     t0 = time.monotonic()

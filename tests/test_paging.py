@@ -387,6 +387,22 @@ class TestPerReplicaPools:
         assert "b" in kv._slots and "d" in kv._slots
         assert kv._slots["b"].pages and kv._slots["d"].pages
 
+    def test_best_donor_prefers_same_replica_on_ties(self):
+        """Equal-prefix donors on both replicas: the same-replica one
+        must win — its span ALIASES for free where the cross-replica one
+        would be device-copied into duplicate pages (review finding)."""
+        kv = self._kv(data_size=2)
+        prefix = list(range(2 * PS))
+        kv.acquire("a")                      # replica 0
+        kv.acquire("b")                      # replica 1
+        for n in ("a", "b"):
+            kv.ensure_capacity(n, len(prefix), write_from=0)
+            kv.commit(n, prefix)
+        kv.acquire("c")                      # replica 0 (2 slots vs 2... tie→0)
+        donor, n = kv.best_donor("c", prefix + [7])
+        assert n == len(prefix)
+        assert donor.replica == kv._slots["c"].replica
+
     def test_exhaustion_names_the_replica(self):
         kv = self._kv(data_size=2, num_pages=2 * (8 + 1))  # 8 usable each
         kv.acquire("a")

@@ -293,14 +293,23 @@ class PagedKVCache:
 
     def best_donor(self, name: str,
                    tokens: list[int]) -> tuple[Optional[PagedSlot], int]:
-        best, best_len = None, 0
+        """Longest-common-prefix donor; prefix-length ties prefer a donor
+        on the SAME replica as `name` — same-replica spans alias for free
+        while cross-replica spans degrade to device copies plus duplicate
+        pages out of the destination replica's range (review finding)."""
+        dst = self._slots.get(name)
+        dst_replica = dst.replica if dst is not None else 0
+        best, best_key = None, (0, -1)
         for state in self._slots.values():
             if state.name == name or not state.tokens:
                 continue
             n = self.common_prefix_len(state.tokens, tokens)
-            if n > best_len:
-                best, best_len = state, n
-        return best, best_len
+            if n == 0:
+                continue
+            key = (n, 1 if state.replica == dst_replica else 0)
+            if key > best_key:
+                best, best_key = state, key
+        return best, best_key[0]
 
     # --- capacity + sharing ---
 
