@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 hardware window — VERDICT r3 strict order (items 1 and 2):
+#   1. bench.py          re-measure config 1 (pipelined decode segments +
+#                        pool-direct paged prefill have no hardware number)
+#   2. bench_profile.py  first-ever hardware decode attribution (the
+#                        45%-of-roofline gap)
+#   3. bench_discuss.py  config 2 — the north-star metric's first
+#                        hardware number
+#   4. bench_suite.py    configs 3-5 refresh (median-of-3 + spread now)
+#
+# Each bench is probe-first watchdogged (bench_common): a dead tunnel
+# yields a machine-readable bench_status record instead of a hang, and
+# every completed record streams into the artifact even if a later step
+# dies. Artifacts are committed after EVERY step — the tunnel has died
+# mid-round in rounds 2, 3, and (so far) 4.
+set -u
+cd "$(dirname "$0")"
+OUT=BENCH_r04_builder.jsonl
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+
+run_step() {
+  local name="$1"; shift
+  echo "=== $(stamp) $name ===" >> "$OUT.log"
+  "$@" >> "$OUT" 2>> "$OUT.log"
+  local rc=$?
+  git add "$OUT" "$OUT.log" >/dev/null 2>&1
+  git commit -q -m "Hardware window: $name artifact (rc=$rc)
+
+No-Verification-Needed: measurement artifact only, no source change" \
+    2>/dev/null || true
+  return $rc
+}
+
+run_step "bench.py (config 1)"        python bench.py
+run_step "bench_profile.py"           python bench_profile.py
+run_step "bench_discuss.py (config 2)" python bench_discuss.py
+run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
+echo "window complete: $(stamp)"; tail -n +1 "$OUT" | wc -l
