@@ -14,7 +14,7 @@
 # dies. Artifacts are committed after EVERY step — the tunnel has died
 # mid-round in rounds 2, 3, and (so far) 4.
 set -u
-cd "$(dirname "$0")"
+cd "$(dirname "$0")" || exit 1
 OUT=BENCH_r04_builder.jsonl
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
@@ -23,11 +23,17 @@ run_step() {
   echo "=== $(stamp) $name ===" >> "$OUT.log"
   "$@" >> "$OUT" 2>> "$OUT.log"
   local rc=$?
-  git add "$OUT" "$OUT.log" >/dev/null 2>&1
-  git commit -q -m "Hardware window: $name artifact (rc=$rc)
+  # Commit ONLY the artifact files (-o): anything else staged stays out
+  # of the artifact commit; a real commit failure must be loud — the
+  # per-step commit IS the durability guarantee this script exists for.
+  if ! git commit -q -o "$OUT" -o "$OUT.log" \
+      -m "Hardware window: $name artifact (rc=$rc)
 
-No-Verification-Needed: measurement artifact only, no source change" \
-    2>/dev/null || true
+No-Verification-Needed: measurement artifact only, no source change"
+  then
+    echo "WARN: artifact commit failed after $name (rc=$rc)" \
+      | tee -a "$OUT.log" >&2
+  fi
   return $rc
 }
 

@@ -126,6 +126,24 @@ text2 = eng.generate("the knights assemble across two hosts and speak",
 info["served"] = text1
 info["served_reused"] = eng.last_stats.reused_tokens
 info["served2"] = text2
+
+# PIPELINE-parallel serving across the process boundary: the 2-stage
+# pipe mesh has one stage per PROCESS, so every GPipe step's ppermute
+# and the per-token decode ring hop cross hosts. Outputs are emitted
+# with out_specs P() (replicated), so both processes' host loops read
+# identical tokens and stay in lockstep.
+from theroundtaible_tpu.engine.pp_serving import PPEngine
+
+pp = PPEngine(get_model_config("tiny-llama", max_seq_len=128),
+              n_stages=2, n_micro=2, num_slots=2, dtype=jnp.float32,
+              sampling=SamplingParams(temperature=0.0, max_new_tokens=5))
+pp1 = pp.generate("stage zero speaks to stage one", slot_name="p",
+                  max_new_tokens=5)
+pp2 = pp.generate("stage zero speaks to stage one again", slot_name="p",
+                  max_new_tokens=5)
+info["pp_served"] = pp1
+info["pp_served2"] = pp2
+info["pp_reused"] = pp.last_stats.reused_tokens
 print(json.dumps(info), flush=True)
 """
 
@@ -165,7 +183,7 @@ def test_two_process_group_real_initialize(tmp_path):
             env=env))
     results = []
     for p in procs:
-        out, err = p.communicate(timeout=120)
+        out, err = p.communicate(timeout=240)
         assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
         results.append(json.loads(out.strip().splitlines()[-1]))
     assert sorted(r["process_index"] for r in results) == [0, 1]
@@ -183,3 +201,9 @@ def test_two_process_group_real_initialize(tmp_path):
     assert results[0]["served"] == results[1]["served"]
     assert results[0]["served2"] == results[1]["served2"]
     assert all(r["served_reused"] > 0 for r in results)
+    # PP serving with one stage per process: identical generations on
+    # both hosts (the ppermute ring crossed the boundary every step),
+    # stage-local-cache slot reuse on the second turn
+    assert results[0]["pp_served"] == results[1]["pp_served"]
+    assert results[0]["pp_served2"] == results[1]["pp_served2"]
+    assert all(r["pp_reused"] > 0 for r in results)
