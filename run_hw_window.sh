@@ -23,9 +23,12 @@ run_step() {
   echo "=== $(stamp) $name ===" >> "$OUT.log"
   "$@" >> "$OUT" 2>> "$OUT.log"
   local rc=$?
-  # Commit ONLY the artifact files (-o): anything else staged stays out
-  # of the artifact commit; a real commit failure must be loud — the
-  # per-step commit IS the durability guarantee this script exists for.
+  # add first (-o alone errors on UNTRACKED paths — the first window's
+  # artifacts are new files), then commit ONLY the artifact files (-o):
+  # anything else staged stays out of the artifact commit. A real commit
+  # failure must be loud — the per-step commit IS the durability
+  # guarantee this script exists for.
+  git add "$OUT" "$OUT.log"
   if ! git commit -q -o "$OUT" -o "$OUT.log" \
       -m "Hardware window: $name artifact (rc=$rc)
 

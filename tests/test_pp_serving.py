@@ -200,12 +200,23 @@ class TestPPConfigValidation:
         eng = PPEngine.from_config(self._cfg(attn="flash"))
         assert eng.cfg.attn_impl == "flash"
 
-    def test_flash_attn_raises_with_tp_in_stage(self):
-        with pytest.raises(ValueError, match="flash"):
-            PPEngine.from_config(
-                self._cfg(mesh={"pipe": 2, "model": 2}, attn="flash"))
+    def test_flash_attn_honored_with_tp_in_stage(self):
+        """Divisible heads (tiny-llama H4/K2 over model 2): explicit
+        flash runs via the nested-shard_map spmd wrappers."""
+        eng = PPEngine.from_config(
+            self._cfg(mesh={"pipe": 2, "model": 2}, attn="flash"))
+        assert eng.cfg.attn_impl == "flash"
 
-    def test_auto_attn_resolves_dense_with_tp_in_stage(self):
+    def test_flash_attn_raises_on_nonpartitionable_heads(self):
+        """tiny-llama K=2 kv heads cannot split 4 ways (and K!=1, so no
+        MQA replication either) — explicit flash must refuse, exactly as
+        on the main engine."""
+        with pytest.raises(ValueError, match="divisible"):
+            PPEngine.from_config(
+                self._cfg(mesh={"pipe": 2, "model": 4}, attn="flash"))
+
+    def test_auto_attn_resolves_dense_on_cpu(self):
+        # auto mirrors the main engine: kernels only on TPU backends
         eng = PPEngine.from_config(
             self._cfg(mesh={"pipe": 2, "model": 2}, attn="auto"))
         assert eng.cfg.attn_impl == "dense"
@@ -352,13 +363,47 @@ class TestPPFlashAndPoolDirect:
                 == self._ref().generate_batch(self.PROMPTS,
                                               max_new_tokens=12))
 
-    def test_tp_in_stage_paged_keeps_gather_view(self):
+    def test_tp_in_stage_paged_is_pool_direct_and_matches(self):
+        """Partitionable heads: pool-direct survives TP-in-stage via the
+        paged spmd wrappers (nested shard_map over "model")."""
         pp = PPEngine(
             get_model_config("tiny-gemma", max_seq_len=256),
             n_stages=2, n_model=2, n_micro=2, num_slots=4,
             dtype=jnp.float32, seed=3, kv_layout="paged",
             sampling=SamplingParams(temperature=0.0, max_new_tokens=12))
-        assert not pp._pool_direct
+        assert pp._pool_direct
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref().generate_batch(self.PROMPTS,
+                                              max_new_tokens=12))
+
+    def test_tp_in_stage_flash_matches_reference(self):
+        """Explicit flash under pipe 2 x model 2: attention runs through
+        the spmd wrappers as a nested shard_map inside the manual-pipe
+        stage body — token-identical to the main engine."""
+        pp = PPEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            n_stages=2, n_model=2, n_micro=2, num_slots=4,
+            dtype=jnp.float32, seed=3, attn="flash",
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12))
+        assert pp.cfg.attn_impl == "flash"
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref().generate_batch(self.PROMPTS,
+                                              max_new_tokens=12))
+        assert pp.last_stats.decode_tokens > 0
+
+    def test_tp_in_stage_full_matrix_matches_reference(self):
+        """flash + int8 + paged pool-direct + pipe 2 x model 2 — the
+        complete composition in one engine."""
+        pp = PPEngine(
+            get_model_config("tiny-gemma", max_seq_len=256),
+            n_stages=2, n_model=2, n_micro=2, num_slots=4,
+            dtype=jnp.float32, seed=3, attn="flash", quant="int8",
+            kv_layout="paged",
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=12))
+        assert pp._pool_direct
+        assert (pp.generate_batch(self.PROMPTS, max_new_tokens=12)
+                == self._ref(quant="int8").generate_batch(
+                    self.PROMPTS, max_new_tokens=12))
 
 
 class TestPPPaged:

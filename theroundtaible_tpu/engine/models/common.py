@@ -226,7 +226,7 @@ def attention(
         t = q.shape[1]
         out = None
         mesh = current_spmd_mesh()
-        if mesh is not None and mesh.devices.size > 1:
+        if mesh is not None and mesh.size > 1:
             # multi-device: kernels under shard_map (kv heads on "model",
             # rows on "data"); None = not partitionable → dense below
             out = pattn.flash_attention_spmd(

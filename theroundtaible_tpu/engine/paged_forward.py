@@ -77,7 +77,7 @@ def forward_paged(
             k_pool2 = k_pool.at[pages, offs].set(k)
             v_pool2 = v_pool.at[pages, offs].set(v)
             mesh = current_spmd_mesh()
-            multi = mesh is not None and mesh.devices.size > 1
+            multi = mesh is not None and mesh.size > 1
             if t == 1:
                 if multi:
                     out = pattn.paged_decode_spmd(

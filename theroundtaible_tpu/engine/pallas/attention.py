@@ -407,12 +407,26 @@ def paged_prefill_spmd(
     fn = shard_map(body, mesh=mesh,
                    in_specs=(q_spec, pool_spec, pool_spec,
                              P(batch_ax, None), P(batch_ax), P(batch_ax)),
-                   out_specs=q_spec, check_vma=False)
+                   out_specs=q_spec, axis_names=_manual_axes(mesh),
+                   check_vma=False)
     return fn(q, k_pool, v_pool, table.astype(jnp.int32),
               offsets.astype(jnp.int32), kv_valid.astype(jnp.int32))
 
 
 # --- decode kernel ---
+
+
+def _manual_axes(mesh):
+    """The axes this wrapper's shard_map must manualize: the mesh's AUTO
+    axes. On the engines' concrete meshes every axis is Auto, so this is
+    the same set shard_map would manualize with no axis_names at all.
+    Inside a partial-manual region — the PP engine's manual-"pipe" stage
+    bodies calling these wrappers with the context AbstractMesh — the
+    already-Manual "pipe" axis must be excluded, leaving a NESTED
+    shard_map over "model" only."""
+    from jax.sharding import AxisType
+    return {a for a, t in zip(mesh.axis_names, mesh.axis_types)
+            if t == AxisType.Auto}
 
 
 def _spmd_axes(mesh, h: int, kh: int, b: int):
@@ -493,7 +507,8 @@ def flash_attention_spmd(
 
     fn = shard_map(body, mesh=mesh,
                    in_specs=(q_spec, kv_spec, kv_spec, row_spec, row_spec),
-                   out_specs=out_spec, check_vma=False)
+                   out_specs=out_spec, axis_names=_manual_axes(mesh),
+                   check_vma=False)
     return fn(q, k, v, offsets.astype(jnp.int32),
               kv_valid.astype(jnp.int32))
 
@@ -660,7 +675,8 @@ def paged_decode_spmd(
     fn = shard_map(body, mesh=mesh,
                    in_specs=(q_spec, pool_spec, pool_spec,
                              P(batch_ax, None), P(batch_ax)),
-                   out_specs=q_spec, check_vma=False)
+                   out_specs=q_spec, axis_names=_manual_axes(mesh),
+                   check_vma=False)
     return fn(q, k_pool, v_pool, table.astype(jnp.int32),
               kv_valid.astype(jnp.int32))
 

@@ -28,17 +28,21 @@ cache — the memory-capacity property PP exists for.
 - kv_layout="paged": a stage-stacked page pool [st, per, P, ps, K, D]
   managed by the main engine's PagedKVCache allocator (one page table
   for every layer; page aliasing replaces span copies for prefix
-  sharing). On pipe-only meshes serving is POOL-DIRECT: prefill chunks
-  and decode steps scatter into the rows' pages and attend through the
-  page-table-aware Pallas kernels, so the position-aligned gather view
-  (which would temporarily recreate the full contiguous HBM budget —
-  precisely on the models PP exists for) is never built. Under
-  TP-in-stage meshes (or attn="dense") the gather-view fallback runs.
-- Attention inside stages: the raw single-device Pallas flash kernels
-  on pipe-only meshes (the stage body is fully manual, so per-stage
-  arrays are local and full-size); dense XLA einsums under TP-in-stage
-  (an opaque pallas_call cannot be partitioned over the auto "model"
-  axis).
+  sharing). Serving is POOL-DIRECT: prefill chunks and decode steps
+  scatter into the rows' pages and attend through the page-table-aware
+  Pallas kernels, so the position-aligned gather view (which would
+  temporarily recreate the full contiguous HBM budget — precisely on
+  the models PP exists for) is never built. Under TP-in-stage the
+  kernels run through the paged SPMD wrappers as a NESTED shard_map
+  over the auto "model" axis; attn="dense" (or a non-partitionable
+  head layout) keeps the gather-view fallback.
+- Attention inside stages: the Pallas flash kernels — raw single-device
+  calls on pipe-only meshes (the stage body is fully manual, so
+  per-stage arrays are local and full-size); under TP-in-stage the
+  main engine's spmd wrappers run as a nested shard_map that
+  manualizes only the still-auto "model" axis (the context mesh has
+  "pipe" Manual already). Dense XLA einsums remain the opt-out and the
+  non-partitionable-heads fallback.
 
 The reference has no counterpart (its models fit one GPU via Ollama);
 SURVEY.md §2.3 "PP" row is the requirement this file closes.
@@ -99,27 +103,31 @@ class PPEngine:
         # engine used to force dense): on a pipe-only mesh the stage body
         # is fully manual, every array is stage-local and full-size, so
         # the RAW single-device Pallas kernels apply directly
-        # (current_spmd_mesh() is unset here, so models/common.attention
+        # (current_spmd_mesh() is unset there, so models/common.attention
         # takes its single-device kernel branch with per-shape
-        # supported() fallback). On a (pipe, model) mesh the stage
-        # body's tensors are auto-sharded over "model", which an opaque
-        # pallas_call cannot partition — dense (XLA-sharded einsums)
-        # remains the TP-composable implementation, same fallback rule
-        # as the main engine's non-divisible-heads case.
-        if n_model > 1:
-            if attn == "flash":
-                raise ValueError(
-                    "attn='flash' is not supported with mesh "
-                    "{'pipe': N, 'model': M}: the stage body's model "
-                    "axis is compiler-managed and a Pallas kernel "
-                    "cannot be auto-partitioned — use attn='auto' or "
-                    "'dense'")
-            resolved = "dense"
-        elif attn == "auto":
+        # supported() fallback). On a (pipe, model) mesh the kernels run
+        # through the same spmd wrappers the main engine uses, as a
+        # NESTED shard_map: the stage body is manual over "pipe" only, so
+        # the wrapper manualizes the remaining auto "model" axis
+        # (pallas/attention._manual_axes) — heads must divide the model
+        # axis exactly as on the main engine (explicit flash on a
+        # non-divisible layout raises; auto falls back to dense).
+        from .pallas.attention import spmd_partitionable
+        heads_divide = spmd_partitionable(
+            model_cfg.num_heads, model_cfg.num_kv_heads, n_model)
+        if attn == "flash" and n_model > 1 and not heads_divide:
+            raise ValueError(
+                f"attn='flash' on a {n_model}-way model axis needs head "
+                f"counts divisible by it (got H={model_cfg.num_heads}, "
+                f"K={model_cfg.num_kv_heads}) — use attn='auto' or "
+                "'dense'")
+        if attn == "auto":
             # Mirror the main engine's auto rule: kernels on TPU with
-            # lane-aligned head_dim, dense elsewhere.
+            # lane-aligned head_dim (and a partitionable head layout
+            # when TP runs inside the stages), dense elsewhere.
             resolved = ("flash" if jax.default_backend() == "tpu"
-                        and model_cfg.head_dim % 128 == 0 else "dense")
+                        and model_cfg.head_dim % 128 == 0
+                        and (n_model == 1 or heads_divide) else "dense")
         else:
             resolved = attn
         model_cfg = dataclasses.replace(model_cfg, attn_impl=resolved)
@@ -184,15 +192,17 @@ class PPEngine:
         # budget, precisely on the models PP exists for) is never built.
         # Same gating as the main engine: attn="dense" is an explicit
         # opt-out of every Pallas kernel ("auto" still takes pool-direct
-        # on CPU, where the kernel runs in interpret mode); TP-in-stage
-        # meshes keep the gather view (the kernel cannot be partitioned
-        # over the auto model axis).
+        # on CPU, where the kernel runs in interpret mode). TP-in-stage
+        # meshes take the paged SPMD wrappers as a nested shard_map over
+        # the auto "model" axis (head layout must partition; otherwise
+        # the gather view remains).
         self._pool_direct = False
         if kv_layout == "paged":
             from .pallas.attention import paged_decode_supported
             self._pool_direct = (
-                attn != "dense" and n_model == 1
-                and paged_decode_supported(page_size, model_cfg.head_dim))
+                attn != "dense"
+                and paged_decode_supported(page_size, model_cfg.head_dim)
+                and (n_model == 1 or heads_divide))
         if kv_layout == "paged":
             # Stage-stacked page pool [st, per, P, ps, K, D]: ONE
             # allocator manages the page axis (a slot's page mapping is
@@ -268,6 +278,18 @@ class PPEngine:
         cfg = model_cfg
         mesh = self.mesh
         s_len = self.max_seq_len
+        # TP-in-stage kernels: with flash resolved on a (pipe, model)
+        # mesh, the stage bodies trace attention under the CONTEXT
+        # AbstractMesh (pipe already Manual there) — the spmd wrappers
+        # then run as a nested shard_map over the auto "model" axis.
+        tp_kernels = cfg.attn_impl == "flash" and n_model > 1
+
+        def _stage_mesh_ctx():
+            from contextlib import nullcontext
+            from .models.common import spmd_mesh
+            if not tp_kernels:
+                return nullcontext()
+            return spmd_mesh(jax.sharding.get_abstract_mesh())
 
         def stage_scan(stage_layers, kc_l, vc_l, h, positions, valid,
                        offsets, slot_idx, write_ok):
@@ -290,8 +312,9 @@ class PPEngine:
                     jnp.where(write_ok, nv, vc1[slot_idx]))
                 return h, (kc1, vc1)
 
-            h, (kc_l, vc_l) = jax.lax.scan(
-                body, h, (stage_layers, kc_l, vc_l))
+            with _stage_mesh_ctx():
+                h, (kc_l, vc_l) = jax.lax.scan(
+                    body, h, (stage_layers, kc_l, vc_l))
             return h, kc_l, vc_l
 
         def make_pp_programs(scan_step):
@@ -549,7 +572,37 @@ class PPEngine:
                             jnp.where(write_ok, k, cur_k))
                         vp2 = vp1.at[pages, offs_in].set(
                             jnp.where(write_ok, v, cur_v))
-                        if hh.shape[1] == 1:
+                        if n_model > 1:
+                            # TP-in-stage: the paged kernels as a nested
+                            # shard_map over the auto "model" axis (the
+                            # context mesh has "pipe" already Manual).
+                            # The build-time gate guarantees the head
+                            # layout partitions, so None cannot happen.
+                            ctx = jax.sharding.get_abstract_mesh()
+                            if hh.shape[1] == 1:
+                                out = pattn.paged_decode_spmd(
+                                    ctx, q, kp2, vp2, table, valid,
+                                    sliding_window=cfg.sliding_window,
+                                    softcap=cfg.attn_logit_softcap)
+                            else:
+                                out = pattn.paged_prefill_spmd(
+                                    ctx, q, kp2, vp2, table,
+                                    positions[:, 0], valid,
+                                    sliding_window=cfg.sliding_window,
+                                    softcap=cfg.attn_logit_softcap)
+                            if out is None:
+                                # The build gate already guarantees the
+                                # head layout partitions, so the only
+                                # reachable cause is an unsupported
+                                # chunk/pool shape.
+                                raise ValueError(
+                                    "paged pool-direct under TP-in-stage "
+                                    "could not serve this dispatch: "
+                                    f"chunk T={hh.shape[1]} / page_size="
+                                    f"{ps} / head_dim={q.shape[-1]} is "
+                                    "not kernel-legal (or the head "
+                                    "layout stopped partitioning)")
+                        elif hh.shape[1] == 1:
                             out = pattn.paged_decode_attention(
                                 q, kp2, vp2, table, valid,
                                 sliding_window=cfg.sliding_window,
@@ -1000,12 +1053,14 @@ class PPEngine:
                       if getattr(self, "quant_auto_degraded", False)
                       else self.quant),
             "scope": "PP serving: prefill + decode with stage-local KV "
-                     "(contiguous or paged pool; pool-direct paged "
-                     "kernels on pipe-only meshes, gather-view under "
-                     "TP-in-stage); flash kernels inside stages on "
-                     "pipe-only meshes (dense under TP-in-stage); "
-                     "own-slot LCP reuse; cross-knight donor + leader "
-                     "prefix sharing (page aliasing when paged); "
-                     "per-row sampling; int8 w8a16",
+                     "(contiguous or paged pool; pool-direct "
+                     "page-table kernels, incl. TP-in-stage via nested "
+                     "shard_map over the model axis); flash kernels "
+                     "inside stages (raw on pipe-only meshes, spmd "
+                     "wrappers under TP-in-stage; dense only by opt-out "
+                     "or non-partitionable heads); own-slot LCP reuse; "
+                     "cross-knight donor + leader prefix sharing (page "
+                     "aliasing when paged); per-row sampling; int8 "
+                     "w8a16",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
