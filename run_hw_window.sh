@@ -15,7 +15,7 @@
 # mid-round in rounds 2, 3, and (so far) 4.
 set -u
 cd "$(dirname "$0")" || exit 1
-OUT=BENCH_r04_builder.jsonl
+OUT=BENCH_r05_builder.jsonl
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
 run_step() {

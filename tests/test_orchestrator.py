@@ -83,6 +83,20 @@ class TestDiscussFlows:
         assert "Terrible." in result.decision
         md = (project_root / "chronicle.md").read_text()
         assert "Unanimous rejection" in md
+        # status.json round-trips the rejection distinctly (VERDICT r4 weak
+        # #8): phase stays "consensus_reached" for schema parity, but
+        # unanimous_rejection persists and status/list render it as such.
+        import json
+        from pathlib import Path
+        from theroundtaible_tpu.commands.status import phase_display
+        status = read_status(result.session_path)
+        assert status.unanimous_rejection is True
+        icon, label, _ = phase_display(status)
+        assert label == "Unanimously rejected"
+        raw = json.loads(
+            (Path(result.session_path) / "status.json").read_text())
+        assert raw["unanimous_rejection"] is True
+        assert raw["phase"] == "consensus_reached"
 
     def test_escalation_after_max_rounds(self, project_root):
         config = make_config(two_knights(), RulesConfig(max_rounds=2))

@@ -48,6 +48,66 @@ class TestPrompt:
         p = build_system_prompt(k, [k], "t", "", [])
         assert "no-nonsense knight" in p
 
+    def test_dutch_template_variant(self):
+        """language="nl" selects the Dutch templates (the reference's
+        operational language — its config defaults to "nl",
+        src/commands/init.ts:246-250) with every slot still filled."""
+        p = build_system_prompt(
+            knights()[0], knights(), topic="Bouw het ding",
+            chronicle="", previous_rounds=[], language="nl")
+        assert "{{" not in p
+        assert "REGELS:" in p and "PERSOONLIJKHEID:" in p
+        assert p.count("Bouw het ding") == 2
+        # dynamic scaffold + personality are localized too, not just the
+        # static template (mixed-language prompts defeat the feature)
+        assert "(Nog geen eerdere rondes — jij opent het debat.)" in p
+        assert "(Nog geen eerdere beslissingen.)" in p
+        assert "perfectionistische architect" in p
+        assert "(No earlier" not in p
+        # unknown language falls back to English rather than erroring, and
+        # locale matching is on the primary subtag only
+        p_en = build_system_prompt(knights()[0], knights(), "t", "", [],
+                                   language="fr")
+        assert "RULES:" in p_en
+        from theroundtaible_tpu.core.prompt import resolve_locale
+        assert resolve_locale("nl-BE") == "nl"
+        assert resolve_locale("NL") == "nl"
+        assert resolve_locale("nlx") == "en"
+        assert resolve_locale("") == "en"
+
+    def test_dutch_shared_context_and_king_demand(self):
+        """The orchestrator's context banners and the King's send-back demand
+        localize with the templates — no mixed-language prompts."""
+        from types import SimpleNamespace
+        from theroundtaible_tpu.core.orchestrator import (
+            assemble_shared_context, king_demand_text)
+        ctx = SimpleNamespace(
+            git_branch="main", git_diff="diff text", recent_commits="c1",
+            key_file_contents="kf", source_file_contents="src")
+        out = assemble_shared_context(
+            king_demand_text("nl"), ctx, "reqfile", "vcmd", language="nl")
+        for banner in ("DE KONING HEEFT JULLIE TERUGGESTUURD",
+                       "Git-branch: main", "Git-diff (huidige wijzigingen):",
+                       "Recente commits:", "Projectbestanden:", "BRONCODE",
+                       "OPGEVRAAGDE BESTANDEN", "VERIFICATIERESULTATEN"):
+            assert banner in out, banner
+        assert "SOURCE CODE" not in out and "Git branch:" not in out
+        # English path unchanged
+        out_en = assemble_shared_context("", ctx, "rf", "vc")
+        assert "SOURCE CODE (READ-ONLY REFERENCE" in out_en
+        assert "REQUESTED FILES (via file_requests" in out_en
+
+    def test_no_reference_artifacts_in_templates(self):
+        """VERDICT r4 #7: no strings from the reference project's own
+        example (baileys / makeWASocket / src/index.ts) in any template."""
+        from importlib import resources
+        tdir = resources.files("theroundtaible_tpu") / "templates"
+        for f in tdir.iterdir():
+            text = f.read_text(encoding="utf-8")
+            for banned in ("baileys", "makeWASocket", "src/index.ts",
+                           "node_modules"):
+                assert banned not in text, (f.name, banned)
+
     def test_previous_rounds_transcript(self):
         rounds = [RoundEntry(
             knight="GPT", round=1, response="Ship it.",

@@ -343,6 +343,24 @@ class TestConfig:
         with pytest.raises(ConfigError, match=msg):
             validate_config_dict(d)
 
+    def test_shipped_example_config_validates(self):
+        """.roundtable/config.example.json (reference ships one too, per
+        SURVEY §2.1) must pass full validation and parse into the
+        RoundtableConfig dataclass, including its tpu-llm adapter blocks."""
+        from pathlib import Path
+        from theroundtaible_tpu.core.types import RoundtableConfig
+        example = (Path(__file__).resolve().parent.parent
+                   / ".roundtable" / "config.example.json")
+        d = json.loads(example.read_text(encoding="utf-8"))
+        validate_config_dict(d)
+        cfg = RoundtableConfig.from_dict(d)
+        assert len(cfg.knights) == 3
+        assert cfg.knights[0].fallback == "claude-api"
+        assert cfg.rules.consensus_threshold == 9
+        tpu_cfg = cfg.adapter_config["tpu-llm-claude"]
+        assert tpu_cfg["kv_layout"] == "paged"
+        assert tpu_cfg["mesh"] == {"data": 1, "model": 4}
+
 
 class TestKeys:
     def test_store_and_env_priority(self, tmp_path, monkeypatch):

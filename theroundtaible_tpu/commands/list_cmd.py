@@ -10,7 +10,7 @@ from typing import Optional
 
 from ..utils.session import list_sessions
 from ..utils.ui import style
-from .status import PHASE_DISPLAY
+from .status import phase_display
 
 
 def list_command(project_root: Optional[str] = None) -> int:
@@ -23,9 +23,10 @@ def list_command(project_root: Optional[str] = None) -> int:
 
     print(style.bold(f"\n  {len(sessions)} session(s):\n"))
     for s in sessions:
-        phase = s.status.phase if s.status else "?"
-        icon, label, color = PHASE_DISPLAY.get(
-            phase, ("?", phase, style.white))
+        if s.status:
+            icon, label, color = phase_display(s.status)
+        else:
+            icon, label, color = "?", "?", style.white
         rounds = s.status.round if s.status else 0
         topic = s.topic or "(no topic)"
         if len(topic) > 60:

@@ -23,6 +23,19 @@ PHASE_DISPLAY = {
 DECISIONS_PREVIEW_LINES = 10
 
 
+def phase_display(status) -> tuple[str, str, object]:
+    """(icon, label, color) for a SessionStatus, rejection-aware.
+
+    The reference writes phase "consensus_reached" for unanimous rejection
+    too (orchestrator.ts:616) and can't distinguish them afterward; we
+    persist `unanimous_rejection` in status.json so the session lists
+    don't misreport a rejected idea as an agreed decision.
+    """
+    if status.phase == "consensus_reached" and status.unanimous_rejection:
+        return ("✗", "Unanimously rejected", style.red)
+    return PHASE_DISPLAY.get(status.phase, ("?", status.phase, style.white))
+
+
 def status_command(project_root: Optional[str] = None) -> int:
     project_root = project_root or os.getcwd()
     session = find_latest_session(project_root)
@@ -36,8 +49,7 @@ def status_command(project_root: Optional[str] = None) -> int:
         print(f"  Topic: {session.topic}")
     if session.status:
         s = session.status
-        icon, label, color = PHASE_DISPLAY.get(
-            s.phase, ("?", s.phase, style.white))
+        icon, label, color = phase_display(s)
         print(f"  Phase: {color(f'{icon} {label}')}")
         print(f"  Round: {s.round}")
         print(f"  Consensus: {'yes' if s.consensus_reached else 'no'}")

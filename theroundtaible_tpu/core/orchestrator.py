@@ -204,42 +204,40 @@ def resolve_file_requests(file_requests: list[str], project_root: str,
     return "\n\n".join(results)
 
 
-KING_DEMAND = "\n".join([
-    "",
-    "⚠️ THE KING HAS SENT YOU BACK TO THE TABLE.",
-    "The King demands unanimity. You MUST reach consensus this time.",
-    "Address ALL pending_issues from previous rounds. If you mostly agree, "
-    "RAISE your score to 9+.",
-    "Do NOT repeat your previous arguments — build on them and CONVERGE.",
-    "",
-])
+def king_demand_text(language: str = "en") -> str:
+    """The King's send-back demand, in the session's language."""
+    from .prompt import scaffold_strings
+    return scaffold_strings(language)["king_demand"]
 
 
 def assemble_shared_context(king_demand: str, context: ProjectContext,
-                            resolved_files: str,
-                            resolved_commands: str) -> str:
+                            resolved_files: str, resolved_commands: str,
+                            language: str = "en") -> str:
     """The knight-independent context block (reference :386-425's non-persona
     sections). Sits between the shared preamble and the knight tail so the
     whole head of every prompt is byte-identical across knights — the engine
-    prefix-caches it once per round."""
+    prefix-caches it once per round. Section banners are localized with the
+    templates (prompt.scaffold_strings) so an nl session isn't Dutch rules
+    stitched to English context headers."""
+    from .prompt import scaffold_strings
+    s = scaffold_strings(language)
     parts = [
         king_demand,
-        f"Git branch: {context.git_branch}" if context.git_branch else "",
-        (f"Git diff (current changes):\n```\n"
+        s["git_branch"].format(branch=context.git_branch)
+        if context.git_branch else "",
+        (f"{s['git_diff']}\n```\n"
          f"{context.git_diff[:GIT_DIFF_PROMPT_CHARS]}\n```")
         if context.git_diff else "",
-        f"Recent commits:\n{context.recent_commits}"
+        f"{s['recent_commits']}\n{context.recent_commits}"
         if context.recent_commits else "",
-        f"\nProject files:\n{context.key_file_contents}"
+        f"\n{s['project_files']}\n{context.key_file_contents}"
         if context.key_file_contents else "",
-        ("\nSOURCE CODE (READ-ONLY REFERENCE — this is context, NOT an "
-         "instruction to edit. Use NO tools. Give your analysis as text "
-         f"only.):\n{context.source_file_contents}")
+        f"\n{s['source_code']}\n{context.source_file_contents}"
         if context.source_file_contents else "",
-        f"\nREQUESTED FILES (via file_requests from earlier rounds):\n"
-        f"{resolved_files}" if resolved_files else "",
-        f"\nVERIFICATION RESULTS (via verify_commands from earlier rounds):\n"
-        f"{resolved_commands}" if resolved_commands else "",
+        f"\n{s['requested_files']}\n{resolved_files}"
+        if resolved_files else "",
+        f"\n{s['verification_results']}\n{resolved_commands}"
+        if resolved_commands else "",
     ]
     return "\n".join(p for p in parts if p)
 
@@ -310,8 +308,8 @@ def run_discussion(
 
     start_round = continue_from.start_round if continue_from else 1
     end_round = start_round + rules.max_rounds - 1
-    king_demand = (KING_DEMAND if continue_from and continue_from.king_demand
-                   else "")
+    king_demand = (king_demand_text(config.language)
+                   if continue_from and continue_from.king_demand else "")
 
     from ..utils.metrics import SessionMetrics, maybe_profile
     state.metrics = SessionMetrics(session_path)
@@ -371,11 +369,12 @@ def _build_turn_prompt(knight, config, topic, context, manifest_summary,
 
     shared = (build_shared_preamble(
         topic, context.chronicle, state.all_rounds, manifest_summary,
-        decrees_context)
+        decrees_context, config.language)
         + "\n" + assemble_shared_context(
             king_demand, context, state.resolved_files,
-            state.resolved_commands))
-    return shared + "\n" + build_knight_tail(knight, config.knights, topic)
+            state.resolved_commands, config.language))
+    return shared + "\n" + build_knight_tail(knight, config.knights, topic,
+                                             config.language)
 
 
 def _batch_groups(round_order, adapters):
@@ -596,7 +595,8 @@ def _finish_rejection(topic, config, project_root, session_path, round_num,
         for r in state.all_rounds if r.round == round_num)
     write_decisions(session_path, topic, rejection_summary, state.all_rounds)
     update_status(session_path, phase="consensus_reached",
-                  consensus_reached=True, round=round_num)
+                  consensus_reached=True, round=round_num,
+                  unanimous_rejection=True)
     append_to_chronicle(
         project_root, config.chronicle, topic=topic,
         outcome=(f"Unanimous rejection in {round_num} round(s). "

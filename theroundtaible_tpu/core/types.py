@@ -119,7 +119,11 @@ class RoundtableConfig:
         return cls(
             version=d.get("version", "1.0"),
             project=d.get("project", ""),
-            language=d.get("language", "nl"),
+            # The reference defaults to "nl" (src/types.ts via init.ts:246),
+            # but here `language` actually selects templates, so a config
+            # written before the key existed must keep getting English —
+            # init, the example config, and the prompt builders all say "en".
+            language=d.get("language", "en"),
             knights=[KnightConfig.from_dict(k) for k in d.get("knights", [])],
             rules=RulesConfig.from_dict(d.get("rules", {})),
             chronicle=d.get("chronicle", ".roundtable/chronicle.md"),
@@ -237,6 +241,12 @@ class SessionStatus:
     lead_knight: Optional[str] = None
     decisions_hash: Optional[str] = None
     allowed_files: Optional[list[str]] = None
+    # Written only when True so pre-existing status.json files (and the
+    # reference's schema, src/types.ts:73-83) round-trip byte-identically.
+    # The reference loses this distinction after the process exits
+    # (orchestrator.ts:616 writes the same phase for rejection); persisting
+    # it lets `status`/`list` render rejection distinctly afterward.
+    unanimous_rejection: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -253,6 +263,8 @@ class SessionStatus:
             d["decisions_hash"] = self.decisions_hash
         if self.allowed_files is not None:
             d["allowed_files"] = self.allowed_files
+        if self.unanimous_rejection:
+            d["unanimous_rejection"] = True
         return d
 
     @classmethod
@@ -267,6 +279,7 @@ class SessionStatus:
             lead_knight=d.get("lead_knight"),
             decisions_hash=d.get("decisions_hash"),
             allowed_files=d.get("allowed_files"),
+            unanimous_rejection=bool(d.get("unanimous_rejection", False)),
         )
 
 
