@@ -177,6 +177,29 @@ class TestPagedEngineParity:
         assert stats_p.reused_tokens > 0
         assert stats_p.reused_tokens == stats_d.reused_tokens
 
+    def test_ring_prefill_with_replica_padding(self):
+        """data>1 pool-direct + seq_parallel: fresh long prompts take the
+        ring program with replica-PADDED rows (regression: _prefill_ring
+        sized its arrays from the unpadded slot_ids and crashed on any
+        padded batch). Uneven groups + a pad row, parity vs chunked."""
+        cfg = get_model_config("tiny-llama", max_seq_len=512)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        ring = InferenceEngine(
+            cfg, mesh_shape={"data": 2, "model": 2}, num_slots=4,
+            kv_layout="paged", page_size=32, num_pages=40,
+            dtype=jnp.float32, seed=3,
+            seq_parallel=4, long_threshold=32, sampling=sp)
+        ref = InferenceEngine(cfg, mesh_shape={"data": 2, "model": 2},
+                              num_slots=4, dtype=jnp.float32, seed=3,
+                              sampling=sp)
+        assert ring.paged_direct and ring._paged_replicas == 2
+        bos = ring.tokenizer.bos_id
+        prompts = [("a", [bos] + [7] * 255),   # tpad 256 → ring path
+                   ("b", [bos] + [9] * 199),
+                   ("c", [bos] + [11] * 179)]  # 3 rows / 2 replicas → pad
+        assert (ring.generate_batch(prompts, max_new_tokens=8)
+                == ref.generate_batch(prompts, max_new_tokens=8))
+
     def test_paged_engine_pages_scale_with_use(self):
         paged, _ = self._engines()
         paged.generate("short", slot_name="s", max_new_tokens=8)
@@ -452,8 +475,66 @@ class TestDataShardedPagedEngine:
         spec = tuple(k0.sharding.spec)
         assert spec[0] == "data"
         assert k0.sharding.shard_shape(k0.shape)[0] == k0.shape[0] // 2
-        # pool-direct stays a data==1 fast path; data>1 serves gather-view
-        assert not paged.paged_direct
+        # data>1 serves pool-direct too (VERDICT r4 #4): batches are
+        # replica-grouped + padded, the gather view is never built
+        assert paged.paged_direct
+        assert paged.describe()["paged_decode"] == "pool-direct"
+        assert paged._paged_replicas == 2
+
+    def test_odd_batch_pads_replica_groups(self):
+        """3 rows over data=2 replicas (groups 2/1) force a pad row;
+        generations must be unaffected and identical to contiguous."""
+        paged, ref = self._engines()
+        prompts = [("a", "knight a considers the design."),
+                   ("b", "knight b considers the design."),
+                   ("c", "knight c considers the design.")]
+        assert (paged.generate_batch(prompts, max_new_tokens=10)
+                == ref.generate_batch(prompts, max_new_tokens=10))
+        # single-row follow-up turn pads to one row per replica
+        one = [("b", prompts[1][1] + " and now a follow-up turn.")]
+        assert (paged.generate_batch(one, max_new_tokens=8)
+                == ref.generate_batch(one, max_new_tokens=8))
+
+    def test_warmup_covers_skewed_compositions(self):
+        """b_padded depends on batch COMPOSITION (a 2-row batch on one
+        replica pads to 4); warmup must pre-compile those shapes — incl.
+        when num_slots doesn't divide the data axis — and cap warm
+        prompt lengths at what the pool can pin instead of exhausting."""
+        import time
+        cfg = get_model_config("tiny-llama", max_seq_len=256)
+        eng = InferenceEngine(
+            cfg, mesh_shape={"data": 2, "model": 2}, num_slots=3,
+            kv_layout="paged", page_size=32, dtype=jnp.float32, seed=3,
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=4))
+        eng.warmup(batch_sizes=(2,))  # must not exhaust the half pool
+        for n in "abc":
+            eng.kv.acquire(n)
+        same = [n for n in "abc" if eng.kv.replica_of(n) == 0][:2]
+        assert len(same) == 2
+        t0 = time.monotonic()
+        outs = eng.generate_batch([(same[0], "one question"),
+                                   (same[1], "two question")],
+                                  max_new_tokens=4)
+        assert len(outs) == 2
+        # skewed composition pads to shape 4 — pre-warmed, no mid-serve
+        # compile (a fresh compile of these programs takes many seconds)
+        assert time.monotonic() - t0 < 2.5
+
+    def test_replica_group_plan_layout(self):
+        from theroundtaible_tpu.engine.serving_loop import ReplicaGroupPlan
+        plan = ReplicaGroupPlan([1, 0, 0, 1, 1], 2)
+        assert plan.b_padded == 6 and plan.group == 3
+        # block 0 = replica-0 rows (original order), block 1 = replica-1
+        assert list(plan.pos) == [3, 0, 1, 4, 5]
+        assert list(plan.pad_positions) == [2]
+        assert plan.pad_replicas == [0]
+        vals = plan.scatter_rows(np.asarray([10, 20, 30, 40, 50]), -1)
+        assert list(np.asarray(vals)) == [20, 30, -1, 10, 40, 50]
+        assert list(np.asarray(vals)[plan.pos]) == [10, 20, 30, 40, 50]
+        table = np.arange(10).reshape(5, 2)
+        padded = plan.pad_table(table, lambda r: 100 + r)
+        assert list(padded[plan.pos].ravel()) == list(table.ravel())
+        assert list(padded[2]) == [100, 100]
 
     def test_batch_parity_with_cross_replica_sharing(self):
         paged, ref = self._engines()

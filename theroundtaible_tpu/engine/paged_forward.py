@@ -24,10 +24,12 @@ so the per-step scatter never touches an aliased page.
 
 Multi-device: the kernel runs under shard_map via paged_decode_spmd
 (kv heads on "model" — matching the engine's pool sharding — batch
-rows on "data" when divisible); head layouts that don't partition fall
-back to the engine's gather-view decode at build time
-(engine.paged_direct), so this module never traces an unpartitionable
-kernel.
+rows on "data"). With pool_replicas > 1 the pool's page axis is also
+data-sharded and the caller must deliver replica-grouped, padded
+batches (engine ReplicaGroupPlan); the kernels rebase tables to each
+shard's local page range. Head layouts that don't partition fall back
+to the engine's gather-view serving at build time (engine.paged_direct),
+so this module never traces an unpartitionable kernel.
 """
 
 from __future__ import annotations
@@ -48,6 +50,7 @@ def forward_paged(
     pools: list,                  # per-layer (k_pool, v_pool) [P,ps,K,D]
     table: jax.Array,             # [B, pages_per_seq] int32
     kv_valid_len: jax.Array,      # [B] valid entries AFTER this call
+    pool_replicas: int = 1,       # data-axis shards of the page axis
 ) -> tuple[jax.Array, list]:
     """One serving step off the page pools — decode (T==1) or a prefill
     chunk (T==bucket); returns (logits [B,T,V], new_pools). Mirrors
@@ -83,7 +86,8 @@ def forward_paged(
                     out = pattn.paged_decode_spmd(
                         mesh, q, k_pool2, v_pool2, table, kv_valid_len,
                         sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        pool_replicas=pool_replicas)
                 else:
                     out = pattn.paged_decode_attention(
                         q, k_pool2, v_pool2, table, kv_valid_len,
@@ -95,7 +99,8 @@ def forward_paged(
                         mesh, q, k_pool2, v_pool2, table,
                         positions[:, 0], kv_valid_len,
                         sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        pool_replicas=pool_replicas)
                 else:
                     out = pattn.paged_prefill_attention(
                         q, k_pool2, v_pool2, table, positions[:, 0],

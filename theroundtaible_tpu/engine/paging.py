@@ -28,10 +28,13 @@ scatters stay replica-local), every slot is pinned to one replica at
 creation (least-loaded, deterministic) and only ever allocates from its
 replica's range, and cross-replica prefix sharing falls back from page
 ALIASING to page COPIES (an aliased page cannot live on two replicas).
-Serving under data>1 uses the gather-view programs, where XLA inserts
-the cross-replica collectives the dynamic page ownership implies; the
-pool-direct kernels (which shard batch rows over "data" and would need
-rows grouped by replica) remain a data==1 fast path.
+Serving under data>1 is pool-direct too (VERDICT r4 #4): the engine
+permutes each batch into contiguous per-replica row blocks — matching
+how shard_map splits the batch axis — pads every block to the largest
+group with scratch-table rows that start done, and the spmd kernels
+rebase each shard's table to its local page range via axis_index. The
+gather view survives only as the non-partitionable-heads / attn="dense"
+fallback.
 
 The device side stays simple on purpose: the engine's jit'd programs
 gather `pool[table]` into the same position-aligned `[B, S, K, D]` view
@@ -407,6 +410,23 @@ class PagedKVCache:
                 jnp.asarray(cow_dst, jnp.int32))
 
     # --- device tables ---
+
+    def replica_of(self, name: str) -> int:
+        """Data-axis replica owning every page of `name`'s slot — the
+        engine's replica-grouped batch plan keys on this (pool-direct
+        serving under data>1 shards batch rows over "data", so each row
+        must sit in the batch block of the replica holding its pages)."""
+        return self._slots[name].replica
+
+    def pages_per_replica(self) -> int:
+        """Usable (non-scratch) pages in each replica's range — what a
+        replica's rows can collectively pin before exhaustion."""
+        return self._per_replica - 1
+
+    def scratch_page(self, replica: int) -> int:
+        """The reserved scratch page of a replica's range — pad batch
+        rows point their whole table here (never aliased, never read)."""
+        return self._scratch[replica]
 
     def table_for(self, names: list[str]) -> np.ndarray:
         """[B, pages_per_seq] int32 page table, padded with each slot's

@@ -382,10 +382,13 @@ def paged_prefill_spmd(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    pool_replicas: int = 1,
 ) -> Optional[jax.Array]:
     """paged_prefill_attention under a (data, model) mesh — the same
     partitioning as paged_decode_spmd (kv heads on "model" matching the
-    pool's sharding; table/offsets/valid row-aligned with the batch)."""
+    pool's sharding; table/offsets/valid row-aligned with the batch;
+    pool_replicas > 1 shards the page axis over "data" and rebases each
+    shard's table to its local range — see paged_decode_spmd)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -395,11 +398,20 @@ def paged_prefill_spmd(
     if axes_t is None or not paged_prefill_supported(t, page_size, d):
         return None
     batch_ax, head_ax, kv_head_ax = axes_t
+    page_ax = None
+    if pool_replicas > 1:
+        if (batch_ax != "data"
+                or dict(mesh.shape).get("data", 1) != pool_replicas):
+            return None
+        page_ax = "data"
+    per_replica = k_pool.shape[0] // pool_replicas
 
     q_spec = P(batch_ax, None, head_ax, None)
-    pool_spec = P(None, None, kv_head_ax, None)
+    pool_spec = P(page_ax, None, kv_head_ax, None)
 
     def body(ql, kp, vp, tl, ol, vl):
+        if page_ax is not None:
+            tl = tl - jax.lax.axis_index("data") * per_replica
         return paged_prefill_attention(
             ql, kp, vp, tl, ol, vl, sliding_window=sliding_window,
             softcap=softcap, interpret=interpret)
@@ -642,6 +654,7 @@ def paged_decode_spmd(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    pool_replicas: int = 1,
 ) -> Optional[jax.Array]:
     """paged_decode_attention under a multi-device (data, model) mesh.
 
@@ -653,6 +666,16 @@ def paged_decode_spmd(
     single kv head and shards only q heads. Returns None when the head
     layout doesn't partition — the engine then serves paged decode
     through the gather view instead.
+
+    pool_replicas > 1 (VERDICT r4 #4): the pool's PAGE axis is sharded
+    over "data" (per-replica pools, engine/paging.py), so each data
+    shard holds pages [r*P/R, (r+1)*P/R) and the batch MUST arrive
+    replica-grouped: block r's rows reference only replica r's pages
+    (the engine's ReplicaGroupPlan pads and permutes the batch to make
+    this hold). The body rebases each shard's table to its local page
+    range via axis_index — the gather view is never built. Returns None
+    when the batch doesn't divide over "data" (serving always pads) or
+    the mesh's data size disagrees with pool_replicas.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -663,11 +686,20 @@ def paged_decode_spmd(
     if axes_t is None or not paged_decode_supported(page_size, d):
         return None
     batch_ax, head_ax, kv_head_ax = axes_t
+    page_ax = None
+    if pool_replicas > 1:
+        if (batch_ax != "data"
+                or dict(mesh.shape).get("data", 1) != pool_replicas):
+            return None
+        page_ax = "data"
+    per_replica = k_pool.shape[0] // pool_replicas
 
     q_spec = P(batch_ax, None, head_ax, None)
-    pool_spec = P(None, None, kv_head_ax, None)
+    pool_spec = P(page_ax, None, kv_head_ax, None)
 
     def body(ql, kp, vp, tl, vl):
+        if page_ax is not None:
+            tl = tl - jax.lax.axis_index("data") * per_replica
         return paged_decode_attention(
             ql, kp, vp, tl, vl, sliding_window=sliding_window,
             softcap=softcap, interpret=interpret)
