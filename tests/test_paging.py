@@ -500,25 +500,35 @@ class TestDataShardedPagedEngine:
         replica pads to 4); warmup must pre-compile those shapes — incl.
         when num_slots doesn't divide the data axis — and cap warm
         prompt lengths at what the pool can pin instead of exhausting."""
-        import time
+
         cfg = get_model_config("tiny-llama", max_seq_len=256)
         eng = InferenceEngine(
             cfg, mesh_shape={"data": 2, "model": 2}, num_slots=3,
             kv_layout="paged", page_size=32, dtype=jnp.float32, seed=3,
             sampling=SamplingParams(temperature=0.0, max_new_tokens=4))
+        # Spy on the warm batches: the expansion must warm a 3-row
+        # balanced batch (whose padded device shape is 4 = the shape a
+        # SKEWED 2-row batch pads to), not just the requested size 2.
+        # Deterministic — doesn't depend on compile-cache state.
+        warmed_sizes = set()
+        real_generate = eng.generate_batch
+
+        def spy(turns, **kw):
+            warmed_sizes.add(len(turns))
+            return real_generate(turns, **kw)
+
+        eng.generate_batch = spy
         eng.warmup(batch_sizes=(2,))  # must not exhaust the half pool
+        eng.generate_batch = real_generate
+        assert {2, 3} <= warmed_sizes, warmed_sizes
         for n in "abc":
             eng.kv.acquire(n)
         same = [n for n in "abc" if eng.kv.replica_of(n) == 0][:2]
         assert len(same) == 2
-        t0 = time.monotonic()
         outs = eng.generate_batch([(same[0], "one question"),
                                    (same[1], "two question")],
                                   max_new_tokens=4)
         assert len(outs) == 2
-        # skewed composition pads to shape 4 — pre-warmed, no mid-serve
-        # compile (a fresh compile of these programs takes many seconds)
-        assert time.monotonic() - t0 < 2.5
 
     def test_replica_group_plan_layout(self):
         from theroundtaible_tpu.engine.serving_loop import ReplicaGroupPlan

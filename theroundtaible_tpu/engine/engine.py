@@ -99,8 +99,9 @@ class InferenceEngine:
         self.sampling = sampling or SamplingParams()
         self.tokenizer = load_tokenizer(checkpoint or None)
 
-        if quant not in ("none", "int8"):
-            raise ValueError(f"quant must be none|int8, got {quant!r}")
+        if quant not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"quant must be none|int8|int4, got {quant!r}")
         self.quant = quant
 
         if checkpoint:
@@ -115,16 +116,16 @@ class InferenceEngine:
         # full-bf16 + int8 (on one device shard_params may alias, and
         # free_source below then deletes those same buffers).
         params = None
-        if quant == "int8":
+        if quant in ("int8", "int4"):
             # AFTER sharding: q/s are jnp ops on the sharded weights, so
             # XLA propagates the NamedShardings (engine/quant.py).
             # free_source: nothing references the bf16 tree after this, so
             # each source leaf is freed as its q lands — 7B-class int8
             # builds peak near bf16-total instead of bf16+int8.
             from .quant import quantize_params
-            self.params = quantize_params(self.params, model_cfg,
-                                          act_dtype=dtype,
-                                          free_source=True)
+            self.params = quantize_params(
+                self.params, model_cfg, act_dtype=dtype,
+                free_source=True, bits=8 if quant == "int8" else 4)
         self.num_params = param_count(self.params)
 
         if kv_layout not in ("contiguous", "paged"):

@@ -139,12 +139,63 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
     return cap * jnp.tanh(x / cap)
 
 
+@dataclasses.dataclass
+class Int4Leaf:
+    """Packed w4a16 weight (engine/quant.py, bits=4): two SIGNED nibbles
+    per int8 byte along `axis` (even element in the low nibble), with
+    per-`group` absmax scales — `s4` has q4's logical shape except
+    `axis` holds n_groups. Dequantization (`dequant_int4`) is a pure
+    elementwise unpack+scale chain, so XLA fuses it into the consuming
+    matmul's operand read and HBM streams the PACKED bytes: ~4.25
+    bits/param vs int8's 8 — llama.cpp's own default serving precision
+    class (reference adapters go through 4-bit GGUF).
+
+    axis/group are static pytree metadata (register_dataclass), so
+    tree_map / sharding / param-byte accounting see only q4/s4 arrays.
+    """
+
+    q4: jax.Array
+    s4: jax.Array
+    axis: int
+    group: int
+
+
+jax.tree_util.register_dataclass(
+    Int4Leaf, data_fields=("q4", "s4"), meta_fields=("axis", "group"))
+
+
+def dequant_int4(q4: jax.Array, s4: jax.Array, axis: int, group: int,
+                 dtype) -> jax.Array:
+    """Unpack + scale an int4-packed weight back to `dtype` — kept a
+    pure elementwise/reshape chain (no gathers) so it fuses."""
+    lo = jnp.int8(q4 << 4) >> 4          # sign-extended low nibble
+    hi = q4 >> 4                         # arithmetic shift: high nibble
+    w = jnp.stack([lo, hi], axis=axis + 1)
+    shape = list(q4.shape)
+    shape[axis] *= 2
+    w = w.reshape(shape)
+    grouped = list(shape)
+    grouped[axis:axis + 1] = [shape[axis] // group, group]
+    s_shape = list(s4.shape)
+    s_shape[axis:axis + 1] = [s4.shape[axis], 1]
+    w = w.reshape(grouped).astype(dtype) \
+        * s4.reshape(s_shape).astype(dtype)
+    return w.reshape(shape)
+
+
 def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
     # bf16 inputs, f32 accumulation on the MXU. An int8-quantized weight
     # ({"q", "s"} dict, engine/quant.py) streams half the HBM bytes: the
     # int8→activation-dtype convert fuses into the matmul operand and the
     # per-output-channel scale applies to the OUTPUT (the scale axes are
-    # the weight's non-contracted axes, which land trailing).
+    # the weight's non-contracted axes, which land trailing). An int4
+    # leaf streams a quarter: its grouped dequant is elementwise, so it
+    # rides the same operand fusion.
+    if isinstance(b, Int4Leaf):
+        return jnp.einsum(spec, a,
+                          dequant_int4(b.q4, b.s4, b.axis, b.group,
+                                       a.dtype),
+                          preferred_element_type=jnp.float32)
     if isinstance(b, dict) and "q" in b:
         y = jnp.einsum(spec, a, b["q"].astype(a.dtype),
                        preferred_element_type=jnp.float32)
@@ -154,8 +205,15 @@ def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
 
 
 def embed_tokens(emb, tokens: jax.Array) -> jax.Array:
-    """Embedding lookup; int8 tables dequantize per looked-up row. The
-    result's dtype follows the param dtype (s carries it for quant)."""
+    """Embedding lookup; quantized tables dequantize per looked-up row.
+    The result's dtype follows the param dtype (s carries it)."""
+    if isinstance(emb, Int4Leaf):
+        # rows gather keeps the packed axis (1 → tokens.ndim after the
+        # gather); dequant only the looked-up rows
+        rows_q = emb.q4[tokens]
+        rows_s = emb.s4[tokens]
+        return dequant_int4(rows_q, rows_s, tokens.ndim, emb.group,
+                            emb.s4.dtype)
     if isinstance(emb, dict) and "q" in emb:
         rows = emb["q"][tokens].astype(emb["s"].dtype)
         return rows * emb["s"][tokens][..., None]
@@ -467,4 +525,15 @@ def init_params(cfg: ModelConfig, key: jax.Array,
 
 
 def param_count(params: Params) -> int:
-    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+    """Logical parameter count: an Int4Leaf's packed byte holds TWO
+    parameters, so it counts 2·q4.size (+ scales, matching how int8
+    counts q + s)."""
+    leaves = jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, Int4Leaf))
+    total = 0
+    for x in leaves:
+        if isinstance(x, Int4Leaf):
+            total += 2 * x.q4.size + x.s4.size
+        else:
+            total += x.size
+    return total

@@ -88,7 +88,9 @@ class PPEngine:
         import dataclasses
 
         if quant not in ("none", "int8"):
-            raise ValueError(f"unknown quant mode {quant!r}")
+            raise ValueError(
+                f"pipeline engine supports quant none|int8, got {quant!r}"
+                " (int4 serves through the main engine)")
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(
                 f"kv_layout must be contiguous|paged, got {kv_layout!r}")

@@ -61,8 +61,27 @@ _EXPERT_SCALE_AXES = {
 }
 
 
+# Axis the int4 packer groups/packs along, per weight key: the LAST
+# einsum-contracted axis (the complement of _SCALE_AXES). Any axis is
+# mathematically valid (int4 dequant is a full elementwise multiply
+# before the contraction), but grouping along the input dim is the
+# llama.cpp-family convention and keeps group error uncorrelated with
+# output channels.
+_PACK_AXIS: dict[str, int] = {
+    "q_proj": 0, "k_proj": 0, "v_proj": 0,   # [E, H|K, D] → E
+    "o_proj": 1,                             # [H, D, E] → D
+    "gate_proj": 0, "up_proj": 0,            # [E, F] → E
+    "down_proj": 0,                          # [F, E] → F
+    "router": 0,                             # [E, X] → E
+    "embedding": 1, "lm_head": 1,            # [V, E] → E
+}
+_EXPERT_PACK_AXIS = {"gate_proj": 1, "up_proj": 1, "down_proj": 1}
+
+
 def quantized(leaf: Any) -> bool:
-    return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
+    from .models.common import Int4Leaf
+    return (isinstance(leaf, dict) and "q" in leaf and "s" in leaf) \
+        or isinstance(leaf, Int4Leaf)
 
 
 def _quantize_leaf(w, scale_axes: tuple[int, ...], act_dtype,
@@ -85,23 +104,85 @@ def _quantize_leaf(w, scale_axes: tuple[int, ...], act_dtype,
     return out
 
 
+def _int4_group_for(dim: int, group: int) -> int:
+    """Largest even divisor of `dim` that is <= group (0 = no valid
+    grouping; the leaf then falls back to int8)."""
+    for g in range(min(group, dim), 1, -1):
+        if g % 2 == 0 and dim % g == 0:
+            return g
+    return 0
+
+
+def _quantize_leaf_int4(w, pack_axis: int, scale_axes: tuple[int, ...],
+                        act_dtype, free_source: bool,
+                        group: int) -> Any:
+    """Symmetric per-group int4 (w ≈ q4 * s4, |q4| <= 7), two nibbles
+    packed per int8 byte along `pack_axis`. A dim that can't group
+    falls back to that leaf staying int8 — mixed trees serve fine
+    (the einsum seam dispatches per leaf)."""
+    from .models.common import Int4Leaf
+
+    pack_axis %= w.ndim
+    dim = w.shape[pack_axis]
+    g = _int4_group_for(dim, group)
+    if g < 2:
+        return _quantize_leaf(w, scale_axes, act_dtype, free_source)
+    w32 = w.astype(jnp.float32)
+    grouped = list(w.shape)
+    grouped[pack_axis:pack_axis + 1] = [dim // g, g]
+    wg = w32.reshape(grouped)
+    absmax = jnp.max(jnp.abs(wg), axis=pack_axis + 1, keepdims=True)
+    s = jnp.maximum(absmax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / s), -8, 7).astype(jnp.int8)
+    q = q.reshape(w.shape)
+    # pack: even element → low nibble, odd → high (dequant_int4's order)
+    paired = list(w.shape)
+    paired[pack_axis:pack_axis + 1] = [dim // 2, 2]
+    q2 = q.reshape(paired)
+    even = jnp.take(q2, 0, axis=pack_axis + 1)
+    odd = jnp.take(q2, 1, axis=pack_axis + 1)
+    packed = (((odd.astype(jnp.int32) & 0xF) << 4)
+              | (even.astype(jnp.int32) & 0xF)).astype(jnp.int8)
+    s4 = jnp.squeeze(s, axis=pack_axis + 1).astype(act_dtype)
+    out = Int4Leaf(q4=packed, s4=s4, axis=pack_axis, group=g)
+    if free_source and isinstance(w, jax.Array):
+        jax.block_until_ready((out.q4, out.s4))
+        w.delete()
+    return out
+
+
 def quantize_params(params: Params, cfg: ModelConfig,
                     act_dtype=jnp.bfloat16,
-                    free_source: bool = False) -> Params:
+                    free_source: bool = False, bits: int = 8,
+                    group: int = 64) -> Params:
     """Quantize the big matmul weights; returns a new tree (norms and any
     unrecognized leaves pass through untouched).
+
+    bits=8 → per-output-channel int8 dicts; bits=4 → per-`group` packed
+    Int4Leaf (a leaf whose pack dim can't group falls back to int8).
 
     free_source=True deletes each source weight buffer as soon as its
     quantized replacement is materialized — the caller must own `params`
     (every serving engine does: the init/load tree is not referenced
     after quantization). Pass-through leaves are never deleted."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
+
+    def one(value, key, expert=False):
+        scale_axes = (_EXPERT_SCALE_AXES if expert else _SCALE_AXES)[key]
+        if bits == 4:
+            pack = (_EXPERT_PACK_AXIS if expert else _PACK_AXIS)[key]
+            return _quantize_leaf_int4(value, pack, scale_axes,
+                                       act_dtype, free_source, group)
+        return _quantize_leaf(value, scale_axes, act_dtype, free_source)
+
     out: Params = {}
     for key, value in params.items():
         if key in ("embedding", "lm_head"):
-            out[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype,
-                                      free_source)
+            out[key] = one(value, key)
         elif key == "layers":
-            out[key] = [_quantize_layer(layer, act_dtype, free_source)
+            out[key] = [_quantize_layer(layer, act_dtype, free_source,
+                                        one)
                         for layer in value]
         else:
             out[key] = value
@@ -109,16 +190,14 @@ def quantize_params(params: Params, cfg: ModelConfig,
 
 
 def _quantize_layer(layer: dict[str, Any], act_dtype,
-                    free_source: bool) -> dict[str, Any]:
+                    free_source: bool, one) -> dict[str, Any]:
     new: dict[str, Any] = {}
     for key, value in layer.items():
         if key == "experts":
-            new[key] = {k: _quantize_leaf(v, _EXPERT_SCALE_AXES[k],
-                                          act_dtype, free_source)
+            new[key] = {k: one(v, k, expert=True)
                         for k, v in value.items()}
         elif key in _SCALE_AXES and "norm" not in key:
-            new[key] = _quantize_leaf(value, _SCALE_AXES[key], act_dtype,
-                                      free_source)
+            new[key] = one(value, key)
         else:
             new[key] = value
     return new
