@@ -290,9 +290,14 @@ def measure_served(min_turns: int = 20) -> dict:
     sample_turns = []
     with tempfile.TemporaryDirectory() as root:
         (Path(root) / ".roundtable" / "sessions").mkdir(parents=True)
-        for topic in TOPICS:
-            if turns >= min_turns and len(sessions) >= 3:
-                break
+        # Cycle topics (with a pass suffix after the first lap) until the
+        # promised turn count is genuinely reached — a lap of quick
+        # round-1 consensus sessions must not end the measurement short.
+        while (turns < min_turns or len(sessions) < 3) \
+                and len(sessions) < 40:
+            topic = TOPICS[len(sessions) % len(TOPICS)]
+            if lap := len(sessions) // len(TOPICS):
+                topic = f"{topic} (pass {lap + 1})"
             res = run_discussion(topic, config, {"tpu-llm": adapter},
                                  root, read_source_code=False)
             for entry in res.all_rounds:
@@ -341,6 +346,14 @@ def main() -> int:
     else:
         print("using cached checkpoint", CKPT_DIR, flush=True)
         record["training"] = "cached"
+        if ARTIFACT.exists():
+            # keep the cached checkpoint's training stats in the artifact
+            try:
+                prior = json.loads(ARTIFACT.read_text()).get("training")
+                if isinstance(prior, dict):
+                    record["training"] = prior
+            except (json.JSONDecodeError, OSError):
+                pass
 
     print("serving through orchestrator...", flush=True)
     record["served"] = measure_served(args.min_turns)

@@ -118,6 +118,32 @@ class TestHbmFits:
         assert all(c["quant"] == "int8" for c in cfgs)
         assert all(c["devices"] == [0] for c in cfgs)
 
+    def test_deeper_overcommit_degrades_to_int4(self):
+        # ~12 GiB device: two 7B-class models fit neither bf16 (~34 GB)
+        # nor both-int8 (~18 GB); the second degrade tier re-flips the
+        # AUTO-int8 groups to grouped int4 (~10 GB total) instead of
+        # raising.
+        cfgs = [{"model": "mistral-7b-instruct", "max_seq_len": 2048,
+                 "num_slots": 2},
+                {"model": "llama-3-8b-instruct", "max_seq_len": 2048,
+                 "num_slots": 2}]
+        with pytest.warns(UserWarning):
+            plan_fleet(cfgs, n_devices=1, budget_bytes=12 * self.GIB)
+        assert any(c["quant"] == "int4" for c in cfgs)
+        assert all(c["quant"] in ("int8", "int4") for c in cfgs)
+        assert all(c.get("_quant_auto_degraded") for c in cfgs)
+
+    def test_explicit_int8_never_reflipped_to_int4(self):
+        # Operator-pinned int8 is an explicit choice: over-budget must
+        # raise, not silently drop precision further.
+        cfgs = [{"model": "mistral-7b-instruct", "quant": "int8",
+                 "max_seq_len": 2048, "num_slots": 2},
+                {"model": "llama-3-8b-instruct", "quant": "int8",
+                 "max_seq_len": 2048, "num_slots": 2}]
+        with pytest.raises(ValueError, match="does not fit"):
+            plan_fleet(cfgs, n_devices=1, budget_bytes=12 * self.GIB)
+        assert all(c["quant"] == "int8" for c in cfgs)
+
     def test_impossible_fit_raises_clear_error(self):
         # Explicit quant pins the configs: nothing to degrade, so the
         # check must raise with the breakdown, not let XLA OOM later.

@@ -506,29 +506,39 @@ class TestDataShardedPagedEngine:
             cfg, mesh_shape={"data": 2, "model": 2}, num_slots=3,
             kv_layout="paged", page_size=32, dtype=jnp.float32, seed=3,
             sampling=SamplingParams(temperature=0.0, max_new_tokens=4))
-        # Spy on the warm batches: the expansion must warm a 3-row
-        # balanced batch (whose padded device shape is 4 = the shape a
-        # SKEWED 2-row batch pads to), not just the requested size 2.
-        # Deterministic — doesn't depend on compile-cache state.
-        warmed_sizes = set()
-        real_generate = eng.generate_batch
+        # Record every padded DEVICE batch shape (ReplicaGroupPlan
+        # b_padded) warmup compiles, then assert the skewed serve's
+        # shape is in that set — the actual no-mid-serve-compile
+        # guarantee, deterministic regardless of compile-cache state
+        # and robust to future padding-rule changes.
+        import theroundtaible_tpu.engine.engine as engine_mod
+        recorded: list[int] = []
+        real_plan = engine_mod.ReplicaGroupPlan
 
-        def spy(turns, **kw):
-            warmed_sizes.add(len(turns))
-            return real_generate(turns, **kw)
+        class RecordingPlan(real_plan):
+            def __init__(self, replicas, n):
+                super().__init__(replicas, n)
+                recorded.append(self.b_padded)
 
-        eng.generate_batch = spy
-        eng.warmup(batch_sizes=(2,))  # must not exhaust the half pool
-        eng.generate_batch = real_generate
-        assert {2, 3} <= warmed_sizes, warmed_sizes
-        for n in "abc":
-            eng.kv.acquire(n)
-        same = [n for n in "abc" if eng.kv.replica_of(n) == 0][:2]
-        assert len(same) == 2
-        outs = eng.generate_batch([(same[0], "one question"),
-                                   (same[1], "two question")],
-                                  max_new_tokens=4)
+        engine_mod.ReplicaGroupPlan = RecordingPlan
+        try:
+            eng.warmup(batch_sizes=(2,))  # must not exhaust the pool
+            warm_shapes = set(recorded)
+            recorded.clear()
+            for n in "abc":
+                eng.kv.acquire(n)
+            same = [n for n in "abc" if eng.kv.replica_of(n) == 0][:2]
+            assert len(same) == 2
+            outs = eng.generate_batch([(same[0], "one question"),
+                                       (same[1], "two question")],
+                                      max_new_tokens=4)
+        finally:
+            engine_mod.ReplicaGroupPlan = real_plan
         assert len(outs) == 2
+        assert recorded, "skewed serve should build a plan"
+        # the skewed 2-row batch pads to a shape warmup already compiled
+        assert set(recorded) <= warm_shapes, (recorded, warm_shapes)
+        assert max(warm_shapes) >= 4  # the skew shape itself
 
     def test_replica_group_plan_layout(self):
         from theroundtaible_tpu.engine.serving_loop import ReplicaGroupPlan

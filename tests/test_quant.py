@@ -258,9 +258,28 @@ class TestInt4:
         assert tree_bytes(q4.params) < 0.33 * tree_bytes(fp.params)
         assert q4.num_params >= fp.num_params
 
-    def test_pp_engine_rejects_int4(self):
+    def test_pp_tp_int4_matches_main_engine(self):
+        """int4 under the pipeline engine (Int4Leaf leaves stacked per
+        stage, placed via quantized_specs' metadata-mirroring spec tree,
+        TP inside stages): token parity with the main engine's int4 on
+        the same seed, contiguous AND paged."""
         from theroundtaible_tpu.engine.pp_serving import PPEngine
-        with pytest.raises(ValueError, match="int4"):
-            PPEngine(get_model_config("tiny-llama", max_seq_len=128),
-                     n_stages=2, n_micro=2, num_slots=2, quant="int4",
-                     devices=[0, 1])
+        cfg = get_model_config("tiny-llama", max_seq_len=128)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        ref = InferenceEngine(cfg, num_slots=2, quant="int4",
+                              dtype=jnp.float32, seed=7, sampling=sp)
+        for extra in ({}, {"kv_layout": "paged", "page_size": 32,
+                           "num_pages": 9}):
+            pp = PPEngine(cfg, n_stages=2, n_model=2, n_micro=2,
+                          num_slots=2, quant="int4", dtype=jnp.float32,
+                          seed=7, sampling=sp, devices=list(range(4)),
+                          **extra)
+            p = "the pipeline serves packed nibbles now"
+            ext = p + " and a follow-up turn reuses the slot prefix"
+            for eng in (pp, ref):
+                eng.kv.release("k")
+            assert (pp.generate(p, slot_name="k", max_new_tokens=8)
+                    == ref.generate(p, slot_name="k", max_new_tokens=8))
+            assert (pp.generate(ext, slot_name="k", max_new_tokens=8)
+                    == ref.generate(ext, slot_name="k", max_new_tokens=8))
+            assert pp.last_stats.reused_tokens > 0

@@ -57,7 +57,11 @@ def estimate_engine_hbm_bytes(engine_cfg: dict[str, Any],
     dtype_b = _DTYPE_BYTES.get(engine_cfg.get("dtype", "bfloat16"), 2)
     # int8: 1 byte per weight + per-output-channel scales (~a few % of
     # leaf count) — 1.05 covers every registry family's scale overhead.
-    w_bytes = int(n_params * (1.05 if engine_cfg.get("quant") == "int8"
+    # int4: packed nibbles (0.5 B) + per-group scales (2 B / 64-group)
+    # — 0.58 covers scales plus the few leaves that fall back to int8.
+    quant = engine_cfg.get("quant")
+    w_bytes = int(n_params * (1.05 if quant == "int8"
+                              else 0.58 if quant == "int4"
                               else dtype_b))
     num_slots = int(engine_cfg.get("num_slots", 4))
     kv_bytes = (num_slots * max_seq * model_cfg.num_layers * 2
@@ -210,13 +214,18 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
         if not over:
             return
         worst_dev = max(over, key=over.get)
+        # Two degrade tiers: bf16 → int8, then (still over) int8 → int4.
+        # Only AUTO-degraded int8 re-flips — an operator's explicit
+        # quant/dtype choice is never rewritten.
         flippable = [(ident, cfgs, per_dev)
                      for ident, cfgs, group, per_dev in contrib
                      if worst_dev in group
                      # EVERY config in the group must be unpinned — the
                      # flip rewrites them all, and an explicit
                      # quant/float32 choice is the operator's to keep
-                     and all("quant" not in c
+                     and all((("quant" not in c)
+                              or (c.get("_quant_auto_degraded")
+                                  and c.get("quant") == "int8"))
                              and c.get("dtype", "bfloat16") != "float32"
                              for c in cfgs)]
         if not flippable:
@@ -228,17 +237,19 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
             raise ValueError(
                 f"Fleet does not fit: device {worst_dev} needs "
                 f"{gib(over[worst_dev])} of {gib(budget_bytes)} HBM "
-                f"({lines}). Fix: quant='int8' on the big models, fewer "
-                "models per chip, smaller max_seq_len/num_slots, or more "
-                "devices.")
+                f"({lines}). Fix: quant='int8'/'int4' on the big models, "
+                "fewer models per chip, smaller max_seq_len/num_slots, "
+                "or more devices.")
         ident, cfgs, per_dev = max(flippable, key=lambda x: x[2])
+        next_quant = ("int4" if cfgs[0].get("quant") == "int8"
+                      else "int8")
         warnings.warn(
             f"Fleet over HBM budget on device {worst_dev}: quantizing "
-            f"{ident.split('|')[0]} to int8 (w8a16) to fit; set "
+            f"{ident.split('|')[0]} to {next_quant} to fit; set "
             "quant explicitly to override", stacklevel=3)
         for c in cfgs:
-            c["quant"] = "int8"
-            # Surfaced in the engine's describe() as "int8
+            c["quant"] = next_quant
+            # Surfaced in the engine's describe() as e.g. "int8
             # (auto-degraded)" — a non-interactive/driver run can easily
             # miss the warning stream, and the serving numerics silently
             # differ from what the operator configured (advisor r3).

@@ -82,7 +82,7 @@ def stack_stage_params(params: Params, cfg: ModelConfig, n_stages: int,
     specs = param_specs(cfg)
     if any(quantized(l) for l in
            jax.tree_util.tree_leaves(params, is_leaf=quantized)):
-        specs = quantized_specs(specs)
+        specs = quantized_specs(specs, params)
     has_model = len(mesh.axis_names) > 1
 
     stacked = jax.tree_util.tree_map(

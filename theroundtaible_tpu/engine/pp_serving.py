@@ -87,10 +87,9 @@ class PPEngine:
                  devices: Optional[list[int]] = None):
         import dataclasses
 
-        if quant not in ("none", "int8"):
+        if quant not in ("none", "int8", "int4"):
             raise ValueError(
-                f"pipeline engine supports quant none|int8, got {quant!r}"
-                " (int4 serves through the main engine)")
+                f"quant must be none|int8|int4, got {quant!r}")
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(
                 f"kv_layout must be contiguous|paged, got {kv_layout!r}")
@@ -159,16 +158,17 @@ class PPEngine:
             params = init_params(model_cfg, jax.random.PRNGKey(seed), dtype)
         self.num_params = param_count(params)
         self.quant = quant
-        if quant == "int8":
+        if quant in ("int8", "int4"):
             # PP is the engine for checkpoints too big for one chip —
-            # exactly where halving streamed weight bytes matters most.
-            # Quantize BEFORE stacking: the {"q","s"} dict leaves stack and
-            # shard like any other layer leaf, and the stage programs reach
-            # them only through _einsum/embed_tokens (which dequantize on
-            # the matmul OUTPUT, see engine/quant.py).
+            # exactly where shrinking streamed weight bytes matters most.
+            # Quantize BEFORE stacking: the {"q","s"} dict / Int4Leaf
+            # leaves stack and shard like any other layer leaf, and the
+            # stage programs reach them only through _einsum/embed_tokens
+            # (which dequantize fusably, see engine/quant.py).
             from .quant import quantize_params
             params = quantize_params(params, model_cfg, act_dtype=dtype,
-                                     free_source=True)
+                                     free_source=True,
+                                     bits=8 if quant == "int8" else 4)
         self.shared, self.staged = stack_stage_params(
             params, model_cfg, n_stages, self.mesh)
 

@@ -33,7 +33,7 @@ _einsum/embed_tokens accessors.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -213,37 +213,60 @@ def _spec_for_scale(spec, scale_axes: tuple[int, ...]):
                for a in scale_axes))
 
 
-def quantized_specs(specs: Params) -> Params:
+def quantized_specs(specs: Params,
+                    params: Optional[Params] = None) -> Params:
     """Transform a param PartitionSpec tree (sharding.param_specs) into
     the spec tree matching quantize_params' OUTPUT structure: each
-    quantized weight spec becomes {"q": spec, "s": kept-axes spec}, so a
-    quantized tree can be explicitly placed (the PP engine stacks leaves
-    itself and cannot rely on jit sharding propagation).
+    quantized weight spec becomes {"q": spec, "s": kept-axes spec} — or
+    an Int4Leaf of specs mirroring the actual leaf's static axis/group
+    metadata (pytree treedefs include that metadata, so explicit
+    placement via tree_map needs it to MATCH; pass the quantized
+    `params` tree whenever it may contain int4 leaves). Needed because
+    the PP engine stacks leaves itself and cannot rely on jit sharding
+    propagation.
 
     Mirrors quantize_params/_quantize_layer key-for-key; keep the two in
     sync when a new weight becomes quantizable."""
     out: Params = {}
     for key, value in specs.items():
+        pv = params.get(key) if params is not None else None
         if key in ("embedding", "lm_head"):
-            out[key] = {"q": value,
-                        "s": _spec_for_scale(value, _SCALE_AXES[key])}
+            out[key] = _qspec_leaf(value, _SCALE_AXES[key], pv)
         elif key == "layers":
-            out[key] = [_quantized_layer_specs(layer) for layer in value]
+            out[key] = [
+                _quantized_layer_specs(
+                    layer, pv[i] if pv is not None else None)
+                for i, layer in enumerate(value)]
         else:
             out[key] = value
     return out
 
 
-def _quantized_layer_specs(layer: dict[str, Any]) -> dict[str, Any]:
+def _qspec_leaf(spec, scale_axes: tuple[int, ...], param_leaf):
+    from .models.common import Int4Leaf
+    if isinstance(param_leaf, Int4Leaf):
+        # q4 shares the weight's spec (pack axis halved — placement's
+        # _fallback_replicated checks divisibility against the actual
+        # shape); s4 has the same rank with the pack axis → n_groups,
+        # so the same entries apply.
+        return Int4Leaf(q4=spec, s4=spec, axis=param_leaf.axis,
+                        group=param_leaf.group)
+    return {"q": spec, "s": _spec_for_scale(spec, scale_axes)}
+
+
+def _quantized_layer_specs(layer: dict[str, Any],
+                           param_layer: Optional[dict[str, Any]] = None
+                           ) -> dict[str, Any]:
     new: dict[str, Any] = {}
     for key, value in layer.items():
+        pv = param_layer.get(key) if param_layer is not None else None
         if key == "experts":
-            new[key] = {k: {"q": v,
-                            "s": _spec_for_scale(v, _EXPERT_SCALE_AXES[k])}
-                        for k, v in value.items()}
+            new[key] = {
+                k: _qspec_leaf(v, _EXPERT_SCALE_AXES[k],
+                               pv.get(k) if pv is not None else None)
+                for k, v in value.items()}
         elif key in _SCALE_AXES and "norm" not in key:
-            new[key] = {"q": value,
-                        "s": _spec_for_scale(value, _SCALE_AXES[key])}
+            new[key] = _qspec_leaf(value, _SCALE_AXES[key], pv)
         else:
             new[key] = value
     return new
