@@ -8,8 +8,16 @@ JSON — the data that says whether the missing milliseconds are in the
 int8 dequant (unfused convert materializing bf16 weights), the
 attention kernel, the sampling epilogue, or dispatch gaps.
 
-Usage: python bench_profile.py          (real chip; gemma-2b int8)
-       ROUNDTABLE_BENCH_CPU=1 ...       (tiny model smoke)
+On hardware, main() runs TWO watchdogged children — `--quant int8`
+then `--quant int4` (gemma-2b each, ~2 records total) — so each config
+gets its own attempt/timeout isolation: a slow int4 trace can never
+force an invisible re-run of an already-captured int8 one. int8
+attributes the standing 45%-of-roofline gap; int4 answers whether the
+packed unpack+scale chain fused into the matmul operand (unfused
+dequant would dominate its trace).
+
+Usage: python bench_profile.py          (real chip; int8 + int4 children)
+       ROUNDTABLE_BENCH_CPU=1 ...       (tiny model smoke, one child)
 Same probe-first watchdog as every bench (bench_common).
 """
 
@@ -23,7 +31,7 @@ import sys
 import tempfile
 import time
 
-ATTEMPT_TIMEOUT_S = 420.0
+ATTEMPT_TIMEOUT_S = 420.0  # per child = per quant config
 MAX_ATTEMPTS = 2
 RETRY_DELAY_S = 20.0
 
@@ -87,16 +95,24 @@ def child() -> int:
     from theroundtaible_tpu.engine import enable_compilation_cache
     enable_compilation_cache()
 
-    from theroundtaible_tpu.engine.engine import InferenceEngine
     from theroundtaible_tpu.engine.models.registry import get_model_config
-    from theroundtaible_tpu.engine.sampling import SamplingParams
 
     on_cpu = jax.devices()[0].platform == "cpu"
+    quant = "int8"
+    if "--quant" in sys.argv:
+        quant = sys.argv[sys.argv.index("--quant") + 1]
     if on_cpu:
-        cfg, decode_tokens, quant = get_model_config("tiny-gemma"), 64, "none"
+        _profile_one(get_model_config("tiny-gemma"), 64, "none")
     else:
-        cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
-        decode_tokens, quant = 192, "int8"
+        _profile_one(get_model_config("gemma-2b-it", max_seq_len=2048),
+                     192, quant)
+    return 0
+
+
+def _profile_one(cfg, decode_tokens: int, quant: str) -> None:
+    import jax
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.sampling import SamplingParams
 
     engine = InferenceEngine(
         cfg, num_slots=2, quant=quant,
@@ -133,7 +149,7 @@ def child() -> int:
     s = engine.last_stats
 
     rec = {
-        "metric": f"decode_profile[{cfg.name}]",
+        "metric": f"decode_profile[{cfg.name}][{quant}]",
         "value": round(s.decode_tps, 2),
         "unit": "tokens/s",
         "vs_baseline": 0.0,  # diagnostic record, not a headline
@@ -150,13 +166,19 @@ def child() -> int:
         },
     }
     print(json.dumps(rec), flush=True)
-    return 0
 
 
 def main() -> int:
     from bench_common import run_watchdogged
-    return run_watchdogged(os.path.abspath(__file__), [],
-                           ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS, RETRY_DELAY_S)
+    rc = 0
+    for quant in ("int8", "int4"):
+        rc |= run_watchdogged(os.path.abspath(__file__),
+                              ["--quant", quant],
+                              ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS,
+                              RETRY_DELAY_S)
+        if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+            break  # CPU smoke profiles one tiny config
+    return rc
 
 
 if __name__ == "__main__":
