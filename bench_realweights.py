@@ -122,12 +122,13 @@ def make_prompt_and_reply(rng: random.Random) -> tuple[str, str]:
         parse_consensus_from_response
 
     me = knights[rng.randrange(3)]
+    # COMPLETE previous rounds only: measure_served runs with
+    # parallel_rounds=True, where every knight's prompt contains whole
+    # rounds and never a partial current one — training must match.
     rounds = []
     n_rounds = rng.randrange(0, 3)
     for rnum in range(1, n_rounds + 1):
         for k in knights:
-            if rnum == n_rounds and k.name == me.name:
-                break
             resp = make_reply(rng)
             # attach the PARSED block so format_previous_rounds renders
             # the "Consensus score: X/10" lines real round-2+ prompts
@@ -321,7 +322,7 @@ def measure_served(min_turns: int = 20) -> dict:
     return {
         "turns": turns, "parsed": parsed,
         "parse_rate": round(parsed / max(turns, 1), 3),
-        "score_histogram": dict(sorted(scores.items())),
+        "score_histogram": dict(sorted(scores.items(), key=lambda kv: int(kv[0]))),
         "session_outcomes": outcomes, "sessions": sessions,
         "sample_turns": sample_turns,
     }

@@ -97,6 +97,44 @@ class TestPrompt:
         assert "SOURCE CODE (READ-ONLY REFERENCE" in out_en
         assert "REQUESTED FILES (via file_requests" in out_en
 
+    def test_nl_full_discussion_prompt_has_no_english_scaffolding(self):
+        """End-to-end nl prompt THROUGH THE ORCHESTRATOR (not the
+        builders directly): empty manifest fallback, decree banner and
+        rejection displays must all localize — each of these leaked
+        English in review despite the builder-level tests passing."""
+        import random
+        import tempfile
+        from pathlib import Path
+        from theroundtaible_tpu.adapters.fake import (FakeAdapter,
+                                                      scripted_response)
+        from theroundtaible_tpu.core.orchestrator import run_discussion
+        from theroundtaible_tpu.core.types import (KnightConfig,
+                                                   RoundtableConfig,
+                                                   RulesConfig)
+        from theroundtaible_tpu.utils.decree_log import add_decree_entry
+
+        config = RoundtableConfig(
+            version="1.0", project="p", language="nl",
+            knights=[KnightConfig(name="Claude", adapter="f",
+                                  capabilities=["bouw"], priority=1)],
+            rules=RulesConfig(max_rounds=1), chronicle="chronicle.md",
+            adapter_config={"f": {}})
+        seen = []
+        adapter = FakeAdapter("Claude", [scripted_response(9)],
+                              on_execute=seen.append)
+        with tempfile.TemporaryDirectory() as root:
+            (Path(root) / ".roundtable" / "sessions").mkdir(parents=True)
+            add_decree_entry(root, "deferred", "self",
+                             "een eerder onderwerp", "te vroeg")
+            run_discussion("een nieuw onderwerp", config, {"f": adapter},
+                           root, rng=random.Random(0))
+        prompt = seen[0]
+        assert "KONINKLIJKE DECRETEN" in prompt
+        assert "Nog geen implementatiegeschiedenis." in prompt
+        for english in ("KING'S DECREES", "No implementation history",
+                        "(No earlier", "RULES:", "Git branch:"):
+            assert english not in prompt, english
+
     def test_no_reference_artifacts_in_templates(self):
         """VERDICT r4 #7: no strings from the reference project's own
         example (baileys / makeWASocket / src/index.ts) in any template."""

@@ -52,7 +52,12 @@ def status_command(project_root: Optional[str] = None) -> int:
         icon, label, color = phase_display(s)
         print(f"  Phase: {color(f'{icon} {label}')}")
         print(f"  Round: {s.round}")
-        print(f"  Consensus: {'yes' if s.consensus_reached else 'no'}")
+        # consensus_reached is True for unanimous rejection too (schema
+        # parity with the reference) — the display must not contradict
+        # the rejection phase line above it
+        consensus = ("unanimous rejection" if s.unanimous_rejection
+                     else "yes" if s.consensus_reached else "no")
+        print(f"  Consensus: {consensus}")
         if s.current_knight:
             print(f"  Current knight: {s.current_knight}")
         if s.lead_knight:

@@ -281,10 +281,11 @@ def run_discussion(
                             max_source_chars,
                             on_overflow=reporter.overflow_warning)
     manifest = read_manifest(project_root)
-    manifest_summary = get_manifest_summary(manifest)
+    manifest_summary = get_manifest_summary(manifest, config.language)
     decree_log = read_decree_log(project_root)
     active_decrees = get_active_decrees(decree_log)
-    decrees_context = format_decrees_for_prompt(active_decrees)
+    decrees_context = format_decrees_for_prompt(active_decrees,
+                                                config.language)
     reporter.context_done(context, len(manifest.features), len(active_decrees))
 
     if continue_from:

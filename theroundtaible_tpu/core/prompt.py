@@ -139,6 +139,9 @@ _SCAFFOLD = {
         "open_points": "Open points: {issues}",
         "no_chronicle": "(No earlier decisions.)",
         "no_manifest": "No implementation history yet.",
+        "decrees_banner": ("KING'S DECREES (rejected decisions — do NOT "
+                           "re-propose unless you explicitly address the "
+                           "rejection reason):"),
         "git_branch": "Git branch: {branch}",
         "git_diff": "Git diff (current changes):",
         "recent_commits": "Recent commits:",
@@ -168,6 +171,9 @@ _SCAFFOLD = {
         "open_points": "Open punten: {issues}",
         "no_chronicle": "(Nog geen eerdere beslissingen.)",
         "no_manifest": "Nog geen implementatiegeschiedenis.",
+        "decrees_banner": ("KONINKLIJKE DECRETEN (afgewezen beslissingen "
+                           "— stel NIET opnieuw voor tenzij je de "
+                           "afwijsreden expliciet adresseert):"),
         "git_branch": "Git-branch: {branch}",
         "git_diff": "Git-diff (huidige wijzigingen):",
         "recent_commits": "Recente commits:",

@@ -1,4 +1,4 @@
-"""int8 weight quantization (w8a16) for serving.
+"""Weight quantization for serving: int8 (w8a16) and grouped int4 (w4a16).
 
 Decode throughput is weight-streaming-bound: every generated token reads
 every parameter from HBM once, so bf16 weights cap a v5e-1 at roughly
@@ -10,12 +10,20 @@ Ollama/llama.cpp serves quantized GGUF by default (reference
 src/adapters/local-llm.ts reaches 4-bit llama.cpp kernels), so bf16-only
 serving would be racing a quantized baseline with one leg tied.
 
-Representation: each big matmul weight leaf becomes a dict
+Representations (consumers must handle BOTH — `quantized()` is the
+predicate):
+- bits=8: each big matmul weight leaf becomes a dict
   {"q": int8[w.shape], "s": act_dtype[kept axes]}
-where `s` = absmax/127 over the einsum-CONTRACTED axes (w ≈ q * s with s
-broadcast over the kept/output axes). models/common.py's `_einsum` and
-`embed_tokens` dequantize by scaling the matmul OUTPUT — a fusable
-elementwise multiply — never materializing a bf16 copy of the weight.
+  where `s` = absmax/127 over the einsum-CONTRACTED axes (w ≈ q * s with
+  s broadcast over the kept/output axes). models/common.py's `_einsum`
+  and `embed_tokens` dequantize by scaling the matmul OUTPUT — a fusable
+  elementwise multiply — never materializing a bf16 copy of the weight.
+- bits=4: an Int4Leaf (models/common.py) — two SIGNED nibbles packed per
+  int8 byte along the contracted `axis`, per-`group` absmax/7 scales
+  (axis/group are static pytree metadata). Dequant is a pure elementwise
+  unpack+scale chain that fuses into the consuming matmul operand; a
+  leaf whose pack dim cannot group falls back to the int8 dict form, so
+  bits=4 trees are MIXED by design.
 Norm weights stay untouched (tiny, accuracy-critical).
 
 Quantization runs AFTER shard_params: q/s are computed with jnp ops on

@@ -94,18 +94,18 @@ def get_active_decrees(log: DecreeLog, max_entries: int = 5) -> list[DecreeEntry
     return active[-max_entries:]
 
 
-def format_decrees_for_prompt(decrees: list[DecreeEntry]) -> str:
-    """Prompt injection block (reference decree-log.ts:89-103)."""
+def format_decrees_for_prompt(decrees: list[DecreeEntry],
+                              language: str = "en") -> str:
+    """Prompt injection block (reference decree-log.ts:89-103; its
+    banner is Dutch — ours localizes with the session language)."""
     if not decrees:
         return ""
+    from ..core.prompt import scaffold_strings
     lines = []
     for d in decrees:
         date_short = d.date[:10]
         topic_short = d.topic[:47] + "..." if len(d.topic) > 50 else d.topic
         lines.append(f'- [{d.id}] {d.type.upper()} — "{topic_short}": '
                      f'"{d.reason}" ({date_short})')
-    return "\n".join([
-        "KING'S DECREES (afgewezen beslissingen — stel NIET opnieuw voor "
-        "tenzij je de afwijsreden expliciet adresseert):",
-        *lines,
-    ])
+    return "\n".join([scaffold_strings(language)["decrees_banner"],
+                      *lines])

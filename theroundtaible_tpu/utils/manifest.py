@@ -77,11 +77,14 @@ def check_manifest(project_root: str | Path) -> list[str]:
     return warnings
 
 
-def get_manifest_summary(manifest: Manifest) -> str:
+def get_manifest_summary(manifest: Manifest, language: str = "en") -> str:
     """Compact prompt summary: last 15 features, newest first
-    (reference manifest.ts:124-144)."""
+    (reference manifest.ts:124-144). The empty-history fallback is
+    localized with the prompt scaffolding (an nl session must not get
+    an English IMPLEMENTATIESTATUS body)."""
     if not manifest.features:
-        return "No implementation history yet."
+        from ..core.prompt import scaffold_strings
+        return scaffold_strings(language)["no_manifest"]
     recent = list(reversed(manifest.features[-15:]))
     lines = []
     for f in recent:
