@@ -44,4 +44,16 @@ run_step "bench.py (config 1)"        python bench.py
 run_step "bench_profile.py"           python bench_profile.py
 run_step "bench_discuss.py (config 2)" python bench_discuss.py
 run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
+# LAST + timeout-guarded: bench_realweights is not watchdogged (its CPU
+# artifact is already committed) — on a live chip this serves the REAL
+# trained checkpoint through discuss on TPU, but a mid-window tunnel
+# death must not hang the window after the core four steps landed.
+run_step "bench_realweights.py (on-chip)" \
+  timeout 900 python bench_realweights.py --min-turns 20
+git add REALWEIGHTS_r05.json 2>/dev/null && \
+  git commit -q -o REALWEIGHTS_r05.json \
+    -m "Hardware window: on-chip realweights artifact
+
+No-Verification-Needed: measurement artifact only, no source change" \
+  || true
 echo "window complete: $(stamp)"; tail -n +1 "$OUT" | wc -l
