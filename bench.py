@@ -113,6 +113,11 @@ def child() -> int:
         decode_tokens = 256
 
     failed: list[dict] = []  # configs that errored (emit records them)
+    base_key = f"decode_tokens_per_sec_per_chip[{cfg.name}]"
+
+    def config_label(quant: str, kv_layout: str) -> str:
+        return ("bf16" if quant == "none" else quant) + \
+            ("-paged" if kv_layout == "paged" else "")
 
     def emit(run: dict, headline: bool) -> None:
         """Print one complete result record for `run` (flushed).
@@ -125,7 +130,6 @@ def child() -> int:
         configs."""
         decode_tps = run["decode_tps"]
         label = run["label"]
-        base_key = f"decode_tokens_per_sec_per_chip[{cfg.name}]"
         detail = {
             "headline": headline,
             "runs": runs if headline else [run],
@@ -189,9 +193,7 @@ def child() -> int:
 
         med, spread, repeats = timed_repeats(run_once)
         s = engine.last_stats
-        label = "bf16" if quant == "none" else quant
-        if kv_layout == "paged":
-            label += "-paged"
+        label = config_label(quant, kv_layout)
         run = {
             "label": label,
             "quant": quant,
@@ -258,8 +260,11 @@ def child() -> int:
         try:
             run = measure(quant, kv_layout)
         except Exception as e:  # noqa: BLE001 — recorded, not hidden
-            label = ("bf16" if quant == "none" else quant) + \
-                ("-paged" if kv_layout == "paged" else "")
+            # Full traceback to stderr: run_watchdogged surfaces its
+            # tail, so a hardware-window failure stays diagnosable.
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            label = config_label(quant, kv_layout)
             failed.append({"quant": quant, "kv_layout": kv_layout,
                            "label": label,
                            "error": f"{type(e).__name__}: {e}"[:300]})
@@ -269,8 +274,7 @@ def child() -> int:
             # through (per-key dedup would suppress it if failures
             # shared the success key).
             print(json.dumps({
-                "metric": (f"decode_tokens_per_sec_per_chip[{cfg.name}]"
-                           f"[{label}][failed]"),
+                "metric": f"{base_key}[{label}][failed]",
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "detail": {"failed": True, **failed[-1]},
             }), flush=True)

@@ -255,3 +255,40 @@ def test_probe_tunnel_real_cpu_child(monkeypatch):
     """probe_tunnel's real child succeeds against the cpu backend."""
     monkeypatch.setenv("ROUNDTABLE_BENCH_CPU", "1")
     assert bench_common.probe_tunnel(timeout_s=120.0, attempts=1)
+
+
+@pytest.mark.slow
+def test_bench_child_survives_one_config_failing(monkeypatch, capsys):
+    """bench.py's per-config failure tolerance: one config raising (the
+    TPU-compile-surprise case) must still land every other config's
+    record AND the headline (the driver's stable metric key), emit the
+    failure under a distinct [label][failed] key, and exit nonzero so
+    the watchdog's retry + per-key dedup can recover the missing
+    config after a transient error."""
+    monkeypatch.setenv("ROUNDTABLE_BENCH_CPU", "1")
+    import theroundtaible_tpu.engine.engine as engine_mod
+
+    real = engine_mod.InferenceEngine
+
+    class Boom(real):
+        def __init__(self, *a, **kw):
+            if (kw.get("quant") == "int8"
+                    and kw.get("kv_layout", "contiguous") == "paged"):
+                raise RuntimeError("simulated TPU compile failure")
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(engine_mod, "InferenceEngine", Boom)
+    import bench
+    rc = bench.child()
+    assert rc == 1  # nonzero → watchdog retry fills the missing config
+    recs = [json.loads(line) for line in
+            capsys.readouterr().out.splitlines()
+            if line.startswith("{")]
+    by_metric = {r["metric"]: r for r in recs}
+    fail_key = [k for k in by_metric if k.endswith("[failed]")]
+    assert fail_key and by_metric[fail_key[0]]["detail"]["failed"]
+    headline = [r for r in recs if r["detail"].get("headline")]
+    assert len(headline) == 1
+    d = headline[0]["detail"]
+    assert {run["label"] for run in d["runs"]} == {"bf16", "int8", "int4"}
+    assert d["failed_configs"][0]["label"] == "int8-paged"
