@@ -45,6 +45,30 @@ class TestModelCore:
         assert len(caches) == cfg.num_layers
         assert caches[0][0].shape == (1, 8, cfg.num_kv_heads, cfg.head_dim)
 
+    def test_last_pos_matches_post_slice(self):
+        """forward(last_pos=p) must equal slicing full logits at p —
+        the prefill paths pass last_pos so the lm head only ever sees
+        one row per batch element (a batched full-sequence [B,T,V] f32
+        logits temp OOM'd the discuss bench on hardware, BENCH_r05);
+        this pins the gather-before-head refactor to the old semantics,
+        including ragged per-row positions."""
+        cfg = get_model_config("tiny-llama")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8],
+                              [4, 3, 2, 1, 0, 0, 0, 0]])
+        positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+        valid = jnp.asarray([8, 4])
+        last = valid - 1
+        full, _ = forward(params, cfg, tokens, positions, None, None,
+                          valid)
+        got, _ = forward(params, cfg, tokens, positions, None, None,
+                         valid, last_pos=last)
+        assert got.shape == (2, 1, cfg.vocab_size)
+        want = np.stack([np.asarray(full[i, int(last[i])], np.float32)
+                         for i in range(2)])
+        np.testing.assert_allclose(np.asarray(got[:, 0], np.float32),
+                                   want, rtol=1e-5, atol=1e-5)
+
     def test_causality(self):
         """Changing a future token must not affect earlier logits."""
         cfg = get_model_config("tiny-llama")

@@ -165,11 +165,12 @@ class TestInt4:
         leaf = qp["layers"][0]["q_proj"]
         assert isinstance(leaf, Int4Leaf)
         assert leaf.q4.dtype == jnp.int8
-        # pack axis E halved; scales per group along E, other axes kept
-        E = cfg.embed_dim
-        assert leaf.q4.shape == (E // 2, cfg.num_heads, cfg.head_dim)
-        assert leaf.s4.shape == (E // leaf.group, cfg.num_heads,
-                                 cfg.head_dim)
+        # LAST axis (D) packed two-per-byte; scales per group along D,
+        # other axes kept (bitcast-unpack layout, see dequant_int4)
+        E, H, D = cfg.embed_dim, cfg.num_heads, cfg.head_dim
+        assert leaf.axis == 2
+        assert leaf.q4.shape == (E, H, D // 2)
+        assert leaf.s4.shape == (E, H, D // leaf.group)
         w = np.asarray(params["layers"][0]["q_proj"], np.float32)
         deq = np.asarray(dequant_int4(leaf.q4, leaf.s4, leaf.axis,
                                       leaf.group, jnp.float32))

@@ -287,13 +287,12 @@ class InferenceEngine:
                 positions = offsets[:, None] + jnp.arange(t)[None, :]
                 valid = offsets + lengths
                 logits, new_b = forward(params, cfg, tokens, positions,
-                                        caches_b, offsets, valid)
+                                        caches_b, offsets, valid,
+                                        last_pos=lengths - 1)
                 new_layers = [
                     (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
                     for (k, v), (nk, nv) in zip(cache_layers, new_b)]
-                last = jnp.take_along_axis(
-                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                return host_read(last), new_layers
+                return host_read(logits[:, 0]), new_layers
 
         self._prefill_step = prefill_step
 
@@ -468,11 +467,10 @@ class InferenceEngine:
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
                     valid = offsets + lengths
                     logits, new_b = forward(params, cfg, tokens, positions,
-                                            caches_b, offsets, valid)
+                                            caches_b, offsets, valid,
+                                            last_pos=lengths - 1)
                     new_pools = scatter_view(pools, tables, new_b, b)
-                    last = jnp.take_along_axis(
-                        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                    return host_read(last), new_pools
+                    return host_read(logits[:, 0]), new_pools
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step_paged_direct(params, pools, tables, tokens,
@@ -484,10 +482,9 @@ class InferenceEngine:
                     valid = offsets + lengths
                     logits, new_pools = forward_paged(
                         params, cfg, tokens, positions, pools, tables,
-                        valid, pool_replicas=data_size)
-                    last = jnp.take_along_axis(
-                        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                    return host_read(last), new_pools
+                        valid, pool_replicas=data_size,
+                        last_pos=lengths - 1)
+                    return host_read(logits[:, 0]), new_pools
 
             self._prefill_step_paged = (prefill_step_paged_direct
                                         if self.paged_direct

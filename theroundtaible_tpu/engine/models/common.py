@@ -142,16 +142,28 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
 @dataclasses.dataclass
 class Int4Leaf:
     """Packed w4a16 weight (engine/quant.py, bits=4): two SIGNED nibbles
-    per int8 byte along `axis` (even element in the low nibble), with
-    per-`group` absmax scales — `s4` has q4's logical shape except
-    `axis` holds n_groups. Dequantization (`dequant_int4`) is a pure
-    elementwise unpack+scale chain, so XLA fuses it into the consuming
-    matmul's operand read and HBM streams the PACKED bytes: ~4.25
-    bits/param vs int8's 8 — llama.cpp's own default serving precision
-    class (reference adapters go through 4-bit GGUF).
+    per int8 byte along the weight's LAST axis (even element in the low
+    nibble), with per-`group` absmax scales — `s4` has q4's logical
+    shape except the last axis holds n_groups. Dequantization
+    (`dequant_int4`) is `lax.bitcast_convert_type(int8 → 2×int4)` —
+    whose nibble pair expands minor-most, exactly matching the last-axis
+    pack — followed by convert, minor-dim reshapes, and the grouped
+    scale multiply: no shifts, no interleaving shuffle, so XLA/Mosaic
+    fuses the chain into the consuming matmul's operand read and HBM
+    streams the PACKED bytes: ~4.25 bits/param vs int8's 8 — llama.cpp's
+    own default serving precision class (reference adapters go through
+    4-bit GGUF). An earlier revision packed along the einsum-contracted
+    axis and unpacked with a stack+reshape interleave; on real TPU that
+    shuffle broke operand fusion and decode measured SLOWER than bf16
+    (BENCH_r05: 22.9 tok/s vs bf16's 130) — the last-axis/bitcast layout
+    exists to keep the unpack inside the matmul fusion.
 
-    axis/group are static pytree metadata (register_dataclass), so
-    tree_map / sharding / param-byte accounting see only q4/s4 arrays.
+    `axis` is always q4.ndim-1 at pack time and is kept as metadata so
+    spec mirroring (quantized_specs) and PP stage-stacking round-trip
+    the treedef; packing minor-most makes it invariant under the PP
+    engine's leading stage-stack. axis/group are static pytree metadata
+    (register_dataclass), so tree_map / sharding / param-byte accounting
+    see only q4/s4 arrays.
     """
 
     q4: jax.Array
@@ -166,20 +178,21 @@ jax.tree_util.register_dataclass(
 
 def dequant_int4(q4: jax.Array, s4: jax.Array, axis: int, group: int,
                  dtype) -> jax.Array:
-    """Unpack + scale an int4-packed weight back to `dtype` — kept a
-    pure elementwise/reshape chain (no gathers) so it fuses."""
-    lo = jnp.int8(q4 << 4) >> 4          # sign-extended low nibble
-    hi = q4 >> 4                         # arithmetic shift: high nibble
-    w = jnp.stack([lo, hi], axis=axis + 1)
+    """Unpack + scale a last-axis int4-packed weight back to `dtype`.
+
+    bitcast int8 → [..., 2]·int4 puts the low nibble at [..., 0], which
+    is exactly the even-low/odd-high pack order, so the unpack is a
+    bitcast + convert + minor-dim merge — every reshape here touches
+    only trailing dims, so the whole chain stays fusable into the
+    consuming matmul operand on TPU (no cross-lane shuffle). `axis`
+    must be the last axis (the only layout the packer emits)."""
+    assert axis == q4.ndim - 1, "int4 pack axis must be minor-most"
+    pairs = jax.lax.bitcast_convert_type(q4, jnp.int4)   # [..., n/2, 2]
     shape = list(q4.shape)
-    shape[axis] *= 2
-    w = w.reshape(shape)
-    grouped = list(shape)
-    grouped[axis:axis + 1] = [shape[axis] // group, group]
-    s_shape = list(s4.shape)
-    s_shape[axis:axis + 1] = [s4.shape[axis], 1]
-    w = w.reshape(grouped).astype(dtype) \
-        * s4.reshape(s_shape).astype(dtype)
+    shape[-1] *= 2
+    w = pairs.astype(dtype).reshape(shape)               # [..., n]
+    grouped = shape[:-1] + [shape[-1] // group, group]
+    w = w.reshape(grouped) * s4[..., None].astype(dtype)
     return w.reshape(shape)
 
 
@@ -424,8 +437,17 @@ def forward(
     kv_caches: Optional[list[tuple[jax.Array, jax.Array]]],
     cache_offset: Optional[jax.Array],   # [B]
     kv_valid_len: jax.Array,      # [B] valid entries AFTER this step
+    last_pos: Optional[jax.Array] = None,   # [B] row index into T
 ) -> tuple[jax.Array, list[tuple[jax.Array, jax.Array]]]:
-    """Full model forward. Returns (logits [B,T,V], updated caches)."""
+    """Full model forward. Returns (logits [B,T,V], updated caches) —
+    or (logits [B,1,V]) when `last_pos` is given: the hidden state is
+    gathered at last_pos BEFORE the lm-head matmul, so prefill never
+    materializes full-sequence logits. On a 256k-vocab model a batched
+    [B,T,V] f32 logits temp is gigabytes (B=3, T=2048 ≈ 6.3 GB — it
+    OOM'd the 3-knight discuss bench on a v5e chip, BENCH_r05) and XLA
+    cannot push the caller's post-hoc dynamic slice back through the
+    einsum; callers that only need the last valid row must pass
+    last_pos instead of slicing the result."""
     # Activations follow the param dtype: bf16 params (serving) keep the
     # whole network bf16; f32 params (HF logit-parity tests) stay f32.
     x = embed_tokens(params["embedding"], tokens)
@@ -447,10 +469,19 @@ def forward(
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,
                  cfg.rmsnorm_unit_offset)
+    if last_pos is not None:
+        x = gather_rows(x, last_pos)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
     logits = _einsum("bte,ve->btv", x, head)
     logits = _softcap(logits, cfg.final_logit_softcap)
     return logits, new_caches
+
+
+def gather_rows(x: jax.Array, pos: jax.Array) -> jax.Array:
+    """Gather one T-row per batch element: [B,T,E], [B] → [B,1,E]."""
+    idx = jnp.broadcast_to(pos[:, None, None],
+                           (x.shape[0], 1, x.shape[2]))
+    return jnp.take_along_axis(x, idx, axis=1)
 
 
 # --- initialization ---

@@ -34,12 +34,14 @@ so this module never traces an unpartitionable kernel.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 from .models.common import (ModelConfig, Params, _einsum, _softcap,
-                            current_spmd_mesh, embed_tokens, project_qkv,
-                            rms_norm, transformer_block)
+                            current_spmd_mesh, embed_tokens, gather_rows,
+                            project_qkv, rms_norm, transformer_block)
 from .pallas import attention as pattn
 
 
@@ -51,9 +53,12 @@ def forward_paged(
     table: jax.Array,             # [B, pages_per_seq] int32
     kv_valid_len: jax.Array,      # [B] valid entries AFTER this call
     pool_replicas: int = 1,       # data-axis shards of the page axis
+    last_pos: Optional[jax.Array] = None,   # [B] row index into T
 ) -> tuple[jax.Array, list]:
     """One serving step off the page pools — decode (T==1) or a prefill
-    chunk (T==bucket); returns (logits [B,T,V], new_pools). Mirrors
+    chunk (T==bucket); returns (logits [B,T,V], new_pools) — [B,1,V]
+    when `last_pos` is given (hidden gathered before the lm head, same
+    OOM guard as models/common.forward). Mirrors
     models/common.forward, with attention + cache update replaced by the
     pool-direct path: each layer scatters its K/V into the rows' pages
     ([B,T] position-indexed — pad-tail cells land on real decode-reserve
@@ -127,6 +132,8 @@ def forward_paged(
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,
                  cfg.rmsnorm_unit_offset)
+    if last_pos is not None:
+        x = gather_rows(x, last_pos)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
     logits = _einsum("bte,ve->btv", x, head)
     logits = _softcap(logits, cfg.final_logit_softcap)

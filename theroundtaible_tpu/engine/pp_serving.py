@@ -66,9 +66,10 @@ from .kvcache import SlotBook
 from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
                            chunked_prefill, decode_segments,
                            finalize_outputs, prompt_budget)
-from .models.common import (ModelConfig, _einsum, embed_tokens, init_params,
-                            make_attention_mask, param_count, project_qkv,
-                            rms_norm, transformer_block)
+from .models.common import (ModelConfig, _einsum, _softcap, embed_tokens,
+                            gather_rows, init_params, make_attention_mask,
+                            param_count, project_qkv, rms_norm,
+                            transformer_block)
 from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
 from .sampling import (SamplingParams, sample_token_batch, sampling_arrays)
 from .tokenizer import load_tokenizer
@@ -415,15 +416,15 @@ class PPEngine:
                 hidden = hidden.reshape(b, t, cfg.embed_dim)
                 hidden = rms_norm(hidden, shared["final_norm"],
                                   cfg.norm_eps, cfg.rmsnorm_unit_offset)
+                # Gather each row's last valid hidden state BEFORE the
+                # lm head: full-sequence [B,T,V] logits on a 256k vocab
+                # are a multi-GB temp (see models/common.forward).
+                hidden = gather_rows(hidden, lengths - 1)
                 head = (shared["embedding"] if cfg.tie_embeddings
                         else shared["lm_head"])
                 logits = _einsum("bte,ve->btv", hidden, head)
-                if cfg.final_logit_softcap is not None:
-                    logits = cfg.final_logit_softcap * jnp.tanh(
-                        logits / cfg.final_logit_softcap)
-                last = jnp.take_along_axis(
-                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-                return last, (c1, c2)
+                logits = _softcap(logits, cfg.final_logit_softcap)
+                return logits[:, 0], (c1, c2)
 
             @partial(jax.jit, donate_argnums=(2,),
                      static_argnames=("max_new", "greedy"))

@@ -19,11 +19,12 @@ predicate):
   and `embed_tokens` dequantize by scaling the matmul OUTPUT — a fusable
   elementwise multiply — never materializing a bf16 copy of the weight.
 - bits=4: an Int4Leaf (models/common.py) — two SIGNED nibbles packed per
-  int8 byte along the contracted `axis`, per-`group` absmax/7 scales
-  (axis/group are static pytree metadata). Dequant is a pure elementwise
-  unpack+scale chain that fuses into the consuming matmul operand; a
-  leaf whose pack dim cannot group falls back to the int8 dict form, so
-  bits=4 trees are MIXED by design.
+  int8 byte along the weight's LAST axis, per-`group` absmax/7 scales
+  (axis/group are static pytree metadata). Dequant is a bitcast
+  (int8 → 2×int4, minor-most expansion) + convert + grouped scale that
+  fuses into the consuming matmul operand on TPU; a leaf whose last dim
+  cannot group falls back to the int8 dict form, so bits=4 trees are
+  MIXED by design.
 Norm weights stay untouched (tiny, accuracy-critical).
 
 Quantization runs AFTER shard_params: q/s are computed with jnp ops on
@@ -69,21 +70,16 @@ _EXPERT_SCALE_AXES = {
 }
 
 
-# Axis the int4 packer groups/packs along, per weight key: the LAST
-# einsum-contracted axis (the complement of _SCALE_AXES). Any axis is
-# mathematically valid (int4 dequant is a full elementwise multiply
-# before the contraction), but grouping along the input dim is the
-# llama.cpp-family convention and keeps group error uncorrelated with
-# output channels.
-_PACK_AXIS: dict[str, int] = {
-    "q_proj": 0, "k_proj": 0, "v_proj": 0,   # [E, H|K, D] → E
-    "o_proj": 1,                             # [H, D, E] → D
-    "gate_proj": 0, "up_proj": 0,            # [E, F] → E
-    "down_proj": 0,                          # [F, E] → F
-    "router": 0,                             # [E, X] → E
-    "embedding": 1, "lm_head": 1,            # [V, E] → E
-}
-_EXPERT_PACK_AXIS = {"gate_proj": 1, "up_proj": 1, "down_proj": 1}
+# The int4 packer always groups/packs along the weight's LAST axis: any
+# axis is mathematically valid (int4 dequant is a full elementwise
+# multiply before the contraction), but only the minor-most axis lets
+# the unpack be a bitcast whose nibble pair expands in place — the
+# layout XLA/Mosaic fuses into the matmul operand on TPU. Packing the
+# contracted axis (the llama.cpp convention, used in an earlier
+# revision) forced an interleaving stack+reshape that broke operand
+# fusion on real TPU and decoded slower than bf16 (BENCH_r05). Scales
+# remain per-group × per-every-other-coordinate, so grouping along a
+# kept axis changes only which direction group error correlates.
 
 
 def quantized(leaf: Any) -> bool:
@@ -121,38 +117,32 @@ def _int4_group_for(dim: int, group: int) -> int:
     return 0
 
 
-def _quantize_leaf_int4(w, pack_axis: int, scale_axes: tuple[int, ...],
+def _quantize_leaf_int4(w, scale_axes: tuple[int, ...],
                         act_dtype, free_source: bool,
                         group: int) -> Any:
     """Symmetric per-group int4 (w ≈ q4 * s4, |q4| <= 7), two nibbles
-    packed per int8 byte along `pack_axis`. A dim that can't group
-    falls back to that leaf staying int8 — mixed trees serve fine
-    (the einsum seam dispatches per leaf)."""
+    packed per int8 byte along the LAST axis (even element → low
+    nibble — the order `lax.bitcast_convert_type` unpacks, see
+    dequant_int4). A last dim that can't group falls back to that leaf
+    staying int8 — mixed trees serve fine (the einsum seam dispatches
+    per leaf)."""
     from .models.common import Int4Leaf
 
-    pack_axis %= w.ndim
-    dim = w.shape[pack_axis]
+    dim = w.shape[-1]
     g = _int4_group_for(dim, group)
     if g < 2:
         return _quantize_leaf(w, scale_axes, act_dtype, free_source)
     w32 = w.astype(jnp.float32)
-    grouped = list(w.shape)
-    grouped[pack_axis:pack_axis + 1] = [dim // g, g]
-    wg = w32.reshape(grouped)
-    absmax = jnp.max(jnp.abs(wg), axis=pack_axis + 1, keepdims=True)
+    wg = w32.reshape(w.shape[:-1] + (dim // g, g))
+    absmax = jnp.max(jnp.abs(wg), axis=-1, keepdims=True)
     s = jnp.maximum(absmax, 1e-8) / 7.0
     q = jnp.clip(jnp.round(wg / s), -8, 7).astype(jnp.int8)
-    q = q.reshape(w.shape)
-    # pack: even element → low nibble, odd → high (dequant_int4's order)
-    paired = list(w.shape)
-    paired[pack_axis:pack_axis + 1] = [dim // 2, 2]
-    q2 = q.reshape(paired)
-    even = jnp.take(q2, 0, axis=pack_axis + 1)
-    odd = jnp.take(q2, 1, axis=pack_axis + 1)
+    q2 = q.reshape(w.shape[:-1] + (dim // 2, 2))
+    even, odd = q2[..., 0], q2[..., 1]
     packed = (((odd.astype(jnp.int32) & 0xF) << 4)
               | (even.astype(jnp.int32) & 0xF)).astype(jnp.int8)
-    s4 = jnp.squeeze(s, axis=pack_axis + 1).astype(act_dtype)
-    out = Int4Leaf(q4=packed, s4=s4, axis=pack_axis, group=g)
+    s4 = jnp.squeeze(s, axis=-1).astype(act_dtype)
+    out = Int4Leaf(q4=packed, s4=s4, axis=w.ndim - 1, group=g)
     if free_source and isinstance(w, jax.Array):
         jax.block_until_ready((out.q4, out.s4))
         w.delete()
@@ -179,8 +169,7 @@ def quantize_params(params: Params, cfg: ModelConfig,
     def one(value, key, expert=False):
         scale_axes = (_EXPERT_SCALE_AXES if expert else _SCALE_AXES)[key]
         if bits == 4:
-            pack = (_EXPERT_PACK_AXIS if expert else _PACK_AXIS)[key]
-            return _quantize_leaf_int4(value, pack, scale_axes,
+            return _quantize_leaf_int4(value, scale_axes,
                                        act_dtype, free_source, group)
         return _quantize_leaf(value, scale_axes, act_dtype, free_source)
 
@@ -253,9 +242,9 @@ def quantized_specs(specs: Params,
 def _qspec_leaf(spec, scale_axes: tuple[int, ...], param_leaf):
     from .models.common import Int4Leaf
     if isinstance(param_leaf, Int4Leaf):
-        # q4 shares the weight's spec (pack axis halved — placement's
+        # q4 shares the weight's spec (last axis halved — placement's
         # _fallback_replicated checks divisibility against the actual
-        # shape); s4 has the same rank with the pack axis → n_groups,
+        # shape); s4 has the same rank with the last axis → n_groups,
         # so the same entries apply.
         return Int4Leaf(q4=spec, s4=spec, axis=param_leaf.axis,
                         group=param_leaf.group)
