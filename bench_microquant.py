@@ -25,20 +25,34 @@ Variants:
             finding, not a wedge).
 
 Usage: python bench_microquant.py          (needs the live chip)
+       ROUNDTABLE_BENCH_CPU=1 ...          (CPU smoke — numbers are
+                                            meaningless, plumbing runs)
+Same watchdogged child-process pattern as every sibling bench: the
+parent probes first and ABANDONS a hung child (no SIGKILL — a killed
+JAX process can wedge the single-claim relay for the whole window).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 E, F = 2048, 16384          # gemma-2b MLP up-projection shape
 GROUP = 64
 ITERS = 50
+ATTEMPT_TIMEOUT_S = 300.0
 
 
-def main() -> int:
+def child() -> int:
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
     import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -122,8 +136,49 @@ def main() -> int:
         print(json.dumps({"variant": "int4-s4", "platform": platform,
                           "error": f"{type(e).__name__}: {e}"[:300]}),
               flush=True)
+
+    # lm-head shape: [V, E] with the CONTRACTED axis (E) packed — the
+    # tied-embedding head is the single biggest per-token weight read
+    # (0.78 ms/tok in the int8 hardware profile), and its dequant sits
+    # on the opposite side of the contraction from the MLP case above.
+    V = 32768  # structural stand-in for 256k (same fusion question)
+    head = jnp.asarray(rng.standard_normal((V, E), np.float32) * 0.02,
+                       jnp.bfloat16)
+    h8 = _quantize_leaf(head, (0,), jnp.bfloat16, False)
+    hleaf = _quantize_leaf_int4(head, (0,), jnp.bfloat16, False, GROUP)
+    assert isinstance(hleaf, Int4Leaf)
+
+    @jax.jit
+    def h_bf16(a, w):
+        return jnp.einsum("be,ve->bv", a, w,
+                          preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def h_int8(a, q, s):
+        y = jnp.einsum("be,ve->bv", a, q.astype(a.dtype),
+                       preferred_element_type=jnp.float32)
+        return y * s.astype(jnp.float32)[None, :]
+
+    @jax.jit
+    def h_int4(a, q4, s4):
+        w = dequant_int4(q4, s4, hleaf.axis, hleaf.group, a.dtype)
+        return jnp.einsum("be,ve->bv", a, w,
+                          preferred_element_type=jnp.float32)
+
+    timed("head-bf16", h_bf16, (a, head), head.size * 2)
+    timed("head-int8", h_int8, (a, h8["q"], h8["s"]),
+          h8["q"].size + h8["s"].size * 2)
+    timed("head-int4", h_int4, (a, hleaf.q4, hleaf.s4),
+          hleaf.q4.size + hleaf.s4.size * 2)
     return 0
 
 
+def main() -> int:
+    from bench_common import run_watchdogged
+
+    return run_watchdogged(os.path.abspath(__file__), [],
+                           ATTEMPT_TIMEOUT_S)
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(child() if "--child" in sys.argv else main())

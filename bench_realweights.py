@@ -329,6 +329,12 @@ def measure_served(min_turns: int = 20) -> dict:
 
 
 def main() -> int:
+    # Clean SIGTERM exit (sys.exit → atexit → PJRT teardown): this bench
+    # runs under `timeout` in the window scripts, and a hard-killed JAX
+    # process can wedge the single-claim relay for the rest of a window.
+    from bench_common import install_sigterm_exit
+    install_sigterm_exit()
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=600)
     ap.add_argument("--fresh", action="store_true",

@@ -16,29 +16,7 @@
 set -u
 cd "$(dirname "$0")" || exit 1
 OUT=BENCH_r05_builder.jsonl
-stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
-
-run_step() {
-  local name="$1"; shift
-  echo "=== $(stamp) $name ===" >> "$OUT.log"
-  "$@" >> "$OUT" 2>> "$OUT.log"
-  local rc=$?
-  # add first (-o alone errors on UNTRACKED paths — the first window's
-  # artifacts are new files), then commit ONLY the artifact files (-o):
-  # anything else staged stays out of the artifact commit. A real commit
-  # failure must be loud — the per-step commit IS the durability
-  # guarantee this script exists for.
-  git add "$OUT" "$OUT.log"
-  if ! git commit -q -o "$OUT" -o "$OUT.log" \
-      -m "Hardware window: $name artifact (rc=$rc)
-
-No-Verification-Needed: measurement artifact only, no source change"
-  then
-    echo "WARN: artifact commit failed after $name (rc=$rc)" \
-      | tee -a "$OUT.log" >&2
-  fi
-  return $rc
-}
+. ./hw_window_lib.sh
 
 run_step "bench.py (config 1)"        python bench.py
 run_step "bench_profile.py"           python bench_profile.py

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 hardware window #2 — after window #1 (BENCH_r05_builder.jsonl
+# lines 1-10) measured the int8 headline but found the int4 fusion break
+# and the discuss-bench logits OOM, both fixed in-tree:
+#   0. bench_microquant.py  ~1-minute per-representation fusion check
+#                           (is the new bitcast int4 layout streaming
+#                           packed bytes? is native-S4 viable?) — its
+#                           own probe-first watchdog, like every bench;
+#                           no shell `timeout` anywhere (a SIGKILLed
+#                           JAX child is the suspected relay-wedge
+#                           event, and windows 2-4 all died mid-run).
+#   1. bench.py             re-measure all 4 configs (int4 relayout +
+#                           prefill lm-head fix land here)
+#   2. bench_discuss.py     config 2's FIRST hardware number (OOM fixed)
+#   3. bench_suite.py all   configs 3-5 (tunnel died before them in #1)
+#   4. bench_profile.py     int4 attribution (keep even if fast — the
+#                           artifact shows WHERE the time goes now)
+#   5. bench_realweights.py on-chip stretch goal, LAST so a hang there
+#                           cannot cost any core measurement
+# Same per-step commit discipline as run_hw_window.sh (shared lib).
+set -u
+cd "$(dirname "$0")" || exit 1
+OUT=BENCH_r05_builder.jsonl
+. ./hw_window_lib.sh
+
+run_step "bench_microquant.py"         python bench_microquant.py
+run_step "bench.py (config 1)"         python bench.py
+run_step "bench_discuss.py (config 2)" python bench_discuss.py
+run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
+run_step "bench_profile.py"            python bench_profile.py
+# timeout sends SIGTERM (not KILL); realweights installs a clean-exit
+# handler, and this is the LAST step so even a wedge costs no data.
+run_step "bench_realweights.py (on-chip)" \
+  timeout 900 python bench_realweights.py --min-turns 20
+git add REALWEIGHTS_r05.json 2>/dev/null && \
+  git commit -q -o REALWEIGHTS_r05.json \
+    -m "Hardware window 2: on-chip realweights artifact
+
+No-Verification-Needed: measurement artifact only, no source change" \
+  || true
+echo "window 2 complete: $(stamp)"; tail -n +1 "$OUT" | wc -l
