@@ -8,16 +8,18 @@ JSON — the data that says whether the missing milliseconds are in the
 int8 dequant (unfused convert materializing bf16 weights), the
 attention kernel, the sampling epilogue, or dispatch gaps.
 
-On hardware, main() runs TWO watchdogged children — `--quant int8`
-then `--quant int4` (gemma-2b each, ~2 records total) — so each config
+On hardware, main() runs THREE watchdogged children — `--quant int8`,
+`--quant int4`, then `--quant int8 --mode prefill` — so each config
 gets its own attempt/timeout isolation: a slow int4 trace can never
 force an invisible re-run of an already-captured int8 one. int8
-attributes the standing 45%-of-roofline gap; int4 answers whether the
-packed unpack+scale chain fused into the matmul operand (unfused
-dequant would dominate its trace).
+attributes the standing roofline gap; int4 answers whether the packed
+unpack+scale chain fused into the matmul operand (unfused dequant
+would dominate its trace); the prefill child attributes the 29-31%
+prefill MFU (VERDICT r4 weak #4) by tracing one fresh full-prompt
+prefill.
 
-Usage: python bench_profile.py          (real chip; int8 + int4 children)
-       ROUNDTABLE_BENCH_CPU=1 ...       (tiny model smoke, one child)
+Usage: python bench_profile.py     (real chip; int8/int4/prefill children)
+       ROUNDTABLE_BENCH_CPU=1 ...  (tiny model smoke, decode + prefill)
 Same probe-first watchdog as every bench (bench_common).
 """
 
@@ -101,15 +103,19 @@ def child() -> int:
     quant = "int8"
     if "--quant" in sys.argv:
         quant = sys.argv[sys.argv.index("--quant") + 1]
+    mode = "decode"
+    if "--mode" in sys.argv:
+        mode = sys.argv[sys.argv.index("--mode") + 1]
     if on_cpu:
-        _profile_one(get_model_config("tiny-gemma"), 64, "none")
+        _profile_one(get_model_config("tiny-gemma"), 64, "none", mode)
     else:
         _profile_one(get_model_config("gemma-2b-it", max_seq_len=2048),
-                     192, quant)
+                     192, quant, mode)
     return 0
 
 
-def _profile_one(cfg, decode_tokens: int, quant: str) -> None:
+def _profile_one(cfg, decode_tokens: int, quant: str,
+                 mode: str = "decode") -> None:
     import jax
     from theroundtaible_tpu.engine.engine import InferenceEngine
     from theroundtaible_tpu.engine.sampling import SamplingParams
@@ -125,6 +131,51 @@ def _profile_one(cfg, decode_tokens: int, quant: str) -> None:
         engine.generate(PROMPT, slot_name="warm",
                         max_new_tokens=decode_tokens)
     engine.kv.release("warm")
+
+    if mode == "prefill":
+        # Prefill attribution (VERDICT r4 weak #4: MFU 29-31% with no
+        # hardware profile): trace ONE fresh full-prompt prefill.
+        # The traced call still pays one decode step (max_new_tokens=1
+        # is generate's floor), ~4.7 ms against a ~150 ms prefill at
+        # the stretched prompt below — a few percent of trace time,
+        # and the record carries prefill_seconds vs wall_s so the
+        # reader can see the decode share. The prompt is stretched
+        # toward the context budget: more prefill per trace means both
+        # better MFU statistics and less relative decode contamination.
+        # ByteTokenizer maps 1 char → 1 token (a real checkpoint's
+        # tokenizer only compresses further, landing safely under
+        # budget), so size the prompt in chars against the context.
+        budget = max(cfg.max_seq_len - decode_tokens - 128, len(PROMPT))
+        long_prompt = (PROMPT * (budget // len(PROMPT) + 1))[:budget]
+        engine.kv.release("warm")
+        engine.kv.release("prof")
+        # warm/rehearse the stretched shape so no compile in the trace
+        engine.generate(long_prompt, slot_name="warm", max_new_tokens=1)
+        engine.kv.release("warm")
+        trace_dir = tempfile.mkdtemp(prefix="rt_profile_pre_")
+        t0 = time.monotonic()
+        with jax.profiler.trace(trace_dir):
+            engine.generate(long_prompt, slot_name="prof",
+                            max_new_tokens=1)
+        wall = time.monotonic() - t0
+        s = engine.last_stats
+        rec = {
+            "metric": f"prefill_profile[{cfg.name}][{quant}]",
+            "value": round(s.prefill_tps, 2),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,  # diagnostic record, not a headline
+            "detail": {
+                "quant": quant,
+                "prefill_tokens": s.prefill_tokens,
+                "prefill_seconds": round(s.prefill_seconds, 3),
+                "wall_s": round(wall, 2),
+                "platform": jax.devices()[0].platform,
+                "trace_dir": trace_dir,
+                "top_ops": _top_device_ops(trace_dir),
+            },
+        }
+        print(json.dumps(rec), flush=True)
+        return
 
     # Prime the slot OUTSIDE the trace, so the profiled call reuses all
     # but one prompt token and the trace is ≥99% decode — otherwise
@@ -170,14 +221,21 @@ def _profile_one(cfg, decode_tokens: int, quant: str) -> None:
 
 def main() -> int:
     from bench_common import run_watchdogged
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        # CPU smoke covers BOTH branches (decode + prefill) on the tiny
+        # model — a hardware window must never be the first executor of
+        # either path (this file's own rehearsal comment records a
+        # compile-in-trace bug the CPU smoke caught).
+        configs = (["--quant", "none"],
+                   ["--quant", "none", "--mode", "prefill"])
+    else:
+        configs = (["--quant", "int8"], ["--quant", "int4"],
+                   ["--quant", "int8", "--mode", "prefill"])
     rc = 0
-    for quant in ("int8", "int4"):
-        rc |= run_watchdogged(os.path.abspath(__file__),
-                              ["--quant", quant],
+    for args in configs:
+        rc |= run_watchdogged(os.path.abspath(__file__), args,
                               ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS,
                               RETRY_DELAY_S)
-        if os.environ.get("ROUNDTABLE_BENCH_CPU"):
-            break  # CPU smoke profiles one tiny config
     return rc
 
 
