@@ -23,6 +23,11 @@ Variants:
             boundary path that RecursionError'd under the axon plugin
             when relayout was needed (watchdogged: a crash here is a
             finding, not a wedge).
+  int4-kernel / head-int4-kernel
+            the fused Pallas w4a16 kernels (pallas/int4mm.py) that
+            dequantize in VMEM — the path engine serving now takes on
+            single-device TPU. These are the numbers that decide
+            whether int4 decode finally streams packed bytes.
 
 Usage: python bench_microquant.py          (needs the live chip)
        ROUNDTABLE_BENCH_CPU=1 ...          (CPU smoke — numbers are
@@ -104,12 +109,30 @@ def child() -> int:
                           preferred_element_type=jnp.float32)
 
     def timed(name, fn, args, streamed_bytes):
+        """Each iteration's activation is perturbed by (prev_out · 0) so
+        every dispatch DEPENDS on the previous one: window #2 measured
+        physically impossible rates (head-bf16 "8.4 TB/s" vs the ~819
+        GB/s HBM roofline) from the independent-repeat loop — under the
+        axon tunnel, block_until_ready on the last of N independent
+        dispatches does not reliably price the other N-1. The full
+        decode bench never had this problem because token feedback
+        chains its steps; this loop now chains the same way. The
+        perturbation is folded INSIDE the jitted call so each iteration
+        stays ONE dispatch (eager per-iter chaining ops would add
+        dispatch overhead comparable to the ~20-60us GEMVs measured)."""
+
+        @jax.jit
+        def chained(prev, *a):
+            a0 = a[0] + (prev.reshape(-1)[0] * 0).astype(a[0].dtype)
+            return fn(a0, *a[1:])
+
         try:
             out = fn(*args)
+            out = chained(out, *args)   # warm the chained compile
             jax.block_until_ready(out)
             t0 = time.perf_counter()
             for _ in range(ITERS):
-                out = fn(*args)
+                out = chained(out, *args)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / ITERS
             print(json.dumps({
@@ -123,11 +146,22 @@ def child() -> int:
                               "error": f"{type(e).__name__}: {e}"[:300]}),
                   flush=True)
 
+    from theroundtaible_tpu.engine.pallas import int4mm
+
+    @jax.jit
+    def f_int4_kernel(a, q4, s4):
+        y = int4mm.einsum_int4(
+            "be,ef->bf", a,
+            Int4Leaf(q4=q4, s4=s4, axis=leaf.axis, group=leaf.group))
+        assert y is not None, "kernel declined MLP shape"
+        return y
+
     timed("bf16", f_bf16, (a, w), w.size * 2)
     timed("int8", f_int8, (a, q8["q"], q8["s"]),
           q8["q"].size + q8["s"].size * 2)
     i4_bytes = leaf.q4.size + leaf.s4.size * 2
     timed("int4", f_int4, (a, leaf.q4, leaf.s4), i4_bytes)
+    timed("int4-kernel", f_int4_kernel, (a, leaf.q4, leaf.s4), i4_bytes)
     try:
         qs4 = to_s4(leaf.q4)
         jax.block_until_ready(qs4)
@@ -165,10 +199,20 @@ def child() -> int:
         return jnp.einsum("be,ve->bv", a, w,
                           preferred_element_type=jnp.float32)
 
+    @jax.jit
+    def h_int4_kernel(a, q4, s4):
+        y = int4mm.einsum_int4(
+            "be,ve->bv", a,
+            Int4Leaf(q4=q4, s4=s4, axis=hleaf.axis, group=hleaf.group))
+        assert y is not None, "kernel declined head shape"
+        return y
+
     timed("head-bf16", h_bf16, (a, head), head.size * 2)
     timed("head-int8", h_int8, (a, h8["q"], h8["s"]),
           h8["q"].size + h8["s"].size * 2)
     timed("head-int4", h_int4, (a, hleaf.q4, hleaf.s4),
+          hleaf.q4.size + hleaf.s4.size * 2)
+    timed("head-int4-kernel", h_int4_kernel, (a, hleaf.q4, hleaf.s4),
           hleaf.q4.size + hleaf.s4.size * 2)
     return 0
 

@@ -1,0 +1,124 @@
+"""Fused w4a16 Pallas matmul (engine/pallas/int4mm.py) — semantic parity
+with the XLA dequant path, exercised in interpret mode on CPU (the same
+strategy the attention kernels use; the kernels' PERFORMANCE claim is
+validated on hardware by bench_microquant.py / bench.py int4).
+
+The kernels compute bit-identical dequantized weights (same nibble
+extraction, same grouped scale in the activation dtype); only the f32
+accumulation ORDER differs (blocked), so comparisons allow float-order
+tolerance, and greedy token parity must hold end to end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theroundtaible_tpu.engine.models.common import (Int4Leaf, ModelConfig,
+                                                     dequant_int4,
+                                                     init_params, forward)
+from theroundtaible_tpu.engine.pallas import int4mm
+from theroundtaible_tpu.engine.quant import (_quantize_leaf_int4,
+                                             quantize_params)
+
+
+@pytest.fixture(autouse=True)
+def _force_kernel(monkeypatch):
+    monkeypatch.setenv("ROUNDTABLE_INT4_MM", "1")
+
+
+def _leaf(shape, group=64, dtype=jnp.float32, seed=0) -> Int4Leaf:
+    w = jax.random.normal(jax.random.PRNGKey(seed), shape,
+                          dtype=jnp.float32) * 0.1
+    leaf = _quantize_leaf_int4(w.astype(dtype), (0,), dtype, False, group)
+    assert isinstance(leaf, Int4Leaf)
+    return leaf
+
+
+def _xla_ref(spec, a, leaf):
+    return jnp.einsum(spec, a,
+                      dequant_int4(leaf.q4, leaf.s4, leaf.axis,
+                                   leaf.group, a.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+# Every serving einsum shape class: mlp up/gate, mlp down, qkv (2 kept
+# dims), o_proj (2 contracted dims), lm head (contracted pack axis).
+CASES = [
+    ("bte,ef->btf", (2, 3, 256), (256, 512)),
+    ("btf,fe->bte", (2, 3, 512), (512, 256)),
+    ("bte,ehd->bthd", (1, 3, 256), (256, 4, 128)),
+    ("bthd,hde->bte", (1, 3, 4, 128), (4, 128, 256)),
+    ("bte,ve->btv", (2, 1, 256), (512, 256)),
+]
+
+
+@pytest.mark.parametrize("spec,ashape,wshape", CASES)
+def test_kernel_matches_xla_dequant(spec, ashape, wshape):
+    leaf = _leaf(wshape)
+    a = jax.random.normal(jax.random.PRNGKey(1), ashape,
+                          dtype=jnp.float32)
+    got = int4mm.einsum_int4(spec, a, leaf)
+    assert got is not None, f"kernel declined supported case {spec}"
+    want = _xla_ref(spec, a, leaf)
+    assert got.shape == want.shape and got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_activations_match():
+    spec, ashape, wshape = CASES[0]
+    leaf = _leaf(wshape, dtype=jnp.bfloat16)
+    a = (jax.random.normal(jax.random.PRNGKey(2), ashape) * 0.5) \
+        .astype(jnp.bfloat16)
+    got = int4mm.einsum_int4(spec, a, leaf)
+    want = _xla_ref(spec, a, leaf)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_declines_unblockable_and_moe():
+    # MoE expert spec: weight dims are kept+cont+kept — not a prefix or
+    # suffix split, must fall back to the XLA path.
+    leaf = _leaf((2, 256, 512))
+    a = jax.random.normal(jax.random.PRNGKey(3), (1, 3, 256))
+    assert int4mm.einsum_int4("bte,xef->btxf", a, leaf) is None
+    # tiny router: last dim too small to block
+    tiny = _leaf((256, 8), group=8)
+    assert int4mm.einsum_int4("bte,ex->btx", a, tiny) is None
+
+
+BLOCKABLE = ModelConfig(
+    name="int4mm-test", vocab_size=512, num_layers=2, embed_dim=256,
+    num_heads=4, num_kv_heads=2, head_dim=128, mlp_dim=512,
+    max_seq_len=64, tie_embeddings=True)
+
+
+def test_model_forward_token_parity(monkeypatch):
+    """Full int4 forward with the kernel on vs off: same greedy tokens,
+    close logits. Dims chosen so every matmul takes the kernel path.
+    Runs under an announced 1-device mesh — the only context in which
+    `_einsum` emits the kernel (engine jits always announce theirs)."""
+    from theroundtaible_tpu.engine.models.common import spmd_mesh
+
+    params = init_params(BLOCKABLE, jax.random.PRNGKey(0), jnp.float32)
+    qp = quantize_params(params, BLOCKABLE, act_dtype=jnp.float32, bits=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, 512)
+    positions = jnp.broadcast_to(jnp.arange(8)[None], (2, 8))
+    valid = jnp.full((2,), 8, jnp.int32)
+    mesh1 = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("one",))
+
+    with spmd_mesh(mesh1):
+        logits_k, _ = forward(qp, BLOCKABLE, tokens, positions, None,
+                              None, valid)
+    monkeypatch.setenv("ROUNDTABLE_INT4_MM", "0")
+    with spmd_mesh(mesh1):
+        logits_x, _ = forward(qp, BLOCKABLE, tokens, positions, None,
+                              None, valid)
+    np.testing.assert_allclose(np.asarray(logits_k),
+                               np.asarray(logits_x),
+                               rtol=1e-4, atol=1e-4)
+    assert jnp.array_equal(jnp.argmax(logits_k, -1),
+                           jnp.argmax(logits_x, -1))

@@ -205,6 +205,23 @@ def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
     # leaf streams a quarter: its grouped dequant is elementwise, so it
     # rides the same operand fusion.
     if isinstance(b, Int4Leaf):
+        mesh = current_spmd_mesh()
+        if mesh is not None and mesh.size == 1:
+            # Fused VMEM-dequant kernel — the only layout that actually
+            # streams packed int4 bytes on real TPU (pallas/int4mm.py;
+            # XLA materializes this dequant, BENCH_r05). Default-safe
+            # gate: the kernel is emitted ONLY where the enclosing
+            # program explicitly announced a 1-device mesh (spmd_mesh —
+            # every engine jit does). Multi-device meshes AND traces
+            # with no announced mesh (e.g. the PP engines' head einsums
+            # under GSPMD) keep the XLA path: a pallas_call under GSPMD
+            # is an opaque, unpartitionable custom call, and "no context"
+            # must never be mistaken for "single device".
+            from ..pallas import int4mm
+            if int4mm.enabled():
+                y = int4mm.einsum_int4(spec, a, b)
+                if y is not None:
+                    return y
         return jnp.einsum(spec, a,
                           dequant_int4(b.q4, b.s4, b.axis, b.group,
                                        a.dtype),

@@ -1,0 +1,268 @@
+"""Pallas TPU fused w4a16 matmul: dequantize int4 weights in VMEM, inside
+the matmul, so HBM streams the PACKED bytes.
+
+Why a kernel at all: the XLA path (models/common.py `_einsum` →
+`dequant_int4`) expresses dequant as bitcast → convert → grouped-scale
+multiply → reshape and hopes XLA fuses that chain into the dot's operand
+read. On real TPU it does not: BENCH_r05 hardware runs measured int4
+decode at 22.9 tok/s (interleave layout) then 31.6 tok/s (bitcast
+layout) against bf16's 130 and int8's 205 — the dequantized bf16 weight
+was materialized (and copied) in HBM every token, so int4 streamed MORE
+bytes than bf16. int8 escapes because its dequant is a plain
+convert (fusable operand) plus an OUTPUT-side scale; int4's grouped
+scale multiplies the weight on the CONTRACTED side of the dot and XLA
+TPU will not fold a multiply-by-different-shaped-operand into a dot
+input. (Reference compute equivalent: llama.cpp's q4 kernels, reached
+through src/adapters/local-llm.ts — its default serving precision —
+dequantize in registers for exactly this reason.)
+
+These kernels make the fusion structural instead of heuristic. The pack
+layout (engine/quant.py: two signed nibbles per byte along the weight's
+LAST axis, even element in the low nibble, per-`group` scales) was
+chosen so NO shuffle is ever needed in-kernel:
+
+- `_mm_pack_out` — every per-layer matmul (qkv/o/gate/up/down: the
+  packed last axis is a NON-contracted output axis). Byte k of a row
+  holds output columns 2k (low nibble) and 2k+1 (high), and both share
+  scale group k // (g/2). The kernel extracts nibbles with two
+  arithmetic shifts, applies the group scale, and runs TWO dots — one
+  producing even output columns, one odd — accumulating over contraction
+  blocks in VMEM scratch. The only reorder is interleaving the two
+  [bm, bp] OUTPUT accumulators at the end: 2·bm·bp elements once per
+  output block, vs. the E·F weight interleave the XLA path choked on.
+- `_mm_pack_contract` — the tied-embedding lm head ([V, E] packed along
+  E, which the head matmul CONTRACTS). Splitting the ACTIVATION into
+  even/odd columns (x[:, 0::2], x[:, 1::2] — a [M, E] strided slice,
+  done once outside the kernel) turns the matmul into
+  dot(x_even, low^T) + dot(x_odd, high^T): no weight interleave, no
+  output interleave, scale group k // (g/2) again shared.
+
+`einsum_int4` is the dispatch seam `_einsum` calls: it classifies the
+einsum spec (contracted axes a prefix of the weight → pack-on-output;
+suffix → pack-on-contraction), flattens to 2-D, pads M to sublane
+multiples, and returns None whenever blocking/grouping cannot be
+arranged — the caller then falls back to the XLA dequant path, so MoE
+expert matmuls ("bte,xef->btxf") and tiny routers serve unchanged.
+
+Single-device only by design: these run inside jit-under-GSPMD, where a
+pallas_call is an opaque unpartitionable custom call. The engine gates
+on mesh size (models/common.py `_einsum`); multi-chip int4 keeps the
+XLA path. On non-TPU backends the kernels run in Pallas interpret mode
+when forced via ROUNDTABLE_INT4_MM=1 — how the CPU suite validates them
+(tests/test_int4mm.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def enabled() -> bool:
+    """Kernel path on by default on real TPU; ROUNDTABLE_INT4_MM=1
+    forces it elsewhere (interpret mode — the test path), =0 disables
+    everywhere (the A/B lever for microbenches)."""
+    v = os.environ.get("ROUNDTABLE_INT4_MM", "")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _pick_block(n: int, candidates: tuple[int, ...],
+                multiple_of: int = 1) -> Optional[int]:
+    for c in candidates:
+        if n % c == 0 and c % multiple_of == 0:
+            return c
+    return None
+
+
+def _nibbles(q_ref, dtype):
+    """int8 packed byte block → (low, high) int4 values in `dtype`.
+    Arithmetic shifts in int32 sign-extend both nibbles; no shuffle."""
+    q = q_ref[...].astype(jnp.int32)
+    low = ((q << 28) >> 28).astype(dtype)
+    high = (q >> 4).astype(dtype)
+    return low, high
+
+
+def _mm_out_kernel(x_ref, q_ref, s_ref, o_ref, acc_lo, acc_hi, *,
+                   gp: int, n_c: int):
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        acc_lo[...] = jnp.zeros_like(acc_lo)
+        acc_hi[...] = jnp.zeros_like(acc_hi)
+
+    x = x_ref[...]
+    low, high = _nibbles(q_ref, x.dtype)
+    srep = jnp.repeat(s_ref[...], gp, axis=1)      # [bc, bp]
+    dims = (((1,), (0,)), ((), ()))
+    acc_lo[...] += jax.lax.dot_general(
+        x, low * srep, dims, preferred_element_type=jnp.float32)
+    acc_hi[...] += jax.lax.dot_general(
+        x, high * srep, dims, preferred_element_type=jnp.float32)
+
+    @pl.when(c == n_c - 1)
+    def _done():
+        lo, hi = acc_lo[...], acc_hi[...]
+        bm, bp = lo.shape
+        # interleave OUTPUT columns: even ← low nibble, odd ← high
+        o_ref[...] = jnp.stack([lo, hi], axis=-1).reshape(bm, 2 * bp)
+
+
+@functools.partial(jax.jit, static_argnames=("gp", "bm", "bp", "bc"))
+def _mm_pack_out(x, q4, s4, gp: int, bm: int, bp: int, bc: int):
+    """x [M, C] · unpack(q4 [C, P], s4 [C, P//gp]) → [M, 2P] f32."""
+    m, c_dim = x.shape
+    _, p_dim = q4.shape
+    grid = (m // bm, p_dim // bp, c_dim // bc)
+    kernel = functools.partial(_mm_out_kernel, gp=gp, n_c=grid[2])
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bc), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bc, bp), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bc, bp // gp), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, 2 * bp), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, 2 * p_dim), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bp), jnp.float32),
+            pltpu.VMEM((bm, bp), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, q4, s4)
+
+
+def _mm_contract_kernel(xe_ref, xo_ref, q_ref, s_ref, o_ref, *, gp: int):
+    xe, xo = xe_ref[...], xo_ref[...]
+    low, high = _nibbles(q_ref, xe.dtype)
+    srep = jnp.repeat(s_ref[...], gp, axis=1)      # [bn, Cp]
+    dims = (((1,), (1,)), ((), ()))                # contract minor×minor
+    o_ref[...] = (
+        jax.lax.dot_general(xe, low * srep, dims,
+                            preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(xo, high * srep, dims,
+                              preferred_element_type=jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("gp", "bm", "bn"))
+def _mm_pack_contract(x_even, x_odd, q4, s4, gp: int, bm: int, bn: int):
+    """x_even/x_odd [M, Cp] · unpack(q4 [N, Cp], s4 [N, Cp//gp])ᵀ
+    → [M, N] f32. Contraction fits one block (lm-head E is small)."""
+    m, cp = x_even.shape
+    n_dim = q4.shape[0]
+    kernel = functools.partial(_mm_contract_kernel, gp=gp)
+    return pl.pallas_call(
+        kernel,
+        grid=(m // bm, n_dim // bn),
+        in_specs=[
+            pl.BlockSpec((bm, cp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, cp), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, cp), lambda i, j: (j, 0)),
+            pl.BlockSpec((bn, cp // gp), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n_dim), jnp.float32),
+        interpret=_interpret(),
+    )(x_even, x_odd, q4, s4)
+
+
+def _pad_rows(x2: jax.Array) -> tuple[jax.Array, int, Optional[int]]:
+    """Pad M to a sublane/block-friendly multiple; returns (padded, M,
+    block_m or None if no block divides)."""
+    m = x2.shape[0]
+    mp = max(8, -(-m // 8) * 8)
+    if mp > 128 and mp % 128:
+        mp = -(-mp // 128) * 128
+    if mp != m:
+        x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
+    bm = mp if mp <= 128 else _pick_block(mp, (128,))
+    return x2, m, bm
+
+
+def einsum_int4(spec: str, a: jax.Array, leaf) -> Optional[jax.Array]:
+    """Run `jnp.einsum(spec, a, dequant(leaf))` through the fused
+    kernels when the spec/shape/grouping allow; None → caller falls
+    back to the XLA dequant path. Result is f32 (matches the XLA path's
+    preferred_element_type)."""
+    lhs, out_dims = spec.split("->")
+    a_dims, b_dims = lhs.split(",")
+    cont = [d for d in b_dims if d in a_dims]
+    kept = [d for d in b_dims if d not in a_dims]
+    if not cont or not kept:
+        return None
+    if a_dims[-len(cont):] != "".join(cont):
+        return None
+    batch = a_dims[:-len(cont)]
+    if out_dims != batch + "".join(kept):
+        return None
+    if leaf.axis != leaf.q4.ndim - 1:
+        return None    # non-minor pack: fall back (XLA path asserts loudly)
+    group = leaf.group
+    if group % 2:
+        return None
+    gp = group // 2
+
+    if list(b_dims) == cont + kept:
+        return _dispatch_pack_out(a, leaf, len(cont), gp)
+    if list(b_dims) == kept + cont and len(cont) == 1:
+        return _dispatch_pack_contract(a, leaf, gp)
+    return None
+
+
+def _dispatch_pack_out(a, leaf, n_cont: int, gp: int):
+    q4, s4 = leaf.q4, leaf.s4
+    cont_shape = q4.shape[:n_cont]
+    c_dim = 1
+    for s in cont_shape:
+        c_dim *= s
+    p_dim = q4.size // c_dim
+    kept_shape = q4.shape[n_cont:-1] + (q4.shape[-1] * 2,)
+    bp = _pick_block(p_dim, (512, 256, 128), multiple_of=gp)
+    bc = _pick_block(c_dim, (512, 1024, 256, 128))
+    if bp is None or bc is None:
+        return None
+    x2 = a.reshape(-1, c_dim)
+    x2, m, bm = _pad_rows(x2)
+    if bm is None:
+        return None
+    y = _mm_pack_out(x2, q4.reshape(c_dim, p_dim),
+                     s4.reshape(c_dim, p_dim // gp), gp, bm, bp, bc)
+    return y[:m].reshape(a.shape[:-n_cont] + kept_shape)
+
+
+def _dispatch_pack_contract(a, leaf, gp: int):
+    q4, s4 = leaf.q4, leaf.s4
+    cp = q4.shape[-1]
+    if cp > 4096 or cp % 128:
+        return None
+    n_dim = q4.size // cp
+    if cp % gp:
+        return None
+    bn = _pick_block(n_dim, (512, 256, 128))
+    if bn is None:
+        return None
+    x2 = a.reshape(-1, 2 * cp)
+    x_even, x_odd = x2[:, 0::2], x2[:, 1::2]
+    x_even, m, bm = _pad_rows(x_even)
+    x_odd = _pad_rows(x_odd)[0]
+    if bm is None:
+        return None
+    y = _mm_pack_contract(x_even, x_odd, q4.reshape(n_dim, cp),
+                          s4.reshape(n_dim, cp // gp), gp, bm, bn)
+    return y[:m].reshape(a.shape[:-1] + q4.shape[:-1])

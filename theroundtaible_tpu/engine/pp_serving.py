@@ -281,16 +281,21 @@ class PPEngine:
         cfg = model_cfg
         mesh = self.mesh
         s_len = self.max_seq_len
-        # TP-in-stage kernels: with flash resolved on a (pipe, model)
-        # mesh, the stage bodies trace attention under the CONTEXT
-        # AbstractMesh (pipe already Manual there) — the spmd wrappers
-        # then run as a nested shard_map over the auto "model" axis.
-        tp_kernels = cfg.attn_impl == "flash" and n_model > 1
+        # Stage bodies trace under the CONTEXT AbstractMesh whenever a
+        # "model" axis exists (pipe already Manual there): the flash spmd
+        # wrappers need it to run as a nested shard_map over the auto
+        # "model" axis. For dense attention this announcement is
+        # defensive hardening only — the quant-aware _einsum's int4 gate
+        # is already default-safe (kernel requires an ANNOUNCED 1-device
+        # mesh; an unset context falls back to XLA), but announcing the
+        # real mesh keeps "context reflects the trace" true at every
+        # multi-device site rather than relying on the default.
+        mesh_in_stage = n_model > 1
 
         def _stage_mesh_ctx():
             from contextlib import nullcontext
             from .models.common import spmd_mesh
-            if not tp_kernels:
+            if not mesh_in_stage:
                 return nullcontext()
             return spmd_mesh(jax.sharding.get_abstract_mesh())
 
