@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 hardware window #3 — after window #2 measured the int8
+# headline (205 tok/s), config 2's first-ever discuss wall-clock
+# (19.91 s = 1.256x), and found end-to-end int4 still materializing
+# (31.6 tok/s), the fused Pallas w4a16 kernels (pallas/int4mm.py)
+# landed. This window:
+#   0. parks on a probe loop until the tunnel revives (probe_tunnel
+#      abandons hung children — never SIGKILL a JAX process, a killed
+#      child is the suspected relay-wedge event)
+#   1. bench_microquant.py  — do the kernels Mosaic-compile and stream
+#                             packed bytes? (int4-kernel / head-int4-
+#                             kernel variants; dependency-chained timing)
+#   2. bench.py             — all 4 configs; int4 decode now takes the
+#                             kernel path end to end
+#   3. bench_suite.py all   — configs 3-5, never measured this round
+#   4. bench_profile.py     — attribution for whatever still lags
+#   5. realweights on-chip  — stretch, LAST so a hang costs no data
+# Same per-step artifact-commit discipline as windows 1-2 (shared lib).
+set -u
+cd "$(dirname "$0")" || exit 1
+OUT=BENCH_r05_builder.jsonl
+. ./hw_window_lib.sh
+
+until python - <<'PY'
+import sys
+from bench_common import probe_tunnel
+sys.exit(0 if probe_tunnel() else 1)
+PY
+do
+  echo "window3: tunnel dead $(stamp), re-probe in 300s" >> "$OUT.log"
+  sleep 300
+done
+echo "window3: tunnel alive $(stamp)" >> "$OUT.log"
+
+run_step "bench_microquant.py (fused kernels)" python bench_microquant.py
+run_step "bench.py (config 1, int4 kernel path)" python bench.py
+run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
+run_step "bench_profile.py" python bench_profile.py
+run_step "bench_realweights.py (on-chip)" \
+  timeout 900 python bench_realweights.py --min-turns 20
+git add REALWEIGHTS_r05.json 2>/dev/null && \
+  git commit -q -o REALWEIGHTS_r05.json \
+    -m "Hardware window 3: on-chip realweights artifact
+
+No-Verification-Needed: measurement artifact only, no source change" \
+  || true
+echo "window 3 complete: $(stamp)"; tail -n +1 "$OUT" | wc -l
