@@ -21,12 +21,26 @@ cd "$(dirname "$0")" || exit 1
 OUT=BENCH_r05_builder.jsonl
 . ./hw_window_lib.sh
 
-until python - <<'PY'
+while :; do
+  python - <<'PY' 2>> "$OUT.log"
 import sys
-from bench_common import probe_tunnel
-sys.exit(0 if probe_tunnel() else 1)
+try:
+    from bench_common import probe_tunnel
+    ok = probe_tunnel()
+except Exception:
+    import traceback
+    traceback.print_exc()
+    sys.exit(2)          # probe CRASHED — not a dead tunnel
+sys.exit(0 if ok else 1)
 PY
-do
+  rc=$?
+  [ "$rc" -eq 0 ] && break
+  if [ "$rc" -ge 2 ]; then
+    # a crashing probe must abort loudly, not impersonate a dead
+    # tunnel forever (traceback is in $OUT.log just above)
+    echo "window3: probe CRASHED rc=$rc $(stamp) — aborting" >> "$OUT.log"
+    exit 1
+  fi
   echo "window3: tunnel dead $(stamp), re-probe in 300s" >> "$OUT.log"
   sleep 300
 done

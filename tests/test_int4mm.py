@@ -49,6 +49,10 @@ def _xla_ref(spec, a, leaf):
 CASES = [
     ("bte,ef->btf", (2, 3, 256), (256, 512)),
     ("btf,fe->bte", (2, 3, 512), (512, 256)),
+    # c_dim 1024 → bc 512 → TWO contraction blocks: numerically
+    # exercises the set/add/flush accumulation across c, which every
+    # other case (bc == c_dim) leaves untested
+    ("btf,fe->bte", (2, 3, 1024), (1024, 256)),
     ("bte,ehd->bthd", (1, 3, 256), (256, 4, 128)),
     ("bthd,hde->bte", (1, 3, 4, 128), (4, 128, 256)),
     ("bte,ve->btv", (2, 1, 256), (512, 256)),
@@ -88,6 +92,39 @@ def test_declines_unblockable_and_moe():
     # tiny router: last dim too small to block
     tiny = _leaf((256, 8), group=8)
     assert int4mm.einsum_int4("bte,ex->btx", a, tiny) is None
+
+
+def test_tpu_mosaic_lowering(monkeypatch):
+    """Cross-lower every kernel shape class for the TPU platform WITHOUT
+    a chip: Mosaic runs in jaxlib at lowering time, so layout/op-support
+    violations (lane-aligned block minors, repeat/interleave lowering)
+    surface here instead of burning a hardware window. This is the test
+    that caught the scale-block minor-dim violation pre-flight."""
+    monkeypatch.setattr(int4mm, "_interpret", lambda: False)
+    rng = np.random.default_rng(0)
+    cases = [
+        ("be,ef->bf", (1, 2048), (2048, 16384)),      # mlp up/gate
+        ("bf,fe->be", (1, 16384), (16384, 2048)),     # mlp down
+        ("be,ehd->bhd", (1, 2048), (2048, 8, 256)),   # qkv
+        ("bhd,hde->be", (1, 8, 256), (8, 256, 2048)),  # o_proj
+        ("be,ve->bv", (1, 2048), (32768, 2048)),      # lm head
+    ]
+    for spec, ashape, wshape in cases:
+        w = jnp.asarray(rng.standard_normal(wshape).astype(np.float32)
+                        * 0.02, jnp.bfloat16)
+        leaf = _quantize_leaf_int4(w, (0,), jnp.bfloat16, False, 64)
+        a = jnp.asarray(rng.standard_normal(ashape).astype(np.float32),
+                        jnp.bfloat16)
+
+        def f(a, q4, s4, leaf=leaf, spec=spec):
+            y = int4mm.einsum_int4(
+                spec, a, Int4Leaf(q4=q4, s4=s4, axis=leaf.axis,
+                                  group=leaf.group))
+            assert y is not None, f"kernel declined {spec}"
+            return y
+
+        jax.jit(f).trace(a, leaf.q4, leaf.s4).lower(
+            lowering_platforms=("tpu",))
 
 
 BLOCKABLE = ModelConfig(

@@ -43,6 +43,12 @@ suffix → pack-on-contraction), flattens to 2-D, pads M to sublane
 multiples, and returns None whenever blocking/grouping cannot be
 arranged — the caller then falls back to the XLA dequant path, so MoE
 expert matmuls ("bte,xef->btxf") and tiny routers serve unchanged.
+These are DECODE kernels: M is capped at 64 rows (decode and the
+post-last_pos-gather lm head are always ≤ batch), because the grid
+iterates p innermost so grouped scales stream once per contraction
+block — which makes the f32 output block round-trip per contraction
+block, negligible at decode M and ruinous at prefill M. Prefill int4
+keeps the XLA path, where the materialized dequant amortizes over T.
 
 Single-device only by design: these run inside jit-under-GSPMD, where a
 pallas_call is an opaque unpartitionable custom call. The engine gates
@@ -98,53 +104,72 @@ def _nibbles(q_ref, dtype):
 
 
 def _mm_out_kernel(x_ref, q_ref, s_ref, o_ref, acc_lo, acc_hi, *,
-                   gp: int, n_c: int):
-    c = pl.program_id(2)
-
-    @pl.when(c == 0)
-    def _init():
-        acc_lo[...] = jnp.zeros_like(acc_lo)
-        acc_hi[...] = jnp.zeros_like(acc_hi)
-
+                   gp: int, bg: int, bp: int, n_c: int):
+    # Grid is (m, c, p) with p INNERMOST: the whole-axis scale block's
+    # index (c, 0) is then constant across each p sweep, so Pallas
+    # elides its DMA and scales stream once per contraction block —
+    # with p outside c they re-streamed every step, ~doubling HBM
+    # traffic on the up/gate shape. The price: accumulators span the
+    # FULL output axis (scratch [bm, P] per nibble, ≤ 8 MB at the
+    # largest bm·P), and each (c==last, p) step flushes its slice.
+    c, j = pl.program_id(1), pl.program_id(2)
     x = x_ref[...]
     low, high = _nibbles(q_ref, x.dtype)
-    srep = jnp.repeat(s_ref[...], gp, axis=1)      # [bc, bp]
+    # s_ref carries the FULL scale axis for this contraction block
+    # (Mosaic wants lane-aligned or whole-axis block minors; the per-p
+    # slab bg = bp/gp is narrower than a lane) — slice it here.
+    s = s_ref[:, pl.ds(j * bg, bg)]
+    srep = jnp.repeat(s, gp, axis=1)               # [bc, bp]
     dims = (((1,), (0,)), ((), ()))
-    acc_lo[...] += jax.lax.dot_general(
-        x, low * srep, dims, preferred_element_type=jnp.float32)
-    acc_hi[...] += jax.lax.dot_general(
-        x, high * srep, dims, preferred_element_type=jnp.float32)
+    lo = jax.lax.dot_general(x, low * srep, dims,
+                             preferred_element_type=jnp.float32)
+    hi = jax.lax.dot_general(x, high * srep, dims,
+                             preferred_element_type=jnp.float32)
+    sl = pl.ds(j * bp, bp)
+
+    @pl.when(c == 0)
+    def _set():
+        acc_lo[:, sl] = lo
+        acc_hi[:, sl] = hi
+
+    @pl.when(c > 0)
+    def _add():
+        acc_lo[:, sl] += lo
+        acc_hi[:, sl] += hi
 
     @pl.when(c == n_c - 1)
     def _done():
-        lo, hi = acc_lo[...], acc_hi[...]
-        bm, bp = lo.shape
+        a_lo, a_hi = acc_lo[:, sl], acc_hi[:, sl]
+        bm = a_lo.shape[0]
         # interleave OUTPUT columns: even ← low nibble, odd ← high
-        o_ref[...] = jnp.stack([lo, hi], axis=-1).reshape(bm, 2 * bp)
+        o_ref[...] = jnp.stack([a_lo, a_hi], axis=-1).reshape(bm, 2 * bp)
 
 
-@functools.partial(jax.jit, static_argnames=("gp", "bm", "bp", "bc"))
-def _mm_pack_out(x, q4, s4, gp: int, bm: int, bp: int, bc: int):
+@functools.partial(jax.jit,
+                   static_argnames=("gp", "bm", "bp", "bc", "interpret"))
+def _mm_pack_out(x, q4, s4, gp: int, bm: int, bp: int, bc: int,
+                 interpret: bool):
     """x [M, C] · unpack(q4 [C, P], s4 [C, P//gp]) → [M, 2P] f32."""
     m, c_dim = x.shape
     _, p_dim = q4.shape
-    grid = (m // bm, p_dim // bp, c_dim // bc)
-    kernel = functools.partial(_mm_out_kernel, gp=gp, n_c=grid[2])
+    grid = (m // bm, c_dim // bc, p_dim // bp)
+    kernel = functools.partial(_mm_out_kernel, gp=gp, bg=bp // gp,
+                               bp=bp, n_c=grid[1])
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bm, bc), lambda i, j, k: (i, k)),
-            pl.BlockSpec((bc, bp), lambda i, j, k: (k, j)),
-            pl.BlockSpec((bc, bp // gp), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bm, bc), lambda i, k, j: (i, k)),
+            pl.BlockSpec((bc, bp), lambda i, k, j: (k, j)),
+            pl.BlockSpec((bc, p_dim // gp), lambda i, k, j: (k, 0)),
         ],
-        out_specs=pl.BlockSpec((bm, 2 * bp), lambda i, j, k: (i, j)),
+        out_specs=pl.BlockSpec((bm, 2 * bp), lambda i, k, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, 2 * p_dim), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((bm, bp), jnp.float32),
-            pltpu.VMEM((bm, bp), jnp.float32),
+            pltpu.VMEM((bm, p_dim), jnp.float32),
+            pltpu.VMEM((bm, p_dim), jnp.float32),
         ],
-        interpret=_interpret(),
+        interpret=interpret,
     )(x, q4, s4)
 
 
@@ -160,8 +185,10 @@ def _mm_contract_kernel(xe_ref, xo_ref, q_ref, s_ref, o_ref, *, gp: int):
                               preferred_element_type=jnp.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("gp", "bm", "bn"))
-def _mm_pack_contract(x_even, x_odd, q4, s4, gp: int, bm: int, bn: int):
+@functools.partial(jax.jit,
+                   static_argnames=("gp", "bm", "bn", "interpret"))
+def _mm_pack_contract(x_even, x_odd, q4, s4, gp: int, bm: int, bn: int,
+                      interpret: bool):
     """x_even/x_odd [M, Cp] · unpack(q4 [N, Cp], s4 [N, Cp//gp])ᵀ
     → [M, N] f32. Contraction fits one block (lm-head E is small)."""
     m, cp = x_even.shape
@@ -178,21 +205,26 @@ def _mm_pack_contract(x_even, x_odd, q4, s4, gp: int, bm: int, bn: int):
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n_dim), jnp.float32),
-        interpret=_interpret(),
+        interpret=interpret,
     )(x_even, x_odd, q4, s4)
 
 
 def _pad_rows(x2: jax.Array) -> tuple[jax.Array, int, Optional[int]]:
-    """Pad M to a sublane/block-friendly multiple; returns (padded, M,
-    block_m or None if no block divides)."""
+    """Pad M to a sublane multiple; returns (padded, M, block_m).
+
+    block_m is None above 64 rows: the kernels are DECODE kernels
+    (weight-streaming-bound GEMVs, where fused dequant is the whole
+    win). Prefill's big-M matmuls keep the XLA path — there the
+    materialized dequant amortizes over T, while this kernel's
+    write-at-last output revisiting would round-trip the [M, 2P] f32
+    output once per contraction block."""
     m = x2.shape[0]
     mp = max(8, -(-m // 8) * 8)
-    if mp > 128 and mp % 128:
-        mp = -(-mp // 128) * 128
+    if mp > 64:
+        return x2, m, None
     if mp != m:
         x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
-    bm = mp if mp <= 128 else _pick_block(mp, (128,))
-    return x2, m, bm
+    return x2, m, mp
 
 
 def einsum_int4(spec: str, a: jax.Array, leaf) -> Optional[jax.Array]:
@@ -242,7 +274,8 @@ def _dispatch_pack_out(a, leaf, n_cont: int, gp: int):
     if bm is None:
         return None
     y = _mm_pack_out(x2, q4.reshape(c_dim, p_dim),
-                     s4.reshape(c_dim, p_dim // gp), gp, bm, bp, bc)
+                     s4.reshape(c_dim, p_dim // gp), gp, bm, bp, bc,
+                     _interpret())
     return y[:m].reshape(a.shape[:-n_cont] + kept_shape)
 
 
@@ -264,5 +297,6 @@ def _dispatch_pack_contract(a, leaf, gp: int):
     if bm is None:
         return None
     y = _mm_pack_contract(x_even, x_odd, q4.reshape(n_dim, cp),
-                          s4.reshape(n_dim, cp // gp), gp, bm, bn)
+                          s4.reshape(n_dim, cp // gp), gp, bm, bn,
+                          _interpret())
     return y[:m].reshape(a.shape[:-1] + q4.shape[:-1])
