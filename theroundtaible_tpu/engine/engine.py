@@ -427,9 +427,14 @@ class InferenceEngine:
             # replica (ReplicaGroupPlan) so each shard_map block reads
             # only its local pages; the kernels rebase tables to the
             # local range via axis_index. No gather view on any mesh.
+            kh_l = model_cfg.num_kv_heads
+            if self.mesh.devices.size > 1 and kh_l % max(n_model, 1) == 0:
+                kh_l //= max(n_model, 1)   # kernel sees the local shard
             self.paged_direct = (
                 attn != "dense"
-                and paged_decode_supported(page_size, model_cfg.head_dim)
+                and paged_decode_supported(
+                    page_size, model_cfg.head_dim, kh_l,
+                    model_cfg.num_heads // model_cfg.num_kv_heads)
                 and (self.mesh.devices.size == 1
                      or spmd_partitionable(model_cfg.num_heads,
                                            model_cfg.num_kv_heads,

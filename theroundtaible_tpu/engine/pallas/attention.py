@@ -83,15 +83,20 @@ def supported(t: int, s: int, d: int) -> bool:
 # --- prefill kernel ---
 
 
-def _prefill_accumulate(q, k, v, q_start, kv_start, valid, m_scr, l_scr,
-                        acc_scr, *, group: int, block_q: int,
-                        block_kv: int, sliding_window: Optional[int],
+def _prefill_accumulate(q, k, v, q_start, kv_start, valid, state, *,
+                        group: int, block_q: int, block_kv: int,
+                        sliding_window: Optional[int],
                         softcap: Optional[float]):
     """One online-softmax accumulation of a q block [G*bq, D] against one
     kv block [bkv, D] whose first entry holds absolute position kv_start.
     Shared by the contiguous (_prefill_kernel) and paged
     (_paged_prefill_kernel) prefill kernels — the two differ ONLY in how
-    the kv block is addressed, so the math lives here once."""
+    the kv block is addressed, so the math lives here once. Pure
+    value-in/value-out over `state` = (m, l, acc) so callers can keep
+    per-kv-head running state in scratch slices (the paged kernels loop
+    heads in-kernel; a ref-mutating helper would pin the scratch
+    layout)."""
+    m_prev, l_prev, acc_prev = state
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)            # [G*bq, bkv]
@@ -111,8 +116,6 @@ def _prefill_accumulate(q, k, v, q_start, kv_start, valid, m_scr, l_scr,
         .reshape(group * block_q, block_kv)
     s = jnp.where(mask, s, NEG_INF)
 
-    m_prev = m_scr[:]                                  # [G*bq, LANES]
-    l_prev = l_scr[:]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
@@ -121,9 +124,7 @@ def _prefill_accumulate(q, k, v, q_start, kv_start, valid, m_scr, l_scr,
     pv = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)            # [G*bq, D]
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-    acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+    return m_new, l_new, acc_prev * alpha[:, :1] + pv
 
 
 def _prefill_blk_bounds(q_start, valid, block_q: int, block_kv: int,
@@ -168,10 +169,11 @@ def _prefill_kernel(offs_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        _prefill_accumulate(
+        m_scr[:], l_scr[:], acc_scr[:] = _prefill_accumulate(
             q_ref[0, 0].reshape(group * block_q, -1), k_ref[0, 0],
-            v_ref[0, 0], q_start, sb * block_kv, valid, m_scr, l_scr,
-            acc_scr, group=group, block_q=block_q, block_kv=block_kv,
+            v_ref[0, 0], q_start, sb * block_kv, valid,
+            (m_scr[:], l_scr[:], acc_scr[:]), group=group,
+            block_q=block_q, block_kv=block_kv,
             sliding_window=sliding_window, softcap=softcap)
 
     @pl.when(sb == num_kv_blocks - 1)
@@ -257,15 +259,17 @@ def flash_prefill_attention(
 def _paged_prefill_kernel(table_ref, offs_ref, valid_ref, q_ref, k_ref,
                           v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                           block_q: int, page_size: int,
-                          num_page_blocks: int, group: int,
+                          num_page_blocks: int, kh: int, group: int,
                           sliding_window: Optional[int],
                           softcap: Optional[float]):
     # Identical math to _prefill_kernel (shared _prefill_accumulate); the
-    # only paged difference lives in the INDEX MAP — the kv block for
-    # grid step sb is pool page table[b, sb].
+    # paged differences: the kv block for grid step sb is pool page
+    # table[b, sb], and ALL kv heads ride one (1, ps, K, D) block with a
+    # static in-kernel head loop — per-head pool blocks are
+    # Mosaic-illegal for K > 1 (see _paged_decode_kernel).
     b = pl.program_id(0)
-    tb = pl.program_id(2)
-    sb = pl.program_id(3)
+    tb = pl.program_id(1)
+    sb = pl.program_id(2)
 
     @pl.when(sb == 0)
     def _init():
@@ -281,26 +285,32 @@ def _paged_prefill_kernel(table_ref, offs_ref, valid_ref, q_ref, k_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        _prefill_accumulate(
-            q_ref[0, 0].reshape(group * block_q, -1), k_ref[0, :, 0, :],
-            v_ref[0, :, 0, :], q_start, sb * page_size, valid, m_scr,
-            l_scr, acc_scr, group=group, block_q=block_q,
-            block_kv=page_size, sliding_window=sliding_window,
-            softcap=softcap)
+        for khi in range(kh):
+            m_scr[khi], l_scr[khi], acc_scr[khi] = _prefill_accumulate(
+                q_ref[0, khi].reshape(group * block_q, -1),
+                k_ref[0, :, khi, :], v_ref[0, :, khi, :], q_start,
+                sb * page_size, valid,
+                (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
+                block_q=block_q, block_kv=page_size,
+                sliding_window=sliding_window, softcap=softcap)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
         d = o_ref.shape[-1]
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype) \
-            .reshape(group, block_q, d)
+        for khi in range(kh):
+            l = jnp.maximum(l_scr[khi, :, :1], 1e-30)
+            o_ref[0, khi] = (acc_scr[khi] / l).astype(o_ref.dtype) \
+                .reshape(group, block_q, d)
 
 
-def paged_prefill_supported(t: int, page_size: int, d: int) -> bool:
-    """Can paged_prefill_attention serve this chunk/pool shape?"""
-    if _pick_block(t, (128, 64, 32, 16, 8)) is None:
+def paged_prefill_supported(t: int, page_size: int, d: int,
+                            kh: int = 1, group: int = 1) -> bool:
+    """Can paged_prefill_attention serve this chunk/pool shape? kh/group
+    as in paged_decode_supported — block_q shrinks until the kh-scaled
+    working set fits VMEM, declining only when even block_q=8 doesn't."""
+    if _paged_prefill_block_q(t, page_size, d, kh, group) is None:
         return False
-    return paged_decode_supported(page_size, d)
+    return paged_decode_supported(page_size, d, kh, group)
 
 
 def paged_prefill_attention(
@@ -327,42 +337,43 @@ def paged_prefill_attention(
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     group = h // kh
     pages_per_seq = table.shape[1]
-    block_q = _pick_block(t, (128, 64, 32, 16, 8))
-    if block_q is None or not paged_decode_supported(page_size, d):
+    block_q = _paged_prefill_block_q(t, page_size, d, kh, group)
+    if block_q is None or not paged_decode_supported(page_size, d, kh,
+                                                     group):
         raise ValueError(f"unsupported shapes T={t} ps={page_size} D={d}")
     interpret = _interpret() if interpret is None else interpret
 
     qt = q.transpose(0, 2, 1, 3).reshape(b, kh, group, t, d)
 
-    def kv_index(bi, khi, tb, sb, table_ref, offs_ref, valid_ref):
+    def kv_index(bi, tb, sb, table_ref, offs_ref, valid_ref):
         q_start = offs_ref[bi] + tb * block_q
         lo_blk, hi_blk = _prefill_blk_bounds(
             q_start, valid_ref[bi], block_q, page_size, sliding_window)
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
-        return (table_ref[bi, sb], 0, khi, 0)
+        return (table_ref[bi, sb], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, kh, t // block_q, pages_per_seq),
+        grid=(b, t // block_q, pages_per_seq),
         in_specs=[
-            pl.BlockSpec((1, 1, group, block_q, d),
-                         lambda bi, khi, tb, sb, t_, o_, v_:
-                         (bi, khi, 0, tb, 0)),
-            pl.BlockSpec((1, page_size, 1, d), kv_index),
-            pl.BlockSpec((1, page_size, 1, d), kv_index),
+            pl.BlockSpec((1, kh, group, block_q, d),
+                         lambda bi, tb, sb, t_, o_, v_:
+                         (bi, 0, 0, tb, 0)),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, group, block_q, d),
-            lambda bi, khi, tb, sb, t_, o_, v_: (bi, khi, 0, tb, 0)),
+            (1, kh, group, block_q, d),
+            lambda bi, tb, sb, t_, o_, v_: (bi, 0, 0, tb, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
-            pltpu.VMEM((group * block_q, _LANES), jnp.float32),
-            pltpu.VMEM((group * block_q, d), jnp.float32),
+            pltpu.VMEM((kh, group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group * block_q, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group * block_q, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _paged_prefill_kernel, block_q=block_q, page_size=page_size,
-        num_page_blocks=pages_per_seq, group=group,
+        num_page_blocks=pages_per_seq, kh=kh, group=group,
         sliding_window=sliding_window, softcap=softcap)
     out = pl.pallas_call(
         kernel,
@@ -395,9 +406,13 @@ def paged_prefill_spmd(
     b, t, h, d = q.shape
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     axes_t = _spmd_axes(mesh, h, kh, b)
-    if axes_t is None or not paged_prefill_supported(t, page_size, d):
+    if axes_t is None:
         return None
     batch_ax, head_ax, kv_head_ax = axes_t
+    kh_local = kh // dict(mesh.shape).get(kv_head_ax, 1) \
+        if kv_head_ax else kh
+    if not paged_prefill_supported(t, page_size, d, kh_local, h // kh):
+        return None
     page_ax = None
     if pool_replicas > 1:
         if (batch_ax != "data"
@@ -525,15 +540,17 @@ def flash_attention_spmd(
               kv_valid.astype(jnp.int32))
 
 
-def _decode_accumulate(q, k, v, kv_start, valid, m_scr, l_scr, acc_scr,
-                       *, group: int, block_kv: int,
+def _decode_accumulate(q, k, v, kv_start, valid, state, *,
+                       group: int, block_kv: int,
                        sliding_window: Optional[int],
                        softcap: Optional[float]):
     """One online-softmax accumulation of a single-position query group
     [G, D] against one kv block [bkv, D] whose first entry holds absolute
     position kv_start. Shared by the contiguous (_decode_kernel) and
     paged (_paged_decode_kernel) decode kernels — the two differ ONLY in
-    how the kv block is addressed, so the math lives here once."""
+    how the kv block is addressed, so the math lives here once. Pure
+    value-in/value-out over `state` = (m, l, acc) — see
+    _prefill_accumulate for why."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # [G, bkv]
@@ -546,7 +563,7 @@ def _decode_accumulate(q, k, v, kv_start, valid, m_scr, l_scr, acc_scr,
         mask &= kv_pos > (valid - 1) - sliding_window
     s = jnp.where(mask, s, NEG_INF)
 
-    m_prev, l_prev = m_scr[:], l_scr[:]
+    m_prev, l_prev, acc_prev = state
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
@@ -555,9 +572,7 @@ def _decode_accumulate(q, k, v, kv_start, valid, m_scr, l_scr, acc_scr,
     pv = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-    acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+    return m_new, l_new, acc_prev * alpha[:, :1] + pv
 
 
 def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
@@ -583,10 +598,11 @@ def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        _decode_accumulate(
+        m_scr[:], l_scr[:], acc_scr[:] = _decode_accumulate(
             q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], sb * block_kv, valid,
-            m_scr, l_scr, acc_scr, group=group, block_kv=block_kv,
-            sliding_window=sliding_window, softcap=softcap)
+            (m_scr[:], l_scr[:], acc_scr[:]), group=group,
+            block_kv=block_kv, sliding_window=sliding_window,
+            softcap=softcap)
 
     @pl.when(sb == num_kv_blocks - 1)
     def _finish():
@@ -594,27 +610,64 @@ def _decode_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-def paged_decode_supported(page_size: int, d: int) -> bool:
+# Conservative VMEM working-set budget for the paged kernels. All kv
+# heads ride one block since the per-head pool block is Mosaic-illegal
+# (see _paged_decode_kernel), so q/out/kv blocks and scratch all scale
+# with kh — large-GQA shapes must shrink block_q or decline to the
+# gather-view fallback INSTEAD of failing Mosaic compilation on chip.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _paged_vmem_est(page_size: int, d: int, kh: int, group: int,
+                    block_q: int) -> int:
+    scratch = kh * group * block_q * (2 * _LANES + d) * 4   # f32 m/l/acc
+    q_out = 2 * kh * group * block_q * d * 2                # bf16 blocks
+    kv = 2 * 2 * page_size * kh * d * 2                     # 2×(k+v) bufs
+    return scratch + q_out + kv
+
+
+def _paged_prefill_block_q(t: int, page_size: int, d: int, kh: int,
+                           group: int) -> Optional[int]:
+    for bq in (128, 64, 32, 16, 8):
+        if t % bq == 0 and _paged_vmem_est(page_size, d, kh, group,
+                                           bq) <= _VMEM_BUDGET:
+            return bq
+    return None
+
+
+def paged_decode_supported(page_size: int, d: int, kh: int = 1,
+                           group: int = 1) -> bool:
     """Can paged_decode_attention serve this pool shape? The page is the
     kv block, so page_size must be a legal block; TPU wants lane-aligned
-    D (any shape goes in interpret mode)."""
+    D (any shape goes in interpret mode). Pass the LOCAL kv-head count
+    and GQA group so the kh-scaled VMEM working set is budgeted — an
+    oversized layout must route to the gather view, not fail Mosaic."""
     if page_size not in (512, 256, 128, 64, 32, 16, 8):
+        return False
+    if _paged_vmem_est(page_size, d, kh, group, 1) > _VMEM_BUDGET:
         return False
     return _interpret() or d % 128 == 0
 
 
 def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
                          m_scr, l_scr, acc_scr, *, page_size: int,
-                         num_page_blocks: int, group: int,
+                         num_page_blocks: int, kh: int, group: int,
                          sliding_window: Optional[int],
                          softcap: Optional[float]):
-    # Identical online-softmax math to _decode_kernel; the only paged
-    # difference lives in the INDEX MAP (the kv block for grid step sb is
-    # pool page table[b, sb], not cache row sb). valid INCLUDES the
-    # current step's entry, which the caller has already written into the
-    # pool (q position = valid - 1).
+    # Identical online-softmax math to _decode_kernel; the paged
+    # differences: the kv block for grid step sb is pool page
+    # table[b, sb] (not cache row sb), and ALL kv heads ride one block —
+    # the pool keeps its [P, ps, K, D] layout, and a per-head block
+    # (1, ps, 1, D) is Mosaic-ILLEGAL for K > 1 (second-minor block dim
+    # 1 is neither 8-aligned nor the full K axis; unseen on hardware
+    # until GQA because gemma's MQA pool has K == 1). So the grid drops
+    # its kv-head dimension, each page is DMA'd once per row with every
+    # head (same total bytes as per-head page reads), and a STATIC
+    # unrolled loop walks the heads against per-head scratch slices.
+    # valid INCLUDES the current step's entry, which the caller has
+    # already written into the pool (q position = valid - 1).
     b = pl.program_id(0)
-    sb = pl.program_id(2)
+    sb = pl.program_id(1)
 
     @pl.when(sb == 0)
     def _init():
@@ -631,16 +684,19 @@ def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when((sb >= lo) & (sb <= hi))
     def _compute():
-        _decode_accumulate(
-            q_ref[0, 0], k_ref[0, :, 0, :], v_ref[0, :, 0, :],
-            sb * page_size, valid, m_scr, l_scr, acc_scr, group=group,
-            block_kv=page_size, sliding_window=sliding_window,
-            softcap=softcap)
+        for khi in range(kh):
+            m_scr[khi], l_scr[khi], acc_scr[khi] = _decode_accumulate(
+                q_ref[0, khi], k_ref[0, :, khi, :], v_ref[0, :, khi, :],
+                sb * page_size, valid,
+                (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
+                block_kv=page_size, sliding_window=sliding_window,
+                softcap=softcap)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        for khi in range(kh):
+            l = jnp.maximum(l_scr[khi, :, :1], 1e-30)
+            o_ref[0, khi] = (acc_scr[khi] / l).astype(o_ref.dtype)
 
 
 def paged_decode_spmd(
@@ -683,9 +739,13 @@ def paged_decode_spmd(
     b, t, h, d = q.shape
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     axes_t = _spmd_axes(mesh, h, kh, b)
-    if axes_t is None or not paged_decode_supported(page_size, d):
+    if axes_t is None:
         return None
     batch_ax, head_ax, kv_head_ax = axes_t
+    kh_local = kh // dict(mesh.shape).get(kv_head_ax, 1) \
+        if kv_head_ax else kh
+    if not paged_decode_supported(page_size, d, kh_local, h // kh):
+        return None
     page_ax = None
     if pool_replicas > 1:
         if (batch_ax != "data"
@@ -731,23 +791,24 @@ def paged_decode_attention(
     block index map reads the page table, so only pages holding each
     row's valid prefix are ever DMA'd, and the [B, S, K, D] gather view
     the engine's fallback path materializes is never built. The pool
-    keeps its prefill-friendly [P, ps, K, D] layout; the kernel's page
-    blocks are sublane-strided (1, ps, 1, D) slices — the DMA still
-    moves only page_size × D elements per (row, kv head, page).
-    Returns [B, 1, H, D].
+    keeps its prefill-friendly [P, ps, K, D] layout; a page block
+    carries ALL kv heads (1, ps, K, D) and a static in-kernel loop walks
+    them — per-head (1, ps, 1, D) blocks are Mosaic-illegal for K > 1,
+    and total DMA bytes are identical either way (each page read once
+    per row). Returns [B, 1, H, D].
     """
     b, t, h, d = q.shape
     assert t == 1, "decode kernel serves exactly one position"
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     group = h // kh
     pages_per_seq = table.shape[1]
-    if not paged_decode_supported(page_size, d):
+    if not paged_decode_supported(page_size, d, kh, group):
         raise ValueError(f"unsupported pool shape ps={page_size} D={d}")
     interpret = _interpret() if interpret is None else interpret
 
     qt = q[:, 0].reshape(b, kh, group, d)
 
-    def kv_index(bi, khi, sb, table_ref, valid_ref):
+    def kv_index(bi, sb, table_ref, valid_ref):
         hi_blk = (valid_ref[bi] - 1) // page_size
         if sliding_window is None:
             lo_blk = jnp.int32(0)
@@ -755,29 +816,29 @@ def paged_decode_attention(
             lo_blk = jnp.maximum(
                 0, (valid_ref[bi] - sliding_window) // page_size)
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
-        return (table_ref[bi, sb], 0, khi, 0)
+        return (table_ref[bi, sb], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, kh, pages_per_seq),
+        grid=(b, pages_per_seq),
         in_specs=[
-            pl.BlockSpec((1, 1, group, d),
-                         lambda bi, khi, sb, t_, v_: (bi, khi, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d), kv_index),
-            pl.BlockSpec((1, page_size, 1, d), kv_index),
+            pl.BlockSpec((1, kh, group, d),
+                         lambda bi, sb, t_, v_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, group, d),
-            lambda bi, khi, sb, t_, v_: (bi, khi, 0, 0)),
+            (1, kh, group, d),
+            lambda bi, sb, t_, v_: (bi, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, _LANES), jnp.float32),
-            pltpu.VMEM((group, _LANES), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((kh, group, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _paged_decode_kernel, page_size=page_size,
-        num_page_blocks=pages_per_seq, group=group,
+        num_page_blocks=pages_per_seq, kh=kh, group=group,
         sliding_window=sliding_window, softcap=softcap)
     out = pl.pallas_call(
         kernel,

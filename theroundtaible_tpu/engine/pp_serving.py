@@ -202,9 +202,14 @@ class PPEngine:
         self._pool_direct = False
         if kv_layout == "paged":
             from .pallas.attention import paged_decode_supported
+            kh_l = model_cfg.num_kv_heads
+            if n_model > 1 and kh_l % n_model == 0:
+                kh_l //= n_model   # kernel sees the local shard
             self._pool_direct = (
                 attn != "dense"
-                and paged_decode_supported(page_size, model_cfg.head_dim)
+                and paged_decode_supported(
+                    page_size, model_cfg.head_dim, kh_l,
+                    model_cfg.num_heads // model_cfg.num_kv_heads)
                 and (n_model == 1 or heads_divide))
         if kv_layout == "paged":
             # Stage-stacked page pool [st, per, P, ps, K, D]: ONE
