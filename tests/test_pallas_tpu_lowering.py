@@ -45,14 +45,19 @@ def _qkv(b, t):
     return q, k, v
 
 
-def test_single_device_kernels_lower():
+# (None, None) = llama/qwen; softcap = gemma-2; window = mistral —
+# each flag switches real kernel code paths (tanh, window masks)
+@pytest.mark.parametrize("softcap,window", [(None, None), (30.0, None),
+                                            (None, 64)])
+def test_single_device_kernels_lower(softcap, window):
     b = 2
     q, k, v = _qkv(b, 1)
     valid = jnp.full((b,), 37, jnp.int32)
 
     def decode(q, k, v, valid):
-        return pattn.ragged_decode_attention(q, k, v, valid,
-                                             interpret=False)
+        return pattn.ragged_decode_attention(
+            q, k, v, valid, sliding_window=window, softcap=softcap,
+            interpret=False)
 
     _lower_tpu(decode, q, k, v, valid)
 
@@ -60,8 +65,9 @@ def test_single_device_kernels_lower():
     offs = jnp.zeros((b,), jnp.int32)
 
     def prefill(q, k, v, offs, valid):
-        return pattn.flash_prefill_attention(q, k, v, offs, valid,
-                                             interpret=False)
+        return pattn.flash_prefill_attention(
+            q, k, v, offs, valid, sliding_window=window,
+            softcap=softcap, interpret=False)
 
     _lower_tpu(prefill, qp, k, v, offs, valid)
 
