@@ -220,6 +220,47 @@ class TestSampling:
             b = sample_token_batch(logits, key, *sampling_arrays([p] * 4))
             assert a.tolist() == b.tolist()
 
+    def test_batch_fast_path_with_pool_smaller_than_vocab(self):
+        """The candidate-pool fast path itself (vocab strictly larger
+        than _K_CAND, thresholds provable inside the pool) must match
+        sample_token draw-for-draw: top_k well under the pool size, and
+        a PEAKED top-p row whose cutoff mass sits in the first few
+        candidates."""
+        from theroundtaible_tpu.engine.sampling import (_K_CAND,
+                                                        sample_token_batch,
+                                                        sampling_arrays)
+        rng = np.random.default_rng(19)
+        v = 4 * _K_CAND
+        peaked = jnp.asarray(rng.normal(size=(3, v)) * 3.0, jnp.float32)
+        for p in (SamplingParams(temperature=0.9, top_k=50),
+                  SamplingParams(temperature=0.8, top_p=0.7),
+                  SamplingParams(temperature=1.1, top_k=64, top_p=0.9)):
+            for seed in (23, 29, 31):
+                key = jax.random.PRNGKey(seed)
+                a = sample_token(peaked, key, p)
+                b = sample_token_batch(peaked, key,
+                                       *sampling_arrays([p] * 3))
+                assert a.tolist() == b.tolist(), (p, seed)
+
+    def test_batch_fallback_beyond_candidate_pool(self):
+        """Rows the lax.top_k candidate pool cannot prove (top_k bigger
+        than the pool; near-flat logits whose top-p cutoff needs more
+        than the pool's mass) must take the exact full-sort fallback and
+        still match sample_token draw-for-draw under the same key."""
+        from theroundtaible_tpu.engine.sampling import (_K_CAND,
+                                                        sample_token_batch,
+                                                        sampling_arrays)
+        rng = np.random.default_rng(13)
+        v = 4 * _K_CAND
+        # near-flat: top-p 0.99 needs far more than _K_CAND candidates
+        flat = jnp.asarray(rng.normal(size=(3, v)) * 0.01, jnp.float32)
+        for p in (SamplingParams(temperature=1.0, top_k=2 * _K_CAND),
+                  SamplingParams(temperature=1.0, top_p=0.99)):
+            key = jax.random.PRNGKey(17)
+            a = sample_token(flat, key, p)
+            b = sample_token_batch(flat, key, *sampling_arrays([p] * 3))
+            assert a.tolist() == b.tolist()
+
 
 class TestKVCacheSlots:
     def test_acquire_release(self):
