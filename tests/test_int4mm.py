@@ -133,6 +133,30 @@ BLOCKABLE = ModelConfig(
     max_seq_len=64, tie_embeddings=True)
 
 
+def test_engine_serving_token_parity(monkeypatch):
+    """The kernels inside the REAL serving path — engine build, slot
+    cache, jitted decode while_loop with donated buffers — not just a
+    bare forward: greedy generations must be identical with the kernel
+    forced on vs off. Dims chosen so every matmul takes the kernel path
+    (registry tiny models decline on block sizes, which would make this
+    vacuous)."""
+    import dataclasses
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    cfg = dataclasses.replace(BLOCKABLE, max_seq_len=128)
+    outs = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("ROUNDTABLE_INT4_MM", flag)
+        eng = InferenceEngine(
+            cfg, num_slots=2, quant="int4",
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        outs[flag] = eng.generate("knights debate the packed nibbles",
+                                  slot_name="k", max_new_tokens=8)
+    assert outs["1"] == outs["0"]
+
+
 def test_model_forward_token_parity(monkeypatch):
     """Full int4 forward with the kernel on vs off: same greedy tokens,
     close logits. Dims chosen so every matmul takes the kernel path.
