@@ -36,6 +36,14 @@ class BaseAdapter(ABC):
     def execute(self, prompt: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
         """Run one prompt to completion and return the raw response text."""
 
+    def execute_for(self, knight_name: str, prompt: str,
+                    timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        """Execute one turn attributed to `knight_name`. Cloud/CLI
+        adapters ignore the name; engine-backed adapters override so the
+        knight keeps its own KV slot and per-knight sampling even when a
+        round degrades from the batched path to serial turns."""
+        return self.execute(prompt, timeout_ms)
+
     @abstractmethod
     def is_available(self) -> bool:
         """Probe whether this backend can serve requests right now."""
@@ -59,6 +67,14 @@ class BaseAdapter(ABC):
         """True when execute_round is a genuine batched dispatch."""
         return False
 
+    def known_unhealthy(self) -> bool:
+        """Cheap, NON-constructive health check: True only when this
+        adapter already knows it cannot serve (open circuit breaker,
+        memoized dead engine). Unlike is_available() it must never
+        trigger lazy engine construction — the orchestrator calls it
+        synchronously while forming batch groups."""
+        return False
+
     def last_stats(self) -> Optional[dict]:
         """Engine-side numbers for the most recent execute/execute_round
         (token counts, prefill/decode tok/s) — None for backends that
@@ -72,4 +88,5 @@ class BaseAdapter(ABC):
         The tpu-llm adapter overrides this with one batched forward pass over
         N persistent KV slots (SURVEY.md §2.3 parallelism table).
         """
-        return [self.execute(t.prompt, timeout_ms) for t in turns]
+        return [self.execute_for(t.knight_name, t.prompt, timeout_ms)
+                for t in turns]

@@ -6,10 +6,22 @@ SURVEY.md §2.3). The adapter is a thin host-side shim: tokenize → dispatch to
 the engine's sharded prefill+decode → detokenize. Engine construction is lazy
 and cached per checkpoint so several knights (or several adapters) share one
 resident model.
+
+Fault tolerance (ISSUE 1, ARCHITECTURE.md "Fault tolerance"): this is the
+adapter rung of the degradation ladder. A failed BATCHED round invalidates
+the batch's KV slots and retries the knights serially (smaller programs,
+per-knight isolation) before giving up; every final failure feeds the
+engine's shared circuit breaker (engine.get_breaker — keyed like the engine
+cache, so adapters sharing a resident engine share its health), and once the
+breaker opens `is_available()` reports False with the breaker's reason so
+the orchestrator's runtime-fallback path seats the knight elsewhere instead
+of feeding more turns into a sick engine.
 """
 
 from __future__ import annotations
 
+import time
+import warnings
 from typing import Any, Optional
 
 from ..core.errors import AdapterError, classify_error
@@ -33,6 +45,9 @@ class TpuLlmAdapter(BaseAdapter):
         self._engine = None
         self._engine_error: Optional[str] = None
         self._last_stats: Optional[dict] = None
+        # Which degradation rung served the last round, if any
+        # ("serial_retry"); chaos tests and metrics read it.
+        self.last_degradation: Optional[str] = None
 
     @classmethod
     def from_config(cls, adapter_id: str, cfg: dict[str, Any],
@@ -40,27 +55,59 @@ class TpuLlmAdapter(BaseAdapter):
         return cls(name=cfg.get("name", adapter_id), engine_config=cfg,
                    timeout_ms=timeout_ms)
 
-    # --- engine lifecycle ---
+    # --- engine lifecycle + health ---
 
-    def _get_engine(self):
+    def breaker(self):
+        """The engine-cache-shared CircuitBreaker for this config."""
+        from ..engine import get_breaker
+        return get_breaker(self.engine_config)
+
+    def _get_engine(self, retry_construction: bool = False):
+        if (retry_construction and self._engine is None
+                and self._engine_error is not None):
+            # The caller was admitted by the breaker (closed, or its
+            # half-open probe), so a memoized construction failure gets a
+            # fresh attempt: a checkpoint fixed after startup (or freed
+            # HBM) closes the breaker in-process on the SAME admitted
+            # call instead of staying memoized-dead. Passive callers
+            # (is_available, get_max_source_chars) keep the memo.
+            self._engine_error = None
         if self._engine is None and self._engine_error is None:
             try:
                 from ..engine import get_engine
                 self._engine = get_engine(self.engine_config)
             except Exception as e:  # noqa: BLE001 — surfaced via is_available
                 self._engine_error = str(e)
+                # A construction failure is permanent, not transient (and
+                # memoized — it would only ever count once), so it OPENS
+                # the breaker outright: fleet_health must report a dead
+                # engine as open, not eternally 'degraded'.
+                self.breaker().trip(e)
         if self._engine is None:
             raise AdapterError(
                 f"TPU engine unavailable: {self._engine_error}",
                 kind=classify_error(RuntimeError(self._engine_error or "")))
         return self._engine
 
+    def known_unhealthy(self) -> bool:
+        # No construction here (contract): just the breaker verdict and
+        # the memoized construction failure.
+        return self.breaker().is_open or self._engine_error is not None
+
     def is_available(self) -> bool:
+        if self.breaker().is_open:
+            return False
         try:
             self._get_engine()
             return True
         except AdapterError:
             return False
+
+    def unavailable_reason(self) -> Optional[str]:
+        """Why is_available() is False (None when it isn't): the open
+        breaker's reason, or the engine construction error."""
+        reason = self.breaker().reason
+        return reason if reason else self._engine_error
 
     # --- serving ---
 
@@ -77,8 +124,16 @@ class TpuLlmAdapter(BaseAdapter):
         return int(available * engine.chars_per_token())
 
     def execute(self, prompt: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        return self.execute_for(self.name, prompt, timeout_ms)
+
+    def execute_for(self, knight_name: str, prompt: str,
+                    timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        # Keyed by the KNIGHT, not the adapter: a knight degraded off the
+        # batched path onto serial turns keeps its own KV slot and
+        # per-knight sampling instead of colliding on the adapter's name.
         return self.execute_round(
-            [KnightTurn(knight_name=self.name, prompt=prompt)], timeout_ms)[0]
+            [KnightTurn(knight_name=knight_name, prompt=prompt)],
+            timeout_ms)[0]
 
     def supports_batched_rounds(self) -> bool:
         return True
@@ -105,27 +160,56 @@ class TpuLlmAdapter(BaseAdapter):
 
     def execute_round(self, turns: list[KnightTurn],
                       timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
-        """One batched forward pass over N persistent per-knight KV slots."""
-        engine = self._get_engine()
-        self._last_stats = None  # a failed call must not leave stale stats
+        """One batched forward pass over N persistent per-knight KV slots.
+
+        Failure handling: a failed batched dispatch degrades to serial
+        per-knight retry (_serial_retry); the final outcome — success or
+        AdapterError — is recorded on the engine's circuit breaker."""
+        breaker = self.breaker()
+        # Clear BEFORE the fail-fast below: a failed call — including one
+        # that never dispatched — must not leave stale stats.
+        self._last_stats = None
+        self.last_degradation = None
+        if not breaker.should_attempt():
+            # Fail fast with the health verdict instead of dispatching
+            # into a sick engine (should_attempt still admits periodic
+            # half-open probes, so a recovered engine closes the breaker
+            # again); the orchestrator's fallback path reads this as any
+            # other adapter failure. The kind comes from the breaker's
+            # underlying error so the operator sees the oom/timeout hint
+            # that actually applies, not a generic backend-error one.
+            reason = breaker.reason or ""
+            raise AdapterError(f"TPU engine unavailable: {reason}",
+                               kind=classify_error(RuntimeError(reason)))
+        # AFTER the breaker gate: this call was admitted (closed breaker
+        # or half-open probe), so a memoized construction failure gets
+        # one fresh attempt — and on success the same call dispatches
+        # and closes the breaker, re-seating the knights in one probe.
+        engine = self._get_engine(retry_construction=True)
         per_turn = None
         if self.engine_config.get("knight_sampling"):
             per_turn = [self._sampling_for(t.knight_name)
                         or engine.sampling for t in turns]
+        # ONE deadline for the whole round, shared by the batched attempt
+        # and every serial retry: execute_round's timeout_ms contract must
+        # not multiply into (N+1)x under degradation.
+        deadline = time.monotonic() + (timeout_ms or self.default_timeout) \
+            / 1000
         try:
-            kwargs = {"timeout_s": (timeout_ms or self.default_timeout)
-                      / 1000}
-            if per_turn is not None:
-                kwargs["sampling_per_turn"] = per_turn
-                # call-level cap = the LARGEST per-knight budget, so a
-                # knight configured above the engine default isn't
-                # silently clamped (row budgets bound each row below it)
-                kwargs["max_new_tokens"] = max(
-                    p.max_new_tokens for p in per_turn)
-            responses, stats = engine.generate_batch_with_stats(
-                [(t.knight_name, t.prompt) for t in turns], **kwargs)
+            responses, stats = self._dispatch_round(engine, turns, per_turn,
+                                                    deadline)
         except Exception as e:  # noqa: BLE001
+            breaker.record_failure(e)
+            # A failure after donation consumed the KV buffers must not
+            # brick the engine: single-turn rounds re-raise before
+            # _serial_retry's revive, so without this the breaker's
+            # half-open probes would die on 'Array has been deleted'
+            # for the process lifetime.
+            self._revive_best_effort(engine)
+            if isinstance(e, AdapterError):
+                raise
             raise AdapterError(str(e), kind=classify_error(e), cause=e)
+        breaker.record_success()
         # per-call snapshot, NOT engine.last_stats — adapters sharing one
         # cached engine would otherwise read each other's numbers
         self._last_stats = {
@@ -138,7 +222,115 @@ class TpuLlmAdapter(BaseAdapter):
             "prefill_tps": round(stats.prefill_tps, 1),
             "decode_tps": round(stats.decode_tps, 1),
         }
+        if self.last_degradation:
+            self._last_stats["degraded"] = self.last_degradation
         return responses
+
+    def _dispatch_round(self, engine, turns, per_turn, deadline):
+        kwargs: dict[str, Any] = {
+            "timeout_s": max(deadline - time.monotonic(), 0.0)}
+        if per_turn is not None:
+            kwargs["sampling_per_turn"] = per_turn
+            # call-level cap = the LARGEST per-knight budget, so a
+            # knight configured above the engine default isn't
+            # silently clamped (row budgets bound each row below it)
+            kwargs["max_new_tokens"] = max(
+                p.max_new_tokens for p in per_turn)
+        try:
+            return engine.generate_batch_with_stats(
+                [(t.knight_name, t.prompt) for t in turns], **kwargs)
+        except Exception as batch_err:  # noqa: BLE001
+            if len(turns) < 2:
+                raise
+            return self._serial_retry(engine, turns, per_turn, deadline,
+                                      batch_err)
+
+    def _serial_retry(self, engine, turns, per_turn, deadline, batch_err):
+        """Batched-round degradation rung: the fan-out failed, so the
+        round becomes best-effort — invalidate the batch's KV slots (a
+        mid-flight failure may have left partial scatter writes) and
+        serve each knight as its own single-row program. Smaller
+        programs, per-knight isolation: one knight's pathology no longer
+        dooms the whole round. Every serial attempt runs inside the
+        ROUND's remaining deadline — a timed-out batch does not buy N
+        fresh timeouts."""
+        if deadline - time.monotonic() <= 0:
+            # No time left to retry anything: surface the timeout BEFORE
+            # the destructive slot invalidation below, so the knights'
+            # cached conversation KV survives for the next round instead
+            # of being wiped for zero benefit.
+            raise AdapterError(
+                f"batched round failed ({batch_err}) and the round's "
+                "deadline passed before serial retry could start",
+                kind="timeout")
+        warnings.warn(
+            f"batched round failed ({batch_err}); invalidating the "
+            f"batch's KV slots and retrying {len(turns)} knight(s) "
+            "serially", stacklevel=3)
+        # A failure that surfaced AFTER donation consumed the KV cache
+        # (jit programs donate the cache buffers) left the engine holding
+        # deleted arrays — reallocate fresh buffers first, else every
+        # serial retry dies on the secondary 'Array has been deleted'
+        # error instead of re-prefilling.
+        if self._revive_best_effort(engine):
+            warnings.warn(
+                "KV buffers were consumed by the failed dispatch; "
+                "reallocated fresh pools (all cached slots lost)",
+                stacklevel=3)
+        for t in turns:
+            engine.kv.release(t.knight_name)
+        from ..engine.engine import GenStats
+        total = GenStats()
+        responses = []
+        failures: list[tuple[str, Exception]] = []
+        for i, t in enumerate(turns):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AdapterError(
+                    f"batched round failed ({batch_err}) and the round's "
+                    f"deadline passed during serial retry at knight "
+                    f"{t.knight_name}", kind="timeout")
+            kwargs: dict[str, Any] = {"timeout_s": remaining}
+            if per_turn is not None:
+                kwargs["sampling_per_turn"] = [per_turn[i]]
+                kwargs["max_new_tokens"] = per_turn[i].max_new_tokens
+            try:
+                out, stats = engine.generate_batch_with_stats(
+                    [(t.knight_name, t.prompt)], **kwargs)
+            except Exception as serial_err:  # noqa: BLE001
+                # Best-effort really means it: one knight's pathology
+                # must not abandon the rest of the round. Keep serving
+                # the remaining knights (revive first, in case THIS
+                # failure consumed the buffers); the succeeded knights'
+                # committed KV makes the orchestrator's per-knight
+                # re-run cheap via prefix reuse.
+                failures.append((t.knight_name, serial_err))
+                self._revive_best_effort(engine)
+                continue
+            responses.append(out[0])
+            total.prefill_tokens += stats.prefill_tokens
+            total.reused_tokens += stats.reused_tokens
+            total.decode_tokens += stats.decode_tokens
+            total.prefill_seconds += stats.prefill_seconds
+            total.decode_seconds += stats.decode_seconds
+        if failures:
+            names = ", ".join(n for n, _ in failures)
+            first = failures[0][1]
+            raise AdapterError(
+                f"batched round failed ({batch_err}) and serial retry "
+                f"failed for knight(s) {names}: {first}",
+                kind=classify_error(first), cause=first)
+        self.last_degradation = "serial_retry"
+        return responses, total
+
+    @staticmethod
+    def _revive_best_effort(engine) -> bool:
+        """revive_kv_if_dead that never raises: a broken revive must not
+        mask the dispatch error the operator actually needs to see."""
+        try:
+            return getattr(engine, "revive_kv_if_dead", lambda: False)()
+        except Exception:  # noqa: BLE001 — the dispatch error wins
+            return False
 
     def last_stats(self) -> Optional[dict]:
         return self._last_stats

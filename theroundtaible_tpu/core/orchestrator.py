@@ -116,7 +116,7 @@ def execute_with_fallback(
     configured fallback adapter and retry once (reference :45-73).
     Returns (response, the adapter that actually served it)."""
     try:
-        return primary.execute(prompt, timeout_ms), primary
+        return primary.execute_for(knight.name, prompt, timeout_ms), primary
     except Exception as primary_error:
         if not knight.fallback:
             raise
@@ -130,7 +130,7 @@ def execute_with_fallback(
         if fallback is None:
             raise primary_error
         reporter.fallback_engaged(knight.name, knight.fallback)
-        return fallback.execute(prompt, timeout_ms), fallback
+        return fallback.execute_for(knight.name, prompt, timeout_ms), fallback
 
 
 def select_lead_knight(knights: list[KnightConfig],
@@ -392,7 +392,16 @@ def _batch_groups(round_order, adapters):
     serial = []
     for k in round_order:
         a = adapters.get(k.adapter)
-        if a is not None and a.supports_batched_rounds():
+        # A KNOWN-sick batch adapter (open circuit breaker, dead engine)
+        # routes its knights to the SERIAL path, where
+        # execute_with_fallback engages each knight's configured
+        # fallback — the discussion continues instead of the whole group
+        # failing every round (ISSUE 1 engine→adapter-fallback rung).
+        # known_unhealthy, not is_available: grouping must stay cheap —
+        # is_available lazily BUILDS the engine, which would serialize
+        # first-round construction here instead of in the group pool.
+        if (a is not None and a.supports_batched_rounds()
+                and not a.known_unhealthy()):
             groups.setdefault(id(a), (a, []))[1].append(k)
         else:
             serial.append(k)
@@ -449,12 +458,16 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
 
         # Record in round order regardless of completion order.
         response_by_knight = {}
+        retry_serially = []
         for (adapter, knights, turns), outcome in zip(jobs, results):
             if isinstance(outcome, Exception):
-                kind = classify_error(outcome)
-                for k in knights:
-                    reporter.knight_failed(k.name, kind, str(outcome),
-                                           hint_for_kind(kind))
+                # Group-failure degradation (ISSUE 1): the batched
+                # dispatch (and the adapter's own serial retry) gave up —
+                # fall through to the per-knight serial path below, where
+                # execute_with_fallback retries the primary once more and
+                # then engages the knight's configured fallback adapter.
+                # Only knights that fail THERE too are reported failed.
+                retry_serially.extend(knights)
                 continue
             responses, group_wall, engine_stats = outcome
             if state.metrics:
@@ -474,6 +487,8 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
                 resp, adapter = response_by_knight[knight.name]
                 _record_turn(knight, round_num, resp, adapter, config,
                              project_root, state, reporter)
+        if retry_serially:
+            serial_order = list(serial_order) + retry_serially
 
     for knight in serial_order:
         adapter = adapters.get(knight.adapter)

@@ -18,6 +18,7 @@ import threading
 from typing import Any
 
 _engines: dict[str, Any] = {}
+_breakers: dict[str, Any] = {}
 _lock = threading.Lock()
 _compile_cache_enabled = False
 
@@ -93,7 +94,43 @@ def get_engine(config: dict[str, Any]):
         return _engines[key]
 
 
+def get_breaker(config: dict[str, Any]):
+    """The circuit breaker for this engine config — keyed exactly like
+    the engine cache, so every adapter sharing a resident engine shares
+    its failure history (a sick engine is sick for all its knights).
+    `breaker_threshold` in the config sets the consecutive-failure trip
+    count (default 3) — FIRST caller wins, since breaker_threshold is
+    deliberately not part of the engine cache key (it isn't
+    serving-relevant); a later caller asking for a different threshold
+    gets the shared breaker as-is, with a warning. Breakers exist even
+    while the engine itself is unbuilt or broken: construction failures
+    count too."""
+    key = _cache_key(config)
+    threshold = max(1, int(config.get("breaker_threshold", 3)))
+    with _lock:
+        breaker = _breakers.get(key)
+        if breaker is None:
+            from .faults import CircuitBreaker
+            breaker = _breakers[key] = CircuitBreaker(
+                threshold=threshold, name=config.get("model", "engine"))
+        elif breaker.threshold != threshold and "breaker_threshold" \
+                in config:
+            import warnings
+            warnings.warn(
+                f"breaker_threshold {threshold} ignored: this engine's "
+                f"shared breaker was created with threshold "
+                f"{breaker.threshold} (first caller wins)")
+        return breaker
+
+
+def breaker_snapshots() -> list[dict[str, Any]]:
+    """Health snapshot of every engine breaker (fleet.fleet_health)."""
+    with _lock:
+        return [b.snapshot() for b in _breakers.values()]
+
+
 def reset_engines() -> None:
-    """Drop all cached engines (tests)."""
+    """Drop all cached engines and their breakers (tests)."""
     with _lock:
         _engines.clear()
+        _breakers.clear()

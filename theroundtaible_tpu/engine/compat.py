@@ -1,0 +1,69 @@
+"""JAX version compatibility — the shard_map API seam.
+
+The engine is written against the modern manual-axes API (`jax.shard_map`
+with `axis_names=`/`check_vma=`, `jax.lax.pcast`, abstract-mesh contexts).
+Older runtimes (jax 0.4.x) ship the same machinery as
+`jax.experimental.shard_map` with the inverse `auto=` parameter, no vma
+tracking and no abstract meshes. This module is the ONE place that
+difference lives: every engine module imports `shard_map` (and friends)
+from here instead of from jax, so a version bump in either direction is a
+compat-module change, not a nine-module sweep.
+
+Translation rules for the experimental fallback:
+- `axis_names={manual...}` → `auto = mesh.axis_names - manual` (the old
+  parameter names the axes NOT manualized);
+- `check_vma` → `check_rep`, defaulting to False (the old rep checker
+  predates pcast-style varying annotations and false-positives on them);
+- `pcast(..., to="varying")` → identity (no vma tracking to convince);
+- partial-manual regions (TP inside PP stages) are REFUSED at build on
+  old jax (pp_serving raises with the fix), so `mesh_manual_axes` only
+  needs the axis_types read on modern meshes and "manualize everything"
+  on old ones.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_native_shard_map = getattr(jax, "shard_map", None)
+HAS_NATIVE_SHARD_MAP = _native_shard_map is not None
+
+if _native_shard_map is not None:
+    shard_map = _native_shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma: Optional[bool] = None):
+        kwargs = {"check_rep": bool(check_vma) if check_vma is not None
+                  else False}
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kwargs["auto"] = auto
+        return _experimental(f, mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+
+
+def pcast(x, axis_names, to: str = "varying"):
+    """`jax.lax.pcast` where it exists; identity elsewhere (pre-vma
+    runtimes don't track varying-ness, so there is nothing to cast)."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_names, to=to)
+
+
+def mesh_manual_axes(mesh) -> set:
+    """The axes a wrapper's shard_map must manualize: the mesh's AUTO
+    axes. Modern meshes carry axis_types; old ones report every axis —
+    correct there, because partial-manual regions (the only case where
+    an axis would already be Manual) are refused at build on old jax."""
+    types = getattr(mesh, "axis_types", None)
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if types is not None and axis_type is not None:
+        return {a for a, t in zip(mesh.axis_names, types)
+                if t == axis_type.Auto}
+    return set(mesh.axis_names)

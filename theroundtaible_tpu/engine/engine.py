@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import faults
 from .kvcache import KVCache
 from .models.common import (ModelConfig, forward, init_params, param_count,
                             spmd_mesh)
@@ -205,6 +206,11 @@ class InferenceEngine:
         # at a time per engine. Distinct engines (fleet submeshes) still
         # run concurrently — each has its own lock.
         self._serve_lock = threading.Lock()
+        # Dispatch retry policy (engine/faults.py): a transient device
+        # dispatch failure retries in place before surfacing to the
+        # adapter's degradation ladder. from_config overrides via the
+        # "dispatch_retries" key.
+        self.retry = faults.DEFAULT_RETRY
 
         # Sequence-parallel long-context prefill (SURVEY.md §7 Phase 6):
         # ring attention (or Ulysses) over a ("seq",) mesh for fresh long
@@ -410,9 +416,10 @@ class InferenceEngine:
         # sharding; pallas.paged_decode_spmd); head layouts that don't
         # partition keep the gather view.
         self.paged_direct = False
+        self.paged_degraded_reason: Optional[str] = None
         self._paged_replicas = 1
         if kv_layout == "paged":
-            from .pallas.attention import (paged_decode_supported,
+            from .pallas.attention import (paged_pool_direct_supported,
                                            spmd_partitionable)
             # attn="dense" is an explicit opt-out of every Pallas kernel
             # (the _resolve_attn contract) — the pool-direct decode IS a
@@ -430,11 +437,12 @@ class InferenceEngine:
             kh_l = model_cfg.num_kv_heads
             if self.mesh.devices.size > 1 and kh_l % max(n_model, 1) == 0:
                 kh_l //= max(n_model, 1)   # kernel sees the local shard
+            group = model_cfg.num_heads // model_cfg.num_kv_heads
             self.paged_direct = (
                 attn != "dense"
-                and paged_decode_supported(
-                    page_size, model_cfg.head_dim, kh_l,
-                    model_cfg.num_heads // model_cfg.num_kv_heads)
+                and paged_pool_direct_supported(
+                    MAX_PREFILL_CHUNK, page_size, model_cfg.head_dim,
+                    kh_l, group)
                 and (self.mesh.devices.size == 1
                      or spmd_partitionable(model_cfg.num_heads,
                                            model_cfg.num_kv_heads,
@@ -491,6 +499,10 @@ class InferenceEngine:
                         last_pos=lengths - 1)
                     return host_read(logits[:, 0]), new_pools
 
+            # Keep BOTH compiled-closure pairs: the gather-view programs
+            # are the runtime degradation target when a pool-direct
+            # kernel fails on chip (_degrade_paged_direct).
+            self._prefill_step_paged_gather = prefill_step_paged
             self._prefill_step_paged = (prefill_step_paged_direct
                                         if self.paged_direct
                                         else prefill_step_paged)
@@ -540,6 +552,7 @@ class InferenceEngine:
                     temps, top_ks, top_ps, row_budgets, done0, max_new,
                     greedy)
 
+            self._decode_loop_paged_gather = decode_loop_paged
             self._decode_loop_paged = (decode_loop_paged_direct
                                        if self.paged_direct
                                        else decode_loop_paged)
@@ -644,6 +657,10 @@ class InferenceEngine:
         # after the fact, not only in the warning stream (advisor r3).
         engine.quant_auto_degraded = bool(
             config.get("_quant_auto_degraded"))
+        if "dispatch_retries" in config:
+            from .faults import RetryPolicy
+            engine.retry = RetryPolicy(
+                max_retries=max(0, int(config["dispatch_retries"])))
         return engine
 
     # --- serving ---
@@ -780,6 +797,33 @@ class InferenceEngine:
         return ((self.kv.pages_per_replica() // max(rows, 1))
                 * self.kv.page_size - DECODE_SEGMENT)
 
+    def revive_kv_if_dead(self) -> bool:
+        """Reallocate KV buffers killed by a failed donated dispatch
+        (the adapter's serial-retry rung calls this so 'batched → serial'
+        recovery also holds for failures that surface AFTER donation
+        consumed the cache). True iff fresh buffers were allocated."""
+        return self.kv.revive_if_dead()
+
+    def _degrade_paged_direct(self, reason: str) -> bool:
+        """Route paged serving off the pool-direct Pallas kernels onto
+        the layout-agnostic gather-view programs, permanently for this
+        engine. The degradation rung for a kernel that compiled-checked
+        clean but fails on chip (Mosaic compile failure, VMEM overrun):
+        the request in flight re-dispatches through the gather view and
+        every later call skips the kernels entirely. Returns False when
+        already degraded / never pool-direct (caller re-raises)."""
+        if not self.paged_direct:
+            return False
+        import warnings
+        warnings.warn(
+            f"paged pool-direct serving degraded to gather-view: {reason}",
+            stacklevel=3)
+        self.paged_direct = False
+        self.paged_degraded_reason = reason
+        self._prefill_step_paged = self._prefill_step_paged_gather
+        self._decode_loop_paged = self._decode_loop_paged_gather
+        return True
+
     def chars_per_token(self) -> float:
         if self._chars_per_token is None:
             sample = ("The quick brown fox jumps over the lazy dog. "
@@ -861,12 +905,30 @@ class InferenceEngine:
         else:
             tables = None
 
+        def paged_prefill(chunk, offs, lengths):
+            if self.paged_direct and faults.ARMED:
+                faults.maybe_inject("mosaic_compile")
+            return self._prefill_step_paged(
+                self.params, self.kv.pools, tables,
+                jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                jnp.asarray(lengths))
+
         def dispatch(chunk, offs, lengths):
             if tables is not None:
-                last, self.kv.pools = self._prefill_step_paged(
-                    self.params, self.kv.pools, tables,
-                    jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                    jnp.asarray(lengths))
+                try:
+                    last, self.kv.pools = paged_prefill(chunk, offs,
+                                                        lengths)
+                except Exception as e:
+                    # Kernel-path failure on a pool-direct engine:
+                    # degrade to the gather-view programs and re-dispatch
+                    # this chunk (inputs are host arrays, pools were not
+                    # consumed by a failed compile). Anything else goes
+                    # to the retry policy / the adapter ladder.
+                    if not (faults.is_kernel_failure(e)
+                            and self._degrade_paged_direct(str(e))):
+                        raise
+                    last, self.kv.pools = paged_prefill(chunk, offs,
+                                                        lengths)
             else:
                 last, self.kv.layers = self._prefill_step(
                     self.params, self.kv.layers, slot_idx,
@@ -876,7 +938,7 @@ class InferenceEngine:
 
         return chunked_prefill(dispatch, token_lists, offsets,
                                self.kv.max_seq_len, self.tokenizer.pad_id,
-                               deadline)
+                               deadline, retry=self.retry)
 
     def _apply_copies(self, copies: list[tuple[int, int, int, int]]) -> None:
         """Dispatch queued (src_slot, dst_slot, lo, hi) K/V span copies.
@@ -1003,6 +1065,12 @@ class InferenceEngine:
 
     def _generate_batch_locked(self, turns, max_new_tokens, timeout_s,
                                sampling_per_turn=None):
+        if faults.ARMED and len(turns) > 1:
+            # Chaos point for the batched-round degradation ladder: a
+            # "corrupted KV slot" fails the fan-out before any slot
+            # bookkeeping mutates; the adapter invalidates the batch's
+            # slots and retries the knights serially (tpu_llm.py).
+            faults.maybe_inject("kv_corrupt")
         stats = GenStats()
         deadline = time.monotonic() + timeout_s
         max_new = max_new_tokens or self.sampling.max_new_tokens
@@ -1129,12 +1197,27 @@ class InferenceEngine:
             if plan is not None:
                 row_budgets = plan.scatter_rows(row_budgets, 0)
             if tables is not None:
-                out, steps, last, valid, done, self.kv.pools = \
-                    self._decode_loop_paged(
+                def run_paged():
+                    if self.paged_direct and faults.ARMED:
+                        faults.maybe_inject("mosaic_compile")
+                    return self._decode_loop_paged(
                         self.params, self.kv.pools, tables, cur_last,
                         cur_valid, self._next_key(), budget, temps,
                         top_ks, top_ps, row_budgets, done0,
                         max_new=DECODE_SEGMENT, greedy=greedy)
+
+                try:
+                    out, steps, last, valid, done, self.kv.pools = \
+                        run_paged()
+                except Exception as e:
+                    # Same degradation rung as prefill: kernel-path
+                    # failure → gather-view programs, re-dispatching
+                    # this segment.
+                    if not (faults.is_kernel_failure(e)
+                            and self._degrade_paged_direct(str(e))):
+                        raise
+                    out, steps, last, valid, done, self.kv.pools = \
+                        run_paged()
             else:
                 out, steps, last, valid, done, self.kv.layers = \
                     self._decode_loop(
@@ -1146,7 +1229,7 @@ class InferenceEngine:
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,
                                  self.tokenizer.eos_id, max_new, deadline,
-                                 timeout_s)
+                                 timeout_s, retry=self.retry)
         stats.decode_seconds = time.monotonic() - t1
         if plan is not None:
             first_np = first_np[plan.pos]

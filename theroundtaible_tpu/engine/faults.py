@@ -1,0 +1,331 @@
+"""Fault injection, retry/backoff, and circuit breakers.
+
+The serving stack's failure story mirrors how it treats recompiles: a
+device program that fails mid-request must DEGRADE, not crash the
+discussion (RTP-LLM, arxiv 2605.29639, builds serving resilience around
+bounded retry and degraded modes; the reference orchestrator survives
+flaky cloud CLIs the same way). Three pieces live here:
+
+- **Injection registry** — deterministic, config/env-armed fault points
+  threaded through the engines (`mosaic_compile`, `dispatch`,
+  `slow_dispatch`, `hbm_oom`, `kv_corrupt`). Each point fires a fixed
+  number of times then disarms, so a chaos test can assert "first
+  dispatch fails, the retry serves". Unarmed injection is ZERO overhead
+  by contract: every hot-path call site guards on the module-level
+  `ARMED` flag (`if faults.ARMED: faults.maybe_inject(...)`) — one
+  attribute load and branch, no dict lookups, no function call.
+- **RetryPolicy** — a small backoff schedule shared by the serving loops
+  and adapters. Transient dispatch failures retry in place; failure
+  kinds where a blind retry cannot help (timeout — the deadline already
+  passed; oom — the allocation will fail again; auth/not_installed)
+  surface immediately so the next degradation rung handles them.
+- **CircuitBreaker** — per-engine consecutive-failure tracking (the
+  engine cache keys breakers the same way it keys engines, see
+  engine/__init__.py). After `threshold` consecutive failures the
+  `tpu-llm` adapter reports unavailable with the breaker's reason, which
+  routes knights onto the orchestrator's existing runtime-fallback path
+  instead of feeding more turns into a sick engine.
+
+The degradation ladder these pieces implement (ARCHITECTURE.md "Fault
+tolerance"): paged pool-direct → gather-view; batched round → serial
+per-knight retry with invalidated KV slots; engine → adapter fallback.
+
+Arming: `arm("dispatch", count=2)` in-process, or the environment at
+import time — `ROUNDTABLE_FAULTS="dispatch:2,slow_dispatch:1@0.5"`
+(point[:count][@delay_seconds]; count -1 = unlimited).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# Module-level guard, the ONLY thing unarmed hot paths touch. Call sites
+# read it as `faults.ARMED` so arm()/disarm() rebinding is visible.
+ARMED = False
+
+POINTS = ("mosaic_compile", "dispatch", "slow_dispatch", "hbm_oom",
+          "kv_corrupt")
+
+# Messages are crafted so core.errors.classify_error maps each fault to
+# the kind its real counterpart would carry ("hbm" → oom, etc.).
+_DEFAULT_MESSAGES = {
+    "mosaic_compile": "injected fault: Mosaic kernel compilation failed "
+                      "(scratch exceeds VMEM budget)",
+    "dispatch": "injected fault: transient device dispatch failure",
+    "slow_dispatch": "injected fault: slow dispatch",
+    "hbm_oom": "injected fault: RESOURCE_EXHAUSTED: out of memory while "
+               "allocating HBM",
+    "kv_corrupt": "injected fault: corrupted KV slot detected",
+}
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed injection point; `point` names which one."""
+
+    def __init__(self, message: str, point: str):
+        super().__init__(message)
+        self.point = point
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    count: int = 1          # firings remaining; -1 = unlimited
+    delay_s: float = 0.0    # slow_dispatch sleeps instead of raising
+    message: str = ""
+    fired: int = 0          # total firings (chaos-test assertions)
+
+
+_registry: dict[str, FaultSpec] = {}
+
+
+def _recompute_armed() -> None:
+    global ARMED
+    ARMED = any(s.count != 0 for s in _registry.values())
+
+
+def arm(point: str, count: int = 1, delay_s: float = 0.0,
+        message: str = "") -> FaultSpec:
+    """Arm an injection point for `count` firings (-1 = unlimited)."""
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r} "
+                         f"(known: {', '.join(POINTS)})")
+    spec = FaultSpec(point=point, count=count, delay_s=delay_s,
+                     message=message or _DEFAULT_MESSAGES[point])
+    _registry[point] = spec
+    _recompute_armed()
+    return spec
+
+
+def disarm(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when none is given."""
+    if point is None:
+        _registry.clear()
+    else:
+        _registry.pop(point, None)
+    _recompute_armed()
+
+
+def spec_for(point: str) -> Optional[FaultSpec]:
+    return _registry.get(point)
+
+
+def maybe_inject(point: str) -> None:
+    """Fire `point` if armed: sleep for slow_dispatch, raise otherwise.
+    Call sites MUST pre-guard with `if faults.ARMED:` — this function is
+    never on an unarmed hot path."""
+    spec = _registry.get(point)
+    if spec is None or spec.count == 0:
+        return
+    if spec.count > 0:
+        spec.count -= 1
+        if spec.count == 0:
+            _recompute_armed()
+    spec.fired += 1
+    if point == "slow_dispatch":
+        time.sleep(spec.delay_s or 0.25)
+        return
+    raise FaultInjected(spec.message, point)
+
+
+def inject_dispatch_faults() -> None:
+    """The dispatch-stage points, in severity order. One call site in the
+    serving loop covers transient failure, slowness and OOM."""
+    maybe_inject("slow_dispatch")
+    maybe_inject("dispatch")
+    maybe_inject("hbm_oom")
+
+
+def _arm_from_env() -> None:
+    """ROUNDTABLE_FAULTS="point[:count][@delay],..." parsed at import.
+    Malformed entries warn and are skipped — the chaos knob must never
+    itself take serving down with an import-time crash."""
+    raw = os.environ.get("ROUNDTABLE_FAULTS", "")
+    for entry in filter(None, (p.strip() for p in raw.split(","))):
+        try:
+            item, delay = entry, 0.0
+            if "@" in item:
+                item, d = item.rsplit("@", 1)
+                delay = float(d)
+            count = 1
+            if ":" in item:
+                item, c = item.rsplit(":", 1)
+                count = int(c)
+            arm(item, count=count, delay_s=delay)
+        except ValueError as e:
+            import warnings
+            # Warn with the ORIGINAL entry, not the stripped-down
+            # fragment — the operator needs to see which part was bad.
+            warnings.warn(
+                f"ignoring malformed ROUNDTABLE_FAULTS entry {entry!r}: "
+                f"{e}")
+
+
+_arm_from_env()
+
+
+# --- degradation classification ---
+
+# Failures of the pool-direct Pallas programs that the layout-agnostic
+# gather-view path is expected to survive: kernel/compile trouble, not
+# generic runtime errors (which retry or surface instead).
+_DEGRADE_MARKERS = ("mosaic", "pallas", "vmem", "scratch", "kernel-legal",
+                    "unsupported shapes", "not supported")
+
+
+def is_kernel_failure(err: BaseException) -> bool:
+    """Would routing around the Pallas kernel (gather-view fallback)
+    plausibly clear this error?"""
+    if isinstance(err, FaultInjected):
+        return err.point == "mosaic_compile"
+    msg = str(err).lower()
+    return any(m in msg for m in _DEGRADE_MARKERS)
+
+
+# --- retry policy ---
+
+# Kinds where an immediate identical retry cannot succeed: the deadline
+# already passed, the allocation will fail again, or the config is wrong.
+_NO_RETRY_KINDS = ("timeout", "oom", "auth", "not_installed")
+
+# Message markers with the same property: a donated-then-failed dispatch
+# leaves its inputs deleted, so re-running the identical program dies on
+# the same dead buffers — only the adapter rung (revive + re-prefill)
+# helps.
+_NO_RETRY_MARKERS = ("has been deleted", "donated")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff, shared by the serving
+    loops (device dispatch) and adapters (engine calls)."""
+
+    max_retries: int = 1
+    backoff_s: float = 0.05
+    backoff_mult: float = 2.0
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before retry `attempt` (0-based)."""
+        return self.backoff_s * (self.backoff_mult ** attempt)
+
+    def retryable(self, err: BaseException) -> bool:
+        if isinstance(err, (KeyboardInterrupt, SystemExit, TimeoutError)):
+            return False
+        msg = str(err).lower()
+        if any(m in msg for m in _NO_RETRY_MARKERS):
+            return False
+        from ..core.errors import classify_error
+        return classify_error(err) not in _NO_RETRY_KINDS
+
+    def run(self, fn: Callable, deadline: float = float("inf"),
+            on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        """fn() with up to max_retries retries on retryable failures,
+        never sleeping past `deadline`."""
+        for attempt in range(self.max_retries + 1):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — policy decides
+                if (attempt >= self.max_retries or not self.retryable(e)
+                        or time.monotonic() >= deadline):
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                pause = min(self.backoff(attempt),
+                            max(deadline - time.monotonic(), 0.0))
+                if pause > 0:
+                    time.sleep(pause)
+
+
+DEFAULT_RETRY = RetryPolicy()
+
+
+# --- circuit breaker ---
+
+@dataclass
+class CircuitBreaker:
+    """Consecutive-failure counter with a trip threshold. Open ⇒ the
+    owner should report itself unavailable (with `reason`) until a
+    success — or an explicit reset — closes it again.
+
+    Thread-safe: the breaker is shared across every adapter of one
+    resident engine, and the orchestrator dispatches batch groups from
+    a thread pool — unsynchronized `failures += 1` read-modify-writes
+    would lose counts, and racing should_attempt calls would admit
+    several simultaneous half-open probes into a sick engine."""
+
+    threshold: int = 3
+    name: str = ""
+    failures: int = 0
+    total_failures: int = 0
+    last_error: str = ""
+    _probes: int = field(default=0, repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def record_failure(self, err: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self.failures += 1
+            self.total_failures += 1
+            if err is not None:
+                self.last_error = str(err)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self._probes = 0
+
+    def trip(self, err: Optional[BaseException] = None) -> None:
+        """Force-open regardless of threshold, for failures known to be
+        permanent rather than transient (engine construction: the
+        checkpoint will not load better on the next call). A later
+        success — e.g. a half-open probe after the operator fixes the
+        config — still closes the breaker normally."""
+        with self._lock:
+            self.failures = max(self.failures, self.threshold)
+            self.total_failures += 1
+            if err is not None:
+                self.last_error = str(err)
+
+    def reset(self) -> None:
+        self.record_success()
+        with self._lock:
+            self.last_error = ""
+
+    @property
+    def is_open(self) -> bool:
+        return self.failures >= self.threshold
+
+    def should_attempt(self) -> bool:
+        """False ⇒ the owner should fail fast. While open, every
+        `threshold` fast-failed calls admits ONE half-open probe
+        dispatch, so a recovered engine closes the breaker on the
+        probe's success instead of staying blacklisted for the process
+        lifetime (a probe that fails re-arms the full fast-fail window
+        via record_failure)."""
+        with self._lock:
+            if self.failures < self.threshold:
+                return True
+            self._probes += 1
+            if self._probes > self.threshold:
+                self._probes = 0
+                return True
+            return False
+
+    @property
+    def reason(self) -> Optional[str]:
+        if not self.is_open:
+            return None
+        return (f"circuit open after {self.failures} consecutive "
+                f"failure(s) (threshold {self.threshold})"
+                + (f": {self.last_error}" if self.last_error else ""))
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "open": self.is_open,
+                "failures": self.failures,
+                "total_failures": self.total_failures,
+                "threshold": self.threshold,
+                "last_error": self.last_error}

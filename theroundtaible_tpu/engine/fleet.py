@@ -256,6 +256,25 @@ def check_fleet_fits(identities: dict[str, list[dict[str, Any]]],
             c["_quant_auto_degraded"] = True
 
 
+def fleet_health() -> dict[str, Any]:
+    """Health roll-up of every resident engine's circuit breaker (ISSUE 1
+    engine→adapter-fallback rung): per-engine snapshots keyed exactly like
+    the engine cache, plus open/total counts. A fleet where `open > 0`
+    has at least one engine the adapters are routing around; `degraded`
+    additionally counts engines with recent (not yet trip-level)
+    consecutive failures. Cheap — host-side counters only, no device
+    work — so status surfaces can poll it per round."""
+    from . import breaker_snapshots
+    snaps = breaker_snapshots()
+    return {
+        "engines": snaps,
+        "total": len(snaps),
+        "open": sum(1 for s in snaps if s["open"]),
+        "degraded": sum(1 for s in snaps
+                        if s["failures"] > 0 and not s["open"]),
+    }
+
+
 def plan_fleet(engine_configs: list[dict[str, Any]],
                n_devices: Optional[int] = None,
                budget_bytes: Optional[int] = None) -> None:

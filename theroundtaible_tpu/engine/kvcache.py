@@ -81,6 +81,21 @@ class SlotBook:
         if name in self._slots:
             self._slots[name].tokens = []
 
+    def forget_all(self) -> None:
+        """Drop every slot record. For buffer reallocation after donation
+        death (revive_if_dead): nothing cached survives, so every later
+        prefill starts from scratch."""
+        self._slots.clear()
+        self._free = list(range(self.num_slots))
+
+    def revive_if_dead(self) -> bool:
+        """Reallocate device buffers if a failed donated dispatch deleted
+        them (jax donate_argnums consumes inputs even when the program
+        faults after transfer). Base SlotBook owns no buffers — caches
+        that do (KVCache, PagedKVCache) override. Returns True iff fresh
+        buffers were allocated (all cached content lost)."""
+        return False
+
     def slot_names(self) -> list[str]:
         return list(self._slots)
 
@@ -205,5 +220,15 @@ class KVCache(SlotBook):
         shape = (num_slots, self.max_seq_len, cfg.num_kv_heads, cfg.head_dim)
         make = (lambda: jnp.zeros(shape, dtype)) if sharding is None else \
             (lambda: jax.device_put(jnp.zeros(shape, dtype), sharding))
+        # Kept for revive_if_dead: reallocation after donation death.
+        self._make = make
         self.layers: list[tuple[jax.Array, jax.Array]] = [
             (make(), make()) for _ in range(cfg.num_layers)]
+
+    def revive_if_dead(self) -> bool:
+        if not self.layers[0][0].is_deleted():
+            return False
+        self.layers = [(self._make(), self._make())
+                       for _ in range(self.cfg.num_layers)]
+        self.forget_all()
+        return True

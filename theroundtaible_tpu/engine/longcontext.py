@@ -58,10 +58,8 @@ BIG_NEG = -2.3819763e38
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:  # pre-0.8 jax
-        from jax.experimental.shard_map import shard_map as sm
-    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from .compat import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def build_seq_mesh(n_seq: int, devices: Optional[list] = None) -> Mesh:

@@ -143,14 +143,16 @@ class PagedKVCache:
             # layer range into ONE stage-sharded pool pair whose page
             # axis this allocator still manages; copy_pages_fn must
             # address pages in that layout).
-            self.pools = pool_factory(self.num_pages)
+            self._make_pools = pool_factory
         else:
             shape = (self.num_pages, page_size, cfg.num_kv_heads,
                      cfg.head_dim)
             make = (lambda: jnp.zeros(shape, dtype)) if sharding is None \
                 else (lambda: jax.device_put(jnp.zeros(shape, dtype),
                                              sharding))
-            self.pools = [(make(), make()) for _ in range(cfg.num_layers)]
+            self._make_pools = lambda n_pages: [
+                (make(), make()) for _ in range(cfg.num_layers)]
+        self.pools = self._make_pools(self.num_pages)
         self._copy_pages_fn = copy_pages_fn
         self._slots: dict[str, PagedSlot] = {}
         # Replica r owns pages [r*per, (r+1)*per); the range's FIRST page
@@ -176,6 +178,23 @@ class PagedKVCache:
 
     def slot_names(self) -> list[str]:
         return list(self._slots)
+
+    def revive_if_dead(self) -> bool:
+        """Reallocate the page pools if a failed donated dispatch deleted
+        them (KVCache.revive_if_dead's paged counterpart). Every slot,
+        page mapping and refcount is dropped — the bytes are gone — so
+        later prefills start from scratch. Returns True iff revived."""
+        k, _ = self.pools[0]
+        if not k.is_deleted():
+            return False
+        self.pools = self._make_pools(self.num_pages)
+        self._slots.clear()
+        self._refs.clear()
+        per = self._per_replica
+        self._free_by_replica = [
+            list(range(r * per + 1, (r + 1) * per))
+            for r in range(self.data_size)]
+        return True
 
     # --- slot lifecycle (KVCache-compatible surface) ---
 

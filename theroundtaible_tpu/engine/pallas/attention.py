@@ -313,6 +313,24 @@ def paged_prefill_supported(t: int, page_size: int, d: int,
     return paged_decode_supported(page_size, d, kh, group)
 
 
+def paged_pool_direct_supported(chunk: int, page_size: int, d: int,
+                                kh_local: int, group: int) -> bool:
+    """The ONE build-time gate for pool-direct paged serving, shared by
+    both engines (engine.py / pp_serving.py — the two copies drifted
+    once, gating only on decode support): pool-direct runs prefill
+    chunks AND decode steps off the pool, so BOTH kernels must accept
+    the shape. A layout only the decode kernel fits would otherwise
+    raise mid-request in the prefill wrapper instead of serving the
+    gather view (ISSUE 1: degrade, don't crash). `chunk` is the largest
+    serving bucket — the block_q search shrinks from there, so smaller
+    buckets only relax the estimate. Pass the LOCAL kv-head count.
+
+    paged_prefill_supported's last clause IS the decode gate, so one
+    delegation covers both kernels without duplicating the conjunction
+    here (the duplicate is how the engines drifted last time)."""
+    return paged_prefill_supported(chunk, page_size, d, kh_local, group)
+
+
 def paged_prefill_attention(
     q: jax.Array,                 # [B, T, H, D] (pre-scaled, rope'd)
     k_pool: jax.Array,            # [P, page_size, K, D] page pool
@@ -400,7 +418,7 @@ def paged_prefill_spmd(
     pool's sharding; table/offsets/valid row-aligned with the batch;
     pool_replicas > 1 shards the page axis over "data" and rebases each
     shard's table to its local range — see paged_decode_spmd)."""
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     b, t, h, d = q.shape
@@ -451,9 +469,8 @@ def _manual_axes(mesh):
     bodies calling these wrappers with the context AbstractMesh — the
     already-Manual "pipe" axis must be excluded, leaving a NESTED
     shard_map over "model" only."""
-    from jax.sharding import AxisType
-    return {a for a, t in zip(mesh.axis_names, mesh.axis_types)
-            if t == AxisType.Auto}
+    from ..compat import mesh_manual_axes
+    return mesh_manual_axes(mesh)
 
 
 def _spmd_axes(mesh, h: int, kh: int, b: int):
@@ -506,7 +523,7 @@ def flash_attention_spmd(
     model axis — the engine's dense path is the fallback, matching
     _fallback_replicated's cache layout in that case).
     """
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     b, t, h, d = q.shape
@@ -733,7 +750,7 @@ def paged_decode_spmd(
     when the batch doesn't divide over "data" (serving always pads) or
     the mesh's data size disagrees with pool_replicas.
     """
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     b, t, h, d = q.shape

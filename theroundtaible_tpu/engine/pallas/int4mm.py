@@ -257,6 +257,25 @@ def einsum_int4(spec: str, a: jax.Array, leaf) -> Optional[jax.Array]:
     return None
 
 
+# Mirror of attention._VMEM_BUDGET: a conservative per-core VMEM cap the
+# kernel's resident working set must fit, else dispatch declines and the
+# XLA dequant path serves. Advisor r5: _mm_pack_out's accumulators span
+# the FULL output axis (scratch 2·[bm, P] f32 — the price of the
+# p-innermost grid that streams scales once), so a large-enough mlp_dim
+# overflowed Mosaic's scratch allocation ON CHIP instead of falling back.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _pack_out_vmem_est(bm: int, bp: int, bc: int, p_dim: int,
+                       gp: int) -> int:
+    scratch = 2 * bm * p_dim * 4          # f32 accumulators span full P
+    x_blk = 2 * bm * bc * 4               # double-buffered, ≤ f32
+    q_blk = 2 * bc * bp                   # packed int4 bytes
+    s_blk = 2 * bc * (p_dim // gp) * 4    # whole-axis scale block
+    out_blk = bm * 2 * bp * 4             # f32 output block
+    return scratch + x_blk + q_blk + s_blk + out_blk
+
+
 def _dispatch_pack_out(a, leaf, n_cont: int, gp: int):
     q4, s4 = leaf.q4, leaf.s4
     cont_shape = q4.shape[:n_cont]
@@ -272,6 +291,8 @@ def _dispatch_pack_out(a, leaf, n_cont: int, gp: int):
     x2 = a.reshape(-1, c_dim)
     x2, m, bm = _pad_rows(x2)
     if bm is None:
+        return None
+    if _pack_out_vmem_est(bm, bp, bc, p_dim, gp) > _VMEM_BUDGET:
         return None
     y = _mm_pack_out(x2, q4.reshape(c_dim, p_dim),
                      s4.reshape(c_dim, p_dim // gp), gp, bm, bp, bc,

@@ -27,8 +27,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .compat import pcast, shard_map
 
 from .models.common import (
     ModelConfig, Params, make_attention_mask, rms_norm, transformer_block)
@@ -164,10 +165,10 @@ def make_pp_prefill(cfg: ModelConfig, mesh: Mesh, n_micro: int):
 
             # initial carries must be typed as varying over the pipe axis
             # (each stage's loop state diverges immediately)
-            state = jax.lax.pcast(jnp.zeros_like(emb[0]), (PIPE_AXIS,),
-                                  to="varying")
-            banked = jax.lax.pcast(jnp.zeros_like(emb), (PIPE_AXIS,),
-                                   to="varying")
+            state = pcast(jnp.zeros_like(emb[0]), (PIPE_AXIS,),
+                          to="varying")
+            banked = pcast(jnp.zeros_like(emb), (PIPE_AXIS,),
+                           to="varying")
 
             def step(i, carry):
                 state, banked = carry

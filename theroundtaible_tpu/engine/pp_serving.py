@@ -58,9 +58,10 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from . import compat, faults
+from .compat import pcast, shard_map
 from .engine import GenStats
 from .kvcache import SlotBook
 from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
@@ -114,6 +115,17 @@ class PPEngine:
         # (pallas/attention._manual_axes) — heads must divide the model
         # axis exactly as on the main engine (explicit flash on a
         # non-divisible layout raises; auto falls back to dense).
+        if n_model > 1 and not compat.HAS_NATIVE_SHARD_MAP:
+            # Partial-manual stage bodies (manual "pipe", auto "model")
+            # lower axis_index to a PartitionId the legacy SPMD
+            # partitioner refuses — TP-in-stage needs the modern
+            # shard_map API. Refuse at build with the fix, instead of
+            # an opaque XLA error mid-prefill.
+            raise ValueError(
+                "mesh={'pipe': N, 'model': M} (TP inside stages) needs "
+                "jax.shard_map, which this jax version lacks — upgrade "
+                "jax or use mesh={'pipe': N} / the main engine's "
+                "(data, model) mesh")
         from .pallas.attention import spmd_partitionable
         heads_divide = spmd_partitionable(
             model_cfg.num_heads, model_cfg.num_kv_heads, n_model)
@@ -201,15 +213,17 @@ class PPEngine:
         # the gather view remains).
         self._pool_direct = False
         if kv_layout == "paged":
-            from .pallas.attention import paged_decode_supported
+            from .pallas.attention import paged_pool_direct_supported
+            from .serving_loop import MAX_PREFILL_CHUNK
             kh_l = model_cfg.num_kv_heads
             if n_model > 1 and kh_l % n_model == 0:
                 kh_l //= n_model   # kernel sees the local shard
+            group = model_cfg.num_heads // model_cfg.num_kv_heads
             self._pool_direct = (
                 attn != "dense"
-                and paged_decode_supported(
-                    page_size, model_cfg.head_dim, kh_l,
-                    model_cfg.num_heads // model_cfg.num_kv_heads)
+                and paged_pool_direct_supported(
+                    MAX_PREFILL_CHUNK, page_size, model_cfg.head_dim,
+                    kh_l, group)
                 and (n_model == 1 or heads_divide))
         if kv_layout == "paged":
             # Stage-stacked page pool [st, per, P, ps, K, D]: ONE
@@ -274,14 +288,21 @@ class PPEngine:
             cache_shape = (n_stages, per, num_slots,
                            self.max_seq_len) + kd
             sh = cache_sharding_for(cache_shape)
-            self.kc = jax.device_put(jnp.zeros(cache_shape, dtype), sh)
-            self.vc = jax.device_put(jnp.zeros(cache_shape, dtype), sh)
+            # Kept for revive_kv_if_dead: reallocation after a failed
+            # donated dispatch deleted the stage-stacked caches.
+            self._make_contig = lambda: jax.device_put(
+                jnp.zeros(cache_shape, dtype), sh)
+            self.kc = self._make_contig()
+            self.vc = self._make_contig()
             self.kv = SlotBook(num_slots)
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
         self.last_stats = GenStats()
         self._serve_lock = threading.Lock()
+        # Shared dispatch retry policy (engine/faults.py), same seam as
+        # the main engine: transient dispatch failures retry in place.
+        self.retry = faults.DEFAULT_RETRY
 
         cfg = model_cfg
         mesh = self.mesh
@@ -302,6 +323,10 @@ class PPEngine:
             from .models.common import spmd_mesh
             if not mesh_in_stage:
                 return nullcontext()
+            # Native shard_map is guaranteed here — the constructor
+            # refuses TP-in-stage on old jax — so the trace-context
+            # AbstractMesh is real (it carries the Manual "pipe" axis
+            # the nested spmd wrappers subtract via axis_types).
             return spmd_mesh(jax.sharding.get_abstract_mesh())
 
         def stage_scan(stage_layers, kc_l, vc_l, h, positions, valid,
@@ -370,12 +395,12 @@ class PPEngine:
                     stage = jax.lax.axis_index(PIPE_AXIS)
                     n_steps = self.n_stages + n_mb - 1
 
-                    state = jax.lax.pcast(jnp.zeros_like(emb[0]),
+                    state = pcast(jnp.zeros_like(emb[0]),
                                           (PIPE_AXIS,), to="varying")
-                    banked = jax.lax.pcast(jnp.zeros_like(emb),
+                    banked = pcast(jnp.zeros_like(emb),
                                            (PIPE_AXIS,), to="varying")
-                    c1_l = jax.lax.pcast(c1_l, (PIPE_AXIS,), to="varying")
-                    c2_l = jax.lax.pcast(c2_l, (PIPE_AXIS,), to="varying")
+                    c1_l = pcast(c1_l, (PIPE_AXIS,), to="varying")
+                    c2_l = pcast(c2_l, (PIPE_AXIS,), to="varying")
 
                     def step(i, carry):
                         state, banked, c1_l, c2_l = carry
@@ -453,9 +478,9 @@ class PPEngine:
                               head, final_norm):
                     stage_layers = jax.tree_util.tree_map(
                         lambda x: x[0], staged)
-                    c1_l = jax.lax.pcast(c1[0], (PIPE_AXIS,),
+                    c1_l = pcast(c1[0], (PIPE_AXIS,),
                                          to="varying")
-                    c2_l = jax.lax.pcast(c2[0], (PIPE_AXIS,),
+                    c2_l = pcast(c2[0], (PIPE_AXIS,),
                                          to="varying")
                     stage = jax.lax.axis_index(PIPE_AXIS)
                     out0 = jnp.zeros((b, max_new), jnp.int32)
@@ -476,7 +501,7 @@ class PPEngine:
                         if cfg.scale_embeddings:
                             h = h * jnp.sqrt(jnp.float32(
                                 cfg.embed_dim)).astype(h.dtype)
-                        h = jax.lax.pcast(h, (PIPE_AXIS,), to="varying")
+                        h = pcast(h, (PIPE_AXIS,), to="varying")
                         positions = valid[:, None]
 
                         def hop(s, carry):
@@ -590,7 +615,9 @@ class PPEngine:
                             # shard_map over the auto "model" axis (the
                             # context mesh has "pipe" already Manual).
                             # The build-time gate guarantees the head
-                            # layout partitions, so None cannot happen.
+                            # layout partitions, so None cannot happen
+                            # (and guarantees native shard_map, so the
+                            # context AbstractMesh is real).
                             ctx = jax.sharding.get_abstract_mesh()
                             if hh.shape[1] == 1:
                                 out = pattn.paged_decode_spmd(
@@ -720,9 +747,33 @@ class PPEngine:
         # Fleet auto-degrade marker — surfaced via describe() (advisor r3).
         engine.quant_auto_degraded = bool(
             config.get("_quant_auto_degraded"))
+        if "dispatch_retries" in config:
+            from .faults import RetryPolicy
+            engine.retry = RetryPolicy(
+                max_retries=max(0, int(config["dispatch_retries"])))
         return engine
 
     # --- serving (same surface the adapter uses on InferenceEngine) ---
+
+    def revive_kv_if_dead(self) -> bool:
+        """InferenceEngine.revive_kv_if_dead's PP counterpart: paged
+        pools live in the allocator; contiguous stage-stacked caches
+        live here next to their SlotBook."""
+        if self.kv_layout == "paged":
+            # Branch on the LAYOUT, not `self.kc is None`: a dispatch
+            # that failed inside the gather→scatter window leaves a
+            # deleted gather view behind (the finally's scatter raised
+            # before resetting kc/vc). Drop the view — the pools are
+            # the source of truth — then let the allocator revive them
+            # if the failure consumed the pools too.
+            self.kc = self.vc = None
+            return self.kv.revive_if_dead()
+        if not self.kc.is_deleted():
+            return False
+        self.kc = self._make_contig()
+        self.vc = self._make_contig()
+        self.kv.forget_all()
+        return True
 
     def chars_per_token(self) -> float:
         if self._chars_per_token is None:
@@ -813,7 +864,7 @@ class PPEngine:
 
         return chunked_prefill(prefill_dispatch, token_lists, offsets,
                                self.max_seq_len, self.tokenizer.pad_id,
-                               deadline)
+                               deadline, retry=self.retry)
 
     def _apply_copies(self, copies) -> None:
         """Dispatch queued (src_slot, dst_slot, lo, hi) span copies —
@@ -849,7 +900,7 @@ class PPEngine:
 
         return chunked_prefill(prefill_dispatch, token_lists, offsets,
                                self.max_seq_len, self.tokenizer.pad_id,
-                               deadline)
+                               deadline, retry=self.retry)
 
     def _prefill_rows_paged(self, names_sub, token_spans, offsets_sub,
                             deadline, pinned) -> None:
@@ -1025,7 +1076,7 @@ class PPEngine:
 
             out_np = decode_segments(decode_dispatch, first, cur_valid,
                                      self.tokenizer.eos_id, max_new,
-                                     deadline, timeout_s)
+                                     deadline, timeout_s, retry=self.retry)
             stats.decode_seconds = time.monotonic() - t1
         finally:
             # Scatter back even on a mid-serve timeout: otherwise the

@@ -144,9 +144,18 @@ def sample_token_batch(logits: jax.Array, key: jax.Array,
     # top-p cutoff beyond the pool's cumulative mass
     bad = (temps > 0.0) & ((top_ks > k_cand)
                            | ((top_ps < 1.0) & (cum[:, -1] < top_ps)))
+    # Per-ROW blend, not a batch-wide switch (advisor r5): only the bad
+    # rows take the exact full-sort logits; provable rows keep the fast
+    # path's even when a batchmate is bad, so a row's sampled token never
+    # depends on which other rows share the batch (the fast and exact
+    # cutoffs can differ by one ≤~1-ulp boundary token — see docstring).
+    # Cost tradeoff: when ANY row is bad the exact tail still computes
+    # for the whole batch (its sorts are full-vocab either way); the
+    # lax.cond keeps the all-good hot case sort-free.
     masked = jax.lax.cond(
         jnp.any(bad),
-        lambda s: _exact_tail(s, top_ks, top_ps),
+        lambda s: jnp.where(bad[:, None], _exact_tail(s, top_ks, top_ps),
+                            masked_fast),
         lambda s: masked_fast, scaled)
 
     sampled = jax.random.categorical(key, masked, axis=-1)

@@ -18,9 +18,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import faults
+
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 MAX_PREFILL_CHUNK = 2048
 DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
+
+
+def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf")):
+    """One device dispatch through the shared fault-tolerance seam: the
+    dispatch-stage injection points fire first (zero overhead unarmed —
+    the guard is the module-level faults.ARMED flag), then the retry
+    policy re-runs a transiently-failed dispatch before it surfaces.
+    Failures a retry can't fix (timeout/oom/...) pass straight through
+    to the caller's degradation rung (RetryPolicy.retryable).
+
+    Scope: retry-in-place helps failures raised BEFORE the device
+    program consumes its inputs (host-side validation, dispatch-queue
+    errors, the injected faults). The engines' KV programs donate their
+    cache buffers (donate_argnums), so a failure that surfaces AFTER
+    donation leaves the cache references dead (and a blind re-dispatch
+    would die on the same dead buffers — RetryPolicy treats deleted-array
+    errors as non-retryable), so that error climbs the ladder to the
+    adapter rung, whose serial retry reallocates the buffers
+    (engine.revive_kv_if_dead) and re-prefills from scratch
+    (tpu_llm._serial_retry)."""
+
+    def call():
+        if faults.ARMED:
+            faults.inject_dispatch_faults()
+        return dispatch()
+
+    if retry is None:
+        return call()
+    return retry.run(call, deadline=deadline)
 
 
 class ReplicaGroupPlan:
@@ -118,6 +149,7 @@ def chunked_prefill(
     max_seq_len: int,
     pad_id: int,
     deadline: float = float("inf"),
+    retry=None,
 ) -> jax.Array:
     """Bucketed multi-chunk prefill. Returns last-token logits [B, V].
 
@@ -153,7 +185,8 @@ def chunked_prefill(
             # outside their committed length and decode overwrites that
             # position with the first real generated token.
             lengths[i] = max(take, 1)
-        last_logits = dispatch(chunk, offs, lengths)
+        last_logits = run_dispatch(
+            lambda: dispatch(chunk, offs, lengths), retry, deadline)
         if final_logits is None:
             final_logits = last_logits
         else:
@@ -199,6 +232,7 @@ def decode_segments(
     max_new: int,
     deadline: float,
     timeout_s: float,
+    retry=None,
 ) -> np.ndarray:
     """Segmented decode: one device program per DECODE_SEGMENT tokens with
     host-side timeout/early-exit checks in between (a single XLA program
@@ -226,7 +260,9 @@ def decode_segments(
     produced = 0
     budget_dev = jnp.int32(max_new)
     first_done = first_token == jnp.int32(eos_id)
-    cur = dispatch(first_token, start_valid, budget_dev, first_done)
+    cur = run_dispatch(
+        lambda: dispatch(first_token, start_valid, budget_dev, first_done),
+        retry, deadline)
     while True:
         out, steps, last, valid, done = cur
         budget_dev = budget_dev - steps
@@ -239,7 +275,8 @@ def decode_segments(
         # (and the gather/scatter around it via the engines' all-done
         # cond), costing microseconds.
         timed_out = time.monotonic() > deadline
-        nxt = (dispatch(last, valid, budget_dev, done)
+        nxt = (run_dispatch(lambda: dispatch(last, valid, budget_dev, done),
+                            retry, deadline)
                if produced + DECODE_SEGMENT < max_new and not timed_out
                else None)
         steps_n = int(steps)  # forces completion of the segment
