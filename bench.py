@@ -32,6 +32,13 @@ the driver's capture window):
   must succeed before the heavy child ever starts, so the watchdog
   never kills a claim-holding child on a tunnel that a probe would
   have proven dead anyway.
+
+Driver-channel resilience (VERDICT item 9): when the probe fails (or
+every attempt dies recordless), the watchdog re-emits the latest
+COMMITTED builder-jsonl headline as an explicitly-marked `cached`
+record with commit-hash provenance (bench_common.emit_cached_headlines)
+— BENCH_r0N.json is never empty while real numbers exist in the repo,
+and a cached number can never masquerade as a fresh one.
 """
 
 from __future__ import annotations

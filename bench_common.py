@@ -163,6 +163,94 @@ def _tunnel_vouched() -> bool:
             and time.monotonic() - _tunnel_ok_at < PROBE_MEMO_S)
 
 
+def _latest_committed_builder_jsonl():
+    """The newest committed BENCH_r*_builder.jsonl (highest round
+    number) plus its commit provenance, or None. Content is read from
+    HEAD (`git show`), not the working tree, so the provenance hash is
+    exactly the bytes emitted."""
+    import os
+    import re
+    import subprocess
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args], capture_output=True, text=True, cwd=root,
+            timeout=15).stdout
+
+    best, best_n = None, -1
+    for f in git("ls-files", "BENCH_*builder.jsonl").split():
+        m = re.fullmatch(r"BENCH_r(\d+)_builder\.jsonl", f)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = f, int(m.group(1))
+    if best is None:
+        return None
+    head = git("log", "-n", "1", "--format=%H %cI", "--", best).split()
+    if len(head) < 2:
+        return None
+    return {"path": best, "commit": head[0], "committed_at": head[1],
+            "content": git("show", f"HEAD:{best}")}
+
+
+def emit_cached_headlines(bench_id: str) -> int:
+    """Driver-channel resilience (VERDICT item 9): when the liveness
+    probe fails (or every attempt dies without records), the capture
+    window must not end empty while REAL numbers exist in the repo —
+    re-emit the latest committed builder-jsonl's HEADLINE records as
+    explicitly-marked `cached` records with commit-hash provenance.
+    A cached record is never confusable with a fresh measurement: the
+    metric key gains a `[cached]` suffix, the top level carries
+    `"cached": true`, and the detail names the source file + commit.
+    Returns how many cached records were emitted; never raises (a
+    broken cache path must not mask the real failure record)."""
+    try:
+        src = _latest_committed_builder_jsonl()
+        if src is None:
+            return 0
+        headlines: dict = {}
+        for line in src["content"].splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not (isinstance(rec, dict)
+                    and (rec.get("detail") or {}).get("headline")):
+                continue
+            # Latest headline per metric key wins (a builder jsonl can
+            # hold several attempts' headlines under one key).
+            headlines[rec.get("metric")] = rec
+        emitted = 0
+        for rec in headlines.values():
+            print(json.dumps({
+                "metric": f"{rec.get('metric')}[cached]",
+                "value": rec.get("value"),
+                "unit": rec.get("unit"),
+                "vs_baseline": rec.get("vs_baseline"),
+                "cached": True,
+                "detail": {
+                    "cached": True,
+                    "reason": f"live measurement unavailable ({bench_id})",
+                    "cached_from": {"path": src["path"],
+                                    "commit": src["commit"],
+                                    "committed_at": src["committed_at"]},
+                    "original_detail": rec.get("detail"),
+                },
+            }), flush=True)
+            emitted += 1
+        if emitted:
+            print(f"{bench_id}: emitted {emitted} cached headline "
+                  f"record(s) from {src['path']}@{src['commit'][:12]}",
+                  file=sys.stderr)
+        return emitted
+    except Exception as e:  # noqa: BLE001 — best-effort by contract
+        print(f"{bench_id}: cached-headline fallback failed: {e}",
+              file=sys.stderr)
+        return 0
+
+
 def _stream_child(cmd: list[str], timeout_s: float,
                   emitted_keys: set[str], attempt: int = 1):
     """Run `cmd`, FORWARDING each JSON line to stdout the moment it
@@ -290,6 +378,10 @@ def run_watchdogged(script_path: str, child_args: list[str],
               f"{len(emitted_keys)} record(s) were forwarded live",
               file=sys.stderr)
         return 0
+    # Nothing measured live: fall back to the latest COMMITTED numbers,
+    # explicitly marked cached with commit provenance (VERDICT item 9 —
+    # BENCH_r0N.json must never be empty while real numbers exist).
+    cached = emit_cached_headlines(bench_id)
     # A dead tunnel must still produce a parseable record (VERDICT r3
     # missing #2: three rounds of `parsed: null` left the driver artifact
     # unable to distinguish "tunnel dead" from "bench broken"). This is a
@@ -304,6 +396,7 @@ def run_watchdogged(script_path: str, child_args: list[str],
         "detail": {
             "bench": bench_id,
             "reason": failure_reason,
+            "cached_records_emitted": cached,
             "explanation": {
                 "tunnel_dead": "device-liveness probe (import jax; "
                                "jax.devices()) hung or failed — the "

@@ -27,7 +27,7 @@ run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
 # trained checkpoint through discuss on TPU, but a mid-window tunnel
 # death must not hang the window after the core four steps landed.
 run_step "bench_realweights.py (on-chip)" \
-  timeout 900 python bench_realweights.py --min-turns 20
+  timeout 900 python bench_realweights.py --min-turns 20 --budget-s 840
 git add REALWEIGHTS_r05.json 2>/dev/null && \
   git commit -q -o REALWEIGHTS_r05.json \
     -m "Hardware window: on-chip realweights artifact

@@ -31,7 +31,7 @@ run_step "bench_profile.py"            python bench_profile.py
 # timeout sends SIGTERM (not KILL); realweights installs a clean-exit
 # handler, and this is the LAST step so even a wedge costs no data.
 run_step "bench_realweights.py (on-chip)" \
-  timeout 900 python bench_realweights.py --min-turns 20
+  timeout 900 python bench_realweights.py --min-turns 20 --budget-s 840
 git add REALWEIGHTS_r05.json 2>/dev/null && \
   git commit -q -o REALWEIGHTS_r05.json \
     -m "Hardware window 2: on-chip realweights artifact

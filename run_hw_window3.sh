@@ -54,7 +54,7 @@ run_step "bench_profile.py" python bench_profile.py
 # is ~330 s and first-time tunnel compiles are 20-40 s per prefill
 # shape bucket. Still LAST so even a hang costs no core measurement.
 run_step "bench_realweights.py (on-chip)" \
-  timeout 1500 python bench_realweights.py --min-turns 20
+  timeout 1500 python bench_realweights.py --min-turns 20 --budget-s 1440
 git add REALWEIGHTS_r05.json 2>/dev/null && \
   git commit -q -o REALWEIGHTS_r05.json \
     -m "Hardware window 3: on-chip realweights artifact

@@ -41,7 +41,46 @@ if not os.environ.get("ROUNDTABLE_TEST_NO_XLA_CACHE"):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+import signal
+import threading
+
 import pytest
+
+# Per-test wall-clock guard (ISSUE 2 tooling satellite): a regression
+# that reintroduces an unbounded device wait must fail ITS test fast
+# with a named culprit instead of eating the whole 870 s tier-1 budget
+# as a silent rc=124. SIGALRM-based (main-thread, POSIX — exactly the
+# tier-1 environment); `slow`-marked tests get a 3x allowance, and
+# ROUNDTABLE_TEST_TIMEOUT=0 disables the guard. The alarm interrupts
+# only interruptible Python — a wait truly stuck in C is the engine
+# watchdog's job (engine/deadlines.py), not this one's.
+_TEST_ALARM_S = int(os.environ.get("ROUNDTABLE_TEST_TIMEOUT", "300"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    use_alarm = (_TEST_ALARM_S > 0 and hasattr(signal, "SIGALRM")
+                 and threading.current_thread()
+                 is threading.main_thread())
+    old_handler = None
+    if use_alarm:
+        budget = _TEST_ALARM_S * (3 if item.get_closest_marker("slow")
+                                  else 1)
+
+        def _on_alarm(signum, frame):
+            pytest.fail(
+                f"{item.nodeid} exceeded the {budget}s per-test guard "
+                "(conftest alarm) — an unbounded wait would otherwise "
+                "consume the whole tier-1 clock", pytrace=False)
+
+        old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, budget)
+    try:
+        yield
+    finally:
+        if use_alarm:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old_handler)
 
 
 @pytest.fixture

@@ -3,6 +3,11 @@ circuit-breaker units, plus the chaos tests that arm every injection
 point and drive a 2-knight discussion end-to-end on the CPU backend —
 asserting the DEGRADED path served (gather-view fallback, serial retry,
 orchestrator adapter-fallback) instead of an unhandled crash.
+
+ISSUE 2 extends the suite with the TIME ladder's chaos points: `hang`
+(a wedged device wait the watchdog must classify within its rung
+budget) and `slow_wait` (a slow-but-successful wait), driven through
+the same adapter/orchestrator rungs.
 """
 
 import time
@@ -20,7 +25,8 @@ from theroundtaible_tpu.core.types import (
     RoundtableConfig,
     RulesConfig,
 )
-from theroundtaible_tpu.engine import faults, get_engine, reset_engines
+from theroundtaible_tpu.engine import deadlines, faults, get_engine, \
+    reset_engines
 from theroundtaible_tpu.engine.engine import GenStats
 from theroundtaible_tpu.engine.faults import (
     CircuitBreaker,
@@ -34,8 +40,14 @@ pytestmark = pytest.mark.chaos
 @pytest.fixture(autouse=True)
 def clean_faults():
     faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
     yield
     faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
 
 
 @pytest.fixture(autouse=True, scope="module")
@@ -110,6 +122,47 @@ class TestFaultRegistry:
         with pytest.raises(FaultInjected) as e:
             faults.maybe_inject("hbm_oom")
         assert classify_error(e.value) == "oom"
+
+    def test_arming_hang_arms_the_watchdog(self):
+        """ROUNDTABLE_FAULTS=hang is a one-variable chaos run: arming
+        the time-ladder points flips deadlines.ACTIVE too."""
+        assert deadlines.ACTIVE is False
+        faults.arm("hang", count=1, delay_s=0.1)
+        assert deadlines.ACTIVE is True
+        faults.disarm()
+        deadlines.disarm_watchdog()
+        faults.arm("slow_wait", count=1, delay_s=0.01)
+        assert deadlines.ACTIVE is True
+
+    def test_watchdog_disarms_when_time_points_exhaust(self):
+        """Symmetric teardown: when the chaos run that AUTO-armed the
+        watchdog ends (points exhausted or disarmed), the watchdog
+        disarms too — no lingering per-wait worker threads on a healthy
+        hot path. An explicitly armed watchdog is never torn down from
+        here."""
+        assert deadlines.ACTIVE is False
+        faults.arm("hang", count=1, delay_s=0.01)
+        assert deadlines.ACTIVE is True
+        with pytest.raises(FaultInjected):
+            faults.maybe_inject("hang")
+        assert deadlines.ACTIVE is False      # exhausted ⇒ torn down
+        deadlines.arm_watchdog()              # operator's explicit arm
+        faults.arm("slow_wait", count=1)
+        faults.disarm()
+        assert deadlines.ACTIVE is True       # explicit arm survives
+
+    def test_hang_env_arming(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_FAULTS", "hang:1@0.2")
+        faults._arm_from_env()
+        assert faults.spec_for("hang").delay_s == 0.2
+        assert deadlines.ACTIVE is True
+
+    def test_hang_message_classifies_as_hang(self):
+        from theroundtaible_tpu.core.errors import classify_error
+        faults.arm("hang", count=1, delay_s=0.01)
+        with pytest.raises(FaultInjected) as e:
+            faults.maybe_inject("hang")
+        assert classify_error(e.value) == "hang"
 
     def test_kernel_failure_classification(self):
         assert faults.is_kernel_failure(
@@ -696,6 +749,72 @@ class TestEngineChaos:
         # and the revived engine keeps serving batched rounds
         assert isinstance(adapter.execute("fully recovered"), str)
 
+    def test_hang_detected_and_classified_single_turn(self):
+        """A wedged dispatch on a single-turn round: the watchdog
+        abandons the wait within the dispatch rung budget (NOT the
+        injected 8 s sleep), the error surfaces as a hang-kind
+        AdapterError, and the breaker counts it."""
+        cfg = _tpu_cfg(seed=121)
+        adapter = TpuLlmAdapter("Sage", cfg, timeout_ms=600_000)
+        adapter.execute("warm the engine first")   # compile outside rung caps
+        deadlines.configure_rungs({"dispatch": 0.5})
+        faults.arm("hang", count=1, delay_s=8.0)
+        t0 = time.monotonic()
+        with pytest.raises(AdapterError) as e:
+            adapter.execute("a wedged question")
+        assert time.monotonic() - t0 < 6.0    # watchdog, not the sleep
+        assert e.value.kind == "hang"
+        assert adapter.breaker().failures == 1
+        assert deadlines.hang_log()
+        # fault exhausted: the engine recovers (KV revived by the
+        # adapter's failure path) and the breaker closes on success
+        deadlines.reset_rungs()
+        assert isinstance(adapter.execute("a healthy question"), str)
+        assert adapter.breaker().failures == 0
+
+    def test_hang_batch_degrades_to_serial_with_recorded_kind(self):
+        """The 2-knight acceptance path at the adapter rung: a hung
+        batched dispatch is detected within its rung budget, the round
+        degrades to serial per-knight retry, serves, and records the
+        hang classification it recovered from."""
+        cfg = _tpu_cfg(seed=122)
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        adapter.execute_round([KnightTurn("Sage", "warm"),
+                               KnightTurn("Oracle", "warm too")])
+        # Warm the 1-row programs the serial rung will dispatch: a cold
+        # compile inside a tight dispatch cap would itself read as a
+        # hang (deliberate semantics — a wedged compile IS a hang — but
+        # not what THIS test measures).
+        adapter.execute_for("Sage", "warm the single-row path")
+        deadlines.configure_rungs({"dispatch": 2.0})
+        faults.arm("hang", count=1, delay_s=10.0)
+        t0 = time.monotonic()
+        with pytest.warns(UserWarning, match="retrying 2 knight"):
+            outs = adapter.execute_round(
+                [KnightTurn("Sage", "first prompt"),
+                 KnightTurn("Oracle", "second prompt")])
+        assert time.monotonic() - t0 < 9.0
+        assert len(outs) == 2 and all(isinstance(o, str) for o in outs)
+        assert adapter.last_degradation == "serial_retry"
+        assert adapter.last_recovered_kind == "hang"
+        assert adapter.last_stats()["recovered_from"] == "hang"
+        assert deadlines.hang_log()[-1]["rung"] == "dispatch"
+        assert adapter.breaker().failures == 0  # round ultimately served
+
+    def test_slow_wait_within_budget_completes(self):
+        """A slow-but-not-wedged wait finishes inside its rung budget:
+        no hang classification, no degradation — the watchdog only
+        bites waits that EXCEED the budget."""
+        cfg = _tpu_cfg(seed=123)
+        adapter = TpuLlmAdapter("Sage", cfg, timeout_ms=600_000)
+        adapter.execute("warm")
+        deadlines.configure_rungs({"dispatch": 5.0})
+        spec = faults.arm("slow_wait", count=1, delay_s=0.05)
+        assert isinstance(adapter.execute("a slow question"), str)
+        assert spec.fired == 1
+        assert deadlines.hang_log() == []
+        assert adapter.last_degradation is None
+
     def test_kv_corrupt_batch_retries_serially(self):
         """Batched fan-out fails → the adapter invalidates the batch's
         KV slots and serves each knight as its own program (best-effort
@@ -761,6 +880,35 @@ class TestDiscussionChaos:
                                   adapters={"tpu-llm": adapter})
         assert result.rounds == 1
         assert adapter.last_degradation == "serial_retry"  # serial rung
+
+    def test_hang_discussion_completes_with_recorded_classification(
+            self, project_root):
+        """ISSUE 2 acceptance: a `hang` fault injected (the
+        ROUNDTABLE_FAULTS=hang path — env-style arming flips the
+        watchdog on) during a 2-knight CPU run_discussion is detected
+        by the watchdog within its rung budget, degrades through the
+        existing ladder (serial retry), and the discussion completes
+        with a recorded hang classification."""
+        cfg = _tpu_cfg(seed=115)
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        # Warm both program shapes so the only slow wait is the fault.
+        adapter.execute_round([KnightTurn("Sage", "warm"),
+                               KnightTurn("Oracle", "warm too")])
+        adapter.execute_for("Sage", "warm the single-row path")
+        deadlines.configure_rungs({"dispatch": 2.0})
+        # Same parse path as ROUNDTABLE_FAULTS="hang:1@10" (arm() is
+        # what _arm_from_env calls; arming the point arms the watchdog).
+        faults.arm("hang", count=1, delay_s=10.0)
+        t0 = time.monotonic()
+        with pytest.warns(UserWarning, match="retrying 2 knight"):
+            result, _ = self._run(project_root, cfg,
+                                  adapters={"tpu-llm": adapter})
+        assert time.monotonic() - t0 < 30.0   # not the 10 s sleep x N
+        assert result.rounds == 1
+        assert len(result.all_rounds) == 2    # both knights spoke
+        assert adapter.last_degradation == "serial_retry"
+        assert adapter.last_recovered_kind == "hang"   # the record
+        assert deadlines.hang_log()[-1]["rung"] == "dispatch"
 
     def test_persistent_oom_engages_adapter_fallback(self, project_root):
         """The last rung: the engine is terminally sick (unlimited OOM),

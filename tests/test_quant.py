@@ -38,7 +38,13 @@ class TestQuantizeParams:
         assert experts["gate_proj"]["s"].shape == (cfg.num_experts,
                                                    cfg.mlp_dim)
         assert experts["down_proj"]["s"].shape == (cfg.embed_dim,)
-        assert qp["layers"][0]["router"]["s"].shape == (cfg.num_experts,)
+        # The router passes through at full precision: its top-k expert
+        # selection amplifies quantization error discontinuously (a
+        # flipped expert changes the output by whole activations), and
+        # at E×X params it is bytes-irrelevant (quant.py _SCALE_AXES).
+        router = qp["layers"][0]["router"]
+        assert router is params["layers"][0]["router"]
+        assert router.dtype == jnp.float32
 
     def test_free_source_deletes_quantized_leaves_only(self):
         """free_source=True frees each source weight as its int8
@@ -69,12 +75,61 @@ class TestQuantizeParams:
         assert np.all(np.abs(deq - w) <= 0.5 * step + 1e-7)
 
 
+def _dequantize_tree(qp):
+    """Explicitly dequantize a quantize_params output back to plain
+    arrays — the 'same numbers, plain representation' reference for
+    mechanics-exactness checks (shared by the int8 MoE and int4 tests).
+    Key-aware: each int8 dict's scale expands back over exactly the
+    reduce axes _quantize_leaf collapsed (quant._SCALE_AXES)."""
+    from theroundtaible_tpu.engine import quant as Q
+    from theroundtaible_tpu.engine.models.common import (Int4Leaf,
+                                                         dequant_int4)
+
+    def deq(leaf, key, expert=False):
+        if isinstance(leaf, Int4Leaf):
+            return dequant_int4(leaf.q4, leaf.s4, leaf.axis,
+                                leaf.group, jnp.float32)
+        if isinstance(leaf, dict) and "q" in leaf:
+            axes = (Q._EXPERT_SCALE_AXES if expert else Q._SCALE_AXES)[key]
+            q = np.asarray(leaf["q"], np.float32)
+            s = np.asarray(leaf["s"], np.float32)
+            keep = tuple(a % q.ndim for a in axes)
+            reduce_axes = tuple(a for a in range(q.ndim) if a not in keep)
+            return jnp.asarray(q * np.expand_dims(s, reduce_axes))
+        return leaf
+
+    out = {}
+    for key, value in qp.items():
+        if key in ("embedding", "lm_head"):
+            out[key] = deq(value, key)
+        elif key == "layers":
+            out[key] = [
+                {k: ({ek: deq(ev, ek, expert=True)
+                      for ek, ev in v.items()} if k == "experts"
+                     else deq(v, k) if isinstance(v, Int4Leaf)
+                     or (isinstance(v, dict) and "q" in v) else v)
+                 for k, v in layer.items()}
+                for layer in value]
+        else:
+            out[key] = value
+    return out
+
+
 @pytest.mark.parametrize("model", ["tiny-gemma", "tiny-llama",
                                    "tiny-mistral", "tiny-mixtral",
                                    "tiny-qwen"])
 def test_forward_logits_close_to_fp(model):
-    """int8 forward tracks the fp32 forward closely on every family —
-    the quant error stays small relative to the logit scale."""
+    """int8 forward tracks the fp32 forward closely on every DENSE
+    family. MoE (tiny-mixtral) gets the int4 tests' two-part contract
+    instead: the serving MECHANICS must be exact (int8 forward ==
+    forward over the explicitly dequantized tree) and the noise vs fp is
+    bounded loosely in rms — top-k expert routing is DISCONTINUOUS, so a
+    sub-step weight perturbation anywhere upstream (here: int8
+    embedding noise on random init weights) can flip a near-tied expert
+    choice and change the output by whole activations. That is inherent
+    to the precision on random weights, not a serving bug (trained
+    checkpoints route with margin; the router itself stays fp —
+    quant.py _SCALE_AXES)."""
     cfg = get_model_config(model, max_seq_len=128)
     params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
     qp = quantize_params(params, cfg, act_dtype=jnp.float32)
@@ -85,9 +140,18 @@ def test_forward_logits_close_to_fp(model):
     got, _ = forward(qp, cfg, tokens, positions, None, None, valid)
     ref = np.asarray(ref, np.float32)
     got = np.asarray(got, np.float32)
-    err = np.abs(got - ref).max()
-    scale = np.abs(ref).max()
-    assert err < 0.05 * scale, f"{model}: err {err} vs scale {scale}"
+    if cfg.num_experts:
+        exact, _ = forward(_dequantize_tree(qp), cfg, tokens, positions,
+                           None, None, valid)
+        exact = np.asarray(exact, np.float32)
+        assert np.abs(got - exact).max() < 1e-4, "mechanics must be exact"
+        rms = float(np.sqrt(np.mean((got - ref) ** 2)))
+        ref_rms = float(np.sqrt(np.mean(ref ** 2)))
+        assert rms < 0.5 * ref_rms, f"{model}: rms {rms} vs {ref_rms}"
+    else:
+        err = np.abs(got - ref).max()
+        scale = np.abs(ref).max()
+        assert err < 0.05 * scale, f"{model}: err {err} vs scale {scale}"
 
 
 class TestQuantServing:
@@ -191,28 +255,10 @@ class TestInt4:
         noise inherent to the precision, not a serving bug (real trained
         checkpoints quantize far more gracefully — llama.cpp ships q4
         as its default for exactly these models)."""
-        from theroundtaible_tpu.engine.models.common import (Int4Leaf,
-                                                             dequant_int4)
         cfg = get_model_config(model, max_seq_len=128)
         params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
         qp = quantize_params(params, cfg, act_dtype=jnp.float32, bits=4)
-
-        def deq(leaf):
-            if isinstance(leaf, Int4Leaf):
-                return dequant_int4(leaf.q4, leaf.s4, leaf.axis,
-                                    leaf.group, jnp.float32)
-            if isinstance(leaf, dict) and "q" in leaf:  # int8 fallback
-                s = np.asarray(leaf["s"], np.float32)
-                q = np.asarray(leaf["q"], np.float32)
-                return jnp.asarray(
-                    q * np.expand_dims(
-                        s, tuple(range(q.ndim - s.ndim))))
-            return leaf
-
-        dq = jax.tree_util.tree_map(
-            deq, qp,
-            is_leaf=lambda x: isinstance(x, Int4Leaf)
-            or (isinstance(x, dict) and "q" in x))
+        dq = _dequantize_tree(qp)
         tokens = jnp.asarray([[1, 9, 4, 7] * 8], jnp.int32)
         positions = jnp.arange(32)[None, :]
         valid = jnp.asarray([32], jnp.int32)

@@ -29,6 +29,13 @@ class KnightTurn:
 class BaseAdapter(ABC):
     """4-method contract (reference base.ts:10-29)."""
 
+    # True when execute_round/execute_for accept a `budget` keyword (an
+    # engine/deadlines.Budget node). The orchestrator only passes one to
+    # adapters that opt in, so third-party/test subclasses overriding
+    # execute_round with the legacy (turns, timeout_ms) signature keep
+    # working unchanged.
+    accepts_budget = False
+
     def __init__(self, name: str):
         self.name = name
 
@@ -37,9 +44,11 @@ class BaseAdapter(ABC):
         """Run one prompt to completion and return the raw response text."""
 
     def execute_for(self, knight_name: str, prompt: str,
-                    timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+                    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                    budget=None) -> str:
         """Execute one turn attributed to `knight_name`. Cloud/CLI
-        adapters ignore the name; engine-backed adapters override so the
+        adapters ignore the name (and the budget — their own process
+        timeouts bound the turn); engine-backed adapters override so the
         knight keeps its own KV slot and per-knight sampling even when a
         round degrades from the batched path to serial turns."""
         return self.execute(prompt, timeout_ms)
@@ -82,11 +91,14 @@ class BaseAdapter(ABC):
         return None
 
     def execute_round(self, turns: list[KnightTurn],
-                      timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
+                      timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                      budget=None) -> list[str]:
         """Execute N same-round prompts. Default: serial loop over execute().
 
         The tpu-llm adapter overrides this with one batched forward pass over
-        N persistent KV slots (SURVEY.md §2.3 parallelism table).
+        N persistent KV slots (SURVEY.md §2.3 parallelism table) and
+        splits `budget` (a round-rung deadlines.Budget) across the
+        batched attempt and any serial retries.
         """
         return [self.execute_for(t.knight_name, t.prompt, timeout_ms)
                 for t in turns]

@@ -20,11 +20,11 @@ of feeding more turns into a sick engine.
 
 from __future__ import annotations
 
-import time
 import warnings
 from typing import Any, Optional
 
 from ..core.errors import AdapterError, classify_error
+from ..engine import deadlines
 from .base import BaseAdapter, DEFAULT_TIMEOUT_MS, KnightTurn
 
 # Reserves mirror the local-llm budget contract (reference local-llm.ts:58-70),
@@ -32,6 +32,14 @@ from .base import BaseAdapter, DEFAULT_TIMEOUT_MS, KnightTurn
 RESPONSE_RESERVE_TOKENS = 4096
 OVERHEAD_RESERVE_TOKENS = 3000
 MIN_AVAILABLE_TOKENS = 2000
+
+# Fraction of a multi-knight round's budget the BATCHED attempt may
+# consume (ISSUE 2: the round budget SPLITS across batched/serial
+# attempts instead of one shared ad-hoc deadline): a hung/wedged batch
+# must leave the serial-retry rung real time to serve the knights.
+# Config key "batch_budget_fraction" overrides. Single-turn rounds have
+# no serial rung and get the whole budget.
+BATCH_BUDGET_FRACTION = 0.6
 
 
 class TpuLlmAdapter(BaseAdapter):
@@ -48,6 +56,10 @@ class TpuLlmAdapter(BaseAdapter):
         # Which degradation rung served the last round, if any
         # ("serial_retry"); chaos tests and metrics read it.
         self.last_degradation: Optional[str] = None
+        # Classified kind of the failure the last round RECOVERED from
+        # ("hang", "oom", ...); None when the round served clean. The
+        # hang acceptance check and status surfaces read it.
+        self.last_recovered_kind: Optional[str] = None
 
     @classmethod
     def from_config(cls, adapter_id: str, cfg: dict[str, Any],
@@ -127,13 +139,16 @@ class TpuLlmAdapter(BaseAdapter):
         return self.execute_for(self.name, prompt, timeout_ms)
 
     def execute_for(self, knight_name: str, prompt: str,
-                    timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+                    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                    budget=None) -> str:
         # Keyed by the KNIGHT, not the adapter: a knight degraded off the
         # batched path onto serial turns keeps its own KV slot and
         # per-knight sampling instead of colliding on the adapter's name.
         return self.execute_round(
             [KnightTurn(knight_name=knight_name, prompt=prompt)],
-            timeout_ms)[0]
+            timeout_ms, budget=budget)[0]
+
+    accepts_budget = True
 
     def supports_batched_rounds(self) -> bool:
         return True
@@ -159,17 +174,29 @@ class TpuLlmAdapter(BaseAdapter):
                                        base.max_new_tokens)))
 
     def execute_round(self, turns: list[KnightTurn],
-                      timeout_ms: int = DEFAULT_TIMEOUT_MS) -> list[str]:
+                      timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                      budget=None) -> list[str]:
         """One batched forward pass over N persistent per-knight KV slots.
 
         Failure handling: a failed batched dispatch degrades to serial
         per-knight retry (_serial_retry); the final outcome — success or
-        AdapterError — is recorded on the engine's circuit breaker."""
+        AdapterError — is recorded on the engine's circuit breaker.
+
+        Time ladder (ISSUE 2): `budget` is the round-rung Budget the
+        orchestrator threads down (None builds a local root from
+        timeout_ms). The round budget is SPLIT across the degradation
+        attempts — the batched dispatch gets BATCH_BUDGET_FRACTION of it
+        when a serial rung exists to fall back to, and each serial
+        retry gets a fair share of whatever remains — so a hung batch
+        can never consume the time its recovery path needs, and
+        execute_round's timeout contract never multiplies into (N+1)x
+        under degradation."""
         breaker = self.breaker()
         # Clear BEFORE the fail-fast below: a failed call — including one
         # that never dispatched — must not leave stale stats.
         self._last_stats = None
         self.last_degradation = None
+        self.last_recovered_kind = None
         if not breaker.should_attempt():
             # Fail fast with the health verdict instead of dispatching
             # into a sick engine (should_attempt still admits periodic
@@ -190,14 +217,16 @@ class TpuLlmAdapter(BaseAdapter):
         if self.engine_config.get("knight_sampling"):
             per_turn = [self._sampling_for(t.knight_name)
                         or engine.sampling for t in turns]
-        # ONE deadline for the whole round, shared by the batched attempt
-        # and every serial retry: execute_round's timeout_ms contract must
-        # not multiply into (N+1)x under degradation.
-        deadline = time.monotonic() + (timeout_ms or self.default_timeout) \
-            / 1000
+        # ONE round budget bounds the batched attempt and every serial
+        # retry (its deadline is the old shared float); the splits
+        # happen inside _dispatch_round/_serial_retry.
+        timeout_s = (timeout_ms or self.default_timeout) / 1000
+        round_budget = (budget.child("round", timeout_s=timeout_s)
+                        if budget is not None
+                        else deadlines.Budget.root(timeout_s, rung="round"))
         try:
             responses, stats = self._dispatch_round(engine, turns, per_turn,
-                                                    deadline)
+                                                    round_budget)
         except Exception as e:  # noqa: BLE001
             breaker.record_failure(e)
             # A failure after donation consumed the KV buffers must not
@@ -224,11 +253,24 @@ class TpuLlmAdapter(BaseAdapter):
         }
         if self.last_degradation:
             self._last_stats["degraded"] = self.last_degradation
+        if self.last_recovered_kind:
+            self._last_stats["recovered_from"] = self.last_recovered_kind
         return responses
 
-    def _dispatch_round(self, engine, turns, per_turn, deadline):
+    def _dispatch_round(self, engine, turns, per_turn, round_budget):
+        # Budget split, batched rung: a multi-knight batch gets a
+        # FRACTION of the round (the serial rung must still have room
+        # behind it); a single-turn round has no fallback and gets all.
+        if len(turns) > 1:
+            frac = float(self.engine_config.get(
+                "batch_budget_fraction", BATCH_BUDGET_FRACTION))
+            batch_budget = round_budget.child(
+                "turn", timeout_s=round_budget.remaining() * frac)
+        else:
+            batch_budget = round_budget.child("turn")
         kwargs: dict[str, Any] = {
-            "timeout_s": max(deadline - time.monotonic(), 0.0)}
+            "timeout_s": max(batch_budget.remaining(), 0.0),
+            "budget": batch_budget}
         if per_turn is not None:
             kwargs["sampling_per_turn"] = per_turn
             # call-level cap = the LARGEST per-knight budget, so a
@@ -242,19 +284,23 @@ class TpuLlmAdapter(BaseAdapter):
         except Exception as batch_err:  # noqa: BLE001
             if len(turns) < 2:
                 raise
-            return self._serial_retry(engine, turns, per_turn, deadline,
-                                      batch_err)
+            return self._serial_retry(engine, turns, per_turn,
+                                      round_budget, batch_err)
 
-    def _serial_retry(self, engine, turns, per_turn, deadline, batch_err):
+    def _serial_retry(self, engine, turns, per_turn, round_budget,
+                      batch_err):
         """Batched-round degradation rung: the fan-out failed, so the
         round becomes best-effort — invalidate the batch's KV slots (a
         mid-flight failure may have left partial scatter writes) and
         serve each knight as its own single-row program. Smaller
         programs, per-knight isolation: one knight's pathology no longer
         dooms the whole round. Every serial attempt runs inside the
-        ROUND's remaining deadline — a timed-out batch does not buy N
-        fresh timeouts."""
-        if deadline - time.monotonic() <= 0:
+        ROUND's remaining budget — a timed-out batch does not buy N
+        fresh timeouts — and each knight gets a FAIR SHARE of what is
+        left (remaining / knights-still-waiting, so early finishers
+        donate their surplus to later knights but a single wedged
+        knight can never starve the rest)."""
+        if round_budget.remaining() <= 0:
             # No time left to retry anything: surface the timeout BEFORE
             # the destructive slot invalidation below, so the knights'
             # cached conversation KV survives for the next round instead
@@ -284,13 +330,20 @@ class TpuLlmAdapter(BaseAdapter):
         responses = []
         failures: list[tuple[str, Exception]] = []
         for i, t in enumerate(turns):
-            remaining = deadline - time.monotonic()
+            remaining = round_budget.remaining()
             if remaining <= 0:
                 raise AdapterError(
                     f"batched round failed ({batch_err}) and the round's "
                     f"deadline passed during serial retry at knight "
                     f"{t.knight_name}", kind="timeout")
-            kwargs: dict[str, Any] = {"timeout_s": remaining}
+            # Fair share of the remaining round budget: knights still
+            # waiting split it evenly, recomputed per knight so early
+            # finishers' surplus flows to later ones.
+            knight_budget = round_budget.child(
+                "turn", timeout_s=remaining / (len(turns) - i))
+            kwargs: dict[str, Any] = {
+                "timeout_s": max(knight_budget.remaining(), 0.0),
+                "budget": knight_budget}
             if per_turn is not None:
                 kwargs["sampling_per_turn"] = [per_turn[i]]
                 kwargs["max_new_tokens"] = per_turn[i].max_new_tokens
@@ -321,6 +374,9 @@ class TpuLlmAdapter(BaseAdapter):
                 f"failed for knight(s) {names}: {first}",
                 kind=classify_error(first), cause=first)
         self.last_degradation = "serial_retry"
+        # What the round recovered FROM — a watchdog-detected hang is
+        # recorded distinctly from a crash (ISSUE 2 acceptance).
+        self.last_recovered_kind = classify_error(batch_err)
         return responses, total
 
     @staticmethod

@@ -69,6 +69,23 @@ def validate_config_dict(config: dict) -> None:
     timeout = rules.get("timeout_per_turn_seconds")
     if not isinstance(timeout, (int, float)) or timeout < 1:
         raise ConfigError("rules.timeout_per_turn_seconds must be a positive number.")
+    # Time-ladder roots (optional — engine/deadlines.py): when present
+    # they must be positive numbers, and a round budget must not exceed
+    # the discussion budget it nests inside (the tree min()s them anyway,
+    # but a config that says otherwise is a mistake worth naming).
+    for key in ("discussion_budget_seconds", "round_budget_seconds"):
+        value = rules.get(key)
+        if value is not None and (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool) or value <= 0):
+            raise ConfigError(f"rules.{key} must be a positive number.")
+    disc = rules.get("discussion_budget_seconds")
+    rnd = rules.get("round_budget_seconds")
+    if disc is not None and rnd is not None and rnd > disc:
+        raise ConfigError(
+            "rules.round_budget_seconds must not exceed "
+            "rules.discussion_budget_seconds (round budgets nest inside "
+            "the discussion budget).")
 
     if not config.get("adapter_config"):
         raise ConfigError("config.json missing 'adapter_config' section.")

@@ -48,7 +48,7 @@ class AdapterError(RoundtableError):
     def __init__(self, message: str, kind: str = "unknown",
                  hint: Optional[str] = None, cause: Optional[BaseException] = None):
         super().__init__(message, hint=hint, cause=cause)
-        self.kind = kind  # not_installed | timeout | auth | api | oom | unknown
+        self.kind = kind  # not_installed | timeout | auth | api | oom | hang | unknown
 
 
 class SessionError(RoundtableError):
@@ -73,6 +73,9 @@ _KIND_HINTS = {
     "api": "The backend returned an error. Check its status page / server logs.",
     "oom": "The device ran out of memory. Use a smaller model, shorter context, "
            "or a larger mesh.",
+    "hang": "A device wait exceeded its watchdog budget — the program is "
+            "presumed wedged. Check device health, or raise the rung budget "
+            "(ROUNDTABLE_RUNG_BUDGETS) if the wait was legitimate.",
     "unknown": None,
 }
 
@@ -91,6 +94,12 @@ _API_MARKERS = ("429", "500", "502", "503", "529", "overloaded",
 # HBM OOM classification mapped onto the taxonomy).
 _OOM_MARKERS = ("resource_exhausted", "out of memory", "hbm", "oom",
                 "allocation failure")
+# Watchdog hang detection (engine/deadlines.py): a wait that exceeded
+# its rung budget is a WEDGED program, not a polite timeout — it must
+# classify ahead of the timeout markers so the ladder treats it like a
+# crash (no blind retry, revive + re-seat). Markers are whole words the
+# watchdog/fault messages carry ("hang" alone would match "change").
+_HANG_MARKERS = ("watchdog", "wedged", "hang detected", "(hang)")
 
 
 def classify_error(err: BaseException) -> str:
@@ -102,6 +111,8 @@ def classify_error(err: BaseException) -> str:
         return "not_installed"
     if any(m in msg for m in _OOM_MARKERS):
         return "oom"
+    if any(m in msg for m in _HANG_MARKERS):
+        return "hang"
     if any(m in msg for m in _TIMEOUT_MARKERS):
         return "timeout"
     if any(m in msg for m in _AUTH_MARKERS):

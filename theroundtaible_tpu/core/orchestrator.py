@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 from ..adapters.base import BaseAdapter, KnightTurn
 from ..adapters.factory import create_adapter
+from ..engine import deadlines
 from ..utils.chronicle import append_to_chronicle
 from ..utils.context import ProjectContext, build_context
 from ..utils.decree_log import (
@@ -107,16 +108,30 @@ def shuffle_order(knights: list[KnightConfig],
     return order
 
 
+def _budget_kwargs(adapter: BaseAdapter, budget) -> dict:
+    """The budget kwarg, but only for adapters that opted in
+    (accepts_budget) — third-party/test subclasses overriding the
+    legacy (turns, timeout_ms) signatures keep working unchanged."""
+    if budget is not None and getattr(adapter, "accepts_budget", False):
+        return {"budget": budget}
+    return {}
+
+
 def execute_with_fallback(
     primary: BaseAdapter, knight: KnightConfig, config: RoundtableConfig,
     prompt: str, timeout_ms: int, adapters: dict[str, BaseAdapter],
-    reporter: Reporter,
+    reporter: Reporter, budget=None,
 ) -> tuple[str, BaseAdapter]:
     """Primary execute; on failure lazily create + cache the knight's
     configured fallback adapter and retry once (reference :45-73).
-    Returns (response, the adapter that actually served it)."""
+    Returns (response, the adapter that actually served it). `budget` is
+    the knight's turn-rung Budget (engine/deadlines.py); the fallback
+    attempt gets its own sibling node so a primary that burned the turn
+    hanging still leaves the fallback the round's remaining time."""
     try:
-        return primary.execute_for(knight.name, prompt, timeout_ms), primary
+        return primary.execute_for(
+            knight.name, prompt, timeout_ms,
+            **_budget_kwargs(primary, budget)), primary
     except Exception as primary_error:
         if not knight.fallback:
             raise
@@ -130,7 +145,11 @@ def execute_with_fallback(
         if fallback is None:
             raise primary_error
         reporter.fallback_engaged(knight.name, knight.fallback)
-        return fallback.execute_for(knight.name, prompt, timeout_ms), fallback
+        fb_budget = budget.parent.child("turn") if (
+            budget is not None and budget.parent is not None) else None
+        return fallback.execute_for(
+            knight.name, prompt, timeout_ms,
+            **_budget_kwargs(fallback, fb_budget)), fallback
 
 
 def select_lead_knight(knights: list[KnightConfig],
@@ -315,8 +334,33 @@ def run_discussion(
     from ..utils.metrics import SessionMetrics, maybe_profile
     state.metrics = SessionMetrics(session_path)
 
+    # Time-ladder root (ISSUE 2): the discussion budget bounds every
+    # round budget, which bounds every turn — threaded top-down through
+    # the budget-aware adapters into the engines' prefill/decode/dispatch
+    # rungs (engine/deadlines.py). Unset budgets are unbounded roots, so
+    # the reference's timeout-per-turn-only behavior is the default.
+    discussion_budget = deadlines.Budget.root(
+        rules.discussion_budget_seconds, rung="discussion")
+
     with maybe_profile(session_path):
         for round_num in range(start_round, end_round + 1):
+            if discussion_budget.expired:
+                # Hard discussion budget exhausted: return PARTIAL
+                # results through the normal escalation path (transcript
+                # and blocks intact, culprit named) instead of letting
+                # the window die with nothing.
+                # The budget can also come from a configured discussion
+                # rung cap, so name whichever bound actually applied.
+                bound = (f"{rules.discussion_budget_seconds:.0f}s"
+                         if rules.discussion_budget_seconds
+                         else f"rung cap {deadlines.rung_cap('discussion'):.0f}s")
+                reporter.verify_event(
+                    "warning",
+                    f"discussion budget ({bound}) exhausted before "
+                    f"round {round_num} — returning partial results")
+                break
+            round_budget = discussion_budget.child(
+                "round", timeout_s=rules.round_budget_seconds)
             is_first = round_num == start_round and not continue_from
             round_order = (sorted_knights if is_first
                            else shuffle_order(sorted_knights, rng))
@@ -327,7 +371,8 @@ def run_discussion(
             _run_round_turns(
                 round_order, round_num, topic, config, adapters,
                 project_root, session_path, context, manifest_summary,
-                decrees_context, king_demand, state, timeout_ms, reporter)
+                decrees_context, king_demand, state, timeout_ms, reporter,
+                round_budget)
             state.metrics.end_round()
             if state.metrics.rounds:
                 reporter.round_footer(state.metrics.rounds[-1])
@@ -417,7 +462,7 @@ def _batch_groups(round_order, adapters):
 def _run_round_turns(round_order, round_num, topic, config, adapters,
                      project_root, session_path, context, manifest_summary,
                      decrees_context, king_demand, state, timeout_ms,
-                     reporter) -> None:
+                     reporter, round_budget=None) -> None:
     if config.rules.parallel_rounds:
         groups, serial_order = _batch_groups(round_order, adapters)
     else:
@@ -442,7 +487,13 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
         def run_group(job):
             adapter, knights, turns = job
             t0 = time.monotonic()
-            responses = adapter.execute_round(turns, timeout_ms)
+            # Each group receives the round budget directly (the adapter
+            # derives its own round-rung child): groups run CONCURRENTLY
+            # on disjoint submeshes, so they share the round's
+            # wall-clock, not a division of it.
+            responses = adapter.execute_round(
+                turns, timeout_ms,
+                **_budget_kwargs(adapter, round_budget))
             if len(responses) != len(turns):
                 raise RuntimeError(
                     f"batched round returned {len(responses)} responses "
@@ -502,10 +553,13 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
             decrees_context, king_demand, state)
         stop_thinking = reporter.knight_thinking(knight.name)
         t0 = time.monotonic()
+        turn_budget = (round_budget.child(
+            "turn", timeout_s=timeout_ms / 1000)
+            if round_budget is not None else None)
         try:
             response, served_by = execute_with_fallback(
                 adapter, knight, config, prompt, timeout_ms, adapters,
-                reporter)
+                reporter, budget=turn_budget)
         except Exception as error:  # noqa: BLE001 — turn-level containment
             stop_thinking()
             kind = classify_error(error)

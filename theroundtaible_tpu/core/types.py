@@ -71,6 +71,15 @@ class RulesConfig:
     # adapter (tpu-llm), each round is ONE batched forward pass — knights
     # speak simultaneously instead of seeing same-round earlier turns.
     parallel_rounds: bool = False
+    # Time-ladder roots (ISSUE 2, engine/deadlines.py): hard wall-clock
+    # budgets for the whole discussion and for each round. None (the
+    # default, and the reference's behavior) = unbounded; the per-turn
+    # timeout remains the only clock. When set, run_discussion derives
+    # the round budgets from the discussion budget top-down and returns
+    # PARTIAL results (escalated, transcript intact) when the discussion
+    # budget is exhausted instead of running the window into a hard kill.
+    discussion_budget_seconds: Optional[float] = None
+    round_budget_seconds: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "RulesConfig":
@@ -90,10 +99,22 @@ class RulesConfig:
             ignore=list(d.get("ignore", default.ignore)),
             parallel_rounds=bool(d.get("parallel_rounds",
                                        default.parallel_rounds)),
+            discussion_budget_seconds=(
+                float(d["discussion_budget_seconds"])
+                if d.get("discussion_budget_seconds") else None),
+            round_budget_seconds=(
+                float(d["round_budget_seconds"])
+                if d.get("round_budget_seconds") else None),
         )
 
     def to_dict(self) -> dict[str, Any]:
-        return asdict(self)
+        d = asdict(self)
+        # Unset budgets are omitted so a config written before the keys
+        # existed round-trips byte-identically (reference schema parity).
+        for key in ("discussion_budget_seconds", "round_budget_seconds"):
+            if d[key] is None:
+                del d[key]
+        return d
 
 
 @dataclass

@@ -56,6 +56,47 @@ def pcast(x, axis_names, to: str = "varying"):
     return fn(x, axis_names, to=to)
 
 
+def _int4_bitcast_expands() -> bool:
+    """Feature-detect `lax.bitcast_convert_type(int8 → int4)`: modern
+    jax appends a minor dim of 2 (one nibble pair per byte); jax 0.4.x
+    abstract-evals it at the SAME rank and then fails MLIR verification
+    at lowering ("rank of smaller element type should be 1 more"). The
+    probe is abstract-only (eval_shape) — no compile, no device."""
+    try:
+        import jax.numpy as jnp
+        out = jax.eval_shape(
+            lambda x: jax.lax.bitcast_convert_type(x, jnp.int4),
+            jax.ShapeDtypeStruct((2,), jnp.int8))
+        return out.shape == (2, 2)
+    except Exception:  # noqa: BLE001 — any probe failure ⇒ fallback
+        return False
+
+
+HAS_INT4_BITCAST = _int4_bitcast_expands()
+
+
+def unpack_int4_pairs(q4):
+    """int8[..., n] → signed nibble pairs int4/int8[..., n, 2], low
+    nibble first (the engine/quant.py pack order).
+
+    Modern jax: the one-op bitcast whose nibble pair expands minor-most
+    — the layout Mosaic fuses into the consuming matmul operand on TPU
+    (models/common.dequant_int4's performance contract). Old jax
+    (0.4.x, broken int4 bitcast — see _int4_bitcast_expands): arithmetic
+    shift extraction + a minor-axis stack. The stack is an interleave
+    XLA:TPU would NOT fuse (the exact layout BENCH_r05 measured slower
+    than bf16), but the fallback only ever runs on runtimes where the
+    bitcast cannot lower AT ALL — correctness-gated, and numerically
+    identical: `(q << 4) >> 4` sign-extends the low nibble, `q >> 4`
+    the high one (arithmetic shifts on int8)."""
+    import jax.numpy as jnp
+    if HAS_INT4_BITCAST:
+        return jax.lax.bitcast_convert_type(q4, jnp.int4)
+    low = jnp.right_shift(jnp.left_shift(q4, 4), 4)
+    high = jnp.right_shift(q4, 4)
+    return jnp.stack([low, high], axis=-1)
+
+
 def mesh_manual_axes(mesh) -> set:
     """The axes a wrapper's shard_map must manualize: the mesh's AUTO
     axes. Modern meshes carry axis_types; old ones report every axis —

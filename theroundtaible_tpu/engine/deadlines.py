@@ -1,0 +1,436 @@
+"""Hierarchical time budgets, hang detection, cooperative cancellation.
+
+PR 1 built the FAILURE ladder (faults.py injection → retry → breakers →
+re-seating); this module builds the TIME ladder production engines treat
+as first-class (RTP-LLM, arxiv 2605.29639, budgets every stage of a
+request; the Gemma-on-TPU serving comparison, arxiv 2605.25645,
+benchmarks against per-request SLOs). Three pieces live here:
+
+- **Budget tree** — one `Budget` node per rung of the serving hierarchy
+  (`discussion → round → turn → prefill|decode → dispatch`). A child's
+  deadline is the MIN of its parent's, its own timeout, and the rung's
+  configured cap, so no leaf can outlive any ancestor. `CancelToken`
+  rides the tree: cancelling a parent cancels every descendant, and
+  engines check it cooperatively between prefill chunks and decode
+  segments (a single XLA program cannot be interrupted — the checks sit
+  at the program boundaries, exactly like the existing timeout checks).
+- **Watchdog** — `watched_wait(fn, budget, rung)` wraps a BLOCKING device
+  wait (dispatch enqueue + compile, the per-segment host sync, the
+  prefill scalar fetch). The wait runs in a worker thread; if it exceeds
+  min(budget remaining, rung cap) the caller raises `HangDetected`
+  (classified as the `hang` fault kind, core/errors.py) and ABANDONS the
+  worker — a wedged device program then degrades through the existing
+  faults.py → RetryPolicy → CircuitBreaker → re-seating ladder exactly
+  like a crashed one, instead of freezing the discussion on
+  `jax.block_until_ready`. Unarmed, the seam is a module-flag check and
+  a direct call — zero measurable overhead, same contract as
+  `faults.ARMED`. An abandoned worker that LATER completes must not
+  commit stale cache state: engines wrap the KV-pool mutation in
+  `with commit_guard():`, which raises `StaleWait` inside the abandoned
+  thread (the result is discarded; the revived pools stay
+  authoritative) and holds the ticket lock across the commit so the
+  abandon decision cannot interleave with it.
+- **Drain gate** — `begin_drain()` flips the module-level `DRAINING`
+  flag; `engine.generate_batch*` refuses NEW admissions while it is set,
+  in-flight generations finish their rung, and `fleet.drain()` then
+  flushes per-knight KV state (see engine/fleet.py).
+
+This module is deliberately host-only (no jax import): the orchestrator
+and adapters import it without touching a backend, and the types stay
+usable in pure-unit tests.
+
+Arming: `arm_watchdog()` in-process, `ROUNDTABLE_WATCHDOG=1` in the
+environment, or arming a `hang`/`slow_wait` fault point
+(`ROUNDTABLE_FAULTS=hang` — engine/faults.py arms the watchdog so the
+chaos knob is one variable). Per-rung caps:
+`ROUNDTABLE_RUNG_BUDGETS="dispatch:120,prefill:300"` or
+`configure_rungs({...})`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+# Module-level guards — the ONLY thing unarmed hot paths touch (one
+# attribute load + branch, same pattern as faults.ARMED).
+ACTIVE = False     # watchdog armed
+DRAINING = False   # fleet drain in progress: refuse new admissions
+
+# The rung hierarchy, outermost first. "prefill"/"decode" are the two
+# phase rungs inside a turn; "dispatch" is a single device program's
+# blocking wait (the leaf the watchdog times).
+RUNGS = ("discussion", "round", "turn", "prefill", "decode", "dispatch")
+
+_INF = float("inf")
+
+# Per-rung wall-clock caps in seconds (None/absent = no cap beyond the
+# parent's remaining time). Empty by default: the root timeout bounds
+# everything, and operators opt into tighter rungs per deployment.
+_rung_caps: dict[str, float] = {}
+
+
+class BudgetExceeded(TimeoutError):
+    """A rung's deadline passed (cooperative check, not a hang)."""
+
+    def __init__(self, message: str, rung: str = ""):
+        super().__init__(message)
+        self.rung = rung
+
+
+class Cancelled(RuntimeError):
+    """The budget's CancelToken was cancelled (drain/abort)."""
+
+    def __init__(self, message: str, reason: str = ""):
+        super().__init__(message)
+        self.reason = reason
+
+
+class HangDetected(RuntimeError):
+    """A blocking device wait exceeded its rung budget — the program is
+    treated as wedged. The message deliberately carries the watchdog
+    markers core/errors.classify_error maps to the `hang` kind."""
+
+    def __init__(self, rung: str, waited_s: float):
+        super().__init__(
+            f"watchdog: device wait at rung '{rung}' still blocked after "
+            f"{waited_s:.1f}s budget — program presumed wedged (hang)")
+        self.rung = rung
+        self.waited_s = waited_s
+
+
+class StaleWait(RuntimeError):
+    """Raised by commit_guard inside an ABANDONED watched wait: the
+    caller already gave up on this dispatch (HangDetected) and may have
+    revived/reallocated the KV state — a late completion must discard
+    its result instead of committing stale cache buffers."""
+
+
+class DrainingError(RuntimeError):
+    """New turn refused because the fleet is draining."""
+
+
+class CancelToken:
+    """Cooperative cancellation, tree-propagating: cancelling a parent
+    cancels every descendant token (but never the reverse)."""
+
+    __slots__ = ("_event", "reason", "_children", "_lock")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason = ""
+        self._children: list["CancelToken"] = []
+        self._lock = threading.Lock()
+
+    def child(self) -> "CancelToken":
+        tok = CancelToken()
+        with self._lock:
+            self._children.append(tok)
+            if self._event.is_set():
+                tok.cancel(self.reason)
+        return tok
+
+    def cancel(self, reason: str = "") -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self.reason = reason
+            self._event.set()
+            children = list(self._children)
+        for c in children:
+            c.cancel(reason)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def check(self) -> None:
+        if self._event.is_set():
+            raise Cancelled(
+                f"cancelled{': ' + self.reason if self.reason else ''}",
+                reason=self.reason)
+
+
+class Budget:
+    """One node of the hierarchical time-budget tree.
+
+    `deadline` is an absolute time.monotonic() value (inf = unbounded),
+    always <= every ancestor's, so the float is directly usable by the
+    legacy `deadline=` seams in serving_loop/RetryPolicy."""
+
+    __slots__ = ("rung", "deadline", "parent", "token")
+
+    def __init__(self, rung: str, deadline: float = _INF,
+                 parent: Optional["Budget"] = None,
+                 token: Optional[CancelToken] = None):
+        self.rung = rung
+        self.deadline = deadline
+        self.parent = parent
+        self.token = token or CancelToken()
+
+    @classmethod
+    def root(cls, timeout_s: Optional[float] = None,
+             rung: str = "discussion",
+             token: Optional[CancelToken] = None) -> "Budget":
+        """A tree root: `timeout_s` None means unbounded (the rung cap,
+        if configured, still applies); a numeric value — including 0 —
+        bounds it (0 = born expired, useful in tests and hard cutoffs)."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else _INF)
+        cap = _rung_caps.get(rung)
+        if cap:
+            deadline = min(deadline, time.monotonic() + cap)
+        return cls(rung, deadline, token=token)
+
+    def child(self, rung: str,
+              timeout_s: Optional[float] = None) -> "Budget":
+        """Derive a sub-budget: deadline = min(parent, own timeout, rung
+        cap). The child gets a linked CancelToken, so cancelling this
+        node cancels the child but a child's cancellation stays local."""
+        deadline = self.deadline
+        now = time.monotonic()
+        if timeout_s is not None and timeout_s >= 0:
+            deadline = min(deadline, now + timeout_s)
+        cap = _rung_caps.get(rung)
+        if cap:
+            deadline = min(deadline, now + cap)
+        return Budget(rung, deadline, parent=self,
+                      token=self.token.child())
+
+    def split(self, n: int, rung: str) -> list["Budget"]:
+        """n children sharing the remaining time evenly (each capped by
+        this node's deadline — a child finishing early does NOT donate
+        to its siblings; use sequential `child(remaining/(n-i))` calls
+        for the fair-share-with-reuse pattern)."""
+        share = self.remaining() / max(n, 1)
+        return [self.child(rung, timeout_s=share) for _ in range(n)]
+
+    def remaining(self) -> float:
+        return max(self.deadline - time.monotonic(), 0.0) \
+            if self.deadline != _INF else _INF
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.deadline
+
+    def check(self) -> None:
+        """Cooperative cancellation + deadline check — call between
+        prefill chunks / decode segments (program boundaries)."""
+        self.token.check()
+        if time.monotonic() >= self.deadline:
+            raise BudgetExceeded(
+                f"{self.rung} budget exhausted (deadline passed)",
+                rung=self.rung)
+
+
+def rung_cap(rung: str) -> Optional[float]:
+    return _rung_caps.get(rung)
+
+
+def configure_rungs(caps: dict[str, float]) -> None:
+    """Set per-rung wall-clock caps (seconds); None/0 removes a cap."""
+    for rung, cap in caps.items():
+        if rung not in RUNGS:
+            raise ValueError(f"unknown rung {rung!r} "
+                             f"(known: {', '.join(RUNGS)})")
+        if cap:
+            _rung_caps[rung] = float(cap)
+        else:
+            _rung_caps.pop(rung, None)
+
+
+def reset_rungs() -> None:
+    _rung_caps.clear()
+
+
+def _configure_from_env() -> None:
+    """ROUNDTABLE_RUNG_BUDGETS="rung:seconds,..." parsed at import.
+    Malformed entries warn and are skipped — the ops knob must never
+    itself take serving down with an import-time crash."""
+    raw = os.environ.get("ROUNDTABLE_RUNG_BUDGETS", "")
+    for entry in filter(None, (p.strip() for p in raw.split(","))):
+        try:
+            rung, sec = entry.rsplit(":", 1)
+            configure_rungs({rung.strip(): float(sec)})
+        except ValueError as e:
+            import warnings
+            warnings.warn(
+                f"ignoring malformed ROUNDTABLE_RUNG_BUDGETS entry "
+                f"{entry!r}: {e}")
+
+
+# --- watchdog ---
+
+_local = threading.local()
+
+# Recent hang events (observability: fleet_health / chaos assertions).
+_hang_log: list[dict] = []
+_HANG_LOG_CAP = 64
+
+
+class _WatchTicket:
+    """State shared between a watched wait's caller and its worker.
+    `lock` serializes the abandon decision against the worker's state
+    commit: the caller flips `abandoned` under it, and commit_guard
+    HOLDS it across the guard-check AND the commit — so either the
+    commit completes before abandonment is visible (the caller's
+    recovery then revives over a consistent committed state) or the
+    guard sees `abandoned` and discards. Never commit-then-revive and
+    revive-then-stale-commit interleaved."""
+
+    __slots__ = ("abandoned", "rung", "lock")
+
+    def __init__(self, rung: str):
+        self.abandoned = False
+        self.rung = rung
+        self.lock = threading.Lock()
+
+
+def arm_watchdog() -> None:
+    global ACTIVE
+    ACTIVE = True
+
+
+def disarm_watchdog() -> None:
+    global ACTIVE
+    ACTIVE = False
+
+
+def hang_log() -> list[dict]:
+    """Recorded hang events ({rung, waited_s, at}) — newest last."""
+    return list(_hang_log)
+
+
+def clear_hang_log() -> None:
+    _hang_log.clear()
+
+
+def wait_abandoned() -> bool:
+    """True inside a watched wait whose caller already raised
+    HangDetected and moved on (worker thread only)."""
+    ticket = getattr(_local, "ticket", None)
+    return ticket is not None and ticket.abandoned
+
+
+class _CommitGuard:
+    """`with deadlines.commit_guard(): <commit cache state>` — inside a
+    dispatch closure, wrap the cache-state mutation: a late-completing
+    abandoned wait must discard its result (the caller may have revived
+    the KV pools since). The guard check and the commit happen under
+    the ticket's lock, and the watchdog flips `abandoned` under the same
+    lock, so a worker can never pass the check and then commit stale
+    state AFTER the caller's recovery revived the pools (the abandon
+    either waits for the in-progress commit or is seen by the guard).
+    Near-free on the unarmed hot path and outside watched waits."""
+
+    __slots__ = ("_ticket",)
+
+    def __enter__(self):
+        ticket = getattr(_local, "ticket", None) if ACTIVE else None
+        self._ticket = ticket
+        if ticket is not None:
+            ticket.lock.acquire()
+            if ticket.abandoned:
+                ticket.lock.release()
+                self._ticket = None
+                raise StaleWait(
+                    f"watched wait at rung '{ticket.rung}' was abandoned "
+                    "by the watchdog — discarding its late result instead "
+                    "of committing stale cache state")
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._ticket is not None:
+            self._ticket.lock.release()
+        return False
+
+
+def commit_guard() -> _CommitGuard:
+    return _CommitGuard()
+
+
+def watched_wait(fn: Callable, budget: Optional[Budget],
+                 rung: str = "dispatch"):
+    """THE deadline seam for blocking device waits.
+
+    Unarmed (ACTIVE False) or unbudgeted: a direct call — zero overhead
+    beyond the flag check the call site already did. Armed: `fn` runs in
+    a dedicated worker thread and the caller waits at most
+    min(budget remaining, rung cap); on expiry the worker is ABANDONED
+    (a wedged device wait cannot be interrupted from Python — the
+    abandoned thread either blocks forever or discards its result via
+    commit_guard) and HangDetected raises into the caller, where the
+    fault ladder takes over."""
+    if not ACTIVE or budget is None:
+        return fn()
+    bound = budget.remaining()
+    cap = _rung_caps.get(rung)
+    if cap:
+        bound = min(bound, cap)
+    if bound == _INF:
+        return fn()
+    if bound <= 0:
+        # Nothing left to wait with: that is an exhausted BUDGET (the
+        # cooperative-timeout classification), not a wedged program —
+        # don't spawn a worker just to abandon it at t=0.
+        raise BudgetExceeded(
+            f"{rung} wait admitted with no remaining budget", rung=rung)
+    done = threading.Event()
+    box: dict = {}
+    ticket = _WatchTicket(rung)
+
+    def work():
+        _local.ticket = ticket
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=work, daemon=True,
+                              name=f"watchdog-{rung}")
+    worker.start()
+    if not done.wait(timeout=max(bound, 0.0)):
+        # Under the ticket lock: an in-progress commit_guard block
+        # finishes first (commit-then-revive order), or the flag lands
+        # before the guard runs and the worker discards (StaleWait).
+        with ticket.lock:
+            ticket.abandoned = True
+        _hang_log.append({"rung": rung, "waited_s": bound,
+                          "at": time.monotonic()})
+        del _hang_log[:-_HANG_LOG_CAP]
+        raise HangDetected(rung, bound)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+# --- drain gate ---
+
+def begin_drain() -> None:
+    """Stop admitting new turns (engine.generate_batch* checks this
+    before taking the serve lock); in-flight generations finish."""
+    global DRAINING
+    DRAINING = True
+
+
+def end_drain() -> None:
+    global DRAINING
+    DRAINING = False
+
+
+def check_admission() -> None:
+    """Raise DrainingError when the fleet is draining. One module-flag
+    check per generate call — nothing on the per-token path."""
+    if DRAINING:
+        raise DrainingError(
+            "fleet is draining: new turns are not admitted "
+            "(fleet.resume() re-opens admission)")
+
+
+if os.environ.get("ROUNDTABLE_WATCHDOG"):
+    arm_watchdog()
+_configure_from_env()

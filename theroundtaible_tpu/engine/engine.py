@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import faults
+from . import deadlines, faults
 from .kvcache import KVCache
 from .models.common import (ModelConfig, forward, init_params, param_count,
                             spmd_mesh)
@@ -36,7 +36,7 @@ from .serving_loop import (DECODE_SEGMENT, MAX_PREFILL_CHUNK,
                            PREFILL_BUCKETS, ReplicaGroupPlan,
                            bucket_for as _bucket,
                            chunked_prefill, decode_segments,
-                           finalize_outputs, prompt_budget)
+                           finalize_outputs, host_sync, prompt_budget)
 from .sharding import build_mesh, kv_cache_spec, shard_params
 from .tokenizer import load_tokenizer
 
@@ -838,7 +838,8 @@ class InferenceEngine:
 
     def _prefill(self, slot_ids: list[int], token_lists: list[list[int]],
                  offsets: list[int], deadline: float = float("inf"),
-                 tables: Optional[np.ndarray] = None) -> jax.Array:
+                 tables: Optional[np.ndarray] = None,
+                 budget=None) -> jax.Array:
         """Prefill dispatch: fresh long prompts go to the sequence-parallel
         ring program; everything else (short prompts, delta prefills on a
         reused prefix) takes the chunked bucketed path."""
@@ -859,7 +860,7 @@ class InferenceEngine:
                 return self._prefill_ring(slot_ids, token_lists, tpad,
                                           tables)
         return self._prefill_chunked(slot_ids, token_lists, offsets,
-                                     deadline, tables)
+                                     deadline, tables, budget)
 
     def _prefill_ring(self, slot_ids: list[int],
                       token_lists: list[list[int]], tpad: int,
@@ -892,7 +893,8 @@ class InferenceEngine:
     def _prefill_chunked(self, slot_ids: list[int],
                          token_lists: list[list[int]], offsets: list[int],
                          deadline: float = float("inf"),
-                         tables: Optional[np.ndarray] = None) -> jax.Array:
+                         tables: Optional[np.ndarray] = None,
+                         budget=None) -> jax.Array:
         """Chunked, bucketed prefill for B rows (serving_loop loop with
         this engine's step program). Returns last-token logits [B, V].
 
@@ -916,8 +918,7 @@ class InferenceEngine:
         def dispatch(chunk, offs, lengths):
             if tables is not None:
                 try:
-                    last, self.kv.pools = paged_prefill(chunk, offs,
-                                                        lengths)
+                    last, pools = paged_prefill(chunk, offs, lengths)
                 except Exception as e:
                     # Kernel-path failure on a pool-direct engine:
                     # degrade to the gather-view programs and re-dispatch
@@ -927,18 +928,24 @@ class InferenceEngine:
                     if not (faults.is_kernel_failure(e)
                             and self._degrade_paged_direct(str(e))):
                         raise
-                    last, self.kv.pools = paged_prefill(chunk, offs,
-                                                        lengths)
+                    last, pools = paged_prefill(chunk, offs, lengths)
+                # A watchdog-abandoned dispatch completing late must NOT
+                # commit onto pools the recovery path may have revived
+                # (the guard holds the ticket lock across the commit).
+                with deadlines.commit_guard():
+                    self.kv.pools = pools
             else:
-                last, self.kv.layers = self._prefill_step(
+                last, layers = self._prefill_step(
                     self.params, self.kv.layers, slot_idx,
                     jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                     jnp.asarray(lengths))
+                with deadlines.commit_guard():
+                    self.kv.layers = layers
             return last
 
         return chunked_prefill(dispatch, token_lists, offsets,
                                self.kv.max_seq_len, self.tokenizer.pad_id,
-                               deadline, retry=self.retry)
+                               deadline, retry=self.retry, budget=budget)
 
     def _apply_copies(self, copies: list[tuple[int, int, int, int]]) -> None:
         """Dispatch queued (src_slot, dst_slot, lo, hi) K/V span copies.
@@ -965,7 +972,8 @@ class InferenceEngine:
 
     def _share_prefixes(self, names: list[str], slot_ids: list[int],
                         all_tokens: list[list[int]], offsets: list[int],
-                        deadline: float) -> tuple[list[int], int]:
+                        deadline: float,
+                        budget=None) -> tuple[list[int], int]:
         """Cross-knight shared-prefix reuse (SURVEY.md §7.3 hard part 2;
         reference prompt assembly src/orchestrator.ts:397-425 makes all
         knights share the giant context+transcript preamble, which the
@@ -1020,10 +1028,10 @@ class InferenceEngine:
                     toks = p.scatter_list(toks, [self.tokenizer.pad_id])
                     offs = p.scatter_list(offs, 0)
                 self._prefill([slot_ids[m]], toks, offs, deadline,
-                              tables=table)
+                              tables=table, budget=budget)
             else:
                 self._prefill([slot_ids[m]], [all_tokens[m][lo:hi]],
-                              [lo], deadline)
+                              [lo], deadline, budget=budget)
 
         return share_prefixes(
             self.kv, names, all_tokens, offsets,
@@ -1041,30 +1049,40 @@ class InferenceEngine:
                        max_new_tokens: Optional[int] = None,
                        timeout_s: float = 600.0,
                        sampling_per_turn: Optional[
-                           list[SamplingParams]] = None) -> list[str]:
+                           list[SamplingParams]] = None,
+                       budget=None) -> list[str]:
         return self.generate_batch_with_stats(
             turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
-            sampling_per_turn=sampling_per_turn)[0]
+            sampling_per_turn=sampling_per_turn, budget=budget)[0]
 
     def generate_batch_with_stats(
             self, turns: list[tuple[str, str]],
             max_new_tokens: Optional[int] = None,
             timeout_s: float = 600.0,
             sampling_per_turn: Optional[list[SamplingParams]] = None,
+            budget=None,
     ) -> tuple[list[str], GenStats]:
         """Serve N (slot_name, prompt) turns as one batched program pair.
 
         sampling_per_turn: per-row SamplingParams (heterogeneous knight
-        personas); None = the engine default for every row. Returns
+        personas); None = the engine default for every row. `budget`: a
+        turn-rung deadlines.Budget threaded down from the adapter (the
+        time ladder); None builds a local root from `timeout_s`, so
+        direct engine callers get the same rung structure. Returns
         (responses, this call's stats) — callers needing stats must take
         them from the return value, not from `last_stats`, which is a
         convenience field that concurrent callers may overwrite."""
+        # Admission gate (fleet.drain): one module-flag check per CALL,
+        # nothing on the per-token path. In-flight generations (already
+        # past this check, possibly waiting on the serve lock) complete.
+        deadlines.check_admission()
         with self._serve_lock:
             return self._generate_batch_locked(turns, max_new_tokens,
-                                               timeout_s, sampling_per_turn)
+                                               timeout_s, sampling_per_turn,
+                                               budget)
 
     def _generate_batch_locked(self, turns, max_new_tokens, timeout_s,
-                               sampling_per_turn=None):
+                               sampling_per_turn=None, budget=None):
         if faults.ARMED and len(turns) > 1:
             # Chaos point for the batched-round degradation ladder: a
             # "corrupted KV slot" fails the fan-out before any slot
@@ -1072,7 +1090,16 @@ class InferenceEngine:
             # slots and retries the knights serially (tpu_llm.py).
             faults.maybe_inject("kv_corrupt")
         stats = GenStats()
-        deadline = time.monotonic() + timeout_s
+        # The turn's budget node: adapters thread one down (round →
+        # turn); direct callers get a local root bounded by timeout_s.
+        # The float deadline stays the single source for the legacy
+        # time checks — always <= every ancestor's deadline. (`budget`
+        # is re-bound below for the prompt-token budget — the Budget
+        # node keeps its own name.)
+        turn_budget = budget if budget is not None \
+            else deadlines.Budget.root(timeout_s, rung="turn")
+        deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
+        pre_budget = turn_budget.child("prefill")
         max_new = max_new_tokens or self.sampling.max_new_tokens
         # Decode budget can never exceed half the context — misconfigured
         # max_new_tokens otherwise drives the prompt budget negative and
@@ -1109,7 +1136,8 @@ class InferenceEngine:
         # paged, aliasing) other slots' K/V; only the per-knight deltas
         # remain to prefill.
         offsets, leader_prefill = self._share_prefixes(
-            names, slot_ids, all_tokens, offsets, deadline)
+            names, slot_ids, all_tokens, offsets, deadline,
+            budget=pre_budget)
         plan = None
         tables_np = None
         if self.kv_layout == "paged":
@@ -1145,11 +1173,14 @@ class InferenceEngine:
                                          [self.tokenizer.pad_id])
             offsets = plan.scatter_list(offsets, 0)
         last_logits = self._prefill(slot_ids, suffixes, offsets,
-                                    deadline=deadline, tables=tables_np)
+                                    deadline=deadline, tables=tables_np,
+                                    budget=pre_budget)
         # A scalar fetch, not block_until_ready: some PJRT transports
         # (the axon relay) return from block_until_ready before the
-        # computation finishes, which would blame prefill time on decode.
-        float(last_logits[0, 0])
+        # computation finishes, which would blame prefill time on decode
+        # — and a blocking read, so it goes through the deadline seam (a
+        # wedged prefill program freezes the host exactly here).
+        host_sync(lambda: float(last_logits[0, 0]), pre_budget, "prefill")
         stats.prefill_seconds = time.monotonic() - t0
 
         per_row = sampling_per_turn or [self.sampling] * len(turns)
@@ -1176,12 +1207,16 @@ class InferenceEngine:
             # Pad rows open at eos so they are done from the first step.
             first = first.at[jnp.asarray(plan.pad_positions)].set(
                 jnp.int32(self.tokenizer.eos_id))
-        first_np = np.asarray(first)
+        first_np = host_sync(lambda: np.asarray(first), pre_budget,
+                             "prefill")
         cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
         if plan is not None:
             cur_valid = plan.scatter_rows(cur_valid, 1)
 
         t1 = time.monotonic()
+        # Decode rung budget is derived NOW, not at call start, so a
+        # configured "decode" cap times the decode phase alone.
+        dec_budget = turn_budget.child("decode")
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
         tables = (jnp.asarray(tables_np)
                   if self.kv_layout == "paged" else None)
@@ -1207,8 +1242,7 @@ class InferenceEngine:
                         max_new=DECODE_SEGMENT, greedy=greedy)
 
                 try:
-                    out, steps, last, valid, done, self.kv.pools = \
-                        run_paged()
+                    out, steps, last, valid, done, pools = run_paged()
                 except Exception as e:
                     # Same degradation rung as prefill: kernel-path
                     # failure → gather-view programs, re-dispatching
@@ -1216,20 +1250,24 @@ class InferenceEngine:
                     if not (faults.is_kernel_failure(e)
                             and self._degrade_paged_direct(str(e))):
                         raise
-                    out, steps, last, valid, done, self.kv.pools = \
-                        run_paged()
+                    out, steps, last, valid, done, pools = run_paged()
+                with deadlines.commit_guard():
+                    self.kv.pools = pools
             else:
-                out, steps, last, valid, done, self.kv.layers = \
+                out, steps, last, valid, done, layers = \
                     self._decode_loop(
                         self.params, self.kv.layers, slot_idx, cur_last,
                         cur_valid, self._next_key(), budget, temps,
                         top_ks, top_ps, row_budgets, done0,
                         max_new=DECODE_SEGMENT, greedy=greedy)
+                with deadlines.commit_guard():
+                    self.kv.layers = layers
             return out, steps, last, valid, done
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,
                                  self.tokenizer.eos_id, max_new, deadline,
-                                 timeout_s, retry=self.retry)
+                                 timeout_s, retry=self.retry,
+                                 budget=dec_budget)
         stats.decode_seconds = time.monotonic() - t1
         if plan is not None:
             first_np = first_np[plan.pos]

@@ -8,7 +8,9 @@ flaky cloud CLIs the same way). Three pieces live here:
 
 - **Injection registry** — deterministic, config/env-armed fault points
   threaded through the engines (`mosaic_compile`, `dispatch`,
-  `slow_dispatch`, `hbm_oom`, `kv_corrupt`). Each point fires a fixed
+  `slow_dispatch`, `hbm_oom`, `kv_corrupt`; ISSUE 2 adds the TIME
+  ladder's `hang` — a wedged wait the watchdog must classify, arming it
+  auto-arms engine/deadlines.py — and `slow_wait`). Each point fires a fixed
   number of times then disarms, so a chaos test can assert "first
   dispatch fails, the retry serves". Unarmed injection is ZERO overhead
   by contract: every hot-path call site guards on the module-level
@@ -48,10 +50,11 @@ from typing import Callable, Optional
 ARMED = False
 
 POINTS = ("mosaic_compile", "dispatch", "slow_dispatch", "hbm_oom",
-          "kv_corrupt")
+          "kv_corrupt", "hang", "slow_wait")
 
 # Messages are crafted so core.errors.classify_error maps each fault to
-# the kind its real counterpart would carry ("hbm" → oom, etc.).
+# the kind its real counterpart would carry ("hbm" → oom, "wedged" →
+# hang, etc.).
 _DEFAULT_MESSAGES = {
     "mosaic_compile": "injected fault: Mosaic kernel compilation failed "
                       "(scratch exceeds VMEM budget)",
@@ -60,7 +63,15 @@ _DEFAULT_MESSAGES = {
     "hbm_oom": "injected fault: RESOURCE_EXHAUSTED: out of memory while "
                "allocating HBM",
     "kv_corrupt": "injected fault: corrupted KV slot detected",
+    "hang": "injected fault: device dispatch wedged (hang)",
+    "slow_wait": "injected fault: slow device wait",
 }
+
+# Default sleep for an injected `hang` before it raises: long enough
+# that an armed watchdog with a realistic rung budget fires FIRST (the
+# wait is classified as a hang), short enough that an UNWATCHED chaos
+# run still ladders through the raised FaultInjected within seconds.
+_HANG_DEFAULT_DELAY_S = 5.0
 
 
 class FaultInjected(RuntimeError):
@@ -82,10 +93,28 @@ class FaultSpec:
 
 _registry: dict[str, FaultSpec] = {}
 
+# True while THIS module is the reason the deadlines watchdog is armed
+# (arming a hang/slow_wait point flipped it). An explicitly armed
+# watchdog (arm_watchdog() / ROUNDTABLE_WATCHDOG=1, ACTIVE already True
+# when the point armed) is never disarmed from here.
+_watchdog_auto_armed = False
+
+# The time-ladder points whose arming implies the watchdog.
+_WATCHDOG_POINTS = ("hang", "slow_wait")
+
 
 def _recompute_armed() -> None:
-    global ARMED
+    global ARMED, _watchdog_auto_armed
     ARMED = any(s.count != 0 for s in _registry.values())
+    if _watchdog_auto_armed and not any(
+            s.count != 0 for p, s in _registry.items()
+            if p in _WATCHDOG_POINTS):
+        # Symmetric teardown: the chaos run that auto-armed the watchdog
+        # is over (points exhausted or disarmed) — stop paying the
+        # per-wait worker-thread cost on the now-healthy hot path.
+        from . import deadlines
+        deadlines.disarm_watchdog()
+        _watchdog_auto_armed = False
 
 
 def arm(point: str, count: int = 1, delay_s: float = 0.0,
@@ -97,6 +126,17 @@ def arm(point: str, count: int = 1, delay_s: float = 0.0,
     spec = FaultSpec(point=point, count=count, delay_s=delay_s,
                      message=message or _DEFAULT_MESSAGES[point])
     _registry[point] = spec
+    if point in _WATCHDOG_POINTS:
+        # The time-ladder chaos points only bite when the watchdog is
+        # watching the waits — arming them arms it, so
+        # ROUNDTABLE_FAULTS=hang is a one-variable chaos run. Remember
+        # whether WE armed it (vs an operator's explicit arm), so point
+        # exhaustion / disarm() tears it down symmetrically.
+        from . import deadlines
+        global _watchdog_auto_armed
+        if not deadlines.ACTIVE:
+            _watchdog_auto_armed = True
+            deadlines.arm_watchdog()
     _recompute_armed()
     return spec
 
@@ -126,17 +166,29 @@ def maybe_inject(point: str) -> None:
         if spec.count == 0:
             _recompute_armed()
     spec.fired += 1
-    if point == "slow_dispatch":
+    if point in ("slow_dispatch", "slow_wait"):
         time.sleep(spec.delay_s or 0.25)
         return
+    if point == "hang":
+        # Simulate a wedged device wait: block (inside the watchdog's
+        # worker thread when one is watching), then RAISE rather than
+        # proceed — an abandoned worker must never complete the real
+        # dispatch and commit stale cache state behind the recovery
+        # path. With the watchdog armed and a tighter rung budget, the
+        # caller classifies the wait as a hang long before this sleep
+        # ends; unwatched, the raise ladders like any dispatch fault.
+        time.sleep(spec.delay_s or _HANG_DEFAULT_DELAY_S)
+        raise FaultInjected(spec.message, point)
     raise FaultInjected(spec.message, point)
 
 
 def inject_dispatch_faults() -> None:
     """The dispatch-stage points, in severity order. One call site in the
-    serving loop covers transient failure, slowness and OOM."""
+    serving loop covers transient failure, slowness, wedging and OOM."""
     maybe_inject("slow_dispatch")
+    maybe_inject("slow_wait")
     maybe_inject("dispatch")
+    maybe_inject("hang")
     maybe_inject("hbm_oom")
 
 
@@ -189,8 +241,11 @@ def is_kernel_failure(err: BaseException) -> bool:
 # --- retry policy ---
 
 # Kinds where an immediate identical retry cannot succeed: the deadline
-# already passed, the allocation will fail again, or the config is wrong.
-_NO_RETRY_KINDS = ("timeout", "oom", "auth", "not_installed")
+# already passed, the allocation will fail again, the config is wrong —
+# or the device program is wedged (hang: the wait already consumed its
+# rung budget and likely its donated buffers; only the adapter rung's
+# revive + re-prefill helps).
+_NO_RETRY_KINDS = ("timeout", "oom", "auth", "not_installed", "hang")
 
 # Message markers with the same property: a donated-then-failed dispatch
 # leaves its inputs deleted, so re-running the identical program dies on

@@ -262,9 +262,11 @@ def fleet_health() -> dict[str, Any]:
     the engine cache, plus open/total counts. A fleet where `open > 0`
     has at least one engine the adapters are routing around; `degraded`
     additionally counts engines with recent (not yet trip-level)
-    consecutive failures. Cheap — host-side counters only, no device
-    work — so status surfaces can poll it per round."""
-    from . import breaker_snapshots
+    consecutive failures. `draining` reports the admission gate and
+    `hangs` the watchdog's recent hang detections (ISSUE 2 time ladder).
+    Cheap — host-side counters only, no device work — so status surfaces
+    can poll it per round."""
+    from . import breaker_snapshots, deadlines
     snaps = breaker_snapshots()
     return {
         "engines": snaps,
@@ -272,7 +274,72 @@ def fleet_health() -> dict[str, Any]:
         "open": sum(1 for s in snaps if s["open"]),
         "degraded": sum(1 for s in snaps
                         if s["failures"] > 0 and not s["open"]),
+        "draining": deadlines.DRAINING,
+        "hangs": len(deadlines.hang_log()),
     }
+
+
+def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
+    """Graceful fleet drain (ISSUE 2): stop admitting turns, let every
+    in-flight generation finish its rung, then flush per-knight KV state.
+
+    Sequence:
+    1. Flip the module-level admission gate (deadlines.begin_drain) —
+       every later `generate_batch*` call on ANY resident engine raises
+       DrainingError; calls already past the gate (in flight, or queued
+       on a serve lock) complete normally.
+    2. For each resident engine, acquire its serve lock within
+       `timeout_s` — acquisition IS the proof that in-flight work
+       finished — and, holding it, flush every per-knight slot through
+       the cache's normal release path (SlotBook.flush: paged pools
+       decref/free their pages; contiguous slots return to the free
+       list). An engine whose in-flight turn outlives the timeout is
+       reported `in_flight_drained: False` and left unflushed.
+
+    Admission stays closed after drain() returns (the caller is shutting
+    down, checkpointing, or re-seating); `resume()` re-opens it. Returns
+    a report: per-engine flush counts and whether the drain was clean."""
+    import time
+    from . import _engines, _lock, deadlines
+    deadlines.begin_drain()
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        engines = list(_engines.items())
+    report: dict[str, Any] = {"draining": True, "clean": True,
+                              "engines": []}
+    for key, eng in engines:
+        entry: dict[str, Any] = {
+            "engine": getattr(getattr(eng, "cfg", None), "name", key)}
+        lock = getattr(eng, "_serve_lock", None)
+        acquired = True
+        if lock is not None:
+            acquired = lock.acquire(
+                timeout=max(deadline - time.monotonic(), 0.0))
+        entry["in_flight_drained"] = acquired
+        if acquired:
+            try:
+                if flush_kv:
+                    # Best-effort per engine: one cache's flush failure
+                    # must not abandon the remaining engines mid-drain.
+                    try:
+                        entry["flushed_slots"] = eng.kv.flush()
+                    except Exception as e:  # noqa: BLE001
+                        entry["flush_error"] = str(e)
+                        report["clean"] = False
+            finally:
+                if lock is not None:
+                    lock.release()
+        else:
+            report["clean"] = False
+        report["engines"].append(entry)
+    return report
+
+
+def resume() -> None:
+    """Re-open admission after a drain (fleet_health()['draining'] goes
+    False; engines accept new turns again)."""
+    from . import deadlines
+    deadlines.end_drain()
 
 
 def plan_fleet(engine_configs: list[dict[str, Any]],

@@ -88,6 +88,16 @@ class SlotBook:
         self._slots.clear()
         self._free = list(range(self.num_slots))
 
+    def flush(self) -> int:
+        """Release every per-knight slot through the normal release path
+        (graceful drain's KV flush, fleet.drain): paged caches decref
+        and free their pages, contiguous slots return to the free list.
+        Returns how many slots were flushed."""
+        names = list(self._slots)
+        for name in names:
+            self.release(name)
+        return len(names)
+
     def revive_if_dead(self) -> bool:
         """Reallocate device buffers if a failed donated dispatch deleted
         them (jax donate_argnums consumes inputs even when the program

@@ -185,9 +185,13 @@ def dequant_int4(q4: jax.Array, s4: jax.Array, axis: int, group: int,
     bitcast + convert + minor-dim merge — every reshape here touches
     only trailing dims, so the whole chain stays fusable into the
     consuming matmul operand on TPU (no cross-lane shuffle). `axis`
-    must be the last axis (the only layout the packer emits)."""
+    must be the last axis (the only layout the packer emits). On jax
+    runtimes whose int8→int4 bitcast cannot lower (0.4.x), the compat
+    seam substitutes a shift/stack unpack with identical numerics
+    (compat.unpack_int4_pairs)."""
     assert axis == q4.ndim - 1, "int4 pack axis must be minor-most"
-    pairs = jax.lax.bitcast_convert_type(q4, jnp.int4)   # [..., n/2, 2]
+    from ..compat import unpack_int4_pairs
+    pairs = unpack_int4_pairs(q4)                        # [..., n/2, 2]
     shape = list(q4.shape)
     shape[-1] *= 2
     w = pairs.astype(dtype).reshape(shape)               # [..., n]

@@ -231,6 +231,16 @@ class PagedKVCache:
             for p in state.pages:
                 self._decref(p)
 
+    def flush(self) -> int:
+        """Release every per-knight slot (graceful drain's KV flush,
+        fleet.drain — SlotBook.flush's paged counterpart): each slot's
+        pages decref and free back to their replica ranges. Returns how
+        many slots were flushed."""
+        names = list(self._slots)
+        for name in names:
+            self.release(name)
+        return len(names)
+
     def reset_slot(self, name: str) -> None:
         if name in self._slots:
             state = self._slots[name]

@@ -60,13 +60,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from . import compat, faults
+from . import compat, deadlines, faults
 from .compat import pcast, shard_map
 from .engine import GenStats
 from .kvcache import SlotBook
 from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
                            chunked_prefill, decode_segments,
-                           finalize_outputs, prompt_budget)
+                           finalize_outputs, host_sync, prompt_budget)
 from .models.common import (ModelConfig, _einsum, _softcap, embed_tokens,
                             gather_rows, init_params, make_attention_mask,
                             param_count, project_qkv, rms_norm,
@@ -837,34 +837,41 @@ class PPEngine:
 
     def generate_batch(self, turns, max_new_tokens=None,
                        timeout_s: float = 600.0,
-                       sampling_per_turn=None) -> list[str]:
+                       sampling_per_turn=None, budget=None) -> list[str]:
         return self.generate_batch_with_stats(
             turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
-            sampling_per_turn=sampling_per_turn)[0]
+            sampling_per_turn=sampling_per_turn, budget=budget)[0]
 
     def generate_batch_with_stats(self, turns, max_new_tokens=None,
                                   timeout_s: float = 600.0,
-                                  sampling_per_turn=None):
+                                  sampling_per_turn=None, budget=None):
+        # Admission gate (fleet.drain) — same contract as the main
+        # engine: one flag check per call, in-flight turns complete.
+        deadlines.check_admission()
         with self._serve_lock:
             return self._generate_locked(turns, max_new_tokens, timeout_s,
-                                         sampling_per_turn)
+                                         sampling_per_turn, budget)
 
     def _chunked_rows(self, slot_ids, token_lists, offsets,
-                      deadline) -> jax.Array:
+                      deadline, budget=None) -> jax.Array:
         """Chunked bucketed prefill of the given rows through the PP step
         program; returns last-token logits [B, V]."""
         slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
         def prefill_dispatch(chunk, offs, lengths):
-            last, (self.kc, self.vc) = self._pp_prefill(
+            last, caches = self._pp_prefill(
                 self.shared, self.staged, (self.kc, self.vc), slot_idx,
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                 jnp.asarray(lengths))
+            # Late completion of a watchdog-abandoned wait must not
+            # clobber caches the recovery path revived (deadlines.py).
+            with deadlines.commit_guard():
+                self.kc, self.vc = caches
             return last
 
         return chunked_prefill(prefill_dispatch, token_lists, offsets,
                                self.max_seq_len, self.tokenizer.pad_id,
-                               deadline, retry=self.retry)
+                               deadline, retry=self.retry, budget=budget)
 
     def _apply_copies(self, copies) -> None:
         """Dispatch queued (src_slot, dst_slot, lo, hi) span copies —
@@ -887,7 +894,7 @@ class PPEngine:
                                                lo, hi)
 
     def _chunked_rows_pool_direct(self, token_lists, offsets, tables,
-                                  deadline) -> jax.Array:
+                                  deadline, budget=None) -> jax.Array:
         """Chunked bucketed prefill straight off the stage-stacked page
         pools (no gather view); returns last-token logits [B, V]."""
         def prefill_dispatch(chunk, offs, lengths):
@@ -895,15 +902,16 @@ class PPEngine:
                 self.shared, self.staged, self.kv.pools[0], tables,
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                 jnp.asarray(lengths))
-            self.kv.pools = [pools0]
+            with deadlines.commit_guard():
+                self.kv.pools = [pools0]
             return last
 
         return chunked_prefill(prefill_dispatch, token_lists, offsets,
                                self.max_seq_len, self.tokenizer.pad_id,
-                               deadline, retry=self.retry)
+                               deadline, retry=self.retry, budget=budget)
 
     def _prefill_rows_paged(self, names_sub, token_spans, offsets_sub,
-                            deadline, pinned) -> None:
+                            deadline, pinned, budget=None) -> None:
         """Prefill rows against the pool — pool-direct when the kernels
         are active, else the gather→chunked-prefill→scatter fallback.
         Either way the paged leader pass must land in the pool BEFORE
@@ -914,19 +922,19 @@ class PPEngine:
         tables = jnp.asarray(self.kv.table_for(list(names_sub)))
         if self._pool_direct:
             self._chunked_rows_pool_direct(token_spans, offsets_sub,
-                                           tables, deadline)
+                                           tables, deadline, budget)
             return
         self.kc, self.vc = self._gather_view(self.kv.pools, tables)
         try:
             self._chunked_rows(list(range(len(names_sub))), token_spans,
-                               offsets_sub, deadline)
+                               offsets_sub, deadline, budget)
         finally:
             self.kv.pools = self._scatter_view(self.kv.pools, tables,
                                                self.kc, self.vc)
             self.kc = self.vc = None
 
     def _share_prefixes(self, names, slot_ids, all_tokens, offsets,
-                        deadline):
+                        deadline, budget=None):
         """Cross-knight shared-prefix reuse on the stage-local caches —
         kvcache.share_prefixes (the same two-pass algorithm the main
         engine runs) with PP device mechanics: stage-sharded span copies
@@ -952,10 +960,10 @@ class PPEngine:
             if paged:
                 self._prefill_rows_paged(
                     [names[m]], [all_tokens[m][lo:hi]], [lo], deadline,
-                    pinned)
+                    pinned, budget)
             else:
                 self._chunked_rows([slot_ids[m]], [all_tokens[m][lo:hi]],
-                                   [lo], deadline)
+                                   [lo], deadline, budget)
 
         return share_prefixes(
             self.kv, names, all_tokens, offsets,
@@ -963,9 +971,16 @@ class PPEngine:
             flush_shares=flush_shares, prefill_span=prefill_span)
 
     def _generate_locked(self, turns, max_new_tokens, timeout_s,
-                         sampling_per_turn=None):
+                         sampling_per_turn=None, budget=None):
         stats = GenStats()
-        deadline = time.monotonic() + timeout_s
+        # Turn budget node (engine/deadlines.py) — same rung structure
+        # as the main engine; the float deadline feeds the legacy
+        # checks. (`budget` is re-bound below for the prompt-token
+        # budget — the Budget node keeps its own name.)
+        turn_budget = budget if budget is not None \
+            else deadlines.Budget.root(timeout_s, rung="turn")
+        deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
+        pre_budget = turn_budget.child("prefill")
         max_new = max_new_tokens or self.sampling.max_new_tokens
         max_new = max(1, min(max_new, self.max_seq_len // 2))
         max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
@@ -984,7 +999,8 @@ class PPEngine:
             all_tokens.append(tokens)
 
         offsets, extra_prefill = self._share_prefixes(
-            list(pinned), slot_ids, all_tokens, offsets, deadline)
+            list(pinned), slot_ids, all_tokens, offsets, deadline,
+            budget=pre_budget)
         # Copied donor spans count as reused (same accounting as the main
         # engine); the leader's extra span was genuinely prefilled.
         stats.reused_tokens = sum(offsets) - extra_prefill
@@ -1018,11 +1034,15 @@ class PPEngine:
             spans = [t[o:] for t, o in zip(all_tokens, offsets)]
             if tables is not None and self._pool_direct:
                 last_logits = self._chunked_rows_pool_direct(
-                    spans, offsets, tables, deadline)
+                    spans, offsets, tables, deadline, pre_budget)
             else:
                 last_logits = self._chunked_rows(slot_ids, spans,
-                                                 offsets, deadline)
-            float(last_logits[0, 0])
+                                                 offsets, deadline,
+                                                 pre_budget)
+            # Blocking scalar fetch → the deadline seam (a wedged
+            # prefill program freezes the host loop exactly here).
+            host_sync(lambda: float(last_logits[0, 0]), pre_budget,
+                      "prefill")
             stats.prefill_seconds = time.monotonic() - t0
             slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
@@ -1040,11 +1060,15 @@ class PPEngine:
                 first = sample_token_batch(
                     last_logits.astype(jnp.float32), self._next_key(),
                     temps, top_ks, top_ps).astype(jnp.int32)
-            first_np = np.asarray(first)
+            first_np = host_sync(lambda: np.asarray(first), pre_budget,
+                                 "prefill")
             cur_valid = jnp.asarray([len(t) for t in all_tokens],
                                     jnp.int32)
 
             t1 = time.monotonic()
+            # Decode rung budget derived at decode start, so a
+            # configured "decode" cap times the decode phase alone.
+            dec_budget = turn_budget.child("decode")
             # Per-row decode budgets (knight_sampling max_new_tokens) —
             # serving_loop.row_budget_fn, one definition for both engines.
             from .serving_loop import row_budget_fn
@@ -1060,7 +1084,8 @@ class PPEngine:
                             tables, cur_last, valid, self._next_key(),
                             budget, temps, top_ks, top_ps, row_budgets,
                             done0, max_new=DECODE_SEGMENT, greedy=greedy)
-                    self.kv.pools = [pools0]
+                    with deadlines.commit_guard():
+                        self.kv.pools = [pools0]
                     return out, steps, last, valid, done
             else:
                 def decode_dispatch(cur_last, valid, budget, done0):
@@ -1071,12 +1096,14 @@ class PPEngine:
                             slot_idx, cur_last, valid, self._next_key(),
                             budget, temps, top_ks, top_ps, row_budgets,
                             done0, max_new=DECODE_SEGMENT, greedy=greedy)
-                    self.kc, self.vc = caches
+                    with deadlines.commit_guard():
+                        self.kc, self.vc = caches
                     return out, steps, last, valid, done
 
             out_np = decode_segments(decode_dispatch, first, cur_valid,
                                      self.tokenizer.eos_id, max_new,
-                                     deadline, timeout_s, retry=self.retry)
+                                     deadline, timeout_s, retry=self.retry,
+                                     budget=dec_budget)
             stats.decode_seconds = time.monotonic() - t1
         finally:
             # Scatter back even on a mid-serve timeout: otherwise the

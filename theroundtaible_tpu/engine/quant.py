@@ -25,7 +25,9 @@ predicate):
   fuses into the consuming matmul operand on TPU; a leaf whose last dim
   cannot group falls back to the int8 dict form, so bits=4 trees are
   MIXED by design.
-Norm weights stay untouched (tiny, accuracy-critical).
+Norm weights stay untouched (tiny, accuracy-critical), and so does the
+MoE router (tiny, and its top-k expert SELECTION amplifies quantization
+error discontinuously — see the _SCALE_AXES note).
 
 Quantization runs AFTER shard_params: q/s are computed with jnp ops on
 the already-sharded weights, so XLA propagates the NamedShardings (q
@@ -59,7 +61,19 @@ _SCALE_AXES: dict[str, tuple[int, ...]] = {
     "gate_proj": (1,),     # dense [E, F] → s[F]
     "up_proj": (1,),
     "down_proj": (1,),     # dense [F, E] → s[E]
-    "router": (1,),        # [E, X] → s[X]
+    # NOTE: the MoE "router" is deliberately ABSENT — it stays full
+    # precision. Router logits pick top-k experts, a DISCONTINUOUS
+    # decision: near-tied logits flip expert selection under
+    # fraction-of-a-step perturbations, and a flipped expert changes
+    # the output by whole-activation magnitudes (tests/test_quant.py
+    # measures exactly this amplification on tiny-mixtral — even
+    # embedding-quant noise upstream of an fp router can flip a
+    # near-tied choice on random weights). Quantizing the decision-maker
+    # itself invites those flips for E×X params of savings — bytes-
+    # irrelevant — so it stays fp, which is standard MoE deployment
+    # practice. Keep quantized_specs' key-for-key mirror in mind:
+    # absence here makes BOTH the weights and the spec tree pass it
+    # through.
     "embedding": (0,),     # [V, E] → s[V] (row scale: lookup AND lm head)
     "lm_head": (0,),
 }

@@ -18,20 +18,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import faults
+from . import deadlines, faults
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 MAX_PREFILL_CHUNK = 2048
 DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
 
 
-def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf")):
-    """One device dispatch through the shared fault-tolerance seam: the
-    dispatch-stage injection points fire first (zero overhead unarmed —
-    the guard is the module-level faults.ARMED flag), then the retry
-    policy re-runs a transiently-failed dispatch before it surfaces.
-    Failures a retry can't fix (timeout/oom/...) pass straight through
-    to the caller's degradation rung (RetryPolicy.retryable).
+def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf"),
+                 budget=None, rung: str = "dispatch"):
+    """One device dispatch through the shared fault-tolerance AND
+    deadline seams: the dispatch-stage injection points fire first (zero
+    overhead unarmed — the guard is the module-level faults.ARMED flag),
+    the watchdog times the blocking part of the dispatch against its
+    rung budget when armed (deadlines.ACTIVE — a wait that exceeds it
+    raises HangDetected, which classifies as the non-retryable `hang`
+    kind and climbs the ladder like a crash), then the retry policy
+    re-runs a transiently-failed dispatch before it surfaces. Failures
+    a retry can't fix (timeout/oom/hang/...) pass straight through to
+    the caller's degradation rung (RetryPolicy.retryable).
 
     Scope: retry-in-place helps failures raised BEFORE the device
     program consumes its inputs (host-side validation, dispatch-queue
@@ -49,9 +54,25 @@ def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf")):
             faults.inject_dispatch_faults()
         return dispatch()
 
-    if retry is None:
+    def attempt():
+        if deadlines.ACTIVE and budget is not None:
+            return deadlines.watched_wait(call, budget, rung)
         return call()
-    return retry.run(call, deadline=deadline)
+
+    if retry is None:
+        return attempt()
+    return retry.run(attempt, deadline=deadline)
+
+
+def host_sync(fn: Callable, budget=None, rung: str = "decode"):
+    """A blocking device→host read through the deadline seam: the read
+    is where a wedged device program actually freezes the host loop
+    (`int(steps)` / `float(logits[0, 0])` block until the program
+    completes), so it gets the same watchdog treatment as a dispatch.
+    Unarmed: a direct call behind the module-flag check."""
+    if deadlines.ACTIVE and budget is not None:
+        return deadlines.watched_wait(fn, budget, rung)
+    return fn()
 
 
 class ReplicaGroupPlan:
@@ -150,6 +171,7 @@ def chunked_prefill(
     pad_id: int,
     deadline: float = float("inf"),
     retry=None,
+    budget=None,
 ) -> jax.Array:
     """Bucketed multi-chunk prefill. Returns last-token logits [B, V].
 
@@ -160,8 +182,16 @@ def chunked_prefill(
     silently clamp the offset and corrupt it). Each row's logits are kept
     from the chunk where its REAL tokens ended — later pad-only chunks
     must not clobber them.
+
+    `budget` (engine/deadlines.py): the prefill rung's Budget. Each
+    chunk's dispatch runs under the watchdog at the "dispatch" rung, and
+    cooperative cancellation/deadline checks run between chunks (a
+    single XLA program cannot be interrupted — the boundaries are where
+    a drain or an exhausted ancestor budget takes effect).
     """
     b = len(token_lists)
+    if budget is not None:
+        deadline = min(deadline, budget.deadline)
     offs = list(offsets)
     remaining = [list(t) for t in token_lists]
     final_logits: Optional[jax.Array] = None
@@ -185,8 +215,11 @@ def chunked_prefill(
             # outside their committed length and decode overwrites that
             # position with the first real generated token.
             lengths[i] = max(take, 1)
+        if budget is not None:
+            budget.check()
         last_logits = run_dispatch(
-            lambda: dispatch(chunk, offs, lengths), retry, deadline)
+            lambda: dispatch(chunk, offs, lengths), retry, deadline,
+            budget=budget)
         if final_logits is None:
             final_logits = last_logits
         else:
@@ -233,6 +266,7 @@ def decode_segments(
     deadline: float,
     timeout_s: float,
     retry=None,
+    budget=None,
 ) -> np.ndarray:
     """Segmented decode: one device program per DECODE_SEGMENT tokens with
     host-side timeout/early-exit checks in between (a single XLA program
@@ -256,13 +290,15 @@ def decode_segments(
     are discarded.
     """
     b = first_token.shape[0]
+    if budget is not None:
+        deadline = min(deadline, budget.deadline)
     segments: list[np.ndarray] = []
     produced = 0
     budget_dev = jnp.int32(max_new)
     first_done = first_token == jnp.int32(eos_id)
     cur = run_dispatch(
         lambda: dispatch(first_token, start_valid, budget_dev, first_done),
-        retry, deadline)
+        retry, deadline, budget=budget)
     while True:
         out, steps, last, valid, done = cur
         budget_dev = budget_dev - steps
@@ -275,16 +311,28 @@ def decode_segments(
         # (and the gather/scatter around it via the engines' all-done
         # cond), costing microseconds.
         timed_out = time.monotonic() > deadline
+        cancelled = budget is not None and budget.token.cancelled
         nxt = (run_dispatch(lambda: dispatch(last, valid, budget_dev, done),
-                            retry, deadline)
+                            retry, deadline, budget=budget)
                if produced + DECODE_SEGMENT < max_new and not timed_out
+               and not cancelled
                else None)
-        steps_n = int(steps)  # forces completion of the segment
-        segments.append(np.asarray(out)[:, :steps_n])
+
+        # The segment's host sync is the blocking wait a wedged device
+        # program freezes — it goes through the watchdog seam, not a
+        # raw np.asarray (the deadline-seam contract for every blocking
+        # device wait in the serving paths).
+        def read_segment(steps=steps, out=out, done=done):
+            n = int(steps)  # forces completion of the segment
+            return n, np.asarray(out)[:, :n], bool(np.all(np.asarray(done)))
+
+        steps_n, seg, all_done = host_sync(read_segment, budget, "decode")
+        segments.append(seg)
         produced += steps_n
-        all_done = bool(np.all(np.asarray(done)))
         if produced >= max_new or all_done:
             break
+        if cancelled:
+            budget.check()  # raises Cancelled with the drain/abort reason
         if timed_out:
             raise TimeoutError(
                 f"generation timed out after {timeout_s:.0f}s "
