@@ -201,6 +201,21 @@ def child() -> int:
         med, spread, repeats = timed_repeats(run_once)
         s = engine.last_stats
         label = config_label(quant, kv_layout)
+        # Path provenance (ISSUE 3): which einsum dispatches compiled to
+        # the fused w4a16 kernels vs the XLA dequant fallback — the
+        # window's int4 number must be attributable to the kernel, and
+        # every decline carries an explicit fallback_reason.
+        int4_paths = None
+        if quant == "int4":
+            rep = engine.int4_path_report()
+            if rep is not None:
+                int4_paths = {
+                    "pallas_w4a16": sorted(
+                        {e["spec"] for e in rep["pallas_w4a16"]}),
+                    "xla_dequant": sorted(
+                        {(e["spec"], e.get("fallback_reason", ""))
+                         for e in rep["xla_dequant"]}),
+                }
         run = {
             "label": label,
             "quant": quant,
@@ -214,6 +229,7 @@ def child() -> int:
             "warmup_s": round(warmup_s, 1),
             "param_bytes": param_bytes,
             "repeats": repeats,
+            **({"int4_paths": int4_paths} if int4_paths else {}),
             "spread": {
                 "decode_tps": [round(spread["decode_tps"][0], 2),
                                round(spread["decode_tps"][1], 2)],
@@ -252,9 +268,11 @@ def child() -> int:
     # record is printed the moment it lands; the headline (fastest) is
     # printed LAST under the same STABLE metric key (round-over-round
     # comparisons track the key). int4 measures FIRST: it is the config
-    # whose number is newest (the fused Pallas kernels have never run
-    # compiled), and windows die mid-bench often enough that the
-    # least-replaceable measurement must land before the re-measures.
+    # whose number is newest (the shard-aware fused kernels are what the
+    # window exists to price), and windows die mid-bench often enough
+    # that the least-replaceable measurement must land before the
+    # re-measures. Its record carries `int4_paths` so the number is
+    # attributable to the kernel path, never a silent XLA fallback.
     runs: list[dict] = []
     for quant, kv_layout in (("int4", "contiguous"),
                              ("none", "contiguous"),

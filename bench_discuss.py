@@ -65,6 +65,22 @@ def child() -> int:
     max_new = 48 if on_cpu else 160
     rounds = 5
 
+    # Sampler provenance (ISSUE 3 satellite): config 2 records WHICH
+    # sampler path its decode ran — greedy (the temp=0 default), the
+    # sort-free candidate-pool fast path, or the exact full-vocab sort —
+    # so the unmeasured sort-free sampler gets an attributable number in
+    # the same window. Flip the env knobs to measure the sampled paths:
+    # ROUNDTABLE_BENCH_TEMPERATURE=0.7 [ROUNDTABLE_BENCH_TOP_P=0.95,
+    # ROUNDTABLE_BENCH_TOP_K=40] turns the run sort-free;
+    # ROUNDTABLE_BENCH_TOP_K>128 forces the sort fallback.
+    temp = float(os.environ.get("ROUNDTABLE_BENCH_TEMPERATURE", "0.0"))
+    top_p = float(os.environ.get("ROUNDTABLE_BENCH_TOP_P", "1.0"))
+    top_k = int(os.environ.get("ROUNDTABLE_BENCH_TOP_K", "0"))
+    from theroundtaible_tpu.engine.sampling import (SamplingParams,
+                                                    sampler_mode)
+    mode = sampler_mode([SamplingParams(temperature=temp, top_k=top_k,
+                                        top_p=top_p)])
+
     real_parse = {"count": 0, "ok": 0, "seconds": 0.0}
 
     class ScriptedConsensusAdapter(TpuLlmAdapter):
@@ -106,7 +122,8 @@ def child() -> int:
 
     adapter = ScriptedConsensusAdapter(
         "tpu-llm", {"model": model, "max_seq_len": max_seq, "num_slots": 4,
-                    "sampling": {"temperature": 0.0,
+                    "sampling": {"temperature": temp, "top_k": top_k,
+                                 "top_p": top_p,
                                  "max_new_tokens": max_new}})
 
     config = RoundtableConfig(
@@ -153,8 +170,14 @@ def child() -> int:
     reused = agg["reused_tokens"]
     reuse_pct = 100.0 * reused / max(prefill + reused, 1)
 
+    # The stable greedy metric key is unchanged; a sampled run (the env
+    # knobs above) lands under a mode-suffixed key so the two never
+    # collide in per-key dedup and each stays attributable.
+    metric_key = f"discuss_wall_clock_3knight_{rounds}round[{model}]"
+    if mode != "greedy":
+        metric_key += f"[{mode}]"
     result_line = {
-        "metric": f"discuss_wall_clock_3knight_{rounds}round[{model}]",
+        "metric": metric_key,
         "value": round(wall, 2),
         "unit": "seconds",
         "vs_baseline": round(A100_OLLAMA_DISCUSS_WALL_S / max(wall, 1e-9),
@@ -169,6 +192,10 @@ def child() -> int:
             "warmup_s": round(warmup_s, 1),
             "engine_wall_s": totals.get("wall_s"),
             "platform": jax.devices()[0].platform,
+            # Per-run sampler attribution: greedy / sort-free / sort
+            # (engine/sampling.sampler_mode) + the knobs that chose it.
+            "sampler": {"mode": mode, "temperature": temp,
+                        "top_k": top_k, "top_p": top_p},
             # Scores are scripted (random weights can't emit the JSON
             # block) but the full parse→validate path ran inside the
             # wall on every turn via a forced continuation:

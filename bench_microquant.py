@@ -108,7 +108,7 @@ def child() -> int:
         return jnp.einsum("be,ef->bf", a, w.reshape(E, F),
                           preferred_element_type=jnp.float32)
 
-    def timed(name, fn, args, streamed_bytes):
+    def timed(name, fn, args, streamed_bytes, extra=None):
         """Each iteration's activation is perturbed by (prev_out · 0) so
         every dispatch DEPENDS on the previous one: window #2 measured
         physically impossible rates (head-bf16 "8.4 TB/s" vs the ~819
@@ -140,6 +140,7 @@ def child() -> int:
                 "us_per_call": round(dt * 1e6, 1),
                 "streamed_mb": round(streamed_bytes / 1e6, 2),
                 "effective_gbps": round(streamed_bytes / dt / 1e9, 1),
+                **(extra or {}),
             }), flush=True)
         except Exception as e:  # a variant crashing is itself the data
             print(json.dumps({"variant": name, "platform": platform,
@@ -156,12 +157,31 @@ def child() -> int:
         assert y is not None, "kernel declined MLP shape"
         return y
 
+    def timed_kernel(name, fn, args, streamed_bytes, spec, a_shape,
+                     klf):
+        """Kernel variants carry PATH PROVENANCE (ISSUE 3): a shape the
+        plan declines emits an explicit fallback_reason record instead
+        of crashing the whole child — the window's numbers stay
+        attributable either way."""
+        reason = int4mm.plan_reason(spec, a_shape, klf)
+        if reason:
+            print(json.dumps({"variant": name, "platform": platform,
+                              "path": "xla_dequant",
+                              "fallback_reason": reason}), flush=True)
+            return
+        timed(name, fn, args, streamed_bytes,
+              extra={"path": "pallas_w4a16"})
+
+    # Kernel variants measure FIRST (window ordering, ISSUE 3): they are
+    # the least-replaceable numbers — a child killed mid-run has already
+    # landed the records the window exists for.
+    i4_bytes = leaf.q4.size + leaf.s4.size * 2
+    timed_kernel("int4-kernel", f_int4_kernel, (a, leaf.q4, leaf.s4),
+                 i4_bytes, "be,ef->bf", (1, E), leaf)
     timed("bf16", f_bf16, (a, w), w.size * 2)
     timed("int8", f_int8, (a, q8["q"], q8["s"]),
           q8["q"].size + q8["s"].size * 2)
-    i4_bytes = leaf.q4.size + leaf.s4.size * 2
     timed("int4", f_int4, (a, leaf.q4, leaf.s4), i4_bytes)
-    timed("int4-kernel", f_int4_kernel, (a, leaf.q4, leaf.s4), i4_bytes)
     try:
         qs4 = to_s4(leaf.q4)
         jax.block_until_ready(qs4)
@@ -207,12 +227,14 @@ def child() -> int:
         assert y is not None, "kernel declined head shape"
         return y
 
+    timed_kernel("head-int4-kernel", h_int4_kernel,
+                 (a, hleaf.q4, hleaf.s4),
+                 hleaf.q4.size + hleaf.s4.size * 2, "be,ve->bv", (1, E),
+                 hleaf)
     timed("head-bf16", h_bf16, (a, head), head.size * 2)
     timed("head-int8", h_int8, (a, h8["q"], h8["s"]),
           h8["q"].size + h8["s"].size * 2)
     timed("head-int4", h_int4, (a, hleaf.q4, hleaf.s4),
-          hleaf.q4.size + hleaf.s4.size * 2)
-    timed("head-int4-kernel", h_int4_kernel, (a, hleaf.q4, hleaf.s4),
           hleaf.q4.size + hleaf.s4.size * 2)
     return 0
 

@@ -83,6 +83,46 @@ def pytest_runtest_call(item):
             signal.signal(signal.SIGALRM, old_handler)
 
 
+@pytest.fixture(autouse=True)
+def _quant_kernel_guard(request, monkeypatch):
+    """Tier-1 guard for @pytest.mark.quant_kernels (ISSUE 3 satellite):
+    a test that CLAIMS w4a16 kernel-path coverage must not silently run
+    the XLA dequant fallback — every declined dispatch is recorded and
+    any reason outside the marker's `allow=(...)` whitelist fails the
+    test loud with the fallback_reason. Unmarked tests are untouched."""
+    marker = request.node.get_closest_marker("quant_kernels")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine.pallas import int4mm
+
+    declines: list[tuple] = []
+    orig_single = int4mm.einsum_int4_or_reason
+    orig_spmd = int4mm.einsum_int4_spmd
+
+    def spy_single(spec, a, leaf):
+        y, reason = orig_single(spec, a, leaf)
+        if y is None:
+            declines.append((spec, tuple(a.shape), reason))
+        return y, reason
+
+    def spy_spmd(mesh, spec, a, leaf, tp=None):
+        y, reason = orig_spmd(mesh, spec, a, leaf, tp=tp)
+        if y is None:
+            declines.append((spec, tuple(a.shape), reason))
+        return y, reason
+
+    monkeypatch.setattr(int4mm, "einsum_int4_or_reason", spy_single)
+    monkeypatch.setattr(int4mm, "einsum_int4_spmd", spy_spmd)
+    yield
+    allowed = tuple(marker.kwargs.get("allow", ()))
+    unexpected = [d for d in declines
+                  if not any(a in (d[2] or "") for a in allowed)]
+    assert not unexpected, (
+        "quant_kernels-marked test silently fell back to xla_dequant "
+        f"(spec, a_shape, fallback_reason): {unexpected}")
+
+
 @pytest.fixture
 def project_root(tmp_path):
     """A scratch project dir with a .roundtable skeleton."""

@@ -7,6 +7,13 @@ The kernels compute bit-identical dequantized weights (same nibble
 extraction, same grouped scale in the activation dtype); only the f32
 accumulation ORDER differs (blocked), so comparisons allow float-order
 tolerance, and greedy token parity must hold end to end.
+
+Shard-aware coverage (ISSUE 3): einsum_int4_spmd parity on virtual
+(data, model) meshes across even AND uneven shard counts, non-dividing
+group sizes, and every decode-hot projection spec — plus the
+shard-aligned group selection quantize_params emits. Kernel-claiming
+tests carry @pytest.mark.quant_kernels: the conftest guard fails them
+loud on any silent XLA fallback.
 """
 
 from __future__ import annotations
@@ -20,7 +27,8 @@ from theroundtaible_tpu.engine.models.common import (Int4Leaf, ModelConfig,
                                                      dequant_int4,
                                                      init_params, forward)
 from theroundtaible_tpu.engine.pallas import int4mm
-from theroundtaible_tpu.engine.quant import (_quantize_leaf_int4,
+from theroundtaible_tpu.engine.quant import (_int4_group_for,
+                                             _quantize_leaf_int4,
                                              quantize_params)
 
 
@@ -59,6 +67,7 @@ CASES = [
 ]
 
 
+@pytest.mark.quant_kernels
 @pytest.mark.parametrize("spec,ashape,wshape", CASES)
 def test_kernel_matches_xla_dequant(spec, ashape, wshape):
     leaf = _leaf(wshape)
@@ -72,6 +81,7 @@ def test_kernel_matches_xla_dequant(spec, ashape, wshape):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.quant_kernels
 def test_bf16_activations_match():
     spec, ashape, wshape = CASES[0]
     leaf = _leaf(wshape, dtype=jnp.bfloat16)
@@ -94,6 +104,7 @@ def test_declines_unblockable_and_moe():
     assert int4mm.einsum_int4("bte,ex->btx", a, tiny) is None
 
 
+@pytest.mark.quant_kernels
 def test_tpu_mosaic_lowering(monkeypatch):
     """Cross-lower every kernel shape class for the TPU platform WITHOUT
     a chip: Mosaic runs in jaxlib at lowering time, so layout/op-support
@@ -133,13 +144,15 @@ BLOCKABLE = ModelConfig(
     max_seq_len=64, tie_embeddings=True)
 
 
+@pytest.mark.quant_kernels
 def test_engine_serving_token_parity(monkeypatch):
     """The kernels inside the REAL serving path — engine build, slot
     cache, jitted decode while_loop with donated buffers — not just a
     bare forward: greedy generations must be identical with the kernel
     forced on vs off. Dims chosen so every matmul takes the kernel path
     (registry tiny models decline on block sizes, which would make this
-    vacuous)."""
+    vacuous). Mesh pinned to one device — the sharded serving path has
+    its own test below."""
     import dataclasses
 
     from theroundtaible_tpu.engine.engine import InferenceEngine
@@ -151,12 +164,211 @@ def test_engine_serving_token_parity(monkeypatch):
         monkeypatch.setenv("ROUNDTABLE_INT4_MM", flag)
         eng = InferenceEngine(
             cfg, num_slots=2, quant="int4",
+            mesh_shape={"data": 1, "model": 1},
             sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
         outs[flag] = eng.generate("knights debate the packed nibbles",
                                   slot_name="k", max_new_tokens=8)
     assert outs["1"] == outs["0"]
 
 
+# --- shard-aware dispatch (einsum_int4_spmd, ISSUE 3) ---
+
+
+def _mesh(shape, axes=("data", "model")):
+    n = int(np.prod(shape))
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return jax.sharding.Mesh(
+        np.array(jax.devices()[:n]).reshape(shape), axes)
+
+
+# Every decode-hot projection spec with its TP convention; dims sized so
+# per-shard blocks exist up to a 4-way model axis (local lane dim 128).
+SPMD_CASES = [
+    ("bte,ef->btf", "col", (2, 3, 256), (256, 1024)),     # gate/up
+    ("btf,fe->bte", "row", (2, 3, 1024), (1024, 256)),    # down (+psum)
+    ("bte,ehd->bthd", "col", (1, 3, 256), (256, 8, 128)),  # qkv
+    ("bthd,hde->bte", "row", (1, 3, 8, 128), (8, 128, 256)),  # o (+psum)
+    ("bte,ve->btv", "col", (2, 1, 256), (512, 256)),      # tied lm head
+]
+
+
+@pytest.mark.quant_kernels
+@pytest.mark.parametrize("spec,tp,ashape,wshape", SPMD_CASES)
+@pytest.mark.parametrize("mesh_shape", [(1, 2), (2, 2), (1, 4)])
+def test_spmd_kernel_matches_xla_dequant(spec, tp, ashape, wshape,
+                                         mesh_shape):
+    mesh = _mesh(mesh_shape)
+    shards = mesh_shape[1]
+    w = jax.random.normal(jax.random.PRNGKey(0), wshape,
+                          dtype=jnp.float32) * 0.1
+    leaf = _quantize_leaf_int4(w, (0,), jnp.float32, False, 64, shards)
+    assert isinstance(leaf, Int4Leaf)
+    a = jax.random.normal(jax.random.PRNGKey(1), ashape,
+                          dtype=jnp.float32)
+    got, reason = int4mm.einsum_int4_spmd(mesh, spec, a, leaf, tp=tp)
+    assert got is not None, f"spmd dispatch declined: {reason}"
+    want = _xla_ref(spec, a, leaf)
+    assert got.shape == want.shape and got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.quant_kernels
+@pytest.mark.parametrize("group", [64, 32, 16])
+def test_spmd_kernel_non_dividing_groups(group):
+    """Group sizes that don't divide 128-lane blocks evenly into shards
+    still serve on the kernel (the plan checks bp % gp per shard)."""
+    mesh = _mesh((1, 2))
+    w = jax.random.normal(jax.random.PRNGKey(2), (256, 512)) * 0.1
+    leaf = _quantize_leaf_int4(w, (0,), jnp.float32, False, group, 2)
+    a = jax.random.normal(jax.random.PRNGKey(3), (2, 3, 256))
+    got, reason = int4mm.einsum_int4_spmd(mesh, "bte,ef->btf", a, leaf,
+                                          tp="col")
+    assert got is not None, reason
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_xla_ref("bte,ef->btf", a,
+                                                   leaf)),
+                               rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.quant_kernels
+def test_spmd_kernel_uneven_shard_count():
+    """A model axis that does NOT divide the weight's shard axis (8
+    heads over 3 shards) replicates — matching _fallback_replicated's
+    placement — and still runs the kernel, not the XLA fallback."""
+    mesh = _mesh((1, 3))
+    spec, tp, ashape, wshape = SPMD_CASES[2]
+    w = jax.random.normal(jax.random.PRNGKey(4), wshape) * 0.1
+    leaf = _quantize_leaf_int4(w, (0,), jnp.float32, False, 64, 3)
+    a = jax.random.normal(jax.random.PRNGKey(5), ashape)
+    got, reason = int4mm.einsum_int4_spmd(mesh, spec, a, leaf, tp=tp)
+    assert got is not None, reason
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_xla_ref(spec, a, leaf)),
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_spmd_declines_with_reason():
+    """Declines surface machine-readable reasons — prefill-M rows, MoE
+    expert specs, and per-shard blocks too small to serve."""
+    mesh = _mesh((1, 2))
+    leaf = _leaf((256, 1024))
+    big_a = jax.random.normal(jax.random.PRNGKey(6), (2, 64, 256))
+    y, reason = int4mm.einsum_int4_spmd(mesh, "bte,ef->btf", big_a, leaf,
+                                        tp="col")
+    assert y is None and "prefill-m" in reason
+    moe = _leaf((2, 256, 512))
+    a = jax.random.normal(jax.random.PRNGKey(7), (1, 3, 256))
+    y, reason = int4mm.einsum_int4_spmd(mesh, "bte,xef->btxf", a, moe)
+    assert y is None and reason.startswith("spec:")
+    # per-shard kept dim below the smallest block on an 8-way axis
+    mesh8 = _mesh((1, 8))
+    small = _leaf((256, 512))
+    y, reason = int4mm.einsum_int4_spmd(mesh8, "bte,ef->btf",
+                                        jax.random.normal(
+                                            jax.random.PRNGKey(8),
+                                            (2, 3, 256)),
+                                        small, tp="col")
+    assert y is None and "sharded" in reason
+
+
+def test_shard_aligned_group_selection():
+    """quantize_params(model_shards=m) must emit groups dividing the
+    PER-SHARD pack dim for leaves whose pack axis is model-sharded
+    (dense gate/up), so no group straddles a shard boundary."""
+    assert _int4_group_for(512, 64, 1) == 64
+    assert _int4_group_for(512, 64, 4) == 64    # 128 per shard
+    assert _int4_group_for(768, 64, 4) == 64    # 192 per shard → 64 | 192
+    assert _int4_group_for(768, 40, 4) == 32    # largest even g | 192
+    assert _int4_group_for(8, 64, 4) == 2
+    cfg = BLOCKABLE
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qp = quantize_params(params, cfg, act_dtype=jnp.float32, bits=4,
+                         model_shards=2)
+    gate = qp["layers"][0]["gate_proj"]
+    assert isinstance(gate, Int4Leaf)
+    assert (cfg.mlp_dim // 2) % gate.group == 0
+    # q4/s4 both divide on the sharded pack axis — co-partitionable
+    assert gate.q4.shape[-1] % 2 == 0 and gate.s4.shape[-1] % 2 == 0
+
+
+SHARDED = ModelConfig(
+    name="int4mm-spmd-test", vocab_size=512, num_layers=2, embed_dim=256,
+    num_heads=4, num_kv_heads=4, head_dim=128, mlp_dim=512,
+    max_seq_len=128, tie_embeddings=True)
+
+
+@pytest.mark.quant_kernels(allow=("rows:prefill-m",))
+def test_engine_sharded_serving_token_parity(monkeypatch):
+    """The tentpole end to end on the MAIN engine: a real TP mesh
+    (model=2), int4 params quantized shard-aligned, decode through the
+    jitted while_loop — greedy tokens identical with the kernels forced
+    on vs off, and the path-provenance report shows every decode-hot
+    projection on the kernel path (guard: any non-prefill-M fallback
+    fails loud)."""
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    outs, eng = {}, None
+    for flag in ("1", "0"):
+        monkeypatch.setenv("ROUNDTABLE_INT4_MM", flag)
+        e = InferenceEngine(
+            SHARDED, num_slots=2, quant="int4",
+            mesh_shape={"data": 1, "model": 2},
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=8))
+        outs[flag] = e.generate("knights shard the packed nibbles",
+                                slot_name="k", max_new_tokens=8)
+        if flag == "1":
+            eng = e
+    assert outs["1"] == outs["0"]
+    rep = eng.int4_path_report()
+    kernel_specs = {x["spec"] for x in rep["pallas_w4a16"]}
+    for s in ("bte,ehd->bthd", "bte,ekd->btkd", "bthd,hde->bte",
+              "bte,ef->btf", "btf,fe->bte", "bte,ve->btv"):
+        assert s in kernel_specs, (s, rep)
+    assert eng.describe()["int4_paths"] == rep
+    # stats plumbing: the per-call snapshot carries the same report
+    _, stats = eng.generate_batch_with_stats(
+        [("k", "and continue the debate")], max_new_tokens=4)
+    assert stats.int4_paths["pallas_w4a16"]
+
+
+@pytest.mark.quant_kernels(allow=("rows:prefill-m",))
+def test_pp_pipe_only_int4_kernel_path(monkeypatch):
+    """PP stage bodies on a pipe-only mesh announce LOCAL_MESH (fully
+    manual → arrays local and full-size), so int4 serves on the raw
+    kernels inside the stages AND on the in-stage decode lm head —
+    token parity vs the XLA path, provenance asserted."""
+    import dataclasses
+
+    from theroundtaible_tpu.engine.pp_serving import PPEngine
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = dataclasses.replace(SHARDED, max_seq_len=256)
+    outs, eng = {}, None
+    for flag in ("1", "0"):
+        monkeypatch.setenv("ROUNDTABLE_INT4_MM", flag)
+        e = PPEngine(cfg, n_stages=2, n_model=1, n_micro=2, num_slots=2,
+                     quant="int4", devices=[0, 1],
+                     sampling=SamplingParams(temperature=0.0,
+                                             max_new_tokens=6))
+        outs[flag] = e.generate("pipeline the packed nibbles",
+                                slot_name="pp", max_new_tokens=6)
+        if flag == "1":
+            eng = e
+    assert outs["1"] == outs["0"]
+    rep = eng.int4_path_report()
+    kernel_specs = {x["spec"] for x in rep["pallas_w4a16"]}
+    assert "bte,ve->btv" in kernel_specs, rep   # in-stage decode head
+    assert "bte,ef->btf" in kernel_specs, rep   # stage-scan MLP
+
+
+@pytest.mark.quant_kernels
 def test_model_forward_token_parity(monkeypatch):
     """Full int4 forward with the kernel on vs off: same greedy tokens,
     close logits. Dims chosen so every matmul takes the kernel path.

@@ -101,6 +101,71 @@ def test_paged_vmem_budget_shrinks_or_declines():
     assert not paged_prefill_supported(2048, 512, 512, 16, 16)
 
 
+# gemma-2b-shaped w4a16 matmuls, sharded: every decode-hot projection
+# class with its TP convention (sharding.int4_shard_axis), at dims whose
+# PER-SHARD blocks exist on a 4-way model axis.
+INT4_SPMD_CASES = [
+    ("bte,ef->btf", "col", (1, 1, 2048), (2048, 16384)),     # mlp up/gate
+    ("btf,fe->bte", "row", (1, 1, 16384), (16384, 2048)),    # mlp down
+    ("bte,ehd->bthd", "col", (1, 1, 2048), (2048, 8, 256)),  # qkv
+    ("bthd,hde->bte", "row", (1, 1, 8, 256), (8, 256, 2048)),  # o_proj
+    ("bte,ve->btv", "col", (1, 1, 2048), (32768, 2048)),     # lm head
+]
+
+
+@pytest.mark.quant_kernels
+@pytest.mark.parametrize("spec,tp,ashape,wshape", INT4_SPMD_CASES)
+def test_int4_spmd_lowers_on_data_model_mesh(spec, tp, ashape, wshape,
+                                             monkeypatch):
+    """Chipless Mosaic lowering of the shard-aware w4a16 dispatch
+    (ISSUE 3): the per-shard kernels inside shard_map — including the
+    row-parallel psum — must cross-lower for TPU without a chip, same
+    discipline as the attention spmd wrappers above."""
+    from theroundtaible_tpu.engine.models.common import Int4Leaf
+    from theroundtaible_tpu.engine.pallas import int4mm
+    from theroundtaible_tpu.engine.quant import _quantize_leaf_int4
+
+    monkeypatch.setattr(int4mm, "_interpret", lambda: False)
+    mesh = _mesh((2, 4), ("data", "model"))
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal(wshape).astype(np.float32) * 0.02,
+                    jnp.bfloat16)
+    leaf = _quantize_leaf_int4(w, (0,), jnp.bfloat16, False, 64, 4)
+    assert isinstance(leaf, Int4Leaf)
+    a = jnp.asarray(rng.standard_normal(ashape).astype(np.float32),
+                    jnp.bfloat16)
+
+    def f(a, q4, s4):
+        y, reason = int4mm.einsum_int4_spmd(
+            mesh, spec, a,
+            Int4Leaf(q4=q4, s4=s4, axis=leaf.axis, group=leaf.group),
+            tp=tp)
+        assert y is not None, f"spmd dispatch declined {spec}: {reason}"
+        return y
+
+    _lower_tpu(f, a, leaf.q4, leaf.s4)
+
+
+def test_int4_vmem_budget_declines_not_mosaic():
+    """Oversized shapes must decline BEFORE any pallas_call is emitted —
+    the plan's VMEM estimate is the runtime guarantee that no dispatch
+    can reach a Mosaic allocation failure on chip (acceptance: every
+    kernel dispatch has a budget estimate that declines to XLA)."""
+    from theroundtaible_tpu.engine.pallas.int4mm import (
+        _plan_pack_contract, _plan_pack_out)
+    # healthy decode shapes plan fine
+    assert _plan_pack_out(8, 2048, 8192, 32)[0] is not None
+    assert _plan_pack_contract(8, 1024, 32768, 32)[0] is not None
+    # the accumulators span the full output axis: a huge P overruns
+    plan, reason = _plan_pack_out(64, 2048, 1 << 21, 32)
+    assert plan is None and reason.startswith("vmem:")
+    # contract kernel: whole-cp operand blocks overrun at huge cp
+    plan, reason = _plan_pack_contract(64, 1 << 15, 512, 32)
+    assert plan is None and reason.startswith("vmem:")
+    # prefill-M cap stays a distinct, expected reason
+    assert _plan_pack_out(128, 2048, 8192, 32)[1] == "rows:prefill-m"
+
+
 @pytest.mark.parametrize("pool_replicas", [1, 2])
 def test_paged_spmd_lowers_pool_direct(pool_replicas):
     """The pool-direct paged path, incl. per-replica page pools

@@ -251,6 +251,12 @@ class TpuLlmAdapter(BaseAdapter):
             "prefill_tps": round(stats.prefill_tps, 1),
             "decode_tps": round(stats.decode_tps, 1),
         }
+        if stats.int4_paths is not None:
+            # Path provenance (ISSUE 3): which einsum dispatches ran the
+            # fused w4a16 kernels vs the XLA dequant fallback — rides the
+            # per-turn engine stats into metrics.json so a window's int4
+            # numbers are attributable.
+            self._last_stats["int4_paths"] = stats.int4_paths
         if self.last_degradation:
             self._last_stats["degraded"] = self.last_degradation
         if self.last_recovered_kind:
@@ -361,6 +367,7 @@ class TpuLlmAdapter(BaseAdapter):
                 self._revive_best_effort(engine)
                 continue
             responses.append(out[0])
+            total.int4_paths = stats.int4_paths
             total.prefill_tokens += stats.prefill_tokens
             total.reused_tokens += stats.reused_tokens
             total.decode_tokens += stats.decode_tokens

@@ -45,6 +45,23 @@ from .tokenizer import load_tokenizer
 MIN_SHARED_PREFIX = 64
 
 
+def summarize_int4_paths(dispatches: dict) -> dict:
+    """Fold the trace-time int4 dispatch log (models/common._record_int4
+    entries) into the path-provenance report describe()/stats expose:
+    {"pallas_w4a16": [entry...], "xla_dequant": [entry...]} with each
+    entry carrying spec/shapes (and `fallback_reason` on the XLA side).
+    Shared with the PP engine."""
+    kernel, fallback = [], []
+    for e in dispatches.values():
+        (kernel if e["path"] == "pallas_w4a16" else fallback).append(e)
+
+    def order(e):
+        return (e["spec"], e["a_shape"])
+
+    return {"pallas_w4a16": sorted(kernel, key=order),
+            "xla_dequant": sorted(fallback, key=order)}
+
+
 @dataclass
 class GenStats:
     prefill_tokens: int = 0
@@ -52,6 +69,11 @@ class GenStats:
     decode_tokens: int = 0
     prefill_seconds: float = 0.0
     decode_seconds: float = 0.0
+    # int4 path provenance (ISSUE 3): which path each compiled einsum
+    # dispatch took — {"pallas_w4a16": [...], "xla_dequant": [...]}.
+    # Populated at trace time, snapshotted per call; None on non-int4
+    # engines.
+    int4_paths: Optional[dict] = None
 
     @property
     def prefill_tps(self) -> float:
@@ -104,6 +126,11 @@ class InferenceEngine:
             raise ValueError(
                 f"quant must be none|int8|int4, got {quant!r}")
         self.quant = quant
+        # int4 path-provenance sink: the trace-time dispatch log every
+        # spmd_mesh context below carries (models/common._record_int4) —
+        # populated as each (batch, bucket) program traces, summarized
+        # by int4_path_report()/describe().
+        self._int4_dispatches: dict = {}
 
         if checkpoint:
             from .checkpoint import load_hf_checkpoint
@@ -123,10 +150,15 @@ class InferenceEngine:
             # free_source: nothing references the bf16 tree after this, so
             # each source leaf is freed as its q lands — 7B-class int8
             # builds peak near bf16-total instead of bf16+int8.
+            # model_shards: int4 packing aligns groups to the TP shard
+            # boundary so the shard-aware kernel dispatch can partition
+            # scales with whole groups per shard (engine/quant.py).
             from .quant import quantize_params
+            from .sharding import model_axis_size
             self.params = quantize_params(
                 self.params, model_cfg, act_dtype=dtype,
-                free_source=True, bits=8 if quant == "int8" else 4)
+                free_source=True, bits=8 if quant == "int8" else 4,
+                model_shards=model_axis_size(self.mesh))
         self.num_params = param_count(self.params)
 
         if kv_layout not in ("contiguous", "paged"):
@@ -286,7 +318,7 @@ class InferenceEngine:
                          lengths):
             # spmd_mesh is a TRACE-time context: it tells attention() which
             # mesh to shard_map the Pallas kernels over (models/common.py).
-            with spmd_mesh(mesh):
+            with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
                 caches_b = [(k[slot_idx], v[slot_idx])
                             for k, v in cache_layers]
                 t = tokens.shape[1]
@@ -355,7 +387,7 @@ class InferenceEngine:
 
             state = (jnp.int32(0), first_token, start_valid, done, out,
                      caches, key)
-            with spmd_mesh(mesh):
+            with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
                 step, last, valid, done, out, caches, _ = \
                     jax.lax.while_loop(cond, body, state)
             step, last, valid, done, out = host_read(
@@ -474,7 +506,7 @@ class InferenceEngine:
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step_paged(params, pools, tables, tokens, offsets,
                                    lengths):
-                with spmd_mesh(mesh):
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
                     b, t = tokens.shape
                     caches_b = gather_view(pools, tables, b)
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
@@ -489,7 +521,7 @@ class InferenceEngine:
             def prefill_step_paged_direct(params, pools, tables, tokens,
                                           offsets, lengths):
                 from .paged_forward import forward_paged
-                with spmd_mesh(mesh):
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
                     t = tokens.shape[1]
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
                     valid = offsets + lengths
@@ -796,6 +828,17 @@ class InferenceEngine:
         rows = -(-b // max(self.kv.data_size, 1))
         return ((self.kv.pages_per_replica() // max(rows, 1))
                 * self.kv.page_size - DECODE_SEGMENT)
+
+    def int4_path_report(self) -> Optional[dict]:
+        """Which path each int4 einsum dispatch COMPILED to (ISSUE 3):
+        {"pallas_w4a16": [...], "xla_dequant": [{..., "fallback_reason"}]}
+        keyed by (spec, shapes). Populated at trace time — warmup or the
+        first serve of each (batch, bucket) shape — so bench windows can
+        attribute their numbers to the kernel, not a silent fallback.
+        None on non-int4 engines."""
+        if self.quant != "int4":
+            return None
+        return summarize_int4_paths(self._int4_dispatches)
 
     def revive_kv_if_dead(self) -> bool:
         """Reallocate KV buffers killed by a failed donated dispatch
@@ -1277,6 +1320,7 @@ class InferenceEngine:
             turns, first_np, out_np, all_tokens, max_new,
             self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
             stats)
+        stats.int4_paths = self.int4_path_report()
         self.last_stats = stats
         return results, stats
 
@@ -1295,6 +1339,8 @@ class InferenceEngine:
                       else self.quant),
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
+        if self.quant == "int4":
+            info["int4_paths"] = self.int4_path_report()
         if self.kv_layout == "paged":
             info["page_size"] = self.kv.page_size
             info["num_pages"] = self.kv.num_pages

@@ -219,8 +219,8 @@ def make_ring_prefill(cfg: ModelConfig, mesh: Mesh, scheme: str = "ring"):
             else:
                 core = ring_attention(q, k, v, q_pos, q_pos, lengths, cfg,
                                       SEQ_AXIS, n)
-            out = _einsum("bthd,hde->bte", core,
-                          layer["o_proj"]).astype(h.dtype)
+            out = _einsum("bthd,hde->bte", core, layer["o_proj"],
+                          tp="row").astype(h.dtype)
             return out, (k, v)
 
         caches = []
@@ -234,7 +234,7 @@ def make_ring_prefill(cfg: ModelConfig, mesh: Mesh, scheme: str = "ring"):
         last_h = jnp.einsum("bt,bte->be", hit, x.astype(jnp.float32))
         last_h = jax.lax.psum(last_h, SEQ_AXIS)
         head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
-        logits = _einsum("be,ve->bv", last_h, head)
+        logits = _einsum("be,ve->bv", last_h, head, tp="col")
         logits = _softcap(logits, cfg.final_logit_softcap)
         return logits, caches
 

@@ -44,16 +44,22 @@ _MESH_CTX = _threading.local()
 
 
 class spmd_mesh:
-    """Context manager announcing the mesh the enclosing jit traces under."""
+    """Context manager announcing the mesh the enclosing jit traces
+    under. `int4_sink`, when given, is a dict the int4 einsum dispatch
+    records path provenance into at TRACE time (one entry per distinct
+    (spec, shapes) dispatch — see _record_int4): engines pass their own
+    dict so describe()/stats can report which path each compiled
+    dispatch actually took."""
 
-    def __init__(self, mesh):
+    def __init__(self, mesh, int4_sink=None):
         self.mesh = mesh
+        self.int4_sink = int4_sink
 
     def __enter__(self):
         stack = getattr(_MESH_CTX, "stack", None)
         if stack is None:
             stack = _MESH_CTX.stack = []
-        stack.append(self.mesh)
+        stack.append((self.mesh, self.int4_sink))
         return self.mesh
 
     def __exit__(self, *exc):
@@ -63,7 +69,52 @@ class spmd_mesh:
 
 def current_spmd_mesh():
     stack = getattr(_MESH_CTX, "stack", None)
-    return stack[-1] if stack else None
+    return stack[-1][0] if stack else None
+
+
+def _current_int4_sink():
+    stack = getattr(_MESH_CTX, "stack", None)
+    return stack[-1][1] if stack else None
+
+
+class _ManualLocalMesh:
+    """Mesh sentinel for FULLY-MANUAL regions (the PP engine's stage
+    bodies on pipe-only meshes): every array there is device-local and
+    full-size, so single-device kernel dispatch is correct even though
+    the enclosing program spans many devices. `size` mirrors Mesh so
+    every existing `mesh.size` branch takes its single-device arm.
+    Distinct from an UNSET context — "no announcement" still must never
+    be mistaken for "single device" (a trace under GSPMD with no
+    context keeps the XLA path)."""
+
+    size = 1
+
+    def __repr__(self):
+        return "ManualLocalMesh()"
+
+
+LOCAL_MESH = _ManualLocalMesh()
+
+
+# Path-provenance labels for int4 einsum dispatches (ISSUE 3): the next
+# hardware window's numbers must be attributable to the kernel, not a
+# silent fallback, so every Int4Leaf dispatch records which path it
+# compiled to — into the engine-owned sink the enclosing spmd_mesh
+# carries.
+PATH_KERNEL = "pallas_w4a16"
+PATH_XLA = "xla_dequant"
+
+
+def _record_int4(spec: str, a, leaf, path: str, reason=None) -> None:
+    sink = _current_int4_sink()
+    if sink is None:
+        return
+    entry = {"spec": spec, "a_shape": list(a.shape),
+             "w_shape": list(leaf.q4.shape[:-1]) + [leaf.q4.shape[-1] * 2],
+             "path": path}
+    if reason:
+        entry["fallback_reason"] = reason
+    sink[(spec, tuple(a.shape), tuple(leaf.q4.shape))] = entry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,7 +251,7 @@ def dequant_int4(q4: jax.Array, s4: jax.Array, axis: int, group: int,
     return w.reshape(shape)
 
 
-def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
+def _einsum(spec: str, a: jax.Array, b, tp=None) -> jax.Array:
     # bf16 inputs, f32 accumulation on the MXU. An int8-quantized weight
     # ({"q", "s"} dict, engine/quant.py) streams half the HBM bytes: the
     # int8→activation-dtype convert fuses into the matmul operand and the
@@ -208,24 +259,46 @@ def _einsum(spec: str, a: jax.Array, b) -> jax.Array:
     # the weight's non-contracted axes, which land trailing). An int4
     # leaf streams a quarter: its grouped dequant is elementwise, so it
     # rides the same operand fusion.
+    #
+    # `tp` is the call site's TP convention hint for the shard-aware
+    # int4 kernel dispatch — "col" (column-parallel: q/k/v, gate/up,
+    # lm head) or "row" (row-parallel: o_proj, down_proj), mirroring
+    # sharding.param_specs (see sharding.int4_shard_axis). Ignored for
+    # every non-int4 leaf and on single-device meshes.
     if isinstance(b, Int4Leaf):
+        # Fused VMEM-dequant kernels — the only layout that actually
+        # streams packed int4 bytes on real TPU (pallas/int4mm.py; XLA
+        # materializes this dequant, BENCH_r05). Gate: the kernel is
+        # emitted ONLY where the enclosing program explicitly announced
+        # its mesh (spmd_mesh — every engine jit does). A 1-device mesh
+        # (or a fully-manual region announcing LOCAL_MESH) dispatches
+        # the raw kernel; a multi-device mesh goes through
+        # einsum_int4_spmd, which re-partitions the matmul and runs the
+        # kernel per shard inside shard_map — a bare pallas_call under
+        # GSPMD would be an opaque, unpartitionable custom call. Traces
+        # with NO announced mesh keep the XLA path: "no context" must
+        # never be mistaken for "single device". Every routing decision
+        # is recorded into the engine's provenance sink.
         mesh = current_spmd_mesh()
-        if mesh is not None and mesh.size == 1:
-            # Fused VMEM-dequant kernel — the only layout that actually
-            # streams packed int4 bytes on real TPU (pallas/int4mm.py;
-            # XLA materializes this dequant, BENCH_r05). Default-safe
-            # gate: the kernel is emitted ONLY where the enclosing
-            # program explicitly announced a 1-device mesh (spmd_mesh —
-            # every engine jit does). Multi-device meshes AND traces
-            # with no announced mesh (e.g. the PP engines' head einsums
-            # under GSPMD) keep the XLA path: a pallas_call under GSPMD
-            # is an opaque, unpartitionable custom call, and "no context"
-            # must never be mistaken for "single device".
-            from ..pallas import int4mm
-            if int4mm.enabled():
-                y = int4mm.einsum_int4(spec, a, b)
-                if y is not None:
-                    return y
+        from ..pallas import int4mm
+        if mesh is None:
+            # No context ⇒ no sink either (they share the stack entry),
+            # so this fallback is inherently unattributed — engines
+            # always announce, so only direct forward() callers land
+            # here.
+            pass
+        elif not int4mm.enabled():
+            _record_int4(spec, a, b, PATH_XLA, "kernel-disabled")
+        else:
+            if mesh.size == 1:
+                y, reason = int4mm.einsum_int4_or_reason(spec, a, b)
+            else:
+                y, reason = int4mm.einsum_int4_spmd(mesh, spec, a, b,
+                                                    tp=tp)
+            if y is not None:
+                _record_int4(spec, a, b, PATH_KERNEL)
+                return y
+            _record_int4(spec, a, b, PATH_XLA, reason)
         return jnp.einsum(spec, a,
                           dequant_int4(b.q4, b.s4, b.axis, b.group,
                                        a.dtype),
@@ -264,9 +337,9 @@ def project_qkv(
 
     Shared by dense attention below and the sequence-parallel cores in
     longcontext.py (which replace only the softmax(QK)V part)."""
-    q = _einsum("bte,ehd->bthd", x, layer["q_proj"])     # [B,T,H,D]
-    k = _einsum("bte,ekd->btkd", x, layer["k_proj"])     # [B,T,K,D]
-    v = _einsum("bte,ekd->btkd", x, layer["v_proj"])
+    q = _einsum("bte,ehd->bthd", x, layer["q_proj"], tp="col")  # [B,T,H,D]
+    k = _einsum("bte,ekd->btkd", x, layer["k_proj"], tp="col")  # [B,T,K,D]
+    v = _einsum("bte,ekd->btkd", x, layer["v_proj"], tp="col")
 
     if cfg.attn_bias:  # Qwen2: linear bias applied BEFORE rotary (HF order)
         q = q + layer["q_bias"].astype(jnp.float32)
@@ -337,8 +410,8 @@ def attention(
                     sliding_window=cfg.sliding_window,
                     softcap=cfg.attn_logit_softcap)
         if out is not None:
-            out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
-                .astype(x.dtype)
+            out = _einsum("bthd,hde->bte", out, layer["o_proj"],
+                          tp="row").astype(x.dtype)
             return out, (k_cache, v_cache)
 
     # GQA: expand K/V heads to match query heads.
@@ -353,19 +426,21 @@ def attention(
     logits = jnp.where(attn_mask[:, None, :, :], logits, MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     out = _einsum("bhts,bshd->bthd", probs, v_att).astype(x.dtype)
-    out = _einsum("bthd,hde->bte", out, layer["o_proj"]).astype(x.dtype)
+    out = _einsum("bthd,hde->bte", out, layer["o_proj"],
+                  tp="row").astype(x.dtype)
     return out, (k_cache, v_cache)
 
 
 def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
     if cfg.num_experts:
         return moe_mlp(x, layer, cfg)
-    gate = _einsum("bte,ef->btf", x, layer["gate_proj"])
-    up = _einsum("bte,ef->btf", x, layer["up_proj"])
+    gate = _einsum("bte,ef->btf", x, layer["gate_proj"], tp="col")
+    up = _einsum("bte,ef->btf", x, layer["up_proj"], tp="col")
     act = jax.nn.gelu(gate, approximate=True) if cfg.gelu_mlp \
         else jax.nn.silu(gate)
     hidden = (act * up).astype(x.dtype)
-    return _einsum("btf,fe->bte", hidden, layer["down_proj"]).astype(x.dtype)
+    return _einsum("btf,fe->bte", hidden, layer["down_proj"],
+                   tp="row").astype(x.dtype)
 
 
 def moe_mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
@@ -493,7 +568,7 @@ def forward(
     if last_pos is not None:
         x = gather_rows(x, last_pos)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
-    logits = _einsum("bte,ve->btv", x, head)
+    logits = _einsum("bte,ve->btv", x, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
     return logits, new_caches
 

@@ -122,8 +122,8 @@ def forward_paged(
                     "mesh needs a head layout that partitions over the "
                     f"model axis AND a block-legal chunk (T={t}, "
                     f"ps={page_size})")
-            out = _einsum("bthd,hde->bte", out, layer["o_proj"]) \
-                .astype(h.dtype)
+            out = _einsum("bthd,hde->bte", out, layer["o_proj"],
+                          tp="row").astype(h.dtype)
             return out, (k_pool2, v_pool2)
 
         x, new_pool = transformer_block(
@@ -135,6 +135,6 @@ def forward_paged(
     if last_pos is not None:
         x = gather_rows(x, last_pos)
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
-    logits = _einsum("bte,ve->btv", x, head)
+    logits = _einsum("bte,ve->btv", x, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
     return logits, new_pools

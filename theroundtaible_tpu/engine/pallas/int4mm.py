@@ -40,22 +40,37 @@ chosen so NO shuffle is ever needed in-kernel:
 `einsum_int4` is the dispatch seam `_einsum` calls: it classifies the
 einsum spec (contracted axes a prefix of the weight → pack-on-output;
 suffix → pack-on-contraction), flattens to 2-D, pads M to sublane
-multiples, and returns None whenever blocking/grouping cannot be
-arranged — the caller then falls back to the XLA dequant path, so MoE
-expert matmuls ("bte,xef->btxf") and tiny routers serve unchanged.
-These are DECODE kernels: M is capped at 64 rows (decode and the
-post-last_pos-gather lm head are always ≤ batch), because the grid
-iterates p innermost so grouped scales stream once per contraction
-block — which makes the f32 output block round-trip per contraction
-block, negligible at decode M and ruinous at prefill M. Prefill int4
-keeps the XLA path, where the materialized dequant amortizes over T.
+multiples, and declines (with a machine-readable reason — the
+`fallback_reason` the engine's path-provenance report and the benches
+surface) whenever blocking/grouping/VMEM cannot be arranged — the
+caller then falls back to the XLA dequant path, so MoE expert matmuls
+("bte,xef->btxf") and tiny routers serve unchanged. Every dispatch is
+budgeted against `_VMEM_BUDGET` BEFORE the pallas_call is emitted, so
+no shape can reach a Mosaic VMEM failure on chip. These are DECODE
+kernels: M is capped at 64 rows (decode and the post-last_pos-gather
+lm head are always ≤ batch), because the grid iterates p innermost so
+grouped scales stream once per contraction block — which makes the f32
+output block round-trip per contraction block, negligible at decode M
+and ruinous at prefill M. Prefill int4 keeps the XLA path, where the
+materialized dequant amortizes over T.
 
-Single-device only by design: these run inside jit-under-GSPMD, where a
-pallas_call is an opaque unpartitionable custom call. The engine gates
-on mesh size (models/common.py `_einsum`); multi-chip int4 keeps the
-XLA path. On non-TPU backends the kernels run in Pallas interpret mode
-when forced via ROUNDTABLE_INT4_MM=1 — how the CPU suite validates them
-(tests/test_int4mm.py).
+Multi-device (the ISSUE 3 tentpole): a pallas_call inside jit-under-
+GSPMD is an opaque unpartitionable custom call, so the kernels CANNOT
+simply run on a sharded mesh — `einsum_int4_spmd` instead partitions
+the matmul the way sharding.param_specs already shards the weight
+(megatron column-parallel for qkv/gate/up/lm-head — each shard computes
+its own output slice, no collective; row-parallel for o/down — each
+shard contracts its input slice and one psum over the "model" axis
+combines, exactly the all-reduce the XLA path's sharded einsum inserts)
+and runs the single-device kernel per shard inside `shard_map` (via
+engine/compat.py's version shim). The plan is checked against the
+PER-SHARD shapes before entering shard_map, so the body's dispatch
+never declines mid-trace; a weight axis the mesh does not divide is
+served replicated (matching sharding._fallback_replicated's placement,
+so the in_specs never force a per-dispatch weight regather). On non-TPU
+backends the kernels run in Pallas interpret mode when forced via
+ROUNDTABLE_INT4_MM=1 — how the CPU suite validates them, single-device
+and sharded (tests/test_int4mm.py).
 """
 
 from __future__ import annotations
@@ -209,22 +224,88 @@ def _mm_pack_contract(x_even, x_odd, q4, s4, gp: int, bm: int, bn: int,
     )(x_even, x_odd, q4, s4)
 
 
-def _pad_rows(x2: jax.Array) -> tuple[jax.Array, int, Optional[int]]:
-    """Pad M to a sublane multiple; returns (padded, M, block_m).
+def _classify(spec: str, leaf):
+    """Classify an einsum spec against a packed leaf: ((mode, n_cont,
+    gp), None) with mode "out" (weight = contracted-prefix + kept, pack
+    axis kept-minor) or "contract" (kept + one contracted pack axis —
+    the tied lm head), or (None, reason) when the kernels cannot serve
+    the spec at all. Reasons are stable strings — they surface as the
+    `fallback_reason` in path-provenance reports."""
+    lhs, out_dims = spec.split("->")
+    a_dims, b_dims = lhs.split(",")
+    cont = [d for d in b_dims if d in a_dims]
+    kept = [d for d in b_dims if d not in a_dims]
+    if not cont or not kept:
+        return None, "spec:no-contraction-or-kept"
+    if a_dims[-len(cont):] != "".join(cont):
+        return None, "spec:cont-not-activation-suffix"
+    batch = a_dims[:-len(cont)]
+    if out_dims != batch + "".join(kept):
+        return None, "spec:out-layout"
+    if leaf.axis != leaf.q4.ndim - 1:
+        # non-minor pack: fall back (XLA path asserts loudly)
+        return None, "pack:non-minor-axis"
+    if leaf.group % 2:
+        return None, "pack:odd-group"
+    gp = leaf.group // 2
+    if list(b_dims) == cont + kept:
+        return ("out", len(cont), gp), None
+    if list(b_dims) == kept + cont and len(cont) == 1:
+        return ("contract", 1, gp), None
+    return None, "spec:mixed-kept-contracted"   # MoE expert layouts
 
-    block_m is None above 64 rows: the kernels are DECODE kernels
-    (weight-streaming-bound GEMVs, where fused dequant is the whole
-    win). Prefill's big-M matmuls keep the XLA path — there the
-    materialized dequant amortizes over T, while this kernel's
+
+def _plan_rows(m_rows: int) -> Optional[int]:
+    """Padded block_m for m_rows, or None above 64: the kernels are
+    DECODE kernels (weight-streaming-bound GEMVs, where fused dequant
+    is the whole win). Prefill's big-M matmuls keep the XLA path —
+    there the materialized dequant amortizes over T, while the
     write-at-last output revisiting would round-trip the [M, 2P] f32
     output once per contraction block."""
-    m = x2.shape[0]
-    mp = max(8, -(-m // 8) * 8)
-    if mp > 64:
-        return x2, m, None
-    if mp != m:
-        x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
-    return x2, m, mp
+    mp = max(8, -(-m_rows // 8) * 8)
+    return None if mp > 64 else mp
+
+
+def _plan_pack_out(m_rows: int, c_dim: int, p_dim: int, gp: int):
+    """((bm, bp, bc), None) or (None, reason) for the pack-on-output
+    kernel at these (possibly per-shard) dims. Block search walks the
+    candidates until the working set fits `_VMEM_BUDGET`, so a plan is
+    emitted only for shapes Mosaic can actually allocate."""
+    bm = _plan_rows(m_rows)
+    if bm is None:
+        return None, "rows:prefill-m"
+    for bp in (512, 256, 128):
+        if p_dim % bp or bp % gp:
+            continue
+        for bc in (512, 1024, 256, 128):
+            if c_dim % bc:
+                continue
+            if _pack_out_vmem_est(bm, bp, bc, p_dim, gp) <= _VMEM_BUDGET:
+                return (bm, bp, bc), None
+    if (_pick_block(p_dim, (512, 256, 128), multiple_of=gp) is None
+            or _pick_block(c_dim, (512, 1024, 256, 128)) is None):
+        return None, "blocks:unblockable"
+    return None, "vmem:pack-out"
+
+
+def _plan_pack_contract(m_rows: int, cp: int, n_dim: int, gp: int):
+    """((bm, bn), None) or (None, reason) for the pack-on-contraction
+    kernel. The whole (packed) contraction rides one block, so the
+    budget check shrinks bn until the x/q/s working set fits — replacing
+    the old magic `cp > 4096` gate with an actual per-shape estimate."""
+    bm = _plan_rows(m_rows)
+    if bm is None:
+        return None, "rows:prefill-m"
+    if cp % 128 or cp % gp:
+        return None, "blocks:cp-misaligned"
+    for bn in (512, 256, 128):
+        if n_dim % bn:
+            continue
+        if _pack_contract_vmem_est(bm, bn, cp, gp) <= _VMEM_BUDGET:
+            return (bm, bn), None
+    if _pick_block(n_dim, (512, 256, 128)) is None:
+        return None, "blocks:unblockable"
+    return None, "vmem:pack-contract"
 
 
 def einsum_int4(spec: str, a: jax.Array, leaf) -> Optional[jax.Array]:
@@ -232,29 +313,43 @@ def einsum_int4(spec: str, a: jax.Array, leaf) -> Optional[jax.Array]:
     kernels when the spec/shape/grouping allow; None → caller falls
     back to the XLA dequant path. Result is f32 (matches the XLA path's
     preferred_element_type)."""
-    lhs, out_dims = spec.split("->")
-    a_dims, b_dims = lhs.split(",")
-    cont = [d for d in b_dims if d in a_dims]
-    kept = [d for d in b_dims if d not in a_dims]
-    if not cont or not kept:
-        return None
-    if a_dims[-len(cont):] != "".join(cont):
-        return None
-    batch = a_dims[:-len(cont)]
-    if out_dims != batch + "".join(kept):
-        return None
-    if leaf.axis != leaf.q4.ndim - 1:
-        return None    # non-minor pack: fall back (XLA path asserts loudly)
-    group = leaf.group
-    if group % 2:
-        return None
-    gp = group // 2
+    return einsum_int4_or_reason(spec, a, leaf)[0]
 
-    if list(b_dims) == cont + kept:
-        return _dispatch_pack_out(a, leaf, len(cont), gp)
-    if list(b_dims) == kept + cont and len(cont) == 1:
-        return _dispatch_pack_contract(a, leaf, gp)
-    return None
+
+def einsum_int4_or_reason(spec: str, a: jax.Array, leaf):
+    """(result, None) on the kernel path, (None, fallback_reason) when
+    this dispatch declines — the reason feeds the engine's
+    path-provenance report so a silent XLA fallback is attributable."""
+    cls, reason = _classify(spec, leaf)
+    if cls is None:
+        return None, reason
+    mode, n_cont, gp = cls
+    if mode == "out":
+        return _dispatch_pack_out(a, leaf, n_cont, gp)
+    return _dispatch_pack_contract(a, leaf, gp)
+
+
+def plan_reason(spec: str, a_shape: tuple, leaf) -> Optional[str]:
+    """Why `einsum_int4` would decline this dispatch (None = kernel
+    path) — shape-only, no arrays traced: the benches use it to emit
+    `fallback_reason` provenance without burning a dispatch."""
+    cls, reason = _classify(spec, leaf)
+    if cls is None:
+        return reason
+    mode, n_cont, gp = cls
+    a_size = 1
+    for s in a_shape:
+        a_size *= s
+    q4 = leaf.q4
+    if mode == "out":
+        c_dim = 1
+        for s in q4.shape[:n_cont]:
+            c_dim *= s
+        return _plan_pack_out(a_size // c_dim, c_dim, q4.size // c_dim,
+                              gp)[1]
+    cp = q4.shape[-1]
+    return _plan_pack_contract(a_size // (2 * cp), cp, q4.size // cp,
+                               gp)[1]
 
 
 # Mirror of attention._VMEM_BUDGET: a conservative per-core VMEM cap the
@@ -276,48 +371,161 @@ def _pack_out_vmem_est(bm: int, bp: int, bc: int, p_dim: int,
     return scratch + x_blk + q_blk + s_blk + out_blk
 
 
+def _pack_contract_vmem_est(bm: int, bn: int, cp: int, gp: int) -> int:
+    # the whole (packed) contraction axis rides one block per operand
+    x_blk = 2 * 2 * bm * cp * 4           # x_even + x_odd, double-buffered
+    q_blk = 2 * bn * cp                   # packed int4 bytes
+    s_blk = 2 * bn * (cp // gp) * 4
+    out_blk = bm * bn * 4                 # f32 output block
+    return x_blk + q_blk + s_blk + out_blk
+
+
+def _pad_to(x2: jax.Array, bm: int) -> jax.Array:
+    m = x2.shape[0]
+    return x2 if m == bm else jnp.pad(x2, ((0, bm - m), (0, 0)))
+
+
 def _dispatch_pack_out(a, leaf, n_cont: int, gp: int):
     q4, s4 = leaf.q4, leaf.s4
-    cont_shape = q4.shape[:n_cont]
     c_dim = 1
-    for s in cont_shape:
+    for s in q4.shape[:n_cont]:
         c_dim *= s
     p_dim = q4.size // c_dim
     kept_shape = q4.shape[n_cont:-1] + (q4.shape[-1] * 2,)
-    bp = _pick_block(p_dim, (512, 256, 128), multiple_of=gp)
-    bc = _pick_block(c_dim, (512, 1024, 256, 128))
-    if bp is None or bc is None:
-        return None
     x2 = a.reshape(-1, c_dim)
-    x2, m, bm = _pad_rows(x2)
-    if bm is None:
-        return None
-    if _pack_out_vmem_est(bm, bp, bc, p_dim, gp) > _VMEM_BUDGET:
-        return None
-    y = _mm_pack_out(x2, q4.reshape(c_dim, p_dim),
+    plan, reason = _plan_pack_out(x2.shape[0], c_dim, p_dim, gp)
+    if plan is None:
+        return None, reason
+    bm, bp, bc = plan
+    m = x2.shape[0]
+    y = _mm_pack_out(_pad_to(x2, bm), q4.reshape(c_dim, p_dim),
                      s4.reshape(c_dim, p_dim // gp), gp, bm, bp, bc,
                      _interpret())
-    return y[:m].reshape(a.shape[:-n_cont] + kept_shape)
+    return y[:m].reshape(a.shape[:-n_cont] + kept_shape), None
 
 
 def _dispatch_pack_contract(a, leaf, gp: int):
     q4, s4 = leaf.q4, leaf.s4
     cp = q4.shape[-1]
-    if cp > 4096 or cp % 128:
-        return None
     n_dim = q4.size // cp
-    if cp % gp:
-        return None
-    bn = _pick_block(n_dim, (512, 256, 128))
-    if bn is None:
-        return None
     x2 = a.reshape(-1, 2 * cp)
-    x_even, x_odd = x2[:, 0::2], x2[:, 1::2]
-    x_even, m, bm = _pad_rows(x_even)
-    x_odd = _pad_rows(x_odd)[0]
-    if bm is None:
-        return None
+    plan, reason = _plan_pack_contract(x2.shape[0], cp, n_dim, gp)
+    if plan is None:
+        return None, reason
+    bm, bn = plan
+    m = x2.shape[0]
+    x_even = _pad_to(x2[:, 0::2], bm)
+    x_odd = _pad_to(x2[:, 1::2], bm)
     y = _mm_pack_contract(x_even, x_odd, q4.reshape(n_dim, cp),
                           s4.reshape(n_dim, cp // gp), gp, bm, bn,
                           _interpret())
-    return y[:m].reshape(a.shape[:-1] + q4.shape[:-1])
+    return y[:m].reshape(a.shape[:-1] + q4.shape[:-1]), None
+
+
+# --- shard-aware dispatch (multi-device meshes) ---
+
+
+def einsum_int4_spmd(mesh, spec: str, a: jax.Array, leaf, tp=None):
+    """The fused kernels under a multi-device mesh: per-shard
+    single-device dispatch inside shard_map (compat shim), partitioned
+    the way sharding.param_specs already shards the weight.
+
+    `tp` is the call site's TP convention hint ("col" / "row" — see
+    sharding.int4_shard_axis); it picks WHICH weight axis carries the
+    model shards so the shard_map in_specs match the weights' resident
+    placement (a mismatched spec would regather the weight every
+    dispatch — the one thing a weight-streaming-bound decode cannot
+    afford). Returns (result, None) or (None, fallback_reason):
+
+    - the plan is validated against the PER-SHARD shapes before the
+      shard_map is entered, so the body's dispatch never declines (and
+      no shape can reach a Mosaic VMEM failure on chip);
+    - a weight axis the mesh does not divide is served replicated —
+      matching sharding._fallback_replicated, which replicated exactly
+      those weights at placement time;
+    - row-parallel shards contract locally and psum over "model",
+      exactly the all-reduce the XLA path's sharded einsum inserts;
+    - the manual axis set comes from compat.mesh_manual_axes, so the
+      same call nests correctly inside the PP engine's manual-"pipe"
+      stage bodies (model stays the only axis this wrapper manualizes
+      there)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import mesh_manual_axes, shard_map
+    from ..sharding import MODEL_AXIS, int4_shard_axis, model_axis_size
+
+    cls, reason = _classify(spec, leaf)
+    if cls is None:
+        return None, reason
+    mode, n_cont, gp = cls
+    q4, s4 = leaf.q4, leaf.s4
+    m_shards = model_axis_size(mesh)
+    manual = mesh_manual_axes(mesh)
+    if m_shards > 1 and MODEL_AXIS not in manual:
+        return None, "mesh:model-axis-not-auto"
+
+    w_ax, needs_psum = int4_shard_axis(tp, q4.ndim, n_cont, mode)
+    if m_shards <= 1:
+        w_ax, needs_psum = None, False
+    if w_ax is not None and (q4.shape[w_ax] % m_shards
+                             or s4.shape[w_ax] % m_shards):
+        # Mirrors _fallback_replicated: a dim the mesh doesn't divide
+        # was REPLICATED at placement, so replicated in_specs match.
+        w_ax, needs_psum = None, False
+
+    div = m_shards if w_ax is not None else 1
+    if mode == "out":
+        c_dim = 1
+        for s in q4.shape[:n_cont]:
+            c_dim *= s
+        p_dim = q4.size // c_dim
+        m_rows = a.size // c_dim
+        c_local = c_dim // (div if (w_ax is not None and w_ax < n_cont)
+                            else 1)
+        p_local = p_dim // (div if (w_ax is not None and w_ax >= n_cont)
+                            else 1)
+        plan, reason = _plan_pack_out(m_rows, c_local, p_local, gp)
+    else:
+        cp = q4.shape[-1]
+        n_dim = q4.size // cp
+        m_rows = a.size // (2 * cp)
+        plan, reason = _plan_pack_contract(m_rows, cp, n_dim // div, gp)
+    if plan is None:
+        return None, (reason if w_ax is None else reason + "/sharded")
+
+    def ax_spec(ndim: int, ax: Optional[int]) -> P:
+        return P(*[MODEL_AXIS if i == ax else None for i in range(ndim)])
+
+    w_spec = ax_spec(q4.ndim, w_ax)
+    s_spec = ax_spec(s4.ndim, w_ax)
+    if mode == "out":
+        out_ndim = (a.ndim - n_cont) + (q4.ndim - n_cont)
+        a_ax = (a.ndim - n_cont + w_ax) \
+            if (w_ax is not None and w_ax < n_cont) else None
+        out_ax = ((a.ndim - n_cont) + (w_ax - n_cont)) \
+            if (w_ax is not None and w_ax >= n_cont) else None
+    else:
+        out_ndim = a.ndim
+        a_ax = None
+        out_ax = (a.ndim - 1) if w_ax is not None else None
+    a_spec = ax_spec(a.ndim, a_ax)
+    out_spec = ax_spec(out_ndim, out_ax)
+
+    from ..models.common import Int4Leaf
+
+    def body(al, q4l, s4l):
+        leaf_l = Int4Leaf(q4=q4l, s4=s4l, axis=leaf.axis,
+                          group=leaf.group)
+        if mode == "out":
+            y, why = _dispatch_pack_out(al, leaf_l, n_cont, gp)
+        else:
+            y, why = _dispatch_pack_contract(al, leaf_l, gp)
+        if y is None:   # unreachable: plan checked on these exact shapes
+            raise AssertionError(f"sharded int4 dispatch declined: {why}")
+        if needs_psum:
+            y = jax.lax.psum(y, MODEL_AXIS)
+        return y
+
+    fn = shard_map(body, mesh=mesh, in_specs=(a_spec, w_spec, s_spec),
+                   out_specs=out_spec, axis_names=manual, check_vma=False)
+    return fn(a, q4, s4), None

@@ -70,7 +70,7 @@ from .serving_loop import (DECODE_SEGMENT, PREFILL_BUCKETS, bucket_for,
 from .models.common import (ModelConfig, _einsum, _softcap, embed_tokens,
                             gather_rows, init_params, make_attention_mask,
                             param_count, project_qkv, rms_norm,
-                            transformer_block)
+                            spmd_mesh, transformer_block)
 from .pipeline import PIPE_AXIS, build_pipe_mesh, stack_stage_params
 from .sampling import (SamplingParams, sample_token_batch, sampling_arrays)
 from .tokenizer import load_tokenizer
@@ -106,9 +106,10 @@ class PPEngine:
         # engine used to force dense): on a pipe-only mesh the stage body
         # is fully manual, every array is stage-local and full-size, so
         # the RAW single-device Pallas kernels apply directly
-        # (current_spmd_mesh() is unset there, so models/common.attention
-        # takes its single-device kernel branch with per-shape
-        # supported() fallback). On a (pipe, model) mesh the kernels run
+        # (the stage context announces LOCAL_MESH — size 1 — so
+        # models/common.attention takes its single-device kernel branch
+        # with per-shape supported() fallback, and the int4 kernels
+        # dispatch single-device too). On a (pipe, model) mesh the kernels run
         # through the same spmd wrappers the main engine uses, as a
         # NESTED shard_map: the stage body is manual over "pipe" only, so
         # the wrapper manualizes the remaining auto "model" axis
@@ -178,10 +179,14 @@ class PPEngine:
             # leaves stack and shard like any other layer leaf, and the
             # stage programs reach them only through _einsum/embed_tokens
             # (which dequantize fusably, see engine/quant.py).
+            # model_shards: int4 grouping aligns to the in-stage TP shard
+            # boundary so the shard-aware kernel dispatch partitions
+            # scales with whole groups per shard.
             from .quant import quantize_params
             params = quantize_params(params, model_cfg, act_dtype=dtype,
                                      free_source=True,
-                                     bits=8 if quant == "int8" else 4)
+                                     bits=8 if quant == "int8" else 4,
+                                     model_shards=n_model)
         self.shared, self.staged = stack_stage_params(
             params, model_cfg, n_stages, self.mesh)
 
@@ -300,6 +305,9 @@ class PPEngine:
         self._chars_per_token: Optional[float] = None
         self.last_stats = GenStats()
         self._serve_lock = threading.Lock()
+        # int4 path-provenance sink (models/common._record_int4) —
+        # every stage/head mesh context below carries it.
+        self._int4_dispatches: dict = {}
         # Shared dispatch retry policy (engine/faults.py), same seam as
         # the main engine: transient dispatch failures retry in place.
         self.retry = faults.DEFAULT_RETRY
@@ -310,24 +318,27 @@ class PPEngine:
         # Stage bodies trace under the CONTEXT AbstractMesh whenever a
         # "model" axis exists (pipe already Manual there): the flash spmd
         # wrappers need it to run as a nested shard_map over the auto
-        # "model" axis. For dense attention this announcement is
-        # defensive hardening only — the quant-aware _einsum's int4 gate
-        # is already default-safe (kernel requires an ANNOUNCED 1-device
-        # mesh; an unset context falls back to XLA), but announcing the
-        # real mesh keeps "context reflects the trace" true at every
-        # multi-device site rather than relying on the default.
+        # "model" axis, and the int4 kernel dispatch re-partitions its
+        # matmuls over the same axis (einsum_int4_spmd). On pipe-ONLY
+        # meshes the stage body is FULLY manual — every array is
+        # device-local and full-size — so the context announces the
+        # LOCAL_MESH sentinel: the int4 kernels then dispatch
+        # single-device (lifting the old "unset context → XLA dequant"
+        # fallback inside PP stages, ISSUE 3) while "no announcement"
+        # elsewhere still safely means the XLA path.
         mesh_in_stage = n_model > 1
 
         def _stage_mesh_ctx():
-            from contextlib import nullcontext
-            from .models.common import spmd_mesh
+            from .models.common import LOCAL_MESH, spmd_mesh
             if not mesh_in_stage:
-                return nullcontext()
+                return spmd_mesh(LOCAL_MESH,
+                                 int4_sink=self._int4_dispatches)
             # Native shard_map is guaranteed here — the constructor
             # refuses TP-in-stage on old jax — so the trace-context
             # AbstractMesh is real (it carries the Manual "pipe" axis
             # the nested spmd wrappers subtract via axis_types).
-            return spmd_mesh(jax.sharding.get_abstract_mesh())
+            return spmd_mesh(jax.sharding.get_abstract_mesh(),
+                             int4_sink=self._int4_dispatches)
 
         def stage_scan(stage_layers, kc_l, vc_l, h, positions, valid,
                        offsets, slot_idx, write_ok):
@@ -457,7 +468,14 @@ class PPEngine:
                 hidden = gather_rows(hidden, lengths - 1)
                 head = (shared["embedding"] if cfg.tie_embeddings
                         else shared["lm_head"])
-                logits = _einsum("bte,ve->btv", hidden, head)
+                # The head matmul runs OUTSIDE the stage shard_map, under
+                # plain jit/GSPMD over the (pipe[, model]) mesh — announce
+                # that mesh so an int4 head dispatches the shard-aware
+                # kernel (post-gather M = B rows, decode-kernel legal)
+                # instead of the old silent XLA fallback.
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+                    logits = _einsum("bte,ve->btv", hidden, head,
+                                     tp="col")
                 logits = _softcap(logits, cfg.final_logit_softcap)
                 return logits[:, 0], (c1, c2)
 
@@ -528,7 +546,14 @@ class PPEngine:
                             .astype(h.dtype)
                         h = rms_norm(h, final_norm, cfg.norm_eps,
                                      cfg.rmsnorm_unit_offset)
-                        logits = _einsum("bte,ve->btv", h, head)
+                        # Decode lm head INSIDE the stage region (manual
+                        # over "pipe"): the stage context routes an int4
+                        # head onto the kernel — single-device via
+                        # LOCAL_MESH on pipe-only meshes, nested
+                        # shard_map over "model" under TP-in-stage.
+                        with _stage_mesh_ctx():
+                            logits = _einsum("bte,ve->btv", h, head,
+                                             tp="col")
                         if cfg.final_logit_softcap is not None:
                             logits = cfg.final_logit_softcap * jnp.tanh(
                                 logits / cfg.final_logit_softcap)
@@ -654,7 +679,8 @@ class PPEngine:
                                 sliding_window=cfg.sliding_window,
                                 softcap=cfg.attn_logit_softcap)
                         out = _einsum("bthd,hde->bte", out,
-                                      lyr["o_proj"]).astype(hh.dtype)
+                                      lyr["o_proj"],
+                                      tp="row").astype(hh.dtype)
                         return out, (kp2, vp2)
 
                     # (no kv_valid: with attn_fn set transformer_block
@@ -665,8 +691,13 @@ class PPEngine:
                         attn_fn=attn_fn)
                     return h, (kp1, vp1)
 
-                h, (kp_l, vp_l) = jax.lax.scan(
-                    body, h, (stage_layers, kp_l, vp_l))
+                # Same mesh context as the contiguous stage_scan: the
+                # projections/MLP _einsums inside the blocks route int4
+                # onto the kernel path (LOCAL_MESH on pipe-only meshes,
+                # the abstract mesh under TP-in-stage).
+                with _stage_mesh_ctx():
+                    h, (kp_l, vp_l) = jax.lax.scan(
+                        body, h, (stage_layers, kp_l, vp_l))
                 return h, kp_l, vp_l
 
             self._pp_prefill_paged, self._pp_decode_paged = \
@@ -754,6 +785,15 @@ class PPEngine:
         return engine
 
     # --- serving (same surface the adapter uses on InferenceEngine) ---
+
+    def int4_path_report(self) -> Optional[dict]:
+        """InferenceEngine.int4_path_report's PP counterpart — same
+        trace-time provenance (stage matmuls AND the in-stage decode /
+        post-gather prefill lm-head dispatches)."""
+        if self.quant != "int4":
+            return None
+        from .engine import summarize_int4_paths
+        return summarize_int4_paths(self._int4_dispatches)
 
     def revive_kv_if_dead(self) -> bool:
         """InferenceEngine.revive_kv_if_dead's PP counterpart: paged
@@ -1121,13 +1161,14 @@ class PPEngine:
             turns, first_np, out_np, all_tokens, max_new,
             self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
             stats)
+        stats.int4_paths = self.int4_path_report()
         self.last_stats = stats
         return results, stats
 
     # --- introspection ---
 
     def describe(self) -> dict[str, Any]:
-        return {
+        info = {
             "model": self.cfg.name,
             "params": self.num_params,
             "max_seq_len": self.max_seq_len,
@@ -1152,6 +1193,10 @@ class PPEngine:
                      "or non-partitionable heads); own-slot LCP reuse; "
                      "cross-knight donor + leader prefix sharing (page "
                      "aliasing when paged); per-row sampling; int8 "
-                     "w8a16",
+                     "w8a16; int4 w4a16 on the fused kernels inside "
+                     "stages (LOCAL_MESH / nested shard_map)",
             "devices": [str(d) for d in self.mesh.devices.flatten()],
         }
+        if self.quant == "int4":
+            info["int4_paths"] = self.int4_path_report()
+        return info

@@ -122,9 +122,18 @@ def _quantize_leaf(w, scale_axes: tuple[int, ...], act_dtype,
     return out
 
 
-def _int4_group_for(dim: int, group: int) -> int:
+def _int4_group_for(dim: int, group: int, shards: int = 1) -> int:
     """Largest even divisor of `dim` that is <= group (0 = no valid
-    grouping; the leaf then falls back to int8)."""
+    grouping; the leaf then falls back to int8). When the pack axis is
+    TP-sharded over `shards` devices, the group must divide the
+    PER-SHARD dim so no group (and no packed byte) ever straddles a
+    shard boundary — the shard-aware kernel dispatch (pallas/int4mm
+    einsum_int4_spmd) partitions q4/s4 along that axis with whole
+    groups per shard, and a straddling group would need cross-shard
+    scale reads mid-kernel. g | dim/shards implies g | dim, so the
+    full-axis grouping below stays valid."""
+    if shards > 1 and dim % shards == 0:
+        dim = dim // shards
     for g in range(min(group, dim), 1, -1):
         if g % 2 == 0 and dim % g == 0:
             return g
@@ -133,17 +142,19 @@ def _int4_group_for(dim: int, group: int) -> int:
 
 def _quantize_leaf_int4(w, scale_axes: tuple[int, ...],
                         act_dtype, free_source: bool,
-                        group: int) -> Any:
+                        group: int, pack_shards: int = 1) -> Any:
     """Symmetric per-group int4 (w ≈ q4 * s4, |q4| <= 7), two nibbles
     packed per int8 byte along the LAST axis (even element → low
     nibble — the order `lax.bitcast_convert_type` unpacks, see
-    dequant_int4). A last dim that can't group falls back to that leaf
+    dequant_int4). `pack_shards` > 1 aligns the grouping to the TP
+    shard boundary (see _int4_group_for) for leaves whose pack axis is
+    model-sharded. A last dim that can't group falls back to that leaf
     staying int8 — mixed trees serve fine (the einsum seam dispatches
     per leaf)."""
     from .models.common import Int4Leaf
 
     dim = w.shape[-1]
-    g = _int4_group_for(dim, group)
+    g = _int4_group_for(dim, group, pack_shards)
     if g < 2:
         return _quantize_leaf(w, scale_axes, act_dtype, free_source)
     w32 = w.astype(jnp.float32)
@@ -166,12 +177,20 @@ def _quantize_leaf_int4(w, scale_axes: tuple[int, ...],
 def quantize_params(params: Params, cfg: ModelConfig,
                     act_dtype=jnp.bfloat16,
                     free_source: bool = False, bits: int = 8,
-                    group: int = 64) -> Params:
+                    group: int = 64, model_shards: int = 1) -> Params:
     """Quantize the big matmul weights; returns a new tree (norms and any
     unrecognized leaves pass through untouched).
 
     bits=8 → per-output-channel int8 dicts; bits=4 → per-`group` packed
     Int4Leaf (a leaf whose pack dim can't group falls back to int8).
+
+    model_shards (bits=4): the mesh's model-axis size. Leaves whose PACK
+    axis is the model-sharded axis per sharding.param_specs (dense
+    gate/up: [E, F] packed AND sharded on F) get their group aligned to
+    the per-shard dim, so the shard-aware kernel dispatch partitions
+    scales with whole groups per shard (sharding.int4_shard_axis /
+    pallas/int4mm einsum_int4_spmd). Every other leaf packs an
+    unsharded axis and is unaffected.
 
     free_source=True deletes each source weight buffer as soon as its
     quantized replacement is materialized — the caller must own `params`
@@ -180,11 +199,35 @@ def quantize_params(params: Params, cfg: ModelConfig,
     if bits not in (8, 4):
         raise ValueError(f"bits must be 8 or 4, got {bits}")
 
+    pack_specs = None
+    if bits == 4 and model_shards > 1:
+        from .sharding import param_specs
+        pack_specs = param_specs(cfg)
+
+    def _pack_shards(value, key, expert):
+        """model_shards when this leaf's LAST (pack) axis is the
+        model-sharded axis and divides, else 1 — mirroring
+        _fallback_replicated's placement decision."""
+        if pack_specs is None:
+            return 1
+        from .sharding import MODEL_AXIS
+        layer0 = pack_specs["layers"][0]
+        spec = (layer0.get("experts", {}).get(key) if expert
+                else pack_specs.get(key, layer0.get(key)))
+        if spec is None:
+            return 1
+        entries = tuple(spec)
+        if (len(entries) == value.ndim and entries[-1] == MODEL_AXIS
+                and value.shape[-1] % model_shards == 0):
+            return model_shards
+        return 1
+
     def one(value, key, expert=False):
         scale_axes = (_EXPERT_SCALE_AXES if expert else _SCALE_AXES)[key]
         if bits == 4:
             return _quantize_leaf_int4(value, scale_axes,
-                                       act_dtype, free_source, group)
+                                       act_dtype, free_source, group,
+                                       _pack_shards(value, key, expert))
         return _quantize_leaf(value, scale_axes, act_dtype, free_source)
 
     out: Params = {}

@@ -87,6 +87,22 @@ def _exact_tail(scaled, top_ks, top_ps):
     return jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
 
+def sampler_mode(params_list: list[SamplingParams]) -> str:
+    """Which path sample_token_batch takes for a batch of these per-row
+    params — bench provenance (ISSUE 3 satellite: the sort-free sampler
+    gets an ATTRIBUTABLE number): "greedy" (every row temp <= 0, single
+    argmax — no sampler at all), "sort" (some row's top_k exceeds the
+    _K_CAND candidate pool, forcing the exact full-vocab sort fallback),
+    or "sort-free" (the candidate-pool fast path; boundary rows whose
+    top-p mass outruns the pool may still cond into the exact tail, but
+    the hot case stays sort-free)."""
+    if all(p.temperature <= 0.0 for p in params_list):
+        return "greedy"
+    if any(p.top_k > _K_CAND for p in params_list):
+        return "sort"
+    return "sort-free"
+
+
 def sample_token_batch(logits: jax.Array, key: jax.Array,
                        temps: jax.Array, top_ks: jax.Array,
                        top_ps: jax.Array) -> jax.Array:

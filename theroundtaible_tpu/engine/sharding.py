@@ -151,6 +151,40 @@ def param_specs(cfg: ModelConfig) -> Params:
     return specs
 
 
+def model_axis_size(mesh: Mesh) -> int:
+    """Model-axis (TP) shard count of a mesh — 1 when the axis is
+    absent. The `model_shards` quantize_params needs to emit the
+    shard-aligned int4 pack layout, and the shard count the int4 spmd
+    kernel dispatch partitions against."""
+    return dict(mesh.shape).get(MODEL_AXIS, 1)
+
+
+def int4_shard_axis(tp: Optional[str], w_ndim: int, n_cont: int,
+                    mode: str) -> tuple[Optional[int], bool]:
+    """Which weight axis carries the model shards for a packed-int4
+    kernel matmul — the partition-spec rule for packed leaves, kept HERE
+    so it mirrors param_specs above and the two cannot drift. Returns
+    (weight_axis | None, needs_psum).
+
+    tp="col" — megatron column-parallel (q/k/v, gate/up, the lm head):
+    param_specs puts MODEL on the first KEPT axis (heads / mlp hidden /
+    vocab), each shard computes its own output slice, no collective.
+    tp="row" — row-parallel (o_proj, down_proj): MODEL rides the first
+    CONTRACTED axis, partial sums combine with one psum over the model
+    axis — exactly the all-reduce the XLA path's sharded einsum inserts.
+    `mode` is the kernel's pack classification ("out": weight dims are
+    contracted-prefix + kept with the pack axis kept-minor; "contract":
+    kept + one contracted pack axis — the tied lm head, where "row"
+    would shard the packed contracted axis, a layout no weight uses →
+    replicate). None/unknown tp replicates: the kernel still fuses, the
+    partitioning is just not attempted."""
+    if tp == "col":
+        return (n_cont if mode == "out" else 0), False
+    if tp == "row" and mode == "out":
+        return 0, True
+    return None, False
+
+
 def kv_cache_spec() -> P:
     """KV cache [B, S, K, D]: slots on data axis, kv heads on model axis."""
     return P(DATA_AXIS, None, MODEL_AXIS, None)
