@@ -18,6 +18,13 @@ The reference itself publishes no numbers (BASELINE.md "published: {}").
 
 Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
        ROUNDTABLE_BENCH_CPU=1 ...         (tiny model smoke test)
+       ROUNDTABLE_BENCH_OFFERED_LOAD=1 .. (offered-load sweep, ISSUE 4:
+           K ∈ {1,2,4,8} concurrent scripted discussions through the
+           continuous-batching session scheduler on ONE shared engine;
+           emits one JSON line per K with aggregate decode tok/s,
+           batch-occupancy %, p50/p95 turn latency, and the scheduler's
+           decision provenance embedded like int4_paths.
+           ROUNDTABLE_BENCH_LOAD_KS=1,2,4 overrides the sweep.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -38,6 +45,183 @@ RETRY_DELAY_S = 20.0
 
 TOPIC = ("Should the session store move to an append-only event log "
          "before the apply pipeline lands?")
+
+
+def offered_load_child() -> int:
+    """Offered-load sweep (ISSUE 4 satellite): K concurrent 3-knight
+    scripted discussions through ONE shared engine + session scheduler,
+    for K in {1, 2, 4, 8}. Scores are scripted (random weights can't
+    emit the consensus JSON — same stance as the main benchmark); the
+    serving path is the REAL orchestrator → scheduler-routed adapter →
+    continuously-batched engine."""
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import statistics
+    import tempfile
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+    from theroundtaible_tpu.core.orchestrator import run_discussion
+    from theroundtaible_tpu.core.types import (ConsensusBlock, KnightConfig,
+                                               RoundtableConfig, RulesConfig)
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = "tiny-gemma" if on_cpu else "gemma-2b-it"
+    max_seq = 1024 if on_cpu else 2048
+    max_new = 32 if on_cpu else 96
+    rounds = 2
+    num_slots = 12  # up to 4 concurrent 3-knight sessions resident
+    ks = [int(x) for x in os.environ.get(
+        "ROUNDTABLE_BENCH_LOAD_KS", "1,2,4,8").split(",")]
+
+    class Scripted(TpuLlmAdapter):
+        """Real serving; scripted consensus scores terminate each
+        discussion at exactly `rounds` rounds (random weights cannot
+        emit the JSON block — bench_discuss's standing stance)."""
+
+        def parse_consensus(self, response, round_num):
+            score = 9.5 if round_num >= rounds else 6.0
+            return ConsensusBlock(
+                knight=self.name, round=round_num, consensus_score=score,
+                agrees_with=[], pending_issues=[], proposal="bench",
+                files_to_modify=["bench.md"] if score >= 9 else [])
+
+    engine_cfg = {"model": model, "max_seq_len": max_seq,
+                  "num_slots": num_slots,
+                  "sampling": {"temperature": 0.0,
+                               "max_new_tokens": max_new}}
+
+    def make_config():
+        return RoundtableConfig(
+            version="1.0", project="bench", language="en",
+            knights=[KnightConfig(name=f"Knight-{c}", adapter="tpu-llm",
+                                  capabilities=[], priority=i + 1)
+                     for i, c in enumerate("ABC")],
+            rules=RulesConfig(max_rounds=rounds, consensus_threshold=9,
+                              timeout_per_turn_seconds=300,
+                              escalate_to_user_after=4, auto_execute=False,
+                              parallel_rounds=True),
+            chronicle="chronicle.md", adapter_config={"tpu-llm": {}})
+
+    base = Scripted("tpu-llm", engine_cfg)
+    engine = base._get_engine()
+    t_warm = time.monotonic()
+    engine.warmup(max_prompt_tokens=max_seq - 256, batch_sizes=(1, 3))
+    warmup_s = time.monotonic() - t_warm
+
+    for k in ks:
+        sched = SessionScheduler(engine, admit_hold_s=0.25)
+        config = make_config()
+        entries = []
+        with tempfile.TemporaryDirectory() as root:
+            os.makedirs(os.path.join(root, ".roundtable", "sessions"))
+
+            session_errors = []
+
+            def run_one(i, k=k, root=root, config=config, sched=sched):
+                try:
+                    adapter = Scripted("tpu-llm", engine_cfg)
+                    adapter.attach_scheduler(sched, session=f"k{k}s{i}")
+                    # Disambiguator goes FIRST: slugify truncates topics
+                    # at 50 chars, and same-slug concurrent sessions
+                    # would share (and corrupt) one session directory.
+                    topic = f"(load {k}.{i}) {TOPIC}"
+                    t0 = time.monotonic()
+                    result = run_discussion(topic, config,
+                                            {"tpu-llm": adapter}, root,
+                                            read_source_code=False)
+                    entries.append((result, time.monotonic() - t0))
+                except Exception as e:  # noqa: BLE001 — reported below
+                    # A silently-dropped session would make the emitted
+                    # throughput/occupancy line claim a K-session sweep
+                    # that never happened — fail the run loud instead.
+                    session_errors.append((i, e))
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=run_one, args=(i,))
+                       for i in range(k)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.monotonic() - t0
+
+            turn_walls, queue_waits = [], []
+            decode_tokens = 0
+            occupancies = []
+            for result, _sess_wall in entries:
+                metrics = json.loads(open(os.path.join(
+                    result.session_path, "metrics.json")).read())
+                for r in metrics["rounds"]:
+                    for t in r["turns"]:
+                        turn_walls.append(t["wall_s"])
+                        if t.get("queue_wait_s") is not None:
+                            queue_waits.append(t["queue_wait_s"])
+                        if t.get("batch_occupancy") is not None:
+                            occupancies.append(t["batch_occupancy"])
+                        if t.get("engine"):
+                            decode_tokens += t["engine"].get(
+                                "decode_tokens", 0)
+        provenance = sched.describe()
+        sched.close()
+        if session_errors:
+            raise RuntimeError(
+                f"offered-load K={k}: {len(session_errors)}/{k} "
+                f"session(s) failed: "
+                + "; ".join(f"s{i}: {e}" for i, e in session_errors))
+        assert len(entries) == k, f"K={k} ran only {len(entries)} sessions"
+        assert all(r.consensus for r, _ in entries), \
+            "every scripted discussion must reach consensus"
+        turn_walls.sort()
+
+        def pct(p):
+            if not turn_walls:
+                return 0.0
+            idx = min(int(p / 100 * len(turn_walls)), len(turn_walls) - 1)
+            return round(turn_walls[idx], 3)
+
+        result_line = {
+            "metric": f"offered_load_discuss[{model}][K={k}]",
+            "value": round(decode_tokens / max(wall, 1e-9), 2),
+            "unit": "aggregate_decode_tok_s",
+            "detail": {
+                "sessions": k,
+                "rounds_per_session": rounds,
+                "wall_s": round(wall, 2),
+                "decode_tokens": decode_tokens,
+                "p50_turn_s": pct(50),
+                "p95_turn_s": pct(95),
+                "turn_count": len(turn_walls),
+                "queue_wait_mean_s": (
+                    round(statistics.mean(queue_waits), 3)
+                    if queue_waits else 0.0),
+                "batch_occupancy_mean": (
+                    round(statistics.mean(occupancies), 2)
+                    if occupancies else 0.0),
+                "batch_occupancy_pct": round(
+                    100.0 * provenance["occupancy_mean"]
+                    / max(num_slots, 1), 1),
+                "warmup_s": round(warmup_s, 1),
+                "platform": jax.devices()[0].platform,
+                # Scheduler decision provenance embedded in the run
+                # record, the int4_paths pattern (ISSUE 4).
+                "scheduler": {kk: vv for kk, vv in provenance.items()
+                              if kk != "events"},
+            },
+        }
+        print(json.dumps(result_line), flush=True)
+    return 0
 
 
 def child() -> int:
@@ -222,9 +406,20 @@ def child() -> int:
 
 def main() -> int:
     from bench_common import run_watchdogged
+    # The offered-load sweep runs up to 1+2+4+8 scripted discussions in
+    # one child — give it a wider attempt window than the single run.
+    attempt_s = (2 * ATTEMPT_TIMEOUT_S
+                 if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD")
+                 else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
-                           ATTEMPT_TIMEOUT_S, MAX_ATTEMPTS, RETRY_DELAY_S)
+                           attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
+
+
+def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD"):
+        return offered_load_child()
+    return child()
 
 
 if __name__ == "__main__":
-    sys.exit(child() if "--child" in sys.argv else main())
+    sys.exit(_run_child() if "--child" in sys.argv else main())

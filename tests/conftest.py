@@ -123,6 +123,29 @@ def _quant_kernel_guard(request, monkeypatch):
         f"(spec, a_shape, fallback_reason): {unexpected}")
 
 
+@pytest.fixture(autouse=True)
+def _scheduler_guard(request):
+    """Tier-1 guard for @pytest.mark.scheduler (ISSUE 4 satellite): a
+    test that CLAIMS continuous-batching coverage must not silently fall
+    back to serial serving — if no decode segment during the test ever
+    carried >= 2 rows, the sessions were served one-at-a-time and the
+    test's concurrency claims are vacuous; fail LOUD. Unit tests of the
+    scheduler's non-batching surfaces mark allow_serial=True."""
+    marker = request.node.get_closest_marker("scheduler")
+    if marker is None or marker.kwargs.get("allow_serial"):
+        yield
+        return
+    from theroundtaible_tpu.engine import scheduler as sched_mod
+
+    sched_mod.reset_test_counters()
+    yield
+    assert sched_mod.max_rows_seen() >= 2, (
+        "scheduler-marked test silently fell back to serial serving: no "
+        "decode segment carried more than "
+        f"{sched_mod.max_rows_seen()} row(s) — continuous batching "
+        "never happened (mark allow_serial=True only for unit tests)")
+
+
 @pytest.fixture
 def project_root(tmp_path):
     """A scratch project dir with a .roundtable skeleton."""

@@ -1,0 +1,667 @@
+"""Continuous-batching session scheduler suite (ISSUE 4).
+
+Covers the acceptance criteria end to end on the CPU backend:
+- session-namespaced slot names at the SlotBook/PagedKVCache layer (the
+  cross-session "lancelot" collision fix), with donor scoping;
+- >= 3 concurrent 2-knight discussions through one shared engine with
+  (a) per-session token parity vs the same discussions run serially,
+  (b) batch occupancy > 1 on a decode segment (continuous batching
+  actually happened — the conftest `scheduler` guard enforces this for
+  every strictly-marked test), and (c) a `hang` fault in one session
+  leaving the other sessions' results byte-identical;
+- admission backpressure (queue when capacity is pinned, refuse what
+  can never fit), drain interplay (queued sessions fail fast with
+  DrainingError, fleet_health reports queue state), budget expiry
+  isolation, and the adapter ladder riding THROUGH the scheduler;
+- SessionMetrics queue-wait / batch-occupancy fields under concurrency.
+"""
+
+import threading
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from theroundtaible_tpu.engine import deadlines, faults
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.kvcache import (SlotBook, scoped_slot,
+                                               session_of)
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.scheduler import (SchedulerRefused,
+                                                 SessionScheduler,
+                                                 scheduler_for)
+
+MODEL_KW = dict(max_seq_len=512)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
+    deadlines.end_drain()
+    yield
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
+    deadlines.end_drain()
+
+
+def make_engine(**kw):
+    cfg = get_model_config("tiny-gemma", **MODEL_KW)
+    kw.setdefault("num_slots", 8)
+    return InferenceEngine(cfg, **kw)
+
+
+@pytest.fixture(scope="module")
+def shared_engine():
+    return make_engine()
+
+
+@pytest.fixture(scope="module")
+def baseline_engine():
+    """A separate engine instance for serial baselines, so scheduled
+    serving on shared_engine can never contaminate the expected values
+    (engines share nothing but compiled-program caches)."""
+    return make_engine()
+
+
+PROMPTS = {
+    "s0": [("lancelot", "The round table met at dawn to discuss the "
+                        "castle walls and the eastern gate."),
+           ("galahad", "The round table met at dawn to discuss the "
+                       "castle walls and the eastern gate. Galahad "
+                       "raises the matter of the moat.")],
+    "s1": [("lancelot", "A different discussion entirely, about dragons "
+                        "and the kingdom's gold reserves."),
+           ("galahad", "A different discussion entirely, about dragons "
+                       "and the kingdom's gold reserves. Galahad "
+                       "disagrees sharply.")],
+    "s2": [("lancelot", "Third topic: the harvest festival planning "
+                        "session and the tournament."),
+           ("galahad", "Third topic: the harvest festival planning "
+                       "session and the tournament. Galahad volunteers "
+                       "to judge.")],
+}
+
+
+def serial_baselines(engine, max_new=70):
+    return {sid: engine.generate_batch(turns, max_new_tokens=max_new,
+                                       session=sid)
+            for sid, turns in PROMPTS.items()}
+
+
+def run_concurrent(sched, max_new=70, sessions=None):
+    results, errors = {}, {}
+
+    def run(sid):
+        try:
+            results[sid] = sched.submit(sid, PROMPTS[sid],
+                                        max_new_tokens=max_new)
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            errors[sid] = e
+
+    threads = [threading.Thread(target=run, args=(sid,))
+               for sid in (sessions or PROMPTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# satellite: session-namespaced slot names at the cache layer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.scheduler(allow_serial=True)
+class TestSessionNamespace:
+    def test_scoped_slot_roundtrip(self):
+        assert scoped_slot("s1", "lancelot") == "s1\x1flancelot"
+        assert session_of(scoped_slot("s1", "lancelot")) == "s1"
+        assert scoped_slot(None, "lancelot") == "lancelot"
+        assert scoped_slot("", "lancelot") == "lancelot"
+        assert session_of("lancelot") == ""
+
+    def test_slotbook_two_sessions_two_slots(self):
+        """THE regression: acquire("lancelot") from two sessions used to
+        map to one slot and silently cross-contaminate KV."""
+        book = SlotBook(4)
+        a = book.acquire(scoped_slot("sessA", "lancelot"))
+        b = book.acquire(scoped_slot("sessB", "lancelot"))
+        assert a.slot_id != b.slot_id
+        assert len(book.slot_names()) == 2
+
+    def test_reuse_plan_never_crosses_sessions(self):
+        book = SlotBook(4)
+        tokens = [1, 7, 9, 11, 13, 15]
+        book.commit(scoped_slot("sessA", "lancelot"), tokens)
+        # Same knight name, same token stream, OTHER session: a fresh
+        # slot with zero reuse — not sessA's baked cache.
+        _, reuse = book.reuse_plan(scoped_slot("sessB", "lancelot"),
+                                   tokens)
+        assert reuse == 0
+        # The same session DOES reuse its own history.
+        _, reuse_same = book.reuse_plan(scoped_slot("sessA", "lancelot"),
+                                        tokens)
+        assert reuse_same == len(tokens) - 1
+
+    def test_best_donor_intra_session_only(self):
+        book = SlotBook(4)
+        shared = list(range(1, 100))
+        book.commit(scoped_slot("sessA", "lancelot"), shared)
+        donor, n = book.best_donor(scoped_slot("sessB", "galahad"),
+                                   shared + [101])
+        assert donor is None and n == 0
+        donor, n = book.best_donor(scoped_slot("sessA", "galahad"),
+                                   shared + [101])
+        assert donor is not None and n == len(shared)
+
+    def test_paged_best_donor_intra_session_only(self):
+        from theroundtaible_tpu.engine.paging import PagedKVCache
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        kv = PagedKVCache(cfg, num_slots=4, max_seq_len=256, page_size=64)
+        shared = list(range(1, 100))
+        kv.acquire(scoped_slot("sessA", "lancelot"))
+        kv.commit(scoped_slot("sessA", "lancelot"), shared)
+        donor, n = kv.best_donor(scoped_slot("sessB", "galahad"),
+                                 shared + [101])
+        assert donor is None and n == 0
+        donor, n = kv.best_donor(scoped_slot("sessA", "galahad"),
+                                 shared + [101])
+        assert donor is not None and n == len(shared)
+
+    def test_engine_session_kwarg_namespaces_slots(self):
+        engine = make_engine(num_slots=4)
+        engine.generate_batch([("lancelot", "A short prompt about walls.")],
+                              max_new_tokens=4, session="sA")
+        engine.generate_batch([("lancelot", "A short prompt about walls.")],
+                              max_new_tokens=4, session="sB")
+        names = engine.kv.slot_names()
+        assert scoped_slot("sA", "lancelot") in names
+        assert scoped_slot("sB", "lancelot") in names
+        assert "lancelot" not in names
+
+
+# ---------------------------------------------------------------------------
+# tentpole acceptance: concurrency, parity, occupancy, fault isolation
+# ---------------------------------------------------------------------------
+
+
+class TestContinuousBatching:
+    @pytest.mark.scheduler
+    def test_three_sessions_token_parity_and_occupancy(
+            self, shared_engine, baseline_engine):
+        """Acceptance (a)+(b): >= 3 concurrent 2-knight discussions on
+        one shared engine — per-session token parity with serial runs,
+        and a decode segment with occupancy > 1."""
+        serial = serial_baselines(baseline_engine)
+        sched = SessionScheduler(shared_engine, admit_hold_s=0.3)
+        try:
+            results, errors = run_concurrent(sched)
+            assert not errors, errors
+            for sid in PROMPTS:
+                texts, stats = results[sid]
+                assert texts == serial[sid], f"{sid} diverged"
+                assert stats.sched["occupancy_max"] > 1
+                assert stats.sched["sessions_max"] >= 2
+                assert stats.decode_tokens > 0
+            d = sched.describe()
+            assert d["max_occupancy"] > 1
+            assert any(o > 1 for o in d["occupancy_recent"])
+            assert d["completed"] == 3 and d["failed"] == 0
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    def test_hang_fault_leaves_other_sessions_byte_identical(
+            self, baseline_engine):
+        """Acceptance (c): a hang fault during the SHARED decode batch
+        preempts the batch into per-session dispatches; with the fault
+        exhausted, every session completes byte-identical to serial
+        (the sick dispatch never committed anything)."""
+        serial = serial_baselines(baseline_engine, max_new=200)
+        engine = make_engine()
+        sched = SessionScheduler(engine, admit_hold_s=0.3)
+        try:
+            reqs = {sid: sched.submit_async(sid, PROMPTS[sid],
+                                            max_new_tokens=200)
+                    for sid in PROMPTS}
+            deadline = time.monotonic() + 120
+            while sched.admitted < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sched.admitted == 3, "sessions were never co-admitted"
+            # All three sessions are mid-decode in ONE batch: the next
+            # dispatch the fault hits is the shared segment.
+            faults.arm("hang", count=1, delay_s=0.1)
+            out = {sid: sched.wait(req) for sid, req in reqs.items()}
+            for sid in PROMPTS:
+                assert out[sid][0] == serial[sid], f"{sid} diverged"
+            d = sched.describe()
+            assert d["preemptions"] >= 1, (
+                "hang never hit the shared batch — test raced retirement")
+            assert d["failed"] == 0
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    def test_second_hang_fails_only_one_session(self, baseline_engine):
+        """Two hang firings: the shared segment fails, then the FIRST
+        per-session isolation dispatch fails too — exactly one session
+        climbs to its caller while the others stay byte-identical."""
+        serial = serial_baselines(baseline_engine, max_new=200)
+        engine = make_engine()
+        sched = SessionScheduler(engine, admit_hold_s=0.3)
+        try:
+            reqs = {sid: sched.submit_async(sid, PROMPTS[sid],
+                                            max_new_tokens=200)
+                    for sid in PROMPTS}
+            deadline = time.monotonic() + 120
+            while sched.admitted < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sched.admitted == 3
+            faults.arm("hang", count=2, delay_s=0.1)
+            outcomes, failures = {}, {}
+            for sid, req in reqs.items():
+                try:
+                    outcomes[sid] = sched.wait(req)
+                except Exception as e:  # noqa: BLE001
+                    failures[sid] = e
+            assert len(failures) == 1, (
+                f"expected exactly one failed session, got {failures}")
+            for sid, (texts, _stats) in outcomes.items():
+                assert texts == serial[sid], f"{sid} diverged"
+            assert sched.describe()["preemptions"] >= 1
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    def test_transient_dispatch_fault_retries_in_place(
+            self, baseline_engine):
+        """A retryable dispatch fault is absorbed by the run_dispatch
+        retry seam — no preemption, no failures, full parity."""
+        serial = serial_baselines(baseline_engine)
+        engine = make_engine()
+        sched = SessionScheduler(engine, admit_hold_s=0.3)
+        try:
+            faults.arm("dispatch", count=1)
+            results, errors = run_concurrent(sched)
+            assert not errors, errors
+            for sid in PROMPTS:
+                assert results[sid][0] == serial[sid]
+            assert sched.describe()["preemptions"] == 0
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    def test_next_round_reuses_committed_prefix(self, shared_engine):
+        """Round 2 of a session extends round 1's transcript: the
+        scheduler's retirement commit must feed reuse_plan exactly like
+        generate_batch's (delta-only prefill across rounds)."""
+        sched = SessionScheduler(shared_engine, admit_hold_s=0.2)
+        try:
+            r1, errors = run_concurrent(sched, sessions=["s0", "s1"])
+            assert not errors
+            texts0 = r1["s0"][0]
+            round2 = [(name, prompt + " " + texts0[i] + " The discussion "
+                       "continues into a second round with new points.")
+                      for i, (name, prompt) in enumerate(PROMPTS["s0"])]
+            results, errors2 = {}, {}
+
+            def go():
+                try:
+                    results["s0"] = sched.submit("s0", round2,
+                                                 max_new_tokens=40)
+                except Exception as e:  # noqa: BLE001
+                    errors2["s0"] = e
+
+            def go_other():
+                try:
+                    results["s1"] = sched.submit("s1", PROMPTS["s1"],
+                                                 max_new_tokens=40)
+                except Exception as e:  # noqa: BLE001
+                    errors2["s1"] = e
+
+            t1, t2 = threading.Thread(target=go), threading.Thread(
+                target=go_other)
+            t1.start(); t2.start(); t1.join(120); t2.join(120)
+            assert not errors2, errors2
+            _texts, stats = results["s0"]
+            assert stats.reused_tokens > 0, (
+                "round 2 re-prefilled everything: retirement commit "
+                "broke cross-round prefix reuse")
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# admission queue: backpressure + refusal
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_refuses_what_never_fits(self):
+        engine = make_engine(num_slots=4)
+        sched = SessionScheduler(engine)
+        try:
+            turns = [(f"k{i}", "prompt") for i in range(5)]
+            with pytest.raises(SchedulerRefused):
+                sched.submit("big", turns, max_new_tokens=8)
+            assert sched.describe()["refused"] == 1
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    def test_backpressure_queues_then_serves(self):
+        """With room for one 2-knight session (max_rows=2), the second
+        session queues behind the first and completes after retirement —
+        and co-schedules once capacity frees (rows of BOTH sessions in
+        one segment via the third session's join)."""
+        engine = make_engine()
+        sched = SessionScheduler(engine, max_rows=4, admit_hold_s=0.2)
+        try:
+            a = sched.submit_async("s0", PROMPTS["s0"],
+                                   max_new_tokens=200)
+            b = sched.submit_async("s1", PROMPTS["s1"],
+                                   max_new_tokens=200)
+            c = sched.submit_async("s2", PROMPTS["s2"],
+                                   max_new_tokens=200)
+            outs = [sched.wait(r) for r in (a, b, c)]
+            assert all(o is not None for o in outs)
+            d = sched.describe()
+            assert d["completed"] == 3
+            # 3 sessions × 2 rows > max_rows 4: someone waited.
+            waits = [o[1].sched["queue_wait_s"] for o in outs]
+            assert max(waits) > 0.0
+            assert d["max_occupancy"] <= 4
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_queue_sweep_times_out_non_head(self):
+        """A request stuck BEHIND a non-fitting head still dies at its
+        own deadline with an honest queue timeout (the sweep covers the
+        whole queue, not just the head)."""
+        engine = make_engine()
+        sched = SessionScheduler(engine, max_rows=2)
+        try:
+            a = sched.submit_async("s0", PROMPTS["s0"],
+                                   max_new_tokens=200)
+            deadline = time.monotonic() + 60
+            while sched.admitted < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            b = sched.submit_async("s1", PROMPTS["s1"],
+                                   max_new_tokens=40, timeout_s=300)
+            c = sched.submit_async("s2", PROMPTS["s2"],
+                                   max_new_tokens=40, timeout_s=0.5)
+            with pytest.raises(TimeoutError, match="admission queue"):
+                sched.wait(c)
+            assert sched.wait(a) is not None
+            assert sched.wait(b) is not None
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_pool_exhaustion_requeues_as_backpressure(self):
+        """Real pool exhaustion during admission (the page estimate
+        under-counted) is BACKPRESSURE while other sessions hold pages:
+        the request requeues gated on the batch shrinking, instead of
+        hard-failing into the adapter ladder."""
+        from theroundtaible_tpu.engine.scheduler import _Request, _Row
+        engine = make_engine(num_slots=4, kv_layout="paged",
+                             page_size=64)
+        sched = SessionScheduler(engine)
+        try:
+            blocker = _Row(name=scoped_slot("sX", "k"), tokens=[1],
+                           sampling=engine.sampling, max_new=4)
+            sched._active.append(blocker)
+            req = _Request("s9", [("k", "a prompt")], None, 8, 60.0,
+                           None, sched._fresh_stats())
+            err = RuntimeError(
+                "Page pool exhausted on data replica 0: all its pages "
+                "pinned by the in-flight batch")
+            assert sched._requeue_on_exhaustion(req, err) is True
+            assert req.requeues == 1 and req.fits_below == 1
+            # Gated until the batch actually shrinks below fits_below.
+            assert sched._fits_now(req) is False
+            sched._active.clear()
+            assert sched._fits_now(req) is True
+            # Non-exhaustion errors never requeue.
+            sched._active.append(blocker)
+            assert sched._requeue_on_exhaustion(
+                req, RuntimeError("something else")) is False
+            sched._active.clear()
+            with sched._cv:
+                sched._queue.clear()
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_replica_plan_bucket_group(self):
+        from theroundtaible_tpu.engine.serving_loop import ReplicaGroupPlan
+        exact = ReplicaGroupPlan([0, 0, 0], 2)
+        assert exact.group == 3 and exact.b_padded == 6
+        bucketed = ReplicaGroupPlan([0, 0, 0], 2, bucket_group=True)
+        assert bucketed.group == 4 and bucketed.b_padded == 8
+        # Row placement still round-trips through pos.
+        assert sorted(int(p) for p in bucketed.pos) == [0, 1, 2]
+
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_paged_refusal_on_impossible_pages(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        engine = InferenceEngine(cfg, num_slots=4, kv_layout="paged",
+                                 page_size=64, num_pages=10)
+        sched = SessionScheduler(engine)
+        try:
+            turns = [(f"k{i}", "p") for i in range(4)]
+            with pytest.raises(SchedulerRefused):
+                sched.submit("big", turns, max_new_tokens=200)
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# drain / fleet interplay
+# ---------------------------------------------------------------------------
+
+
+class TestDrainInterplay:
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_drain_rejects_queued_fast_and_health_reports(self):
+        from theroundtaible_tpu.engine import fleet
+        engine = make_engine()
+        sched = SessionScheduler(engine, max_rows=2)
+        try:
+            a = sched.submit_async("s0", PROMPTS["s0"],
+                                   max_new_tokens=200)
+            deadline = time.monotonic() + 60
+            while sched.admitted < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            b = sched.submit_async("s1", PROMPTS["s1"],
+                                   max_new_tokens=200)
+            health = fleet.fleet_health()
+            snap = next(s for s in health["schedulers"]
+                        if s["sessions"])
+            assert "s0" in snap["sessions"]
+            report = fleet.drain(timeout_s=60)
+            assert report["queued_sessions_rejected"] >= 1
+            # The queued session got a CLEAN DrainingError, immediately.
+            with pytest.raises(deadlines.DrainingError):
+                sched.wait(b)
+            # The in-flight session finished its round normally.
+            texts, _stats = sched.wait(a)
+            assert texts and all(isinstance(t, str) for t in texts)
+            # New submissions are refused at the gate while draining.
+            with pytest.raises(deadlines.DrainingError):
+                sched.submit_async("s2", PROMPTS["s2"])
+        finally:
+            fleet.resume()
+            sched.close()
+
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_budget_expiry_fails_only_that_session(self):
+        engine = make_engine()
+        sched = SessionScheduler(engine, admit_hold_s=0.2)
+        try:
+            tight = deadlines.Budget.root(0.0, rung="turn")  # born expired
+            bad = sched.submit_async("s0", PROMPTS["s0"],
+                                     max_new_tokens=200, budget=tight)
+            good = sched.submit_async("s1", PROMPTS["s1"],
+                                      max_new_tokens=40)
+            with pytest.raises(Exception):
+                sched.wait(bad)
+            texts, _ = sched.wait(good)
+            assert texts
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# the adapter ladder THROUGH the scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestAdapterLadder:
+    @pytest.mark.scheduler(allow_serial=True)
+    def test_kv_corrupt_degrades_to_serial_retry_through_scheduler(self):
+        from theroundtaible_tpu.adapters.base import KnightTurn
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        from theroundtaible_tpu.engine import reset_engines
+        reset_engines()
+        try:
+            adapter = TpuLlmAdapter(
+                "tpu-llm", {"model": "tiny-gemma", "max_seq_len": 512,
+                            "num_slots": 8,
+                            "sampling": {"temperature": 0.0,
+                                         "max_new_tokens": 24}})
+            engine = adapter._get_engine()
+            sched = scheduler_for(engine)
+            adapter.attach_scheduler(sched, session="sA")
+            faults.arm("kv_corrupt", count=1)
+            turns = [KnightTurn(knight_name=n, prompt=p)
+                     for n, p in PROMPTS["s0"]]
+            with pytest.warns(UserWarning, match="retrying"):
+                responses = adapter.execute_round(turns, timeout_ms=120000)
+            assert len(responses) == 2
+            assert adapter.last_degradation == "serial_retry"
+            stats = adapter.last_stats()
+            # Serial retries went THROUGH the scheduler: provenance rode
+            # the stats like int4_paths does.
+            assert stats.get("sched") is not None
+            sched.close()
+        finally:
+            reset_engines()
+
+    @pytest.mark.scheduler
+    def test_serve_discussions_two_concurrent_scripted_sessions(
+            self, tmp_path):
+        """commands/serve end-to-end: two concurrent scripted 2-knight
+        discussions through the orchestrator share one engine + one
+        scheduler, both reach consensus, and the report carries the
+        scheduler's decision provenance."""
+        from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+        from theroundtaible_tpu.commands.serve import serve_discussions
+        from theroundtaible_tpu.core.types import (ConsensusBlock,
+                                                   KnightConfig,
+                                                   RoundtableConfig,
+                                                   RulesConfig)
+        from theroundtaible_tpu.engine import reset_engines
+        from theroundtaible_tpu.adapters import factory
+        reset_engines()
+
+        class Scripted(TpuLlmAdapter):
+            def parse_consensus(self, response, round_num):
+                return ConsensusBlock(
+                    knight=self.name, round=round_num,
+                    consensus_score=9.5, agrees_with=[],
+                    pending_issues=[], proposal="p",
+                    files_to_modify=["x.md"])
+
+        engine_cfg = {"model": "tiny-gemma", "max_seq_len": 512,
+                      "num_slots": 8,
+                      "sampling": {"temperature": 0.0,
+                                   "max_new_tokens": 24}}
+        config = RoundtableConfig(
+            version="1.0", project="t", language="en",
+            knights=[KnightConfig(name=f"Knight-{c}", adapter="tpu-llm",
+                                  capabilities=[], priority=i + 1)
+                     for i, c in enumerate("AB")],
+            rules=RulesConfig(max_rounds=1, consensus_threshold=9,
+                              timeout_per_turn_seconds=120,
+                              escalate_to_user_after=4,
+                              auto_execute=False, parallel_rounds=True),
+            chronicle="chronicle.md", adapter_config={"tpu-llm": {}})
+        (tmp_path / ".roundtable" / "sessions").mkdir(parents=True)
+
+        real_create = factory.create_adapter
+
+        def scripted_create(adapter_id, cfg, timeout_ms):
+            if adapter_id.startswith("tpu-llm"):
+                return Scripted("tpu-llm", engine_cfg, timeout_ms)
+            return real_create(adapter_id, cfg, timeout_ms)
+
+        factory.create_adapter = scripted_create
+        try:
+            report = serve_discussions(
+                ["Topic one for the table", "Topic one for the table"],
+                config, str(tmp_path), admit_hold_s=0.4)
+        finally:
+            factory.create_adapter = real_create
+            reset_engines()
+        assert all(e["ok"] for e in report["sessions"]), report["sessions"]
+        assert all(e["result"].consensus for e in report["sessions"])
+        assert len(report["schedulers"]) == 1
+        prov = report["schedulers"][0]
+        assert prov["admitted"] >= 2
+        assert prov["max_occupancy"] > 1
+        # Distinct session dirs even for an identical topic (slug dedup).
+        paths = {e["session_path"] for e in report["sessions"]}
+        assert len(paths) == 2
+
+
+# ---------------------------------------------------------------------------
+# metrics under concurrency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.scheduler(allow_serial=True)
+class TestMetricsConcurrency:
+    def test_turn_records_carry_scheduler_fields(self, tmp_path):
+        from theroundtaible_tpu.utils.metrics import SessionMetrics
+        m = SessionMetrics(tmp_path)
+        m.record_turn("k", 1, 1.0, engine={
+            "decode_tokens": 5,
+            "sched": {"queue_wait_s": 0.25, "occupancy_mean": 4.0}})
+        t = m.rounds[-1].turns[-1]
+        assert t.queue_wait_s == 0.25
+        assert t.batch_occupancy == 4.0
+        m.write()
+        import json
+        data = json.loads((tmp_path / "metrics.json").read_text())
+        turn = data["rounds"][0]["turns"][0]
+        assert turn["queue_wait_s"] == 0.25
+        assert turn["batch_occupancy"] == 4.0
+
+    def test_concurrent_record_turn_is_safe(self, tmp_path):
+        from theroundtaible_tpu.utils.metrics import SessionMetrics
+        m = SessionMetrics(tmp_path)
+        m.start_round(1)
+
+        def spam(k):
+            for _ in range(50):
+                m.record_turn(f"k{k}", 1, 0.01)
+                m.write()
+
+        threads = [threading.Thread(target=spam, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(len(r.turns) for r in m.rounds) == 200
+        m.finish("done")

@@ -46,10 +46,17 @@ class TpuLlmAdapter(BaseAdapter):
     """BaseAdapter over an EngineHandle (theroundtaible_tpu.engine)."""
 
     def __init__(self, name: str, engine_config: dict[str, Any],
-                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                 session: Optional[str] = None):
         super().__init__(name)
         self.engine_config = dict(engine_config)
         self.default_timeout = timeout_ms
+        # Session identity (ISSUE 4): namespaces this adapter's KV slot
+        # names (kvcache.scoped_slot) so concurrent discussions sharing
+        # one resident engine never collide — and routes rounds through
+        # the attached continuous-batching scheduler when one is set.
+        self.session = session
+        self._scheduler = None
         self._engine = None
         self._engine_error: Optional[str] = None
         self._last_stats: Optional[dict] = None
@@ -100,6 +107,51 @@ class TpuLlmAdapter(BaseAdapter):
                 f"TPU engine unavailable: {self._engine_error}",
                 kind=classify_error(RuntimeError(self._engine_error or "")))
         return self._engine
+
+    def attach_scheduler(self, scheduler,
+                         session: Optional[str] = None) -> None:
+        """Route this adapter's rounds through a shared continuous-
+        batching SessionScheduler (engine/scheduler.py). Every rung of
+        the degradation ladder — the batched attempt AND the per-knight
+        serial retries — then goes through the scheduler's queue, so a
+        degraded session keeps co-scheduling with healthy ones instead
+        of seizing the engine serially.
+
+        A scheduled adapter ALWAYS has a session id: with none given
+        (and none set), a unique one is generated — the adapter NAME is
+        not unique (the factory names every instance by adapter id), and
+        two adapters falling back to one shared name would share an
+        isolation domain, re-creating exactly the cross-session slot
+        collision the namespace exists to prevent."""
+        self._scheduler = scheduler
+        if session is not None:
+            self.session = session
+        elif not self.session:
+            import uuid
+            self.session = f"{self.name}-{uuid.uuid4().hex[:8]}"
+
+    def _effective_session(self) -> Optional[str]:
+        """The session namespace the engine-side slots actually live
+        under. _serve and _slot_name MUST agree, or serial-retry slot
+        invalidation would release a name the scheduler never allocated;
+        attach_scheduler guarantees a session id whenever a scheduler
+        is attached."""
+        return self.session
+
+    def _serve(self, engine, turn_pairs, **kwargs):
+        """The one engine-call seam: scheduled sessions submit to the
+        shared batch; unscheduled calls hit the engine directly with the
+        session namespace applied."""
+        if self._scheduler is not None:
+            return self._scheduler.submit(
+                self._effective_session(), turn_pairs, **kwargs)
+        return engine.generate_batch_with_stats(
+            turn_pairs, session=self.session, **kwargs)
+
+    def _slot_name(self, knight_name: str) -> str:
+        """The engine-side slot name for a knight of THIS session."""
+        from ..engine.kvcache import scoped_slot
+        return scoped_slot(self._effective_session(), knight_name)
 
     def known_unhealthy(self) -> bool:
         # No construction here (contract): just the breaker verdict and
@@ -257,6 +309,11 @@ class TpuLlmAdapter(BaseAdapter):
             # per-turn engine stats into metrics.json so a window's int4
             # numbers are attributable.
             self._last_stats["int4_paths"] = stats.int4_paths
+        if stats.sched is not None:
+            # Scheduler provenance (ISSUE 4): queue wait + decode-batch
+            # occupancy ride the per-turn stats into metrics.json, same
+            # pattern as int4_paths.
+            self._last_stats["sched"] = stats.sched
         if self.last_degradation:
             self._last_stats["degraded"] = self.last_degradation
         if self.last_recovered_kind:
@@ -285,8 +342,9 @@ class TpuLlmAdapter(BaseAdapter):
             kwargs["max_new_tokens"] = max(
                 p.max_new_tokens for p in per_turn)
         try:
-            return engine.generate_batch_with_stats(
-                [(t.knight_name, t.prompt) for t in turns], **kwargs)
+            return self._serve(
+                engine, [(t.knight_name, t.prompt) for t in turns],
+                **kwargs)
         except Exception as batch_err:  # noqa: BLE001
             if len(turns) < 2:
                 raise
@@ -329,8 +387,17 @@ class TpuLlmAdapter(BaseAdapter):
                 "KV buffers were consumed by the failed dispatch; "
                 "reallocated fresh pools (all cached slots lost)",
                 stacklevel=3)
-        for t in turns:
-            engine.kv.release(t.knight_name)
+        if self._scheduler is None:
+            # Release the SESSION-SCOPED slots (the names the engine
+            # actually allocated). Scheduled sessions skip this: the
+            # scheduler's _fail_request already released the failed
+            # round's slots ON ITS OWN THREAD — releasing here would
+            # mutate shared SlotBook/PagedKVCache host state from the
+            # session thread while the scheduler thread iterates it
+            # (dict-changed-during-iteration crashes the loop and fails
+            # every other session).
+            for t in turns:
+                engine.kv.release(self._slot_name(t.knight_name))
         from ..engine.engine import GenStats
         total = GenStats()
         responses = []
@@ -354,8 +421,11 @@ class TpuLlmAdapter(BaseAdapter):
                 kwargs["sampling_per_turn"] = [per_turn[i]]
                 kwargs["max_new_tokens"] = per_turn[i].max_new_tokens
             try:
-                out, stats = engine.generate_batch_with_stats(
-                    [(t.knight_name, t.prompt)], **kwargs)
+                # Through the scheduler when attached: the degraded
+                # session's serial turns co-schedule with OTHER sessions'
+                # healthy rows instead of seizing the engine.
+                out, stats = self._serve(
+                    engine, [(t.knight_name, t.prompt)], **kwargs)
             except Exception as serial_err:  # noqa: BLE001
                 # Best-effort really means it: one knight's pathology
                 # must not abandon the rest of the round. Keep serving
@@ -368,6 +438,7 @@ class TpuLlmAdapter(BaseAdapter):
                 continue
             responses.append(out[0])
             total.int4_paths = stats.int4_paths
+            total.sched = stats.sched
             total.prefill_tokens += stats.prefill_tokens
             total.reused_tokens += stats.reused_tokens
             total.decode_tokens += stats.decode_tokens
@@ -386,10 +457,15 @@ class TpuLlmAdapter(BaseAdapter):
         self.last_recovered_kind = classify_error(batch_err)
         return responses, total
 
-    @staticmethod
-    def _revive_best_effort(engine) -> bool:
+    def _revive_best_effort(self, engine) -> bool:
         """revive_kv_if_dead that never raises: a broken revive must not
-        mask the dispatch error the operator actually needs to see."""
+        mask the dispatch error the operator actually needs to see.
+        Scheduled sessions never revive from here — the scheduler's
+        _after_engine_failure owns donation-death recovery on its own
+        thread (a session-thread revive would swap the pools out from
+        under a concurrently-dispatching scheduler)."""
+        if self._scheduler is not None:
+            return False
         try:
             return getattr(engine, "revive_kv_if_dead", lambda: False)()
         except Exception:  # noqa: BLE001 — the dispatch error wins

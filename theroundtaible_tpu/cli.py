@@ -59,6 +59,19 @@ def build_parser():
     d.add_argument("--no-read-code", dest="read_code", action="store_false",
                    help="Skip reading source code without asking")
 
+    v = sub.add_parser(
+        "serve",
+        help="Serve K concurrent discussions on one shared engine fleet")
+    v.add_argument("topics", nargs="+",
+                   help="Topics (one concurrent discussion each)")
+    v.add_argument("--sessions", type=int, default=None,
+                   help="Fan ONE topic into K concurrent discussions")
+    v.add_argument("--read-code", action="store_true", default=None,
+                   help="Read source code into context without asking")
+    v.add_argument("--no-read-code", dest="read_code",
+                   action="store_false",
+                   help="Skip reading source code without asking")
+
     s = sub.add_parser("summon", help="Review the current git diff")
     s.add_argument("--read-code", action="store_true", default=None,
                    help="Read source code into context without asking")
@@ -126,6 +139,10 @@ def dispatch(args) -> int:
             return continue_command(read_code=args.read_code)
         from .commands.discuss import discuss_command
         return discuss_command(args.topic, read_code=args.read_code)
+    if args.command == "serve":
+        from .commands.serve import serve_command
+        return serve_command(args.topics, sessions=args.sessions,
+                             read_code=args.read_code)
     if args.command == "summon":
         from .commands.summon import summon_command
         return summon_command(read_code=args.read_code)

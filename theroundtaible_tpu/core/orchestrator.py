@@ -524,13 +524,19 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
             if state.metrics:
                 # one batched program served the whole group: group wall
                 # for every knight, engine numbers attached once (to the
-                # first knight) so totals don't multiply
+                # first knight) so totals don't multiply. Scheduler
+                # provenance (queue wait, decode-batch occupancy) is a
+                # property of the whole round, not a summable quantity —
+                # every knight's turn record carries it (ISSUE 4).
+                sched = (engine_stats or {}).get("sched") or {}
                 for i, (k, t, resp) in enumerate(
                         zip(knights, turns, responses)):
                     state.metrics.record_turn(
                         k.name, round_num, group_wall,
                         chars_in=len(t.prompt), chars_out=len(resp),
-                        engine=engine_stats if i == 0 else None)
+                        engine=engine_stats if i == 0 else None,
+                        queue_wait_s=sched.get("queue_wait_s"),
+                        batch_occupancy=sched.get("occupancy_mean"))
             for k, resp in zip(knights, responses):
                 response_by_knight[k.name] = (resp, adapter)
         for knight in round_order:

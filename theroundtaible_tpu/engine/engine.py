@@ -74,6 +74,11 @@ class GenStats:
     # Populated at trace time, snapshotted per call; None on non-int4
     # engines.
     int4_paths: Optional[dict] = None
+    # Scheduler provenance (ISSUE 4): set only on calls served through
+    # the continuous-batching session scheduler — queue_wait_s,
+    # occupancy_mean/max (decode-batch rows while this call's rows were
+    # active), segments, sessions_max. None on direct engine calls.
+    sched: Optional[dict] = None
 
     @property
     def prefill_tps(self) -> float:
@@ -1015,8 +1020,9 @@ class InferenceEngine:
 
     def _share_prefixes(self, names: list[str], slot_ids: list[int],
                         all_tokens: list[list[int]], offsets: list[int],
-                        deadline: float,
-                        budget=None) -> tuple[list[int], int]:
+                        deadline: float, budget=None,
+                        extra_pinned: tuple[str, ...] = ()
+                        ) -> tuple[list[int], int]:
         """Cross-knight shared-prefix reuse (SURVEY.md §7.3 hard part 2;
         reference prompt assembly src/orchestrator.ts:397-425 makes all
         knights share the giant context+transcript preamble, which the
@@ -1042,7 +1048,7 @@ class InferenceEngine:
         takes the ring path on sequence-parallel engines."""
         from .kvcache import share_prefixes
         paged = self.kv_layout == "paged"
-        pinned = tuple(names)
+        pinned = tuple(names) + tuple(extra_pinned)
         copies: list[tuple[int, int, int, int]] = []
 
         def add_share(donor, i, lo, hi):
@@ -1079,24 +1085,191 @@ class InferenceEngine:
         return share_prefixes(
             self.kv, names, all_tokens, offsets,
             min_shared=MIN_SHARED_PREFIX, add_share=add_share,
-            flush_shares=flush_shares, prefill_span=prefill_span)
+            flush_shares=flush_shares, prefill_span=prefill_span,
+            extra_pinned=extra_pinned)
+
+    def _prepare_batch(self, turns, max_new_padded, deadline, pre_budget,
+                       sampling_per_turn=None,
+                       extra_pinned: tuple[str, ...] = ()) -> dict:
+        """The pre-decode phase, ONE definition shared by
+        generate_batch and the session scheduler's admission
+        (engine/scheduler.py) so the two can never drift on token
+        parity: tokenize + tail-truncate → own-slot reuse_plan →
+        cross-knight share_prefixes → paged capacity/COW + replica
+        plan → chunked/ring prefill → first-token sample.
+
+        `extra_pinned` names survive every eviction this phase can
+        trigger (the scheduler pins its actively-decoding rows).
+        Returns a dict with: names, slot_ids, all_tokens, offsets
+        (post-share), plan, tables_np (plan-padded when plan is set),
+        per_row, temps/top_ks/top_ps (plan-scattered), greedy,
+        first_np (ORIGINAL row order), prefill_tokens, reused_tokens.
+        """
+        pinned = tuple(name for name, _ in turns) + tuple(extra_pinned)
+        slot_ids, offsets, all_tokens = [], [], []
+        for name, prompt in turns:
+            # A list of ids is accepted as a pre-tokenized prompt (warmup
+            # uses this to hit exact bucket shapes).
+            tokens = (list(prompt) if isinstance(prompt, list)
+                      else self.tokenizer.encode(prompt))
+            budget_tok = prompt_budget(self.max_seq_len, max_new_padded)
+            if len(tokens) > budget_tok:
+                # Keep the tail — the turn ask and latest transcript live
+                # there (head truncation mirrors context budgeting
+                # intent).
+                tokens = (tokens[:1]
+                          + tokens[len(tokens) - budget_tok + 1:])
+            slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
+            slot_ids.append(slot_id)
+            offsets.append(reuse)
+            all_tokens.append(tokens)
+
+        names = [name for name, _ in turns]
+        # Cross-knight shared-prefix reuse raises offsets by copying (or,
+        # paged, aliasing) other slots' K/V; only the per-knight deltas
+        # remain to prefill.
+        offsets, leader_prefill = self._share_prefixes(
+            names, slot_ids, all_tokens, offsets, deadline,
+            budget=pre_budget, extra_pinned=tuple(extra_pinned))
+        plan = None
+        tables_np = None
+        if self.kv_layout == "paged":
+            # Allocate pages for the whole call (prompt + padded decode)
+            # and copy-on-write any shared page in the write range, so
+            # the jit'd programs below never allocate or touch aliased
+            # pages.
+            for i, name in enumerate(names):
+                self.kv.ensure_capacity(
+                    name, len(all_tokens[i]) + max_new_padded,
+                    write_from=offsets[i], pinned=pinned)
+            tables_np = self.kv.table_for(names)
+            if self.paged_direct and self._paged_replicas > 1:
+                # Pool-direct under data>1 (VERDICT r4 #4): shard_map
+                # splits batch rows into contiguous per-data-index
+                # blocks, so rows are permuted into the block of the
+                # replica owning their slot's pages; pad rows point at
+                # that replica's scratch page and start done.
+                plan = ReplicaGroupPlan(
+                    [self.kv.replica_of(n) for n in names],
+                    self._paged_replicas)
+                tables_np = plan.pad_table(tables_np,
+                                           self.kv.scratch_page)
+        suffixes = [t[o:] for t, o in zip(all_tokens, offsets)]
+        prefill_tokens = leader_prefill + sum(len(s) for s in suffixes)
+        # "reused" counts both own-slot LCP hits and copied donor spans.
+        reused_tokens = sum(len(t) for t in all_tokens) - prefill_tokens
+        p_offsets = offsets
+        if plan is not None:
+            suffixes = plan.scatter_list(suffixes,
+                                         [self.tokenizer.pad_id])
+            p_offsets = plan.scatter_list(offsets, 0)
+        last_logits = self._prefill(slot_ids, suffixes, p_offsets,
+                                    deadline=deadline, tables=tables_np,
+                                    budget=pre_budget)
+        # A scalar fetch, not block_until_ready: some PJRT transports
+        # (the axon relay) return from block_until_ready before the
+        # computation finishes, which would blame prefill time on decode
+        # — and a blocking read, so it goes through the deadline seam (a
+        # wedged prefill program freezes the host exactly here).
+        host_sync(lambda: float(last_logits[0, 0]), pre_budget, "prefill")
+
+        per_row = sampling_per_turn or [self.sampling] * len(turns)
+        if len(per_row) != len(turns):
+            raise ValueError(
+                f"sampling_per_turn has {len(per_row)} entries for "
+                f"{len(turns)} turns")
+        temps, top_ks, top_ps = sampling_arrays(per_row)
+        greedy = all(p.temperature <= 0.0 for p in per_row)
+        if plan is not None:
+            # The whole decode phase runs in padded replica-grouped row
+            # order; callers read back through plan.pos.
+            temps = plan.scatter_rows(temps, 1.0)
+            top_ks = plan.scatter_rows(top_ks, 0)
+            top_ps = plan.scatter_rows(top_ps, 1.0)
+        if greedy:
+            first = jnp.argmax(last_logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+        else:
+            first = sample_token_batch(last_logits.astype(jnp.float32),
+                                       self._next_key(), temps, top_ks,
+                                       top_ps).astype(jnp.int32)
+        if plan is not None and len(plan.pad_positions):
+            # Pad rows open at eos so they are done from the first step.
+            first = first.at[jnp.asarray(plan.pad_positions)].set(
+                jnp.int32(self.tokenizer.eos_id))
+        first_np = host_sync(lambda: np.asarray(first), pre_budget,
+                             "prefill")
+        if plan is not None:
+            first_np = first_np[plan.pos]
+        return {
+            "names": names, "slot_ids": slot_ids,
+            "all_tokens": all_tokens, "offsets": offsets, "plan": plan,
+            "tables_np": tables_np, "per_row": per_row, "temps": temps,
+            "top_ks": top_ks, "top_ps": top_ps, "greedy": greedy,
+            "first_np": first_np, "prefill_tokens": prefill_tokens,
+            "reused_tokens": reused_tokens,
+        }
+
+    def _decode_dispatch_paged(self, tables, last, valid, key, budget,
+                               temps, top_ks, top_ps, row_budgets, done0,
+                               *, greedy, max_new=DECODE_SEGMENT):
+        """One paged decode-segment dispatch through the kernel-
+        degradation rung (mosaic chaos point; pool-direct → gather-view
+        on kernel failure, re-dispatching this segment), committing the
+        donated pools under commit_guard. Shared by generate_batch's
+        segment loop and the session scheduler."""
+        def run():
+            if self.paged_direct and faults.ARMED:
+                faults.maybe_inject("mosaic_compile")
+            return self._decode_loop_paged(
+                self.params, self.kv.pools, tables, last, valid, key,
+                budget, temps, top_ks, top_ps, row_budgets, done0,
+                max_new=max_new, greedy=greedy)
+
+        try:
+            out, steps, l2, v2, d2, pools = run()
+        except Exception as e:
+            if not (faults.is_kernel_failure(e)
+                    and self._degrade_paged_direct(str(e))):
+                raise
+            out, steps, l2, v2, d2, pools = run()
+        # A watchdog-abandoned dispatch completing late must NOT commit
+        # onto pools the recovery path may have revived.
+        with deadlines.commit_guard():
+            self.kv.pools = pools
+        return out, steps, l2, v2, d2
+
+    def _decode_dispatch_slots(self, slot_idx, last, valid, key, budget,
+                               temps, top_ks, top_ps, row_budgets, done0,
+                               *, greedy, max_new=DECODE_SEGMENT):
+        """Contiguous-layout counterpart of _decode_dispatch_paged."""
+        out, steps, l2, v2, d2, layers = self._decode_loop(
+            self.params, self.kv.layers, slot_idx, last, valid, key,
+            budget, temps, top_ks, top_ps, row_budgets, done0,
+            max_new=max_new, greedy=greedy)
+        with deadlines.commit_guard():
+            self.kv.layers = layers
+        return out, steps, l2, v2, d2
 
     def generate(self, prompt: str, slot_name: str = "default",
                  max_new_tokens: Optional[int] = None,
-                 timeout_s: float = 600.0) -> str:
+                 timeout_s: float = 600.0, session: Optional[str] = None,
+                 ) -> str:
         return self.generate_batch([(slot_name, prompt)],
                                    max_new_tokens=max_new_tokens,
-                                   timeout_s=timeout_s)[0]
+                                   timeout_s=timeout_s, session=session)[0]
 
     def generate_batch(self, turns: list[tuple[str, str]],
                        max_new_tokens: Optional[int] = None,
                        timeout_s: float = 600.0,
                        sampling_per_turn: Optional[
                            list[SamplingParams]] = None,
-                       budget=None) -> list[str]:
+                       budget=None,
+                       session: Optional[str] = None) -> list[str]:
         return self.generate_batch_with_stats(
             turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
-            sampling_per_turn=sampling_per_turn, budget=budget)[0]
+            sampling_per_turn=sampling_per_turn, budget=budget,
+            session=session)[0]
 
     def generate_batch_with_stats(
             self, turns: list[tuple[str, str]],
@@ -1104,6 +1277,7 @@ class InferenceEngine:
             timeout_s: float = 600.0,
             sampling_per_turn: Optional[list[SamplingParams]] = None,
             budget=None,
+            session: Optional[str] = None,
     ) -> tuple[list[str], GenStats]:
         """Serve N (slot_name, prompt) turns as one batched program pair.
 
@@ -1111,10 +1285,17 @@ class InferenceEngine:
         personas); None = the engine default for every row. `budget`: a
         turn-rung deadlines.Budget threaded down from the adapter (the
         time ladder); None builds a local root from `timeout_s`, so
-        direct engine callers get the same rung structure. Returns
+        direct engine callers get the same rung structure. `session`
+        namespaces the slot names (kvcache.scoped_slot) so two concurrent
+        discussions' same-named knights never collide in the LRU — the
+        cross-session-contamination fix (ISSUE 4 satellite). Returns
         (responses, this call's stats) — callers needing stats must take
         them from the return value, not from `last_stats`, which is a
         convenience field that concurrent callers may overwrite."""
+        if session:
+            from .kvcache import scoped_slot
+            turns = [(scoped_slot(session, name), prompt)
+                     for name, prompt in turns]
         # Admission gate (fleet.drain): one module-flag check per CALL,
         # nothing on the per-token path. In-flight generations (already
         # past this check, possibly waiting on the serve lock) complete.
@@ -1143,115 +1324,36 @@ class InferenceEngine:
             else deadlines.Budget.root(timeout_s, rung="turn")
         deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
         pre_budget = turn_budget.child("prefill")
-        max_new = max_new_tokens or self.sampling.max_new_tokens
-        # Decode budget can never exceed half the context — misconfigured
-        # max_new_tokens otherwise drives the prompt budget negative and
-        # every prompt would silently collapse to [bos].
-        max_new = max(1, min(max_new, self.max_seq_len // 2))
-
-        # Decode runs in whole DECODE_SEGMENT programs, so up to
-        # round-up(max_new, segment) cache positions get written; the
-        # prompt budget must reserve the padded figure or the surplus
-        # tokens' K/V writes would clamp onto (and corrupt) the last
-        # committed cache position.
-        max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
-
-        pinned = tuple(name for name, _ in turns)
-        slot_ids, offsets, all_tokens = [], [], []
-        for name, prompt in turns:
-            # A list of ids is accepted as a pre-tokenized prompt (warmup
-            # uses this to hit exact bucket shapes).
-            tokens = (list(prompt) if isinstance(prompt, list)
-                      else self.tokenizer.encode(prompt))
-            budget = prompt_budget(self.max_seq_len, max_new_padded)
-            if len(tokens) > budget:
-                # Keep the tail — the turn ask and latest transcript live
-                # there (head truncation mirrors context budgeting intent).
-                tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
-            slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
-            slot_ids.append(slot_id)
-            offsets.append(reuse)
-            all_tokens.append(tokens)
+        # One clamp definition for engines + scheduler (serving_loop
+        # .clamp_max_new): drift here desynchronizes admission page
+        # estimates, row budgets, and retirement output caps.
+        from .serving_loop import clamp_max_new
+        max_new, max_new_padded = clamp_max_new(
+            max_new_tokens or self.sampling.max_new_tokens,
+            self.max_seq_len)
 
         t0 = time.monotonic()
-        names = [name for name, _ in turns]
-        # Cross-knight shared-prefix reuse raises offsets by copying (or,
-        # paged, aliasing) other slots' K/V; only the per-knight deltas
-        # remain to prefill.
-        offsets, leader_prefill = self._share_prefixes(
-            names, slot_ids, all_tokens, offsets, deadline,
-            budget=pre_budget)
-        plan = None
-        tables_np = None
-        if self.kv_layout == "paged":
-            # Allocate pages for the whole call (prompt + padded decode)
-            # and copy-on-write any shared page in the write range, so the
-            # jit'd programs below never allocate or touch aliased pages.
-            for i, name in enumerate(names):
-                self.kv.ensure_capacity(
-                    name, len(all_tokens[i]) + max_new_padded,
-                    write_from=offsets[i], pinned=pinned)
-            tables_np = self.kv.table_for(names)
-            if self.paged_direct and self._paged_replicas > 1:
-                # Pool-direct under data>1 (VERDICT r4 #4): shard_map
-                # splits batch rows into contiguous per-data-index
-                # blocks, so rows are permuted into the block of the
-                # replica owning their slot's pages; pad rows point at
-                # that replica's scratch page and start done. The
-                # padded batch runs end to end (prefill chunks AND
-                # decode) with the gather view never built.
-                plan = ReplicaGroupPlan(
-                    [self.kv.replica_of(n) for n in names],
-                    self._paged_replicas)
-                tables_np = plan.pad_table(tables_np,
-                                           self.kv.scratch_page)
-        suffixes = [t[o:] for t, o in zip(all_tokens, offsets)]
-        stats.prefill_tokens = leader_prefill + sum(
-            len(s) for s in suffixes)
-        # "reused" counts both own-slot LCP hits and copied donor spans.
-        stats.reused_tokens = sum(
-            len(t) for t in all_tokens) - stats.prefill_tokens
-        if plan is not None:
-            suffixes = plan.scatter_list(suffixes,
-                                         [self.tokenizer.pad_id])
-            offsets = plan.scatter_list(offsets, 0)
-        last_logits = self._prefill(slot_ids, suffixes, offsets,
-                                    deadline=deadline, tables=tables_np,
-                                    budget=pre_budget)
-        # A scalar fetch, not block_until_ready: some PJRT transports
-        # (the axon relay) return from block_until_ready before the
-        # computation finishes, which would blame prefill time on decode
-        # — and a blocking read, so it goes through the deadline seam (a
-        # wedged prefill program freezes the host exactly here).
-        host_sync(lambda: float(last_logits[0, 0]), pre_budget, "prefill")
+        prep = self._prepare_batch(turns, max_new_padded, deadline,
+                                   pre_budget, sampling_per_turn)
+        stats.prefill_tokens = prep["prefill_tokens"]
+        stats.reused_tokens = prep["reused_tokens"]
         stats.prefill_seconds = time.monotonic() - t0
 
-        per_row = sampling_per_turn or [self.sampling] * len(turns)
-        if len(per_row) != len(turns):
-            raise ValueError(
-                f"sampling_per_turn has {len(per_row)} entries for "
-                f"{len(turns)} turns")
-        temps, top_ks, top_ps = sampling_arrays(per_row)
-        greedy = all(p.temperature <= 0.0 for p in per_row)
+        plan = prep["plan"]
+        all_tokens = prep["all_tokens"]
+        first_np = prep["first_np"]
+        per_row = prep["per_row"]
+        temps, top_ks, top_ps = (prep["temps"], prep["top_ks"],
+                                 prep["top_ps"])
+        greedy = prep["greedy"]
+        # first_np comes back in ORIGINAL row order; the decode phase
+        # runs in plan order (padded replica-grouped rows) when a plan
+        # exists, so scatter it back — pad rows open at eos (done).
         if plan is not None:
-            # The whole decode phase runs in padded replica-grouped row
-            # order; outputs are read back through plan.pos at the end.
-            temps = plan.scatter_rows(temps, 1.0)
-            top_ks = plan.scatter_rows(top_ks, 0)
-            top_ps = plan.scatter_rows(top_ps, 1.0)
-        if greedy:
-            first = jnp.argmax(last_logits.astype(jnp.float32),
-                               axis=-1).astype(jnp.int32)
+            first = plan.scatter_rows(
+                first_np.astype(np.int32), np.int32(self.tokenizer.eos_id))
         else:
-            first = sample_token_batch(last_logits.astype(jnp.float32),
-                                       self._next_key(), temps, top_ks,
-                                       top_ps).astype(jnp.int32)
-        if plan is not None and len(plan.pad_positions):
-            # Pad rows open at eos so they are done from the first step.
-            first = first.at[jnp.asarray(plan.pad_positions)].set(
-                jnp.int32(self.tokenizer.eos_id))
-        first_np = host_sync(lambda: np.asarray(first), pre_budget,
-                             "prefill")
+            first = jnp.asarray(first_np, jnp.int32)
         cur_valid = jnp.asarray([len(t) for t in all_tokens], jnp.int32)
         if plan is not None:
             cur_valid = plan.scatter_rows(cur_valid, 1)
@@ -1260,8 +1362,8 @@ class InferenceEngine:
         # Decode rung budget is derived NOW, not at call start, so a
         # configured "decode" cap times the decode phase alone.
         dec_budget = turn_budget.child("decode")
-        slot_idx = jnp.asarray(slot_ids, jnp.int32)
-        tables = (jnp.asarray(tables_np)
+        slot_idx = jnp.asarray(prep["slot_ids"], jnp.int32)
+        tables = (jnp.asarray(prep["tables_np"])
                   if self.kv_layout == "paged" else None)
         # Per-row decode budgets (knight_sampling max_new_tokens): a row
         # whose own budget is smaller than the batch's stops early (goes
@@ -1275,37 +1377,14 @@ class InferenceEngine:
             if plan is not None:
                 row_budgets = plan.scatter_rows(row_budgets, 0)
             if tables is not None:
-                def run_paged():
-                    if self.paged_direct and faults.ARMED:
-                        faults.maybe_inject("mosaic_compile")
-                    return self._decode_loop_paged(
-                        self.params, self.kv.pools, tables, cur_last,
-                        cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, row_budgets, done0,
-                        max_new=DECODE_SEGMENT, greedy=greedy)
-
-                try:
-                    out, steps, last, valid, done, pools = run_paged()
-                except Exception as e:
-                    # Same degradation rung as prefill: kernel-path
-                    # failure → gather-view programs, re-dispatching
-                    # this segment.
-                    if not (faults.is_kernel_failure(e)
-                            and self._degrade_paged_direct(str(e))):
-                        raise
-                    out, steps, last, valid, done, pools = run_paged()
-                with deadlines.commit_guard():
-                    self.kv.pools = pools
-            else:
-                out, steps, last, valid, done, layers = \
-                    self._decode_loop(
-                        self.params, self.kv.layers, slot_idx, cur_last,
-                        cur_valid, self._next_key(), budget, temps,
-                        top_ks, top_ps, row_budgets, done0,
-                        max_new=DECODE_SEGMENT, greedy=greedy)
-                with deadlines.commit_guard():
-                    self.kv.layers = layers
-            return out, steps, last, valid, done
+                return self._decode_dispatch_paged(
+                    tables, cur_last, cur_valid, self._next_key(),
+                    budget, temps, top_ks, top_ps, row_budgets, done0,
+                    greedy=greedy)
+            return self._decode_dispatch_slots(
+                slot_idx, cur_last, cur_valid, self._next_key(),
+                budget, temps, top_ks, top_ps, row_budgets, done0,
+                greedy=greedy)
 
         out_np = decode_segments(decode_dispatch, first, cur_valid,
                                  self.tokenizer.eos_id, max_new, deadline,
@@ -1313,7 +1392,6 @@ class InferenceEngine:
                                  budget=dec_budget)
         stats.decode_seconds = time.monotonic() - t1
         if plan is not None:
-            first_np = first_np[plan.pos]
             out_np = out_np[plan.pos]
 
         results = finalize_outputs(
@@ -1347,4 +1425,10 @@ class InferenceEngine:
             info["kv_hbm_bytes"] = self.kv.hbm_bytes()
             info["paged_decode"] = ("pool-direct" if self.paged_direct
                                     else "gather-view")
+        # Continuous-batching scheduler provenance (ISSUE 4): attached by
+        # engine/scheduler.SessionScheduler — admit/queue/refuse counts,
+        # queue depth, per-segment batch occupancy.
+        sched = getattr(self, "_scheduler", None)
+        if sched is not None:
+            info["scheduler"] = sched.describe()
         return info

@@ -264,10 +264,15 @@ def fleet_health() -> dict[str, Any]:
     additionally counts engines with recent (not yet trip-level)
     consecutive failures. `draining` reports the admission gate and
     `hangs` the watchdog's recent hang detections (ISSUE 2 time ladder).
-    Cheap — host-side counters only, no device work — so status surfaces
-    can poll it per round."""
+    `schedulers` (ISSUE 4) snapshots every live continuous-batching
+    session scheduler: queue depth and per-session state, so an operator
+    can see WHO is waiting behind a drain or a full batch. Cheap —
+    host-side counters only, no device work — so status surfaces can
+    poll it per round."""
     from . import breaker_snapshots, deadlines
+    from .scheduler import schedulers
     snaps = breaker_snapshots()
+    sched_snaps = [s.snapshot() for s in schedulers()]
     return {
         "engines": snaps,
         "total": len(snaps),
@@ -276,6 +281,8 @@ def fleet_health() -> dict[str, Any]:
                         if s["failures"] > 0 and not s["open"]),
         "draining": deadlines.DRAINING,
         "hangs": len(deadlines.hang_log()),
+        "schedulers": sched_snaps,
+        "queued_sessions": sum(s["queued"] for s in sched_snaps),
     }
 
 
@@ -288,6 +295,12 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
        every later `generate_batch*` call on ANY resident engine raises
        DrainingError; calls already past the gate (in flight, or queued
        on a serve lock) complete normally.
+    1b. Reject every QUEUED-but-unadmitted session scheduler request
+       immediately with a clean DrainingError (ISSUE 4 satellite: a
+       queued session must not wait out its whole budget just to learn
+       the fleet is going away); the schedulers' ACTIVE sessions finish
+       their rounds like any in-flight turn, releasing the serve locks
+       step 2 waits on.
     2. For each resident engine, acquire its serve lock within
        `timeout_s` — acquisition IS the proof that in-flight work
        finished — and, holding it, flush every per-knight slot through
@@ -301,12 +314,18 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
     a report: per-engine flush counts and whether the drain was clean."""
     import time
     from . import _engines, _lock, deadlines
+    from .scheduler import schedulers
     deadlines.begin_drain()
     deadline = time.monotonic() + timeout_s
+    # Queued scheduler sessions fail fast NOW — their submitters were
+    # never admitted, so there is nothing to wait for; active sessions
+    # drain through the serve-lock wait below like any in-flight turn.
+    rejected = sum(s.reject_queued() for s in schedulers())
     with _lock:
         engines = list(_engines.items())
     report: dict[str, Any] = {"draining": True, "clean": True,
-                              "engines": []}
+                              "engines": [],
+                              "queued_sessions_rejected": rejected}
     for key, eng in engines:
         entry: dict[str, Any] = {
             "engine": getattr(getattr(eng, "cfg", None), "name", key)}

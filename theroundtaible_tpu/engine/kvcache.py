@@ -22,6 +22,27 @@ import jax.numpy as jnp
 
 from .models.common import ModelConfig
 
+# Session-namespaced slot names (ISSUE 4 satellite: two concurrent
+# discussions both acquiring "lancelot" used to map to ONE slot and
+# cross-contaminate KV through reuse_plan). The separator is the ASCII
+# unit separator — no tokenizer/config surface produces it, so a scoped
+# name can never collide with a legal knight name.
+SESSION_SEP = "\x1f"
+
+
+def scoped_slot(session: Optional[str], name: str) -> str:
+    """The canonical session-namespaced slot name: `session␟name`.
+    None/"" session returns the bare name (single-session legacy)."""
+    return f"{session}{SESSION_SEP}{name}" if session else name
+
+
+def session_of(name: str) -> str:
+    """The session namespace of a (possibly scoped) slot name; "" for
+    un-scoped names. Used to keep cross-knight prefix DONATION within
+    one session: sessions are isolation domains (a faulted session's
+    slot invalidation must never ripple into another's KV lineage)."""
+    return name.split(SESSION_SEP, 1)[0] if SESSION_SEP in name else ""
+
 
 @dataclass
 class SlotState:
@@ -106,6 +127,25 @@ class SlotBook:
         buffers were allocated (all cached content lost)."""
         return False
 
+    def scratch_slot(self, pinned: tuple[str, ...] = ()) -> Optional[int]:
+        """A slot id safe to use as a throwaway WRITE target — the
+        scheduler's bucketed decode batch points its masked pad rows
+        here (all pads write identical bytes, so the duplicate-index
+        scatter is deterministic; a free slot's stale cells are
+        unreachable behind valid-length masks and the next real acquire
+        prefills over them). Returns a free slot's id, evicting the LRU
+        unpinned slot first when none is free; the id is NOT allocated
+        (it stays at the head of the free list until a real acquire
+        claims it), so use it within the current dispatch only. None
+        when every slot is pinned."""
+        if not self._free:
+            victim = next((n for n in self._slots if n not in pinned),
+                          None)
+            if victim is None:
+                return None
+            self.release(victim)
+        return self._free[0]
+
     def slot_names(self) -> list[str]:
         return list(self._slots)
 
@@ -146,10 +186,16 @@ class SlotBook:
         B's fresh slot can copy knight A's K/V for the common span instead
         of re-prefilling it. Donor records are truncated by reuse_plan when
         they join a batch, so a donor never advertises positions that are
-        about to be overwritten."""
+        about to be overwritten. Donation is INTRA-session only: sessions
+        are isolation domains (scoped_slot), so a donor from another
+        concurrent discussion is never consulted even when its token
+        prefix happens to match."""
         best, best_len = None, 0
+        scope = session_of(name)
         for state in self._slots.values():
             if state.name == name or not state.tokens:
+                continue
+            if session_of(state.name) != scope:
                 continue
             n = self.common_prefix_len(state.tokens, tokens)
             if n > best_len:
@@ -158,8 +204,9 @@ class SlotBook:
 
 
 def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
-                   add_share, flush_shares,
-                   prefill_span) -> tuple[list[int], int]:
+                   add_share, flush_shares, prefill_span,
+                   extra_pinned: tuple[str, ...] = ()
+                   ) -> tuple[list[int], int]:
     """Two-pass cross-knight shared-prefix reuse — THE algorithm, used by
     both serving engines so the donor cap, batch-common-prefix fold,
     l_shared clamp, laggard threshold and extra_prefill accounting cannot
@@ -179,9 +226,13 @@ def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
       prefill_span(row_i, lo, hi) — prefill that row's token span
         (ring-eligible on the main engine, chunked on PP).
 
+    `extra_pinned`: slot names OUTSIDE this batch that must survive any
+    eviction the passes trigger — the session scheduler pins every
+    actively-decoding row while a joining batch runs its passes.
+
     Returns (updated offsets, leader-prefilled token count)."""
     b = len(names)
-    pinned = tuple(names)
+    pinned = tuple(names) + tuple(extra_pinned)
     offsets = list(offsets)
     extra_prefill = 0
 

@@ -170,6 +170,19 @@ class PagedKVCache:
         free = sum(len(f) for f in self._free_by_replica)
         return self.num_pages - self.data_size - free
 
+    def usable_pages(self) -> int:
+        """Total non-scratch pages across every replica range."""
+        return self.num_pages - self.data_size
+
+    def pages_held(self, names: list[str]) -> int:
+        """Pages currently mapped by the named slots (missing names count
+        0). The scheduler's admission backpressure uses this to compute
+        how much of the pool is PINNED by in-flight rows — everything
+        else is reclaimable by the allocator's LRU eviction, so "free
+        right now" would undercount what an admission could use."""
+        return sum(len(self._slots[n].pages)
+                   for n in names if n in self._slots)
+
     def hbm_bytes(self) -> int:
         """Resident pool bytes across all layers (the accounting the
         contiguous layout can't improve on)."""
@@ -328,12 +341,19 @@ class PagedKVCache:
         """Longest-common-prefix donor; prefix-length ties prefer a donor
         on the SAME replica as `name` — same-replica spans alias for free
         while cross-replica spans degrade to device copies plus duplicate
-        pages out of the destination replica's range (review finding)."""
+        pages out of the destination replica's range (review finding).
+        Donation is intra-session only (kvcache.session_of): sessions are
+        isolation domains, and a cross-session alias would couple one
+        session's page lifetime to another's fault recovery."""
+        from .kvcache import session_of
         dst = self._slots.get(name)
         dst_replica = dst.replica if dst is not None else 0
+        scope = session_of(name)
         best, best_key = None, (0, -1)
         for state in self._slots.values():
             if state.name == name or not state.tokens:
+                continue
+            if session_of(state.name) != scope:
                 continue
             n = self.common_prefix_len(state.tokens, tokens)
             if n == 0:

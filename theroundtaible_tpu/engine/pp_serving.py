@@ -870,21 +870,31 @@ class PPEngine:
 
     def generate(self, prompt, slot_name: str = "default",
                  max_new_tokens: Optional[int] = None,
-                 timeout_s: float = 600.0) -> str:
+                 timeout_s: float = 600.0, session=None) -> str:
         return self.generate_batch([(slot_name, prompt)],
                                    max_new_tokens=max_new_tokens,
-                                   timeout_s=timeout_s)[0]
+                                   timeout_s=timeout_s, session=session)[0]
 
     def generate_batch(self, turns, max_new_tokens=None,
                        timeout_s: float = 600.0,
-                       sampling_per_turn=None, budget=None) -> list[str]:
+                       sampling_per_turn=None, budget=None,
+                       session=None) -> list[str]:
         return self.generate_batch_with_stats(
             turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
-            sampling_per_turn=sampling_per_turn, budget=budget)[0]
+            sampling_per_turn=sampling_per_turn, budget=budget,
+            session=session)[0]
 
     def generate_batch_with_stats(self, turns, max_new_tokens=None,
                                   timeout_s: float = 600.0,
-                                  sampling_per_turn=None, budget=None):
+                                  sampling_per_turn=None, budget=None,
+                                  session=None):
+        # Session-namespaced slot names — same cross-session collision
+        # fix as the main engine (kvcache.scoped_slot): concurrent
+        # discussions sharing a PP engine keep disjoint slot lineages.
+        if session:
+            from .kvcache import scoped_slot
+            turns = [(scoped_slot(session, name), prompt)
+                     for name, prompt in turns]
         # Admission gate (fleet.drain) — same contract as the main
         # engine: one flag check per call, in-flight turns complete.
         deadlines.check_admission()
@@ -1021,9 +1031,10 @@ class PPEngine:
             else deadlines.Budget.root(timeout_s, rung="turn")
         deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
         pre_budget = turn_budget.child("prefill")
-        max_new = max_new_tokens or self.sampling.max_new_tokens
-        max_new = max(1, min(max_new, self.max_seq_len // 2))
-        max_new_padded = -(-max_new // DECODE_SEGMENT) * DECODE_SEGMENT
+        from .serving_loop import clamp_max_new
+        max_new, max_new_padded = clamp_max_new(
+            max_new_tokens or self.sampling.max_new_tokens,
+            self.max_seq_len)
 
         pinned = tuple(name for name, _ in turns)
         slot_ids, offsets, all_tokens = [], [], []

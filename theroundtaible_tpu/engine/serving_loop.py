@@ -95,12 +95,23 @@ class ReplicaGroupPlan:
     back with `padded[plan.pos]`.
     """
 
-    def __init__(self, replicas: list[int], n_replicas: int):
+    def __init__(self, replicas: list[int], n_replicas: int,
+                 bucket_group: bool = False):
         groups: list[list[int]] = [[] for _ in range(n_replicas)]
         for i, r in enumerate(replicas):
             groups[r].append(i)
         self.n_replicas = n_replicas
-        self.group = max(1, max(len(g) for g in groups))
+        group = max(1, max(len(g) for g in groups))
+        if bucket_group:
+            # Round the per-replica block up to a power of two: callers
+            # whose batch COMPOSITION changes between dispatches (the
+            # session scheduler's decode batch) keep the padded shape on
+            # a {R*1, R*2, R*4, ...} grid instead of compiling one
+            # program per exact group size. Fixed-composition callers
+            # (generate_batch — one plan per call, warmup covers the
+            # shapes) leave this off.
+            group = pow2_bucket(group)
+        self.group = group
         self.b_padded = n_replicas * self.group
         self.pos = np.empty(len(replicas), np.int64)
         pad_positions: list[int] = []
@@ -137,6 +148,34 @@ class ReplicaGroupPlan:
         for p, r in zip(self.pad_positions, self.pad_replicas):
             out[p, :] = scratch_page(r)
         return out
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n — THE bucketing grid shared by the
+    session scheduler's decode batch and ReplicaGroupPlan's
+    bucket_group, so the two padded-shape families can never diverge
+    into mismatched compiled programs."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def clamp_max_new(max_new: int, max_seq_len: int) -> tuple[int, int]:
+    """(clamped max_new, segment-padded decode reserve) — ONE
+    definition of the decode-budget clamp for both engines and the
+    session scheduler: the same value must bound row budgets at
+    admission, size the page reserve, and cap eos_trim at retirement,
+    or the scheduler and generate_batch drift on token parity.
+
+    The clamp: decode can never exceed half the context (a
+    misconfigured max_new_tokens would otherwise drive the prompt
+    budget negative and collapse every prompt to [bos]); the reserve
+    rounds up to whole DECODE_SEGMENTs because decode runs in whole
+    segment programs whose surplus writes must not clamp onto committed
+    cache positions."""
+    m = max(1, min(max_new, max_seq_len // 2))
+    return m, -(-m // DECODE_SEGMENT) * DECODE_SEGMENT
 
 
 def prompt_budget(max_seq_len: int, max_new_padded: int) -> int:
@@ -342,6 +381,16 @@ def decode_segments(
             else np.zeros((b, 0), np.int32))
 
 
+def eos_trim(ids: list[int], eos_id: int, max_new: int) -> list[int]:
+    """Canonical per-row output epilogue: cut at the first eos, cap at
+    max_new. ONE definition shared by finalize_outputs and the session
+    scheduler's row retirement so a scheduled row's token stream is
+    byte-identical to the same row served by generate_batch."""
+    if eos_id in ids:
+        ids = ids[:ids.index(eos_id)]
+    return ids[:max_new]
+
+
 def finalize_outputs(turns, first_np: np.ndarray, out_np: np.ndarray,
                      all_tokens: list[list[int]], max_new: int,
                      eos_id: int, commit: Callable[[str, list[int]], None],
@@ -351,10 +400,8 @@ def finalize_outputs(turns, first_np: np.ndarray, out_np: np.ndarray,
     reuse, detokenize, and account decode tokens into stats."""
     results = []
     for i, (name, _) in enumerate(turns):
-        ids = [int(first_np[i])] + [int(x) for x in out_np[i]]
-        if eos_id in ids:
-            ids = ids[:ids.index(eos_id)]
-        ids = ids[:max_new]
+        ids = eos_trim([int(first_np[i])] + [int(x) for x in out_np[i]],
+                       eos_id, max_new)
         stats.decode_tokens += len(ids)
         # cache now holds prompt + every fed token (= all but the last
         # sampled one); commit exactly that for next-turn prefix reuse

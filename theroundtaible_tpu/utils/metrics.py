@@ -31,6 +31,13 @@ class TurnMetric:
     chars_out: int = 0
     # engine-side numbers, present only for tpu-llm turns
     engine: Optional[dict[str, Any]] = None
+    # Scheduler numbers (ISSUE 4), present only for turns served through
+    # the continuous-batching session scheduler: how long the round sat
+    # in the admission queue, and the mean decode-batch row count while
+    # this round's rows were live (occupancy > len(own rows) means the
+    # engine genuinely co-served other sessions during this turn).
+    queue_wait_s: Optional[float] = None
+    batch_occupancy: Optional[float] = None
 
 
 @dataclass
@@ -43,6 +50,12 @@ class RoundMetric:
 class SessionMetrics:
     """Collects and persists metrics.json; every mutation rewrites the file
     (same crash-safety stance as status.json, reference session.ts:120-149).
+
+    Concurrency (ISSUE 4 satellite): each discussion session owns its OWN
+    SessionMetrics over its OWN session directory — there is no shared
+    mutable state between concurrent sessions — and WITHIN a session the
+    orchestrator's batch-group thread pool records turns concurrently,
+    so every mutation and the rewrite serialize on an instance lock.
     """
 
     def __init__(self, session_path: str | Path):
@@ -52,6 +65,8 @@ class SessionMetrics:
         self._started = time.monotonic()
         self._round_started = self._started
         self._prior_wall = 0.0
+        import threading
+        self._mu = threading.RLock()
         self._load_existing()
 
     def _load_existing(self) -> None:
@@ -70,22 +85,37 @@ class SessionMetrics:
     # --- recording ---
 
     def start_round(self, round_num: int) -> None:
-        self.rounds.append(RoundMetric(round=round_num))
-        self._round_started = time.monotonic()
+        with self._mu:
+            self.rounds.append(RoundMetric(round=round_num))
+            self._round_started = time.monotonic()
 
     def record_turn(self, knight: str, round_num: int, wall_s: float,
                     chars_in: int = 0, chars_out: int = 0,
-                    engine: Optional[dict[str, Any]] = None) -> None:
-        if not self.rounds or self.rounds[-1].round != round_num:
-            self.start_round(round_num)
-        self.rounds[-1].turns.append(TurnMetric(
-            knight=knight, round=round_num, wall_s=round(wall_s, 3),
-            chars_in=chars_in, chars_out=chars_out, engine=engine))
+                    engine: Optional[dict[str, Any]] = None,
+                    queue_wait_s: Optional[float] = None,
+                    batch_occupancy: Optional[float] = None) -> None:
+        # Scheduler provenance defaults from the engine stats dict when
+        # the caller doesn't pass it explicitly — every surface that
+        # already forwards adapter last_stats() gets the fields free.
+        sched = (engine or {}).get("sched") or {}
+        if queue_wait_s is None:
+            queue_wait_s = sched.get("queue_wait_s")
+        if batch_occupancy is None:
+            batch_occupancy = sched.get("occupancy_mean")
+        with self._mu:
+            if not self.rounds or self.rounds[-1].round != round_num:
+                self.start_round(round_num)
+            self.rounds[-1].turns.append(TurnMetric(
+                knight=knight, round=round_num, wall_s=round(wall_s, 3),
+                chars_in=chars_in, chars_out=chars_out, engine=engine,
+                queue_wait_s=queue_wait_s,
+                batch_occupancy=batch_occupancy))
 
     def end_round(self) -> None:
-        if self.rounds:
-            self.rounds[-1].wall_s = round(
-                time.monotonic() - self._round_started, 3)
+        with self._mu:
+            if self.rounds:
+                self.rounds[-1].wall_s = round(
+                    time.monotonic() - self._round_started, 3)
         self.write()
 
     def finish(self, outcome: str) -> None:
@@ -113,11 +143,12 @@ class SessionMetrics:
         }
 
     def write(self) -> None:
-        payload = {
-            "outcome": self.outcome,
-            "totals": self.totals(),
-            "rounds": [asdict(r) for r in self.rounds],
-        }
+        with self._mu:
+            payload = {
+                "outcome": self.outcome,
+                "totals": self.totals(),
+                "rounds": [asdict(r) for r in self.rounds],
+            }
         try:
             from .session import atomic_write_text
             atomic_write_text(self.path,
