@@ -143,6 +143,12 @@ def child() -> int:
             "devices": len(devices),
             "platform": platform,
         }
+        # Registry snapshot in every run record (ISSUE 5, the
+        # int4_paths pattern): BENCH_r*.json carries the window's
+        # occupancy/fallback/hang/breaker counters with the same commit
+        # provenance as the headline number.
+        from theroundtaible_tpu.utils import telemetry
+        detail["telemetry"] = telemetry.REGISTRY.snapshot_compact()
         if headline:
             detail["winning_config"] = label  # winner of all runs
             detail["anchor_provenance"] = ANCHOR_PROVENANCE

@@ -47,6 +47,12 @@ TOPIC = ("Should the session store move to an append-only event log "
          "before the apply pipeline lands?")
 
 
+def _registry_snapshot() -> dict:
+    """Compact unified-registry snapshot for run-record embedding."""
+    from theroundtaible_tpu.utils import telemetry
+    return telemetry.REGISTRY.snapshot_compact()
+
+
 def offered_load_child() -> int:
     """Offered-load sweep (ISSUE 4 satellite): K concurrent 3-knight
     scripted discussions through ONE shared engine + session scheduler,
@@ -218,6 +224,10 @@ def offered_load_child() -> int:
                 # record, the int4_paths pattern (ISSUE 4).
                 "scheduler": {kk: vv for kk, vv in provenance.items()
                               if kk != "events"},
+                # Unified-registry snapshot (ISSUE 5): the same
+                # occupancy/fallback/hang counters fleet_health reads,
+                # frozen into the run record.
+                "telemetry": _registry_snapshot(),
             },
         }
         print(json.dumps(result_line), flush=True)
@@ -396,6 +406,9 @@ def child() -> int:
                 # termination guarantee for random bench weights.
                 "emergent_consensus_test": "tests/test_emergent_consensus.py",
             },
+            # Unified-registry snapshot (ISSUE 5, the int4_paths
+            # pattern): every run record carries the window's counters.
+            "telemetry": _registry_snapshot(),
         },
     }
     # flush=True: the watchdog salvages a timeout-killed child's stdout,

@@ -146,6 +146,35 @@ def _scheduler_guard(request):
         "never happened (mark allow_serial=True only for unit tests)")
 
 
+@pytest.fixture(autouse=True)
+def _telemetry_guard(request):
+    """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
+    test that CLAIMS span-tracing coverage runs with telemetry armed,
+    and if NO span was emitted during it the tracing silently no-op'd
+    (disarm regression, broken seam) — fail LOUD. Registry/flight-
+    recorder-only unit tests mark allow_no_spans=True. The guard
+    restores the armed flag so unmarked tests keep measuring the
+    disarmed (zero-overhead) hot path."""
+    marker = request.node.get_closest_marker("telemetry")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.utils import telemetry
+
+    was_active = telemetry.ACTIVE
+    telemetry.arm()
+    telemetry.reset_spans_emitted()
+    yield
+    emitted = telemetry.spans_emitted()
+    if not was_active:
+        telemetry.disarm()
+    if not marker.kwargs.get("allow_no_spans"):
+        assert emitted > 0, (
+            "telemetry-marked test emitted NO spans: the span seams "
+            "silently no-op'd (mark allow_no_spans=True only for "
+            "registry/recorder unit tests)")
+
+
 @pytest.fixture
 def project_root(tmp_path):
     """A scratch project dir with a .roundtable skeleton."""

@@ -1,0 +1,556 @@
+"""Unified telemetry suite (ISSUE 5): metrics-registry / flight-recorder
+/ span-tracer units, the watchdog/breaker auto-dump seams, the
+observability-surface drift lint (describe()/fleet_health keys must map
+onto registry series), and the end-to-end acceptance test — a 2-knight
+run_discussion under an injected `hang` fault emits a per-session spans
+JSONL whose nesting matches the Budget tree and ships a flight-recorder
+dump.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from theroundtaible_tpu.adapters.base import KnightTurn
+from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+from theroundtaible_tpu.core.orchestrator import run_discussion
+from theroundtaible_tpu.core.types import (
+    KnightConfig,
+    RoundtableConfig,
+    RulesConfig,
+)
+from theroundtaible_tpu.engine import deadlines, faults, get_engine, \
+    reset_engines
+from theroundtaible_tpu.engine.faults import CircuitBreaker
+from theroundtaible_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(tmp_path, monkeypatch):
+    """Each test gets a pristine registry, ring and dump dir, and the
+    fault/watchdog machinery reset (several tests drive them)."""
+    monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR",
+                       str(tmp_path / "dumps"))
+    telemetry.REGISTRY.reset()
+    telemetry.recorder().clear()
+    telemetry.reset_spans_emitted()
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
+    yield
+    telemetry.REGISTRY.reset()
+    telemetry.recorder().clear()
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.clear_hang_log()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def clean_engines():
+    reset_engines()
+    yield
+    reset_engines()
+
+
+def _tpu_cfg(seed, **extra):
+    cfg = {
+        "model": "tiny-gemma", "max_seq_len": 512, "num_slots": 4,
+        "seed": seed,
+        "sampling": {"temperature": 0.0, "max_new_tokens": 8},
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def _discussion_config(tpu_cfg):
+    return RoundtableConfig(
+        version="1.0", project="t", language="en",
+        knights=[KnightConfig(name="Sage", adapter="tpu-llm", priority=1),
+                 KnightConfig(name="Oracle", adapter="tpu-llm",
+                              priority=2)],
+        rules=RulesConfig(max_rounds=1, timeout_per_turn_seconds=600,
+                          parallel_rounds=True),
+        chronicle="chronicle.md",
+        adapter_config={"tpu-llm": tpu_cfg})
+
+
+# --- metrics registry units ---
+
+
+@pytest.mark.telemetry(allow_no_spans=True)
+class TestRegistry:
+    def test_counter_labels_and_totals(self):
+        telemetry.inc("roundtable_x_total", 2, engine="a")
+        telemetry.inc("roundtable_x_total", 3, engine="b")
+        assert telemetry.counter_total("roundtable_x_total") == 5
+        assert telemetry.counter_total("roundtable_x_total",
+                                       engine="a") == 2
+        assert telemetry.counter_total("roundtable_missing") == 0
+
+    def test_gauge_set_overwrites(self):
+        telemetry.set_gauge("roundtable_g", 4, engine="a")
+        telemetry.set_gauge("roundtable_g", 7, engine="a")
+        assert telemetry.REGISTRY.gauge_value("roundtable_g",
+                                              engine="a") == 7
+
+    def test_histogram_buckets_and_prom_text(self):
+        telemetry.observe("roundtable_h_seconds", 0.02)
+        telemetry.observe("roundtable_h_seconds", 400.0)  # > last bucket
+        text = telemetry.REGISTRY.prometheus_text()
+        assert "# TYPE roundtable_h_seconds histogram" in text
+        assert 'roundtable_h_seconds_bucket{le="+Inf"} 2' in text
+        assert "roundtable_h_seconds_count 2" in text
+
+    def test_snapshot_compact_flattens_counters_and_gauges(self):
+        telemetry.inc("roundtable_c_total", engine="e")
+        telemetry.set_gauge("roundtable_g2", 1.5)
+        snap = telemetry.REGISTRY.snapshot_compact()
+        assert snap["roundtable_c_total{engine=e}"] == 1
+        assert snap["roundtable_g2"] == 1.5
+
+    def test_thread_safe_counting(self):
+        def work():
+            for _ in range(200):
+                telemetry.inc("roundtable_race_total")
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert telemetry.counter_total("roundtable_race_total") == 1600
+
+    def test_reset_clears_everything(self):
+        telemetry.inc("roundtable_r_total")
+        telemetry.REGISTRY.reset()
+        assert telemetry.REGISTRY.snapshot_compact() == {}
+
+
+# --- flight recorder units ---
+
+
+@pytest.mark.telemetry(allow_no_spans=True)
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = telemetry.FlightRecorder("t", capacity=16)
+        for i in range(100):
+            rec.record("e", i=i)
+        events = rec.events()
+        assert len(events) == 16
+        assert events[-1]["i"] == 99  # newest kept, oldest dropped
+
+    def test_dump_ships_ring_and_registry(self, tmp_path):
+        telemetry.inc("roundtable_d_total", 3)
+        telemetry.recorder().record("interesting", detail="x")
+        path = telemetry.flight_dump("unit_test", extra={"why": "test"})
+        assert path and Path(path).exists()
+        payload = json.loads(Path(path).read_text())
+        assert payload["trigger"] == "unit_test"
+        assert payload["extra"] == {"why": "test"}
+        assert any(e["kind"] == "interesting" for e in payload["events"])
+        assert payload["metrics"]["counters"]["roundtable_d_total"] == 3
+        # dumping is itself counted in the registry
+        assert telemetry.counter_total("roundtable_flight_dumps_total",
+                                       trigger="unit_test") == 1
+        assert telemetry.last_dump_path() == path
+
+    def test_dump_dir_env_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR",
+                           str(tmp_path / "custom"))
+        path = telemetry.flight_dump("loc")
+        assert path.startswith(str(tmp_path / "custom"))
+
+    def test_default_dump_dir_is_uid_suffixed(self, monkeypatch):
+        monkeypatch.delenv("ROUNDTABLE_TELEMETRY_DIR", raising=False)
+        import os as _os
+        assert telemetry.dump_dir().endswith(
+            f"roundtable-telemetry-{_os.getuid()}")
+
+    def test_failed_dump_not_counted(self, monkeypatch):
+        """A dump whose write fails returns '' and does NOT bump the
+        success counter — fleet_health must never claim postmortems
+        that were never written (review finding)."""
+        rec = telemetry.recorder()
+        before = rec.dumps
+        monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR",
+                           "/proc/definitely/not/writable")
+        assert rec.dump("doomed") == ""
+        assert rec.dumps == before
+        assert telemetry.counter_total("roundtable_flight_dumps_total",
+                                       trigger="doomed") == 0
+
+    def test_dump_dir_pruned_to_keep_limit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setattr(telemetry, "_DUMP_KEEP", 5)
+        for _ in range(12):
+            telemetry.flight_dump("prune")
+        left = list(tmp_path.glob("flight-*.json"))
+        assert len(left) == 5
+
+
+# --- span tracer units ---
+
+
+@pytest.mark.telemetry
+class TestSpans:
+    def test_nesting_shares_trace_and_chains_parents(self, tmp_path):
+        sink = telemetry.session_sink(tmp_path)
+        with telemetry.span("discussion", sink=sink, session="s") as d:
+            with telemetry.span("round", round=1) as r:
+                with telemetry.span("turn", knight="Sage") as t:
+                    assert t.trace_id == d.trace_id
+                    assert t.parent_id == r.span_id
+                assert r.parent_id == d.span_id
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "telemetry" / "spans.jsonl")
+                 .read_text().splitlines()]
+        # children flush before parents (exit order)
+        assert [ln["rung"] for ln in lines] == ["turn", "round",
+                                                "discussion"]
+        assert len({ln["trace_id"] for ln in lines}) == 1
+        by_id = {ln["span_id"]: ln for ln in lines}
+        turn = next(ln for ln in lines if ln["rung"] == "turn")
+        assert by_id[turn["parent_id"]]["rung"] == "round"
+
+    def test_children_inherit_sink_from_root(self, tmp_path):
+        sink = telemetry.session_sink(tmp_path)
+        with telemetry.span("discussion", sink=sink):
+            with telemetry.span("turn"):
+                pass
+        text = (tmp_path / "telemetry" / "spans.jsonl").read_text()
+        assert '"turn"' in text and '"discussion"' in text
+
+    def test_disarmed_is_noop_singleton(self):
+        telemetry.disarm()
+        try:
+            before = telemetry.spans_emitted()
+            s = telemetry.span("turn", knight="x")
+            with s:
+                s.set_attr("a", 1)
+            assert telemetry.spans_emitted() == before
+        finally:
+            telemetry.arm()  # the guard fixture expects armed
+        with telemetry.span("turn"):
+            pass  # re-armed: the guard's spans-emitted check passes
+
+    def test_cross_thread_attach_parents_correctly(self, tmp_path):
+        sink = telemetry.session_sink(tmp_path)
+        seen = {}
+        with telemetry.span("round", sink=sink) as r:
+            ctx = telemetry.current_context()
+
+            def worker():
+                with telemetry.attached(ctx):
+                    with telemetry.span("turn") as t:
+                        seen["parent"] = t.parent_id
+                        seen["trace"] = t.trace_id
+
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert seen["parent"] == r.span_id
+        assert seen["trace"] == r.trace_id
+        # and the worker's span landed in the session sink it inherited
+        text = (tmp_path / "telemetry" / "spans.jsonl").read_text()
+        assert '"turn"' in text
+
+    def test_manual_start_end_and_error_status(self):
+        s = telemetry.start_span("turn", session="s")
+        s.end(status="error:TimeoutError")
+        spans = telemetry.recorder().span_events()
+        assert spans[-1]["status"] == "error:TimeoutError"
+
+    def test_exception_marks_span_status(self):
+        with pytest.raises(ValueError):
+            with telemetry.span("turn"):
+                raise ValueError("boom")
+        spans = telemetry.recorder().span_events()
+        assert spans[-1]["status"] == "error:ValueError"
+
+    def test_span_flood_does_not_evict_decision_events(self):
+        """Spans ride a separate ring: a long armed decode's hundreds
+        of span records must not push the sched/breaker/hang decision
+        history out of a later dump (review finding)."""
+        telemetry.recorder().record("sched_admit", session="s")
+        for _ in range(2000):
+            with telemetry.span("dispatch"):
+                pass
+        kinds = [e["kind"] for e in telemetry.recorder().events()]
+        assert "sched_admit" in kinds
+        path = telemetry.flight_dump("flood")
+        payload = json.loads(Path(path).read_text())
+        assert any(e["kind"] == "sched_admit"
+                   for e in payload["events"])
+        assert payload["spans"]  # spans shipped too, separately
+
+
+# --- watchdog / breaker auto-dump seams ---
+
+
+@pytest.mark.chaos
+class TestAutoDumps:
+    def test_hang_carries_telemetry_dump_path(self):
+        deadlines.arm_watchdog()
+        budget = deadlines.Budget.root(0.2, rung="dispatch")
+        with pytest.raises(deadlines.HangDetected) as e:
+            deadlines.watched_wait(lambda: time.sleep(5.0), budget,
+                                   "dispatch")
+        assert "telemetry_dump:" in str(e.value)
+        assert Path(e.value.telemetry_dump).exists()
+        payload = json.loads(Path(e.value.telemetry_dump).read_text())
+        assert payload["trigger"] == "hang"
+        assert telemetry.counter_total("roundtable_hangs_total",
+                                       rung="dispatch") == 1
+        # the dump message must still classify as a hang
+        from theroundtaible_tpu.core.errors import classify_error
+        assert classify_error(e.value) == "hang"
+
+    def test_breaker_trip_dumps_once_per_open_transition(self):
+        b = CircuitBreaker(threshold=2, name="eng")
+        b.record_failure(RuntimeError("x"))
+        assert telemetry.counter_total(
+            "roundtable_breaker_trips_total") == 0
+        b.record_failure(RuntimeError("y"))  # crosses the threshold
+        b.record_failure(RuntimeError("z"))  # already open: no re-trip
+        assert telemetry.counter_total(
+            "roundtable_breaker_trips_total", engine="eng") == 1
+        assert telemetry.REGISTRY.gauge_value(
+            "roundtable_breaker_open", engine="eng") == 1.0
+        assert telemetry.counter_total(
+            "roundtable_flight_dumps_total", trigger="breaker_trip") == 1
+        b.record_success()
+        assert telemetry.REGISTRY.gauge_value(
+            "roundtable_breaker_open", engine="eng") == 0.0
+
+    def test_forced_trip_dumps_too(self):
+        b = CircuitBreaker(threshold=3, name="eng2")
+        b.trip(RuntimeError("permanent"))
+        assert telemetry.counter_total(
+            "roundtable_breaker_trips_total", engine="eng2") == 1
+
+    def test_fault_injection_counts(self):
+        faults.arm("dispatch", count=2)
+        with pytest.raises(faults.FaultInjected):
+            faults.maybe_inject("dispatch")
+        assert telemetry.counter_total(
+            "roundtable_faults_injected_total", point="dispatch") == 1
+
+
+# --- single-source-of-truth drift lint (CI satellite) ---
+
+
+class TestSurfaceDrift:
+    def test_fleet_health_keys_are_bound_to_registry_series(self):
+        from theroundtaible_tpu.engine.fleet import fleet_health
+        health = fleet_health()
+        bound = set(telemetry.SURFACE_BINDINGS["fleet_health"])
+        unbound = set(health) - bound
+        assert not unbound, (
+            f"fleet_health grew key(s) {sorted(unbound)} with no "
+            "registry binding — declare how the unified registry sees "
+            "them in telemetry.SURFACE_BINDINGS['fleet_health'] (the "
+            "single-source-of-truth contract, ISSUE 5)")
+
+    def test_scheduler_describe_keys_are_bound(self):
+        from theroundtaible_tpu.engine.scheduler import scheduler_for
+        cfg = _tpu_cfg(seed=301)
+        engine = get_engine(cfg)
+        sched = scheduler_for(engine)
+        try:
+            desc = sched.describe()
+        finally:
+            sched.close()
+        bound = set(telemetry.SURFACE_BINDINGS["scheduler_describe"])
+        unbound = set(desc) - bound
+        assert not unbound, (
+            f"SessionScheduler.describe() grew key(s) {sorted(unbound)} "
+            "with no registry binding — declare them in "
+            "telemetry.SURFACE_BINDINGS['scheduler_describe']")
+
+    def test_fleet_health_telemetry_view_is_live(self):
+        from theroundtaible_tpu.engine.fleet import fleet_health
+        telemetry.inc("roundtable_hangs_total", rung="dispatch")
+        view = fleet_health()["telemetry"]
+        assert view["metrics"][
+            "roundtable_hangs_total{rung=dispatch}"] == 1
+
+    def test_engine_view_label_match_is_exact(self):
+        """'knight' must not absorb 'knight2' series on a prefix match
+        (review finding)."""
+        from theroundtaible_tpu.engine.trace_hooks import \
+            engine_telemetry_view
+        telemetry.inc("roundtable_x_total", 1, engine="knight")
+        telemetry.inc("roundtable_x_total", 5, engine="knight2")
+        view = engine_telemetry_view("knight")
+        assert view["metrics"] == {
+            "roundtable_x_total{engine=knight}": 1}
+
+
+# --- scheduler counters publish in lockstep ---
+
+
+@pytest.mark.telemetry
+@pytest.mark.scheduler(allow_serial=True)
+class TestSchedulerLockstep:
+    def test_describe_counters_match_registry(self):
+        from theroundtaible_tpu.engine.scheduler import scheduler_for
+        cfg = _tpu_cfg(seed=302)
+        engine = get_engine(cfg)
+        sched = scheduler_for(engine)
+        try:
+            out, stats = sched.submit(
+                "sess-a", [("Sage", "one small question")],
+                max_new_tokens=4, timeout_s=120.0)
+            assert len(out) == 1
+            desc = sched.describe()
+            name = engine.cfg.name
+            for key, metric in (
+                    ("admitted", "roundtable_sched_admitted_total"),
+                    ("completed", "roundtable_sched_completed_total"),
+                    ("segments", "roundtable_sched_segments_total")):
+                assert desc[key] == telemetry.counter_total(
+                    metric, engine=name), key
+            assert desc["admitted"] == 1
+            assert stats.sched is not None
+        finally:
+            sched.close()
+
+
+# --- end-to-end acceptance ---
+
+
+@pytest.mark.telemetry
+@pytest.mark.chaos
+class TestEndToEnd:
+    def test_discussion_spans_match_budget_tree_and_hang_dumps(
+            self, project_root):
+        """ISSUE 5 acceptance: with telemetry armed (marker guard), a
+        2-knight run_discussion under an injected `hang` fault (the
+        PR-2 chaos path) completes degraded, emits a per-session
+        spans.jsonl whose nesting matches the Budget-tree rungs
+        discussion→round→turn→prefill|decode→segment→dispatch, writes
+        the registry snapshot next to it, and the hang ships a
+        flight-recorder dump."""
+        cfg = _tpu_cfg(seed=303)
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        # Warm both program shapes so the only slow wait is the fault.
+        adapter.execute_round([KnightTurn("Sage", "warm"),
+                               KnightTurn("Oracle", "warm too")])
+        adapter.execute_for("Sage", "warm the single-row path")
+        deadlines.configure_rungs({"dispatch": 2.0})
+        faults.arm("hang", count=1, delay_s=10.0)
+        config = _discussion_config(cfg)
+        with pytest.warns(UserWarning, match="retrying 2 knight"):
+            result = run_discussion(
+                "telemetry acceptance topic", config,
+                {"tpu-llm": adapter}, str(project_root))
+        assert result.rounds == 1
+        assert len(result.all_rounds) == 2     # both knights spoke
+
+        tdir = Path(result.session_path) / "telemetry"
+        spans = [json.loads(ln) for ln in
+                 (tdir / "spans.jsonl").read_text().splitlines()]
+        by_id = {s["span_id"]: s for s in spans}
+        rungs = {s["rung"] for s in spans}
+        assert {"discussion", "round", "turn", "prefill", "decode",
+                "segment", "dispatch"} <= rungs
+
+        def parent_rung(s):
+            p = by_id.get(s.get("parent_id"))
+            return p["rung"] if p else None
+
+        # Budget-tree nesting, rung by rung (spans whose parents were
+        # cut by the ring/sink boundary — none here — would show None).
+        for s in spans:
+            if s["rung"] == "round":
+                assert parent_rung(s) == "discussion"
+            elif s["rung"] == "turn":
+                assert parent_rung(s) == "round"
+            elif s["rung"] in ("prefill", "decode"):
+                assert parent_rung(s) == "turn"
+            elif s["rung"] == "segment":
+                assert parent_rung(s) == "decode"
+            elif s["rung"] == "dispatch":
+                assert parent_rung(s) in ("prefill", "decode",
+                                          "segment", "turn")
+        # one trace: every span shares the discussion's trace id
+        disc = next(s for s in spans if s["rung"] == "discussion")
+        assert all(s["trace_id"] == disc["trace_id"] for s in spans)
+
+        # the hang shipped its postmortem + counted in the registry
+        assert telemetry.counter_total("roundtable_hangs_total") >= 1
+        assert telemetry.counter_total("roundtable_flight_dumps_total",
+                                       trigger="hang") >= 1
+        dump = Path(telemetry.last_dump_path())
+        assert dump.exists()
+        # the serial-retry ladder escalation dumped too
+        assert telemetry.counter_total(
+            "roundtable_degradations_total", rung="serial_retry") >= 1
+
+        # metrics.prom snapshot written next to the spans
+        prom = (tdir / "metrics.prom").read_text()
+        assert "roundtable_turns_total" in prom
+        assert "roundtable_decode_tokens_total" in prom
+
+    def test_status_telemetry_renders_session_view(self, project_root,
+                                                   capsys):
+        """`roundtable status --telemetry` renders the files the
+        armed discussion produced."""
+        cfg = _tpu_cfg(seed=304)
+        adapter = TpuLlmAdapter("tpu-llm", cfg, timeout_ms=600_000)
+        config = _discussion_config(cfg)
+        run_discussion("status telemetry topic", config,
+                       {"tpu-llm": adapter}, str(project_root))
+        from theroundtaible_tpu.commands.status import status_command
+        rc = status_command(project_root=str(project_root),
+                            telemetry_view=True)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Registry snapshot" in out
+        assert "roundtable_turns_total" in out
+        assert "Spans" in out
+
+
+# --- maybe_profile satellite ---
+
+
+@pytest.mark.telemetry
+class TestMaybeProfile:
+    def test_profile_opens_root_span_sharing_trace_id(self, tmp_path,
+                                                      monkeypatch):
+        from theroundtaible_tpu.utils.metrics import maybe_profile
+        monkeypatch.setenv("ROUNDTABLE_PROFILE",
+                           str(tmp_path / "trace"))
+        sink = telemetry.session_sink(tmp_path)
+        with maybe_profile(tmp_path):
+            with telemetry.span("discussion", sink=sink) as d:
+                disc_trace = d.trace_id
+        spans = [json.loads(ln) for ln in
+                 (tmp_path / "telemetry" / "spans.jsonl")
+                 .read_text().splitlines()]
+        prof = next(s for s in spans if s["rung"] == "profile")
+        # one trace id across the device profile root and the JSONL tree
+        assert prof["trace_id"] == disc_trace
+
+    def test_degrade_warning_goes_through_ui(self, tmp_path,
+                                             monkeypatch, capsys):
+        """A broken profiler start degrades via ui.warn (stderr,
+        styled), not a bare print on stdout."""
+        from theroundtaible_tpu.utils.metrics import maybe_profile
+        monkeypatch.setenv("ROUNDTABLE_PROFILE", str(tmp_path / "t"))
+        import jax as _jax
+        monkeypatch.setattr(
+            _jax.profiler, "start_trace",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("no profiler here")))
+        with maybe_profile(tmp_path):
+            pass
+        captured = capsys.readouterr()
+        assert "tracing unavailable" in captured.err
+        assert "tracing unavailable" not in captured.out

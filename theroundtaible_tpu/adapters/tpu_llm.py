@@ -377,6 +377,19 @@ class TpuLlmAdapter(BaseAdapter):
             f"batched round failed ({batch_err}); invalidating the "
             f"batch's KV slots and retrying {len(turns)} knight(s) "
             "serially", stacklevel=3)
+        # Ladder escalation ships its own postmortem (ISSUE 5): the
+        # flight ring at this moment holds the failed batch's spans and
+        # whatever the hang/fault machinery recorded before it.
+        from ..utils import telemetry
+        telemetry.inc("roundtable_degradations_total",
+                      rung="serial_retry")
+        telemetry.recorder().record(
+            "ladder_escalation", rung="serial_retry",
+            adapter=self.name, error=str(batch_err)[:200])
+        telemetry.flight_dump(
+            "ladder_escalation",
+            extra={"rung": "serial_retry", "adapter": self.name,
+                   "error": str(batch_err)[:500]})
         # A failure that surfaced AFTER donation consumed the KV cache
         # (jit programs donate the cache buffers) left the engine holding
         # deleted arrays — reallocate fresh buffers first, else every

@@ -78,7 +78,10 @@ def build_parser():
     s.add_argument("--no-read-code", dest="read_code", action="store_false",
                    help="Skip reading source code without asking")
 
-    sub.add_parser("status", help="Show the latest session")
+    st = sub.add_parser("status", help="Show the latest session")
+    st.add_argument("--telemetry", action="store_true",
+                    help="Render the session's telemetry view: registry "
+                         "snapshot, span summary, flight-recorder dumps")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -148,7 +151,8 @@ def dispatch(args) -> int:
         return summon_command(read_code=args.read_code)
     if args.command == "status":
         from .commands.status import status_command
-        return status_command()
+        return status_command(
+            telemetry_view=getattr(args, "telemetry", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

@@ -36,13 +36,16 @@ def phase_display(status) -> tuple[str, str, object]:
     return PHASE_DISPLAY.get(status.phase, ("?", status.phase, style.white))
 
 
-def status_command(project_root: Optional[str] = None) -> int:
+def status_command(project_root: Optional[str] = None,
+                   telemetry_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
                         'Start one with "roundtable discuss".\n'))
         return 0
+    if telemetry_view:
+        return telemetry_status(session)
 
     print(style.bold(f"\n  Latest session: {session.name}"))
     if session.topic:
@@ -73,5 +76,82 @@ def status_command(project_root: Optional[str] = None) -> int:
             print(style.dim(f"    {line}"))
         if len(lines) > DECISIONS_PREVIEW_LINES:
             print(style.dim("    ..."))
+    print("")
+    return 0
+
+
+METRICS_PREVIEW_LINES = 40
+SPAN_PREVIEW_LINES = 8
+
+
+def telemetry_status(session) -> int:
+    """`roundtable status --telemetry` — render the latest session's
+    view of the unified registry (ISSUE 5): the per-round Prometheus
+    snapshot metrics.json's writer drops, the span-tree summary from
+    spans.jsonl, and any flight-recorder dumps. All file-based: the
+    serving process owns the live registry; these files are its
+    per-round export (plus this process's own registry when serving
+    in-process, e.g. `roundtable serve` foreground)."""
+    import json as _json
+
+    from ..utils import telemetry
+
+    tdir = Path(session.path) / "telemetry"
+    print(style.bold(f"\n  Telemetry — session {session.name}"))
+    if not tdir.exists() and not telemetry.ACTIVE:
+        print(style.dim(
+            "  No telemetry captured. Run with ROUNDTABLE_TELEMETRY=1 "
+            "to arm span tracing and the registry snapshot.\n"))
+        return 0
+
+    prom = tdir / "metrics.prom"
+    if prom.exists():
+        print(style.bold("\n  Registry snapshot (metrics.prom):"))
+        lines = [ln for ln in
+                 prom.read_text(encoding="utf-8").splitlines()
+                 if ln and not ln.startswith("#")
+                 and "_bucket{" not in ln]
+        for ln in lines[:METRICS_PREVIEW_LINES]:
+            print(style.dim(f"    {ln}"))
+        if len(lines) > METRICS_PREVIEW_LINES:
+            print(style.dim(f"    ... ({len(lines)} series total)"))
+    elif telemetry.ACTIVE:
+        # In-process view (serve foreground / tests): the live registry.
+        print(style.bold("\n  Registry (live, this process):"))
+        for k, v in sorted(
+                telemetry.REGISTRY.snapshot_compact().items()):
+            print(style.dim(f"    {k} {v:g}"))
+
+    spans = tdir / "spans.jsonl"
+    if spans.exists():
+        per_rung: dict[str, int] = {}
+        total = 0
+        tail: list[dict] = []
+        for line in spans.read_text(encoding="utf-8").splitlines():
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                continue
+            total += 1
+            per_rung[rec.get("rung", "?")] = \
+                per_rung.get(rec.get("rung", "?"), 0) + 1
+            tail.append(rec)
+        print(style.bold(f"\n  Spans ({total} in spans.jsonl):"))
+        print(style.dim("    " + "  ".join(
+            f"{r}:{per_rung[r]}" for r in sorted(per_rung))))
+        for rec in tail[-SPAN_PREVIEW_LINES:]:
+            attrs = rec.get("attrs", {})
+            who = attrs.get("session") or attrs.get("engine") or ""
+            print(style.dim(
+                f"    {rec.get('rung', '?'):<10} "
+                f"{rec.get('dur_s', 0):>9.3f}s  "
+                f"{rec.get('status', '')}  {who}"))
+
+    dumps = sorted(Path(telemetry.dump_dir()).glob("flight-*.json")) \
+        if Path(telemetry.dump_dir()).exists() else []
+    if dumps:
+        print(style.bold(f"\n  Flight-recorder dumps ({len(dumps)}):"))
+        for p in dumps[-5:]:
+            print(style.dim(f"    {p}"))
     print("")
     return 0

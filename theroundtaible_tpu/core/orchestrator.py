@@ -31,6 +31,7 @@ from typing import Callable, Optional
 from ..adapters.base import BaseAdapter, KnightTurn
 from ..adapters.factory import create_adapter
 from ..engine import deadlines
+from ..utils import telemetry
 from ..utils.chronicle import append_to_chronicle
 from ..utils.context import ProjectContext, build_context
 from ..utils.decree_log import (
@@ -342,7 +343,16 @@ def run_discussion(
     discussion_budget = deadlines.Budget.root(
         rules.discussion_budget_seconds, rung="discussion")
 
-    with maybe_profile(session_path):
+    # Span-tree root (ISSUE 5): the discussion span mirrors the root
+    # Budget above; the per-session JSONL sink rides the span tree so
+    # every child — across adapter pool threads and the scheduler —
+    # lands in <session>/telemetry/spans.jsonl. Under maybe_profile the
+    # "profile" root wraps this, sharing one trace id with xprof.
+    tele_sink = (telemetry.session_sink(session_path)
+                 if telemetry.ACTIVE else None)
+    with maybe_profile(session_path), telemetry.span(
+            "discussion", sink=tele_sink,
+            session=Path(session_path).name, knights=len(sorted_knights)):
         for round_num in range(start_round, end_round + 1):
             if discussion_budget.expired:
                 # Hard discussion budget exhausted: return PARTIAL
@@ -368,11 +378,12 @@ def run_discussion(
                                    shuffled=not is_first)
 
             state.metrics.start_round(round_num)
-            _run_round_turns(
-                round_order, round_num, topic, config, adapters,
-                project_root, session_path, context, manifest_summary,
-                decrees_context, king_demand, state, timeout_ms, reporter,
-                round_budget)
+            with telemetry.span("round", round=round_num):
+                _run_round_turns(
+                    round_order, round_num, topic, config, adapters,
+                    project_root, session_path, context, manifest_summary,
+                    decrees_context, king_demand, state, timeout_ms,
+                    reporter, round_budget)
             state.metrics.end_round()
             if state.metrics.rounds:
                 reporter.round_footer(state.metrics.rounds[-1])
@@ -484,6 +495,14 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
                 for k in knights]
             jobs.append((adapter, knights, turns))
 
+        # The round span lives on THIS thread; group jobs run on pool
+        # threads, so the span context is handed across explicitly and
+        # re-attached there (telemetry's cross-thread parenting seam) —
+        # the engines' turn/prefill/decode spans then nest under the
+        # right round in the session's JSONL.
+        tele_ctx = telemetry.current_context() if telemetry.ACTIVE \
+            else None
+
         def run_group(job):
             adapter, knights, turns = job
             t0 = time.monotonic()
@@ -491,9 +510,10 @@ def _run_round_turns(round_order, round_num, topic, config, adapters,
             # derives its own round-rung child): groups run CONCURRENTLY
             # on disjoint submeshes, so they share the round's
             # wall-clock, not a division of it.
-            responses = adapter.execute_round(
-                turns, timeout_ms,
-                **_budget_kwargs(adapter, round_budget))
+            with telemetry.attached(tele_ctx):
+                responses = adapter.execute_round(
+                    turns, timeout_ms,
+                    **_budget_kwargs(adapter, round_budget))
             if len(responses) != len(turns):
                 raise RuntimeError(
                     f"batched round returned {len(responses)} responses "

@@ -91,14 +91,22 @@ class Cancelled(RuntimeError):
 class HangDetected(RuntimeError):
     """A blocking device wait exceeded its rung budget — the program is
     treated as wedged. The message deliberately carries the watchdog
-    markers core/errors.classify_error maps to the `hang` kind."""
+    markers core/errors.classify_error maps to the `hang` kind, plus —
+    when the flight recorder shipped a postmortem — the dump path, so
+    the error-log entry an operator reads names the file to open
+    (ISSUE 5 satellite)."""
 
-    def __init__(self, rung: str, waited_s: float):
-        super().__init__(
-            f"watchdog: device wait at rung '{rung}' still blocked after "
-            f"{waited_s:.1f}s budget — program presumed wedged (hang)")
+    def __init__(self, rung: str, waited_s: float,
+                 telemetry_dump: str = ""):
+        msg = (f"watchdog: device wait at rung '{rung}' still blocked "
+               f"after {waited_s:.1f}s budget — program presumed wedged "
+               "(hang)")
+        if telemetry_dump:
+            msg += f" [telemetry_dump: {telemetry_dump}]"
+        super().__init__(msg)
         self.rung = rung
         self.waited_s = waited_s
+        self.telemetry_dump = telemetry_dump
 
 
 class StaleWait(RuntimeError):
@@ -402,7 +410,15 @@ def watched_wait(fn: Callable, budget: Optional[Budget],
         _hang_log.append({"rung": rung, "waited_s": bound,
                           "at": time.monotonic()})
         del _hang_log[:-_HANG_LOG_CAP]
-        raise HangDetected(rung, bound)
+        # Every hang ships its own postmortem (ISSUE 5): count it,
+        # record it, dump the flight recorder, and carry the dump path
+        # in the error the ladder/error-log surfaces.
+        from ..utils import telemetry
+        telemetry.inc("roundtable_hangs_total", rung=rung)
+        telemetry.recorder().record("hang", rung=rung, waited_s=bound)
+        dump = telemetry.flight_dump(
+            "hang", extra={"rung": rung, "waited_s": bound})
+        raise HangDetected(rung, bound, telemetry_dump=dump)
     if "error" in box:
         raise box["error"]
     return box["value"]
@@ -415,11 +431,15 @@ def begin_drain() -> None:
     before taking the serve lock); in-flight generations finish."""
     global DRAINING
     DRAINING = True
+    from ..utils import telemetry
+    telemetry.set_gauge("roundtable_draining", 1.0)
 
 
 def end_drain() -> None:
     global DRAINING
     DRAINING = False
+    from ..utils import telemetry
+    telemetry.set_gauge("roundtable_draining", 0.0)
 
 
 def check_admission() -> None:

@@ -866,6 +866,12 @@ class InferenceEngine:
         warnings.warn(
             f"paged pool-direct serving degraded to gather-view: {reason}",
             stacklevel=3)
+        from ..utils import telemetry
+        telemetry.inc("roundtable_degradations_total",
+                      rung="gather_view")
+        telemetry.recorder().record(
+            "ladder_escalation", rung="gather_view",
+            engine=self.cfg.name, error=reason[:200])
         self.paged_direct = False
         self.paged_degraded_reason = reason
         self._prefill_step_paged = self._prefill_step_paged_gather
@@ -1301,6 +1307,18 @@ class InferenceEngine:
         # past this check, possibly waiting on the serve lock) complete.
         deadlines.check_admission()
         with self._serve_lock:
+            # The "turn" rung of the span tree (ISSUE 5) — same node the
+            # turn Budget bounds; session/engine attrs make concurrent
+            # discussions separable in one trace file.
+            from ..utils import telemetry
+            if telemetry.ACTIVE:
+                with telemetry.span("turn", engine=self.cfg.name,
+                                    rows=len(turns),
+                                    session=session or "",
+                                    knights=[n for n, _ in turns]):
+                    return self._generate_batch_locked(
+                        turns, max_new_tokens, timeout_s,
+                        sampling_per_turn, budget)
             return self._generate_batch_locked(turns, max_new_tokens,
                                                timeout_s, sampling_per_turn,
                                                budget)
@@ -1332,9 +1350,13 @@ class InferenceEngine:
             max_new_tokens or self.sampling.max_new_tokens,
             self.max_seq_len)
 
+        from ..utils import telemetry
         t0 = time.monotonic()
-        prep = self._prepare_batch(turns, max_new_padded, deadline,
-                                   pre_budget, sampling_per_turn)
+        with telemetry.span("prefill", engine=self.cfg.name) as _psp:
+            prep = self._prepare_batch(turns, max_new_padded, deadline,
+                                       pre_budget, sampling_per_turn)
+            _psp.set_attr("prefill_tokens", prep["prefill_tokens"])
+            _psp.set_attr("reused_tokens", prep["reused_tokens"])
         stats.prefill_tokens = prep["prefill_tokens"]
         stats.reused_tokens = prep["reused_tokens"]
         stats.prefill_seconds = time.monotonic() - t0
@@ -1386,10 +1408,12 @@ class InferenceEngine:
                 budget, temps, top_ks, top_ps, row_budgets, done0,
                 greedy=greedy)
 
-        out_np = decode_segments(decode_dispatch, first, cur_valid,
-                                 self.tokenizer.eos_id, max_new, deadline,
-                                 timeout_s, retry=self.retry,
-                                 budget=dec_budget)
+        with telemetry.span("decode", engine=self.cfg.name,
+                            max_new=max_new):
+            out_np = decode_segments(decode_dispatch, first, cur_valid,
+                                     self.tokenizer.eos_id, max_new,
+                                     deadline, timeout_s, retry=self.retry,
+                                     budget=dec_budget)
         stats.decode_seconds = time.monotonic() - t1
         if plan is not None:
             out_np = out_np[plan.pos]
@@ -1399,6 +1423,13 @@ class InferenceEngine:
             self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
             stats)
         stats.int4_paths = self.int4_path_report()
+        # Publish this call into the unified registry (ISSUE 5): token/
+        # throughput counters plus the int4 path-provenance view — the
+        # engine-stats store metrics.json/bench already read stays the
+        # return value; the registry is the shared spine.
+        from . import trace_hooks
+        trace_hooks.publish_gen_stats(stats, self.cfg.name)
+        trace_hooks.publish_int4_paths(stats.int4_paths, self.cfg.name)
         self.last_stats = stats
         return results, stats
 
@@ -1431,4 +1462,10 @@ class InferenceEngine:
         sched = getattr(self, "_scheduler", None)
         if sched is not None:
             info["scheduler"] = sched.describe()
+        # ISSUE 5: this engine's slice of the unified registry + flight
+        # recorder state — describe() is a VIEW of the one store, not a
+        # fifth parallel truth.
+        from . import trace_hooks
+        info["telemetry"] = trace_hooks.engine_telemetry_view(
+            self.cfg.name)
         return info

@@ -45,6 +45,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..utils import telemetry
+
 # Module-level guard, the ONLY thing unarmed hot paths touch. Call sites
 # read it as `faults.ARMED` so arm()/disarm() rebinding is visible.
 ARMED = False
@@ -166,6 +168,11 @@ def maybe_inject(point: str) -> None:
         if spec.count == 0:
             _recompute_armed()
     spec.fired += 1
+    # Chaos provenance in the shared registry (ISSUE 5): injected-fault
+    # counts ride fleet_health/bench records next to the hang/breaker
+    # series instead of living only in FaultSpec.fired.
+    telemetry.inc("roundtable_faults_injected_total", point=point)
+    telemetry.recorder().record("fault_injected", point=point)
     if point in ("slow_dispatch", "slow_wait"):
         time.sleep(spec.delay_s or 0.25)
         return
@@ -323,15 +330,25 @@ class CircuitBreaker:
 
     def record_failure(self, err: Optional[BaseException] = None) -> None:
         with self._lock:
+            was_open = self.failures >= self.threshold
             self.failures += 1
             self.total_failures += 1
             if err is not None:
                 self.last_error = str(err)
+            tripped = not was_open and self.failures >= self.threshold
+        telemetry.inc("roundtable_breaker_failures_total",
+                      engine=self.name or "engine")
+        if tripped:
+            self._on_trip(err)
 
     def record_success(self) -> None:
         with self._lock:
+            was_open = self.failures >= self.threshold
             self.failures = 0
             self._probes = 0
+        if was_open:
+            telemetry.set_gauge("roundtable_breaker_open", 0.0,
+                                engine=self.name or "engine")
 
     def trip(self, err: Optional[BaseException] = None) -> None:
         """Force-open regardless of threshold, for failures known to be
@@ -340,10 +357,25 @@ class CircuitBreaker:
         success — e.g. a half-open probe after the operator fixes the
         config — still closes the breaker normally."""
         with self._lock:
+            was_open = self.failures >= self.threshold
             self.failures = max(self.failures, self.threshold)
             self.total_failures += 1
             if err is not None:
                 self.last_error = str(err)
+        if not was_open:
+            self._on_trip(err)
+
+    def _on_trip(self, err: Optional[BaseException]) -> None:
+        """Open transition: count + gauge in the shared registry, and
+        ship a flight-recorder dump (ISSUE 5: a breaker trip is an
+        incident — its postmortem writes itself). Runs OUTSIDE the
+        breaker lock (snapshot() re-acquires it)."""
+        name = self.name or "engine"
+        telemetry.inc("roundtable_breaker_trips_total", engine=name)
+        telemetry.set_gauge("roundtable_breaker_open", 1.0, engine=name)
+        telemetry.recorder().record(
+            "breaker_trip", engine=name, error=str(err or "")[:200])
+        telemetry.flight_dump("breaker_trip", extra=self.snapshot())
 
     def reset(self) -> None:
         self.record_success()

@@ -270,6 +270,7 @@ def fleet_health() -> dict[str, Any]:
     host-side counters only, no device work — so status surfaces can
     poll it per round."""
     from . import breaker_snapshots, deadlines
+    from ..utils import telemetry
     from .scheduler import schedulers
     snaps = breaker_snapshots()
     sched_snaps = [s.snapshot() for s in schedulers()]
@@ -283,6 +284,10 @@ def fleet_health() -> dict[str, Any]:
         "hangs": len(deadlines.hang_log()),
         "schedulers": sched_snaps,
         "queued_sessions": sum(s["queued"] for s in sched_snaps),
+        # ISSUE 5: the unified store's view — hang/fault/breaker/sched
+        # counters, flight-recorder state — so fleet_health is a window
+        # onto the SAME registry bench records and status render.
+        "telemetry": telemetry.registry_view(),
     }
 
 
@@ -314,8 +319,13 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
     a report: per-engine flush counts and whether the drain was clean."""
     import time
     from . import _engines, _lock, deadlines
+    from ..utils import telemetry
     from .scheduler import schedulers
     deadlines.begin_drain()
+    # The drain is itself a postmortem trigger (ISSUE 5): the ring holds
+    # whatever the fleet was doing when the operator pulled the cord.
+    telemetry.recorder().record("drain_begin", timeout_s=timeout_s)
+    dump_path = telemetry.flight_dump("drain")
     deadline = time.monotonic() + timeout_s
     # Queued scheduler sessions fail fast NOW — their submitters were
     # never admitted, so there is nothing to wait for; active sessions
@@ -325,7 +335,8 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
         engines = list(_engines.items())
     report: dict[str, Any] = {"draining": True, "clean": True,
                               "engines": [],
-                              "queued_sessions_rejected": rejected}
+                              "queued_sessions_rejected": rejected,
+                              "telemetry_dump": dump_path}
     for key, eng in engines:
         entry: dict[str, Any] = {
             "engine": getattr(getattr(eng, "cfg", None), "name", key)}

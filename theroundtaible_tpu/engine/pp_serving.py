@@ -60,7 +60,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from . import compat, deadlines, faults
+from ..utils import telemetry
+from . import compat, deadlines, faults, trace_hooks
 from .compat import pcast, shard_map
 from .engine import GenStats
 from .kvcache import SlotBook
@@ -899,6 +900,15 @@ class PPEngine:
         # engine: one flag check per call, in-flight turns complete.
         deadlines.check_admission()
         with self._serve_lock:
+            # "turn" span — same rung as the main engine (ISSUE 5).
+            from ..utils import telemetry
+            if telemetry.ACTIVE:
+                with telemetry.span("turn", engine=self.cfg.name,
+                                    rows=len(turns),
+                                    session=session or "", pp=True):
+                    return self._generate_locked(
+                        turns, max_new_tokens, timeout_s,
+                        sampling_per_turn, budget)
             return self._generate_locked(turns, max_new_tokens, timeout_s,
                                          sampling_per_turn, budget)
 
@@ -1083,17 +1093,19 @@ class PPEngine:
             # with the PP step program).
             t0 = time.monotonic()
             spans = [t[o:] for t, o in zip(all_tokens, offsets)]
-            if tables is not None and self._pool_direct:
-                last_logits = self._chunked_rows_pool_direct(
-                    spans, offsets, tables, deadline, pre_budget)
-            else:
-                last_logits = self._chunked_rows(slot_ids, spans,
-                                                 offsets, deadline,
-                                                 pre_budget)
-            # Blocking scalar fetch → the deadline seam (a wedged
-            # prefill program freezes the host loop exactly here).
-            host_sync(lambda: float(last_logits[0, 0]), pre_budget,
-                      "prefill")
+            with telemetry.span("prefill", engine=self.cfg.name,
+                                pp=True):
+                if tables is not None and self._pool_direct:
+                    last_logits = self._chunked_rows_pool_direct(
+                        spans, offsets, tables, deadline, pre_budget)
+                else:
+                    last_logits = self._chunked_rows(slot_ids, spans,
+                                                     offsets, deadline,
+                                                     pre_budget)
+                # Blocking scalar fetch → the deadline seam (a wedged
+                # prefill program freezes the host loop exactly here).
+                host_sync(lambda: float(last_logits[0, 0]), pre_budget,
+                          "prefill")
             stats.prefill_seconds = time.monotonic() - t0
             slot_idx = jnp.asarray(slot_ids, jnp.int32)
 
@@ -1151,10 +1163,14 @@ class PPEngine:
                         self.kc, self.vc = caches
                     return out, steps, last, valid, done
 
-            out_np = decode_segments(decode_dispatch, first, cur_valid,
-                                     self.tokenizer.eos_id, max_new,
-                                     deadline, timeout_s, retry=self.retry,
-                                     budget=dec_budget)
+            with telemetry.span("decode", engine=self.cfg.name,
+                                pp=True):
+                out_np = decode_segments(decode_dispatch, first,
+                                         cur_valid,
+                                         self.tokenizer.eos_id, max_new,
+                                         deadline, timeout_s,
+                                         retry=self.retry,
+                                         budget=dec_budget)
             stats.decode_seconds = time.monotonic() - t1
         finally:
             # Scatter back even on a mid-serve timeout: otherwise the
@@ -1173,6 +1189,10 @@ class PPEngine:
             self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
             stats)
         stats.int4_paths = self.int4_path_report()
+        # Unified registry publish (ISSUE 5) — same seam as the main
+        # engine, so PP serving's counters land in the one store too.
+        trace_hooks.publish_gen_stats(stats, self.cfg.name)
+        trace_hooks.publish_int4_paths(stats.int4_paths, self.cfg.name)
         self.last_stats = stats
         return results, stats
 
@@ -1210,4 +1230,7 @@ class PPEngine:
         }
         if self.quant == "int4":
             info["int4_paths"] = self.int4_path_report()
+        # ISSUE 5: the unified registry's per-engine view.
+        info["telemetry"] = trace_hooks.engine_telemetry_view(
+            self.cfg.name)
         return info

@@ -74,7 +74,8 @@ from typing import Any, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from . import deadlines, faults
+from ..utils import telemetry
+from . import deadlines, faults, trace_hooks
 from .kvcache import scoped_slot
 from .sampling import SamplingParams, sampling_arrays
 from .serving_loop import (DECODE_SEGMENT, ReplicaGroupPlan,
@@ -172,7 +173,7 @@ class _Request:
                  "enqueued", "admitted_at", "rows", "stats", "deadline",
                  "turn_budget", "dec_budget", "abandoned", "seg_count",
                  "occ_sum", "occ_max", "sess_max", "requeues",
-                 "fits_below")
+                 "fits_below", "tele_ctx", "tele")
 
     def __init__(self, session, turns, sampling_per_turn, max_new,
                  timeout_s, budget, stats):
@@ -199,6 +200,13 @@ class _Request:
         self.sess_max = 0
         self.requeues = 0        # admissions undone on pool exhaustion
         self.fits_below = None   # re-admit only once active rows < this
+        # Telemetry (ISSUE 5): the submitter thread's span context, so
+        # this request's "turn" span parents into ITS discussion trace
+        # even though the scheduler thread emits it; `tele` is that
+        # span while the request is active.
+        self.tele_ctx = telemetry.current_context() \
+            if telemetry.ACTIVE else None
+        self.tele = None
 
 
 class SessionScheduler:
@@ -249,6 +257,11 @@ class SessionScheduler:
         self.queued_peak = 0
         self._occupancy: deque[int] = deque(maxlen=_OCCUPANCY_LOG_CAP)
         self._events: deque[dict] = deque(maxlen=_EVENT_LOG_CAP)
+        # Registry label for this scheduler's series (ISSUE 5): every
+        # decision counter below publishes into the shared registry in
+        # LOCKSTEP (_bump), so describe() and the registry can never
+        # disagree — the single-source-of-truth migration.
+        self._tname = getattr(engine.cfg, "name", "engine")
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"session-scheduler-{getattr(engine.cfg, 'name', '?')}")
@@ -293,7 +306,7 @@ class SessionScheduler:
         # starve every later session for its whole timeout.
         if len(turns) > self.max_rows:
             with self._cv:  # submitter threads race each other here
-                self.refused += 1
+                self._bump("refused")
             self._event("refuse", session=session,
                         reason=f"{len(turns)} rows > max_rows "
                                f"{self.max_rows}")
@@ -308,7 +321,7 @@ class SessionScheduler:
             need = self._pages_needed(turns, max_new, minimal=True)
             if need > engine.kv.usable_pages():
                 with self._cv:
-                    self.refused += 1
+                    self._bump("refused")
                 self._event("refuse", session=session,
                             reason=f"{need} pages > pool "
                                    f"{engine.kv.usable_pages()}")
@@ -393,11 +406,29 @@ class SessionScheduler:
     # observability
     # ------------------------------------------------------------------
 
+    def _bump(self, counter: str, n: int = 1) -> None:
+        """Increment a decision counter AND its registry series in one
+        place — no counter can move without the registry seeing it
+        (the drift test pins describe()'s keys to these series)."""
+        setattr(self, counter, getattr(self, counter) + n)
+        telemetry.inc(f"roundtable_sched_{counter}_total", n,
+                      engine=self._tname)
+
     def _event(self, kind: str, **fields) -> None:
         e = {"event": kind, "at": round(time.monotonic(), 3)}
         e.update(fields)
         with self._cv:  # RLock — safe from paths already holding it
             self._events.append(e)
+        # Mirror into the flight recorder (bounded ring): a hang/trip
+        # dump then carries the scheduler's recent decisions alongside
+        # the engine's spans — the cross-format stitching ISSUE 5 ends.
+        telemetry.recorder().record(f"sched_{kind}", engine=self._tname,
+                                    **{k: v for k, v in fields.items()
+                                       if k not in ("kind", "at")})
+        telemetry.set_gauge("roundtable_sched_queue_depth",
+                            len(self._queue), engine=self._tname)
+        telemetry.set_gauge("roundtable_sched_active_rows",
+                            len(self._active), engine=self._tname)
 
     def describe(self) -> dict[str, Any]:
         """Scheduler provenance for engine.describe() / bench records —
@@ -473,9 +504,9 @@ class SessionScheduler:
             req.event.set()
             with self._cv:  # drain/close threads race the loop thread
                 if draining:
-                    self.rejected_draining += 1
+                    self._bump("rejected_draining")
                 else:
-                    self.rejected_other += 1
+                    self._bump("rejected_other")
             if draining:
                 self._event("reject_drain", session=req.session)
             else:
@@ -629,6 +660,8 @@ class SessionScheduler:
             return False
         self._release_request_slots(req)
         req.requeues += 1
+        telemetry.inc("roundtable_sched_requeues_total",
+                      engine=self._tname)
         req.fits_below = len(self._active)
         req.admitted_at = None
         with self._cv:
@@ -720,7 +753,15 @@ class SessionScheduler:
         self._active_reqs.append(req)
         for r in rows:
             self._row_req[id(r)] = req
-        self.admitted += 1
+        self._bump("admitted")
+        if telemetry.ACTIVE:
+            # The request's "turn" span: lives across segments (ended at
+            # retire/fail), parented to the SUBMITTER's trace so spans
+            # from the scheduler thread land in the right discussion.
+            req.tele = telemetry.start_span(
+                "turn", parent=req.tele_ctx, session=req.session,
+                engine=self._tname, rows=len(rows), scheduled=True,
+                queue_wait_s=round(req.admitted_at - req.enqueued, 3))
         self._event("admit", session=req.session, rows=len(rows),
                     queue_wait_s=round(req.admitted_at - req.enqueued, 3),
                     reused_tokens=stats.reused_tokens)
@@ -758,7 +799,12 @@ class SessionScheduler:
             alive = [r for r in ctx["rows"] if not r.done]
             counts = self._account_segment(alive)
             try:
-                self._read_segment(ctx, handles)
+                # Scheduler-side "segment" span (sink-less: it spans
+                # SEVERAL sessions' traces, so it lands in the flight
+                # recorder ring rather than any one session's JSONL).
+                with telemetry.span("segment", engine=self._tname,
+                                    rows=len(alive), scheduled=True):
+                    self._read_segment(ctx, handles)
             except Exception as e:  # noqa: BLE001 — preempt-isolate
                 self._handle_segment_failure(alive, e)
                 return
@@ -822,10 +868,12 @@ class SessionScheduler:
             counts[id(req)] = (req, (prev[1] + 1) if prev else 1)
         occ = len(alive)
         sessions = len(counts)
-        self.segments += 1
+        self._bump("segments")
         self.max_occupancy = max(self.max_occupancy, occ)
         with self._cv:
             self._occupancy.append(occ)
+        telemetry.set_gauge("roundtable_sched_occupancy", occ,
+                            engine=self._tname)
         _note_rows(occ)
         for req, _n in counts.values():
             req.seg_count += 1
@@ -1040,7 +1088,7 @@ class SessionScheduler:
         segment from intact host+KV state, byte-identical."""
         if self._after_engine_failure(err):
             return
-        self.preemptions += 1
+        self._bump("preemptions")
         self._event("preempt_isolate", error=str(err)[:200],
                     sessions=[req.session for req in self._reqs_of(live)])
         for req in self._reqs_of(live):
@@ -1081,7 +1129,10 @@ class SessionScheduler:
                     pass
         self._drop_request(req)
         req.error = err
-        self.failed += 1
+        self._bump("failed")
+        if req.tele is not None:
+            req.tele.end(status=f"error:{type(err).__name__}")
+            req.tele = None
         self._event("fail", session=req.session,
                     error=str(err)[:200])
         req.event.set()
@@ -1125,7 +1176,14 @@ class SessionScheduler:
             }
             self._drop_request(req)
             req.result = (texts, req.stats)
-            self.completed += 1
+            self._bump("completed")
+            if req.tele is not None:
+                req.tele.set_attr("decode_tokens",
+                                  req.stats.decode_tokens)
+                req.tele.set_attr("occupancy_max", req.occ_max)
+                req.tele.end()
+                req.tele = None
+            trace_hooks.publish_gen_stats(req.stats, self._tname)
             self._event("retire", session=req.session,
                         decode_tokens=req.stats.decode_tokens,
                         occupancy_max=req.occ_max)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import telemetry
 from . import deadlines, faults
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
@@ -59,9 +60,17 @@ def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf"),
             return deadlines.watched_wait(call, budget, rung)
         return call()
 
-    if retry is None:
+    def attempt_traced():
+        # "dispatch" is the span tree's leaf rung (ISSUE 5), mirroring
+        # the budget rung the watchdog times this wait against.
+        if telemetry.ACTIVE:
+            with telemetry.span("dispatch", stage=rung):
+                return attempt()
         return attempt()
-    return retry.run(attempt, deadline=deadline)
+
+    if retry is None:
+        return attempt_traced()
+    return retry.run(attempt_traced, deadline=deadline)
 
 
 def host_sync(fn: Callable, budget=None, rung: str = "decode"):
@@ -70,9 +79,15 @@ def host_sync(fn: Callable, budget=None, rung: str = "decode"):
     (`int(steps)` / `float(logits[0, 0])` block until the program
     completes), so it gets the same watchdog treatment as a dispatch.
     Unarmed: a direct call behind the module-flag check."""
-    if deadlines.ACTIVE and budget is not None:
-        return deadlines.watched_wait(fn, budget, rung)
-    return fn()
+    def attempt():
+        if deadlines.ACTIVE and budget is not None:
+            return deadlines.watched_wait(fn, budget, rung)
+        return fn()
+
+    if telemetry.ACTIVE:
+        with telemetry.span("dispatch", stage=rung, op="host_sync"):
+            return attempt()
+    return attempt()
 
 
 class ReplicaGroupPlan:
@@ -338,36 +353,45 @@ def decode_segments(
     cur = run_dispatch(
         lambda: dispatch(first_token, start_valid, budget_dev, first_done),
         retry, deadline, budget=budget)
+    seg_idx = 0
     while True:
-        out, steps, last, valid, done = cur
-        budget_dev = budget_dev - steps
-        # Speculative queue while the device results are still in flight
-        # — but never past the deadline (the host clock is already known;
-        # queuing after it would run a whole wasted segment the timeout
-        # then waits on). `produced` lags the just-computed segment, so
-        # the bound is an upper bound on "more work possible"; the
-        # discard case skips the loop body via the carried done mask
-        # (and the gather/scatter around it via the engines' all-done
-        # cond), costing microseconds.
-        timed_out = time.monotonic() > deadline
-        cancelled = budget is not None and budget.token.cancelled
-        nxt = (run_dispatch(lambda: dispatch(last, valid, budget_dev, done),
-                            retry, deadline, budget=budget)
-               if produced + DECODE_SEGMENT < max_new and not timed_out
-               and not cancelled
-               else None)
+        # "segment" span (ISSUE 5): one per consumed decode segment —
+        # the null-span singleton when telemetry is disarmed, so the
+        # hot loop pays one module-flag check inside span().
+        with telemetry.span("segment", index=seg_idx, rows=b):
+            out, steps, last, valid, done = cur
+            budget_dev = budget_dev - steps
+            # Speculative queue while the device results are still in
+            # flight — but never past the deadline (the host clock is
+            # already known; queuing after it would run a whole wasted
+            # segment the timeout then waits on). `produced` lags the
+            # just-computed segment, so the bound is an upper bound on
+            # "more work possible"; the discard case skips the loop body
+            # via the carried done mask (and the gather/scatter around
+            # it via the engines' all-done cond), costing microseconds.
+            timed_out = time.monotonic() > deadline
+            cancelled = budget is not None and budget.token.cancelled
+            nxt = (run_dispatch(
+                lambda: dispatch(last, valid, budget_dev, done),
+                retry, deadline, budget=budget)
+                if produced + DECODE_SEGMENT < max_new and not timed_out
+                and not cancelled
+                else None)
 
-        # The segment's host sync is the blocking wait a wedged device
-        # program freezes — it goes through the watchdog seam, not a
-        # raw np.asarray (the deadline-seam contract for every blocking
-        # device wait in the serving paths).
-        def read_segment(steps=steps, out=out, done=done):
-            n = int(steps)  # forces completion of the segment
-            return n, np.asarray(out)[:, :n], bool(np.all(np.asarray(done)))
+            # The segment's host sync is the blocking wait a wedged
+            # device program freezes — it goes through the watchdog
+            # seam, not a raw np.asarray (the deadline-seam contract for
+            # every blocking device wait in the serving paths).
+            def read_segment(steps=steps, out=out, done=done):
+                n = int(steps)  # forces completion of the segment
+                return (n, np.asarray(out)[:, :n],
+                        bool(np.all(np.asarray(done))))
 
-        steps_n, seg, all_done = host_sync(read_segment, budget, "decode")
-        segments.append(seg)
-        produced += steps_n
+            steps_n, seg, all_done = host_sync(read_segment, budget,
+                                               "decode")
+            segments.append(seg)
+            produced += steps_n
+        seg_idx += 1
         if produced >= max_new or all_done:
             break
         if cancelled:

@@ -1,0 +1,97 @@
+"""Thin telemetry seams for the engine layers.
+
+The engines/serving loop talk to utils/telemetry through this module so
+the per-call publishing lives ONCE: both engines publish a GenStats the
+same way, every int4 routing decision counts the same way, and a future
+engine gets the whole surface by importing two functions. Nothing here
+touches jax — it is host-side counter/span plumbing only, and every
+function is cheap enough to run unguarded at CALL rate (per round/turn,
+never per token); hot per-segment/per-dispatch span call sites pre-guard
+with `if telemetry.ACTIVE:` at the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..utils import telemetry
+
+span = telemetry.span  # re-export: engine call sites read trace_hooks.span
+
+
+def publish_gen_stats(stats, engine_name: str) -> None:
+    """Fold one generate call's GenStats into the registry — the
+    engine-stats store metrics.json/bench records become views of."""
+    if stats is None:
+        return
+    reg = telemetry.REGISTRY
+    if stats.prefill_tokens:
+        reg.inc("roundtable_prefill_tokens_total", stats.prefill_tokens,
+                engine=engine_name)
+    if stats.reused_tokens:
+        reg.inc("roundtable_reused_tokens_total", stats.reused_tokens,
+                engine=engine_name)
+    if stats.decode_tokens:
+        reg.inc("roundtable_decode_tokens_total", stats.decode_tokens,
+                engine=engine_name)
+    if stats.decode_seconds:
+        reg.inc("roundtable_decode_seconds_total", stats.decode_seconds,
+                engine=engine_name)
+        reg.set_gauge("roundtable_decode_tps", stats.decode_tps,
+                      engine=engine_name)
+    if stats.prefill_seconds:
+        reg.inc("roundtable_prefill_seconds_total",
+                stats.prefill_seconds, engine=engine_name)
+    sched = stats.sched or {}
+    if sched.get("queue_wait_s") is not None:
+        reg.observe("roundtable_queue_wait_seconds",
+                    sched["queue_wait_s"])
+    if sched.get("occupancy_mean") is not None:
+        reg.set_gauge("roundtable_batch_occupancy",
+                      sched["occupancy_mean"], engine=engine_name)
+
+
+def publish_int4_paths(report: Optional[dict],
+                       engine_name: str) -> None:
+    """Registry view of the int4 path-provenance sink (PR 3): one gauge
+    pair per engine — distinct kernel dispatches vs distinct XLA
+    fallbacks — plus a counter per fallback reason, so a silent-fallback
+    regression shows up on a dashboard, not only in describe()."""
+    if not report:
+        return
+    reg = telemetry.REGISTRY
+    reg.set_gauge("roundtable_int4_kernel_dispatches",
+                  len(report.get("pallas_w4a16", ())),
+                  engine=engine_name)
+    reg.set_gauge("roundtable_int4_fallback_dispatches",
+                  len(report.get("xla_dequant", ())),
+                  engine=engine_name)
+    for entry in report.get("xla_dequant", ()):
+        reason = entry.get("fallback_reason") or "unknown"
+        # Gauge not counter: the sink is cumulative per engine and this
+        # re-publishes per call — a counter would multiply-count.
+        reg.set_gauge("roundtable_int4_fallbacks", 1.0,
+                      engine=engine_name, reason=reason[:60])
+
+
+def _engine_labeled(key: str, engine_name: str) -> bool:
+    """True when the flattened series key carries EXACTLY the label
+    engine=<engine_name>. Label-element comparison, not substring: a
+    fleet with engines 'knight' and 'knight2' must not fold knight2's
+    series into knight's view on a prefix match."""
+    if "{" not in key:
+        return False
+    labels = key[key.index("{") + 1:key.rindex("}")]
+    return f"engine={engine_name}" in labels.split(",")
+
+
+def engine_telemetry_view(engine_name: str) -> dict[str, Any]:
+    """The describe() embed: this engine's registry series + flight
+    recorder state (one store, viewed per engine)."""
+    snap = telemetry.REGISTRY.snapshot_compact()
+    mine = {k: v for k, v in snap.items()
+            if _engine_labeled(k, engine_name)}
+    rec = telemetry.recorder()
+    return {"metrics": mine, "flight_dumps": rec.dumps,
+            "last_flight_dump": rec.last_dump_path,
+            "armed": telemetry.ACTIVE}

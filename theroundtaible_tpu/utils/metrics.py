@@ -110,6 +110,14 @@ class SessionMetrics:
                 chars_in=chars_in, chars_out=chars_out, engine=engine,
                 queue_wait_s=queue_wait_s,
                 batch_occupancy=batch_occupancy))
+        # Unified-registry publish (ISSUE 5): turn counts and latency
+        # distributions land in the same store the engine/scheduler
+        # series live in, so metrics.json is a per-session VIEW of it
+        # rather than a fourth parallel truth. Token counters are NOT
+        # re-published here — the engines already count them.
+        from . import telemetry
+        telemetry.inc("roundtable_turns_total", knight=knight)
+        telemetry.observe("roundtable_turn_wall_seconds", wall_s)
 
     def end_round(self) -> None:
         with self._mu:
@@ -155,6 +163,21 @@ class SessionMetrics:
                               json.dumps(payload, indent=2, default=str))
         except (OSError, TypeError, ValueError):
             pass  # metrics must never kill a discussion
+        # With telemetry armed, every metrics.json rewrite also drops a
+        # Prometheus-text registry snapshot next to the spans file —
+        # the store `roundtable status --telemetry` renders (a separate
+        # process can't read this process's registry live; the per-round
+        # rewrite cadence is the freshness contract).
+        from . import telemetry
+        if telemetry.ACTIVE:
+            try:
+                from .session import atomic_write_text
+                tdir = self.path.parent / "telemetry"
+                tdir.mkdir(parents=True, exist_ok=True)
+                atomic_write_text(tdir / "metrics.prom",
+                                  telemetry.REGISTRY.prometheus_text())
+            except (OSError, TypeError, ValueError):
+                pass
 
 
 def aggregate_engine_stats(turns) -> dict[str, Any]:
@@ -182,8 +205,15 @@ def maybe_profile(session_path: str | Path):
     """jax.profiler trace of the block when ROUNDTABLE_PROFILE is set.
 
     Profiling must never kill a discussion: a missing jax install or a
-    failed start_trace degrades to a warning + no trace.
+    failed start_trace degrades to a styled ui.warn + no trace.
+
+    Telemetry (ISSUE 5 satellite): while the device trace runs, span
+    mirroring is armed (telemetry.set_profiling) and the block runs
+    under a root "profile" span — the discussion span opened inside
+    becomes its child, so the xprof timeline and the JSONL span tree
+    share one trace id and one set of rung names.
     """
+    from . import telemetry
     target = os.environ.get("ROUNDTABLE_PROFILE")
     if not target:
         yield
@@ -196,10 +226,21 @@ def maybe_profile(session_path: str | Path):
         jax.profiler.start_trace(str(trace_dir))
         profiler = jax
     except Exception as e:  # noqa: BLE001 — opt-in feature, degrade loudly
-        print(f"  (ROUNDTABLE_PROFILE set but tracing unavailable: {e})")
+        from .ui import warn
+        warn(f"  (ROUNDTABLE_PROFILE set but tracing unavailable: {e})")
+    telemetry.set_profiling(profiler is not None)
     try:
-        yield
+        # Root "profile" span over the whole traced block: the
+        # discussion span opened inside becomes its child, so xprof and
+        # the JSONL tree share ONE trace id. The sink rides the root.
+        sink = (telemetry.session_sink(session_path)
+                if telemetry.ACTIVE else None)
+        with telemetry.span("profile", sink=sink,
+                            trace_dir=str(trace_dir),
+                            device_trace=profiler is not None):
+            yield
     finally:
+        telemetry.set_profiling(False)
         if profiler is not None:
             try:
                 profiler.profiler.stop_trace()

@@ -1,0 +1,718 @@
+"""Unified telemetry — span tracer, metrics registry, flight recorder.
+
+The stack grew four private observability surfaces (PRs 1–4):
+`describe()["int4_paths"]`, the scheduler's event log + occupancy
+history, `fleet_health()`'s hang/breaker counts, and per-session
+`metrics.json` — four formats an operator stitches by hand during an
+incident. Production TPU serving engines treat tracing/metrics as ONE
+first-class subsystem feeding live dashboards and postmortems alike
+(RTP-LLM, arxiv 2605.29639), and TPU perf work is only credible with
+xprof-aligned annotations (arxiv 2605.25645). This module is that
+spine; the existing surfaces publish through it and become views.
+
+Three pieces:
+
+- **Span tracer** — explicit spans mirroring the PR-2 Budget tree
+  (`discussion → round → turn → prefill|decode → segment → dispatch`)
+  carrying session/knight/engine attributes. Spans nest via a
+  thread-local stack; cross-thread hops (orchestrator batch pools, the
+  scheduler thread) hand a `current_context()` dict across and attach
+  it with `attached(ctx)`. Finished spans append to the per-session
+  JSONL sink riding the span tree (root spans carry it; children
+  inherit) and into the flight recorder; while a jax profiler trace is
+  armed (`maybe_profile` → `set_profiling`), each span also opens a
+  `jax.profiler.TraceAnnotation` so xprof timelines and JSONL spans
+  line up on the same names. Disarmed, `span()` returns a no-op
+  singleton behind the same module-flag pattern as `deadlines.ACTIVE`
+  / `faults.ARMED` — hot call sites additionally pre-guard with
+  `if telemetry.ACTIVE:`.
+- **Metrics registry** — process-wide counters/gauges/histograms
+  (decode tok/s, queue wait, batch occupancy, pages held, breaker
+  state, hang/fault/fallback counts) with `snapshot()` for embedding
+  in bench/flight records and `prometheus_text()` for the
+  `<session>/telemetry/metrics.prom` file `roundtable status
+  --telemetry` renders. Counters are cheap (one lock + dict add) and
+  stay on regardless of ACTIVE: they fire per EVENT (admission, trip,
+  hang), never per token.
+- **Flight recorder** — a bounded ring of recent spans/events per
+  named recorder. `flight_dump(trigger)` writes ring + registry
+  snapshot to a JSON file and returns its path; deadlines (hang),
+  faults (breaker trip), tpu_llm (ladder escalation) and fleet (drain)
+  call it automatically, so every failure ships its own postmortem.
+
+Host-only by design (no jax import at module load): deadlines/faults
+import this without touching a backend, and the types stay usable in
+pure-unit tests. Arming: `arm()` in-process or `ROUNDTABLE_TELEMETRY=1`
+in the environment; `ROUNDTABLE_TELEMETRY_DIR` overrides where flight
+dumps land.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+# Module-level guard — the ONLY thing unarmed hot paths touch (one
+# attribute load + branch, same contract as deadlines.ACTIVE).
+ACTIVE = False
+
+# True while a jax profiler trace is running (utils/metrics.maybe_profile
+# flips it): armed spans then mirror into jax.profiler.TraceAnnotation.
+_PROFILING = False
+
+# The span rungs, outermost first — the Budget tree (deadlines.RUNGS)
+# plus the two sub-turn seams budgets don't name ("segment" sits between
+# decode and dispatch; "profile" is maybe_profile's root).
+TRACE_RUNGS = ("profile", "discussion", "round", "turn", "prefill",
+               "decode", "segment", "dispatch")
+
+_INF = float("inf")
+
+
+def arm() -> None:
+    global ACTIVE
+    ACTIVE = True
+
+
+def disarm() -> None:
+    global ACTIVE
+    ACTIVE = False
+
+
+def set_profiling(on: bool) -> None:
+    """maybe_profile's seam: while True, armed spans mirror into
+    jax.profiler.TraceAnnotation so xprof and the JSONL tree share
+    names (and, via the root span maybe_profile opens, one trace id)."""
+    global _PROFILING
+    _PROFILING = bool(on)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+# Wall-clock-ish histogram buckets (seconds): sub-10ms dispatches up to
+# multi-minute turns. Fixed buckets keep observe() one bisect + add.
+HIST_BUCKETS = (0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0,
+                60.0, 120.0, 300.0)
+
+
+def _label_key(labels: dict[str, Any]) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Process-wide counters/gauges/histograms, label-aware.
+
+    One instance (`REGISTRY`) serves the whole process: schedulers,
+    engines and adapters label their series (engine=..., point=...,
+    rung=...) instead of owning private stores — the single-source-of-
+    truth migration the four PR-1..4 surfaces converge on."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], dict] = {}
+
+    # --- writes ---
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = {
+                    "counts": [0] * (len(HIST_BUCKETS) + 1),
+                    "sum": 0.0, "count": 0}
+            for i, b in enumerate(HIST_BUCKETS):
+                if value <= b:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["counts"][-1] += 1
+            h["sum"] += value
+            h["count"] += 1
+
+    # --- reads ---
+
+    def counter_total(self, name: str, **labels) -> float:
+        """Sum of a counter across label sets (or the one labeled set
+        when labels are given)."""
+        with self._lock:
+            if labels:
+                return self._counters.get((name, _label_key(labels)), 0.0)
+            return sum(v for (n, _l), v in self._counters.items()
+                       if n == name)
+
+    def gauge_value(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)))
+
+    def snapshot(self) -> dict[str, Any]:
+        """Full structured snapshot (flight dumps, tests)."""
+
+        def flat(store):
+            out = {}
+            for (name, lkey), v in sorted(store.items()):
+                label = ",".join(f"{k}={val}" for k, val in lkey)
+                out[f"{name}{{{label}}}" if label else name] = v
+            return out
+
+        with self._lock:
+            return {
+                "counters": flat(self._counters),
+                "gauges": flat(self._gauges),
+                "histograms": {
+                    key: {"sum": round(h["sum"], 6), "count": h["count"]}
+                    for key, h in flat(self._hists).items()},
+            }
+
+    def snapshot_compact(self) -> dict[str, float]:
+        """Counters + gauges as one flat dict — the bench-record embed
+        (BENCH_r*.json carries occupancy/fallback/hang counters the way
+        int4_paths rides today)."""
+        snap = self.snapshot()
+        out = dict(snap["counters"])
+        out.update(snap["gauges"])
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition-format snapshot (the metrics.prom
+        writer behind `roundtable status --telemetry`)."""
+        lines: list[str] = []
+
+        def fmt_labels(lkey):
+            if not lkey:
+                return ""
+            body = ",".join(f'{k}="{v}"' for k, v in lkey)
+            return "{" + body + "}"
+
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hists.items())
+        seen: set[str] = set()
+        for (name, lkey), v in counters:
+            if name not in seen:
+                lines.append(f"# TYPE {name} counter")
+                seen.add(name)
+            lines.append(f"{name}{fmt_labels(lkey)} {v:g}")
+        for (name, lkey), v in gauges:
+            if name not in seen:
+                lines.append(f"# TYPE {name} gauge")
+                seen.add(name)
+            lines.append(f"{name}{fmt_labels(lkey)} {v:g}")
+        for (name, lkey), h in hists:
+            if name not in seen:
+                lines.append(f"# TYPE {name} histogram")
+                seen.add(name)
+            cum = 0
+            for i, b in enumerate(HIST_BUCKETS):
+                cum += h["counts"][i]
+                le = (("le", f"{b:g}"),)
+                lines.append(
+                    f"{name}_bucket{fmt_labels(lkey + le)} {cum}")
+            cum += h["counts"][-1]
+            lines.append(
+                f'{name}_bucket{fmt_labels(lkey + (("le", "+Inf"),))} '
+                f"{cum}")
+            lines.append(f"{name}_sum{fmt_labels(lkey)} {h['sum']:g}")
+            lines.append(f"{name}_count{fmt_labels(lkey)} {h['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+# Module-level shorthands (call sites read better; one shared registry).
+inc = REGISTRY.inc
+set_gauge = REGISTRY.set_gauge
+observe = REGISTRY.observe
+counter_total = REGISTRY.counter_total
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+_FLIGHT_CAPACITY = int(os.environ.get("ROUNDTABLE_FLIGHT_CAPACITY",
+                                      "512"))
+
+
+# Dumps kept on disk per process lifetime of pruning calls: each dump()
+# trims the dump dir to this many newest files, so a crash-looping
+# serve can't fill the disk with postmortems of the same incident.
+_DUMP_KEEP = int(os.environ.get("ROUNDTABLE_FLIGHT_DUMPS_KEEP", "64"))
+
+
+class FlightRecorder:
+    """Bounded rings of recent events and spans; `dump()` ships both +
+    a registry snapshot to disk so a hang/trip/drain carries its own
+    postmortem. Recording is a lock + deque append — cheap enough to
+    stay on for EVENT-rate callers (admissions, trips, retirements);
+    per-token paths never record. Spans ride a SEPARATE ring from
+    decision events: an armed long decode emits hundreds of span
+    records, and they must not evict the sched_admit/preempt/breaker
+    history the dump exists to preserve."""
+
+    def __init__(self, name: str = "process",
+                 capacity: int = _FLIGHT_CAPACITY):
+        self.name = name
+        self._ring: deque[dict] = deque(maxlen=max(capacity, 8))
+        self._spans: deque[dict] = deque(maxlen=max(capacity, 8))
+        self._lock = threading.Lock()
+        self.dumps = 0          # SUCCESSFUL dumps only (health surfaces)
+        self._seq = 0           # filename counter (attempts, unique)
+        self.last_dump_path: str = ""
+
+    def record(self, kind: str, **fields) -> None:
+        entry = {"kind": kind, "at": round(time.time(), 3)}
+        entry.update(fields)
+        with self._lock:
+            if kind == "span":
+                self._spans.append(entry)
+            else:
+                self._ring.append(entry)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def span_events(self) -> list[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._spans.clear()
+
+    def dump(self, trigger: str,
+             extra: Optional[dict] = None) -> str:
+        """Write both rings + a registry snapshot to the dump dir;
+        returns the file path ('' when the write itself fails — a
+        postmortem must never add a second failure on top of the
+        first, and a failed write is NOT counted in `dumps`)."""
+        payload = {
+            "trigger": trigger,
+            "recorder": self.name,
+            "at": time.time(),
+            "pid": os.getpid(),
+            "events": self.events(),
+            "spans": self.span_events(),
+            "metrics": REGISTRY.snapshot(),
+        }
+        if extra:
+            payload["extra"] = extra
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        try:
+            d = dump_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight-{trigger}-{os.getpid()}-{seq:03d}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=2, default=str)
+            _prune_dumps(d)
+        except OSError:
+            return ""
+        with self._lock:
+            self.dumps += 1
+            self.last_dump_path = path
+        inc("roundtable_flight_dumps_total", trigger=trigger)
+        return path
+
+
+def _prune_dumps(d: str) -> None:
+    """Keep only the newest _DUMP_KEEP flight dumps in `d` — every dump
+    call pays one listdir so the dir can never grow without bound."""
+    try:
+        files = sorted(
+            (p for p in os.listdir(d)
+             if p.startswith("flight-") and p.endswith(".json")),
+            key=lambda p: os.path.getmtime(os.path.join(d, p)))
+        for p in files[:-_DUMP_KEEP] if _DUMP_KEEP > 0 else []:
+            os.unlink(os.path.join(d, p))
+    except OSError:
+        pass  # pruning is best-effort; the dump already landed
+
+
+_recorders: dict[str, FlightRecorder] = {}
+_recorders_lock = threading.Lock()
+
+
+def recorder(name: str = "process") -> FlightRecorder:
+    """Get-or-create a named flight recorder ("process" is the shared
+    default; engines may key their own by engine name)."""
+    with _recorders_lock:
+        rec = _recorders.get(name)
+        if rec is None:
+            rec = _recorders[name] = FlightRecorder(name)
+        return rec
+
+
+def flight_dump(trigger: str, name: str = "process",
+                extra: Optional[dict] = None) -> str:
+    """Dump a named recorder (default the process one); returns path."""
+    return recorder(name).dump(trigger, extra=extra)
+
+
+def last_dump_path() -> str:
+    return recorder().last_dump_path
+
+
+def dump_dir() -> str:
+    """Where flight dumps land: ROUNDTABLE_TELEMETRY_DIR, else a
+    uid-suffixed dir under the system tempdir (a hang must produce a
+    dump even with no session directory in sight; the uid suffix keeps
+    two users on one host from fighting over directory ownership —
+    without it the second user's every dump would die on
+    PermissionError and be silently swallowed)."""
+    configured = os.environ.get("ROUNDTABLE_TELEMETRY_DIR")
+    if configured:
+        return configured
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    return os.path.join(tempfile.gettempdir(),
+                        f"roundtable-telemetry-{uid}")
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+# Emitted-span counter (tests/conftest.py `telemetry` marker guard: a
+# marked test that claims span coverage must actually emit spans).
+_spans_emitted = 0
+_spans_lock = threading.Lock()
+
+
+def spans_emitted() -> int:
+    return _spans_emitted
+
+
+def reset_spans_emitted() -> None:
+    global _spans_emitted
+    with _spans_lock:
+        _spans_emitted = 0
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class SpanSink:
+    """Append-only JSONL span sink (one per session: the root span
+    carries it and children inherit — per-session files work across the
+    thread hops the serving stack makes)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+
+    def write(self, record: dict) -> None:
+        try:
+            with self._lock:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(record, default=str) + "\n")
+        except OSError:
+            pass  # telemetry must never kill serving
+
+
+def session_sink(session_path) -> SpanSink:
+    """The per-session spans file: <session>/telemetry/spans.jsonl."""
+    return SpanSink(os.path.join(str(session_path), "telemetry",
+                                 "spans.jsonl"))
+
+
+class Span:
+    """One span of the trace tree. Context manager for the common
+    same-thread case; `start_span()`/`.end()` for holders that outlive
+    a lexical scope (the scheduler's per-request turn spans)."""
+
+    __slots__ = ("rung", "trace_id", "span_id", "parent_id", "attrs",
+                 "sink", "t0", "_wall0", "status", "_annotation",
+                 "_on_stack")
+
+    def __init__(self, rung: str, trace_id: str, parent_id: str,
+                 sink: Optional[SpanSink], attrs: dict[str, Any]):
+        self.rung = rung
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:12]
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.sink = sink
+        self.t0 = time.monotonic()
+        self._wall0 = time.time()
+        self.status = "ok"
+        self._annotation = None
+        self._on_stack = False
+        if _PROFILING:
+            # Mirror into the device profile: xprof rows named like the
+            # JSONL rungs. Lazy import; any failure silently drops the
+            # mirror (profiling is best-effort by standing contract).
+            try:
+                import jax
+                self._annotation = jax.profiler.TraceAnnotation(
+                    f"rt:{rung}")
+                self._annotation.__enter__()
+            except Exception:  # noqa: BLE001 — mirror is best-effort
+                self._annotation = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    # --- context-manager protocol (same-thread nesting) ---
+
+    def __enter__(self) -> "Span":
+        _stack().append(self)
+        self._on_stack = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.status = f"error:{type(exc).__name__}"
+        self.end()
+        return False
+
+    def end(self, status: Optional[str] = None) -> None:
+        if status is not None:
+            self.status = status
+        if self._on_stack:
+            stack = _stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            elif self in stack:  # unbalanced exit: drop it anyway
+                stack.remove(self)
+            self._on_stack = False
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
+            self._annotation = None
+        record = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "rung": self.rung,
+            "start": round(self._wall0, 6),
+            "dur_s": round(time.monotonic() - self.t0, 6),
+            "status": self.status,
+        }
+        if self.attrs:
+            record["attrs"] = self.attrs
+        if self.sink is not None:
+            self.sink.write(record)
+        ring = {"rung": self.rung, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "dur_s": record["dur_s"], "status": self.status}
+        for k, v in self.attrs.items():
+            if k not in ("kind", "at") and isinstance(
+                    v, (str, int, float, bool)):
+                ring.setdefault(k, v)
+        recorder().record("span", **ring)
+        global _spans_emitted
+        with _spans_lock:
+            _spans_emitted += 1
+
+
+class _NullSpan:
+    """The disarmed singleton: every operation a no-op, reentrant and
+    thread-safe because it holds no state."""
+
+    __slots__ = ()
+    rung = ""
+    trace_id = span_id = parent_id = ""
+    sink = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key, value):
+        pass
+
+    def end(self, status=None):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _AttachedContext:
+    """A foreign span context installed on this thread's stack so spans
+    opened here parent correctly across a thread hop (orchestrator
+    batch pools, scheduler submitters). Not emitted on exit — the real
+    span lives on its own thread."""
+
+    __slots__ = ("trace_id", "span_id", "sink", "rung")
+
+    def __init__(self, ctx: dict):
+        self.trace_id = ctx.get("trace_id", "")
+        self.span_id = ctx.get("span_id", "")
+        self.rung = ctx.get("rung", "")
+        sink = ctx.get("sink")
+        self.sink = sink if isinstance(sink, SpanSink) else None
+
+
+def current_context() -> Optional[dict]:
+    """A picklable-ish handle to the innermost span, for handing across
+    threads: `ctx = telemetry.current_context()` on the parent thread,
+    `with telemetry.attached(ctx):` on the worker."""
+    stack = _stack()
+    if not stack:
+        return None
+    top = stack[-1]
+    return {"trace_id": top.trace_id, "span_id": top.span_id,
+            "rung": top.rung, "sink": top.sink}
+
+
+class attached:
+    """Context manager installing a foreign span context as this
+    thread's parent. A None ctx is a no-op (callers pass
+    current_context()'s result straight through)."""
+
+    def __init__(self, ctx: Optional[dict]):
+        self._ctx = ctx
+        self._pushed = None
+
+    def __enter__(self):
+        if ACTIVE and self._ctx:
+            self._pushed = _AttachedContext(self._ctx)
+            _stack().append(self._pushed)
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed is not None:
+            stack = _stack()
+            if stack and stack[-1] is self._pushed:
+                stack.pop()
+            elif self._pushed in stack:
+                stack.remove(self._pushed)
+            self._pushed = None
+        return False
+
+
+def span(rung: str, sink: Optional[SpanSink] = None,
+         parent: Optional[dict] = None, **attrs):
+    """Open a span at `rung`. Disarmed: the no-op singleton (call sites
+    on hot paths additionally pre-guard with `if telemetry.ACTIVE:`).
+    Armed: parented to `parent` (a current_context() dict) when given,
+    else this thread's innermost span; roots mint a fresh trace id.
+    `sink` overrides the inherited JSONL sink (roots set it)."""
+    if not ACTIVE:
+        return _NULL_SPAN
+    return start_span(rung, sink=sink, parent=parent, **attrs)
+
+
+def start_span(rung: str, sink: Optional[SpanSink] = None,
+               parent: Optional[dict] = None, **attrs) -> Span:
+    """Like span() but always real (callers that hold a span across
+    ticks and end() it manually — check ACTIVE yourself)."""
+    if parent is not None:
+        trace_id = parent.get("trace_id") or uuid.uuid4().hex[:16]
+        parent_id = parent.get("span_id", "")
+        psink = parent.get("sink")
+        inherited = psink if isinstance(psink, SpanSink) else None
+    else:
+        stack = _stack()
+        top = stack[-1] if stack else None
+        trace_id = top.trace_id if top else uuid.uuid4().hex[:16]
+        parent_id = top.span_id if top else ""
+        inherited = top.sink if top else None
+    return Span(rung, trace_id, parent_id,
+                sink if sink is not None else inherited, attrs)
+
+
+# ---------------------------------------------------------------------------
+# observability-surface bindings (single-source-of-truth drift lint)
+# ---------------------------------------------------------------------------
+
+# Every key an observability surface exposes maps to the registry
+# series (or derivation) that backs it. The drift test
+# (tests/test_telemetry.py) asserts the ACTUAL keys of fleet_health()
+# and SessionScheduler.describe() are a subset of these — adding a new
+# surface key without declaring how the registry sees it fails CI, so
+# the four stores can never quietly fork again.
+SURFACE_BINDINGS: dict[str, dict[str, str]] = {
+    "fleet_health": {
+        "engines": "roundtable_breaker_failures_total{engine=...} "
+                   "(per-breaker snapshots; trips under "
+                   "roundtable_breaker_trips_total)",
+        "total": "len(engines)",
+        "open": "roundtable_breaker_open{engine=...} gauge",
+        "degraded": "derived from breaker snapshots",
+        "draining": "roundtable_draining gauge",
+        "hangs": "roundtable_hangs_total",
+        "schedulers": "roundtable_sched_* series, engine-labeled",
+        "queued_sessions": "roundtable_sched_queue_depth gauge sum",
+        "telemetry": "registry snapshot view (this module)",
+    },
+    "scheduler_describe": {
+        "admitted": "roundtable_sched_admitted_total",
+        "refused": "roundtable_sched_refused_total",
+        "completed": "roundtable_sched_completed_total",
+        "failed": "roundtable_sched_failed_total",
+        "rejected_draining": "roundtable_sched_rejected_draining_total",
+        "rejected_other": "roundtable_sched_rejected_other_total",
+        "preemptions": "roundtable_sched_preemptions_total",
+        "segments": "roundtable_sched_segments_total",
+        "requeues": "roundtable_sched_requeues_total",
+        "queued": "roundtable_sched_queue_depth gauge",
+        "queued_peak": "max over roundtable_sched_queue_depth",
+        "active_rows": "roundtable_sched_active_rows gauge",
+        "max_occupancy": "max over roundtable_sched_occupancy gauge",
+        "occupancy_mean": "mean over roundtable_sched_occupancy gauge",
+        "occupancy_recent": "ring view (flight recorder carries events)",
+        "events": "flight recorder ring (sched_* kinds)",
+    },
+}
+
+
+def registry_view() -> dict[str, Any]:
+    """The roll-up fleet_health()/describe() embed: counters + gauges
+    plus flight-recorder state, so the one store is visible from the
+    surfaces operators already poll."""
+    rec = recorder()
+    return {
+        "metrics": REGISTRY.snapshot_compact(),
+        "flight_dumps": rec.dumps,
+        "last_flight_dump": rec.last_dump_path,
+        "spans_emitted": spans_emitted(),
+        "armed": ACTIVE,
+    }
+
+
+if os.environ.get("ROUNDTABLE_TELEMETRY"):
+    arm()

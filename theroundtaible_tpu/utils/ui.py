@@ -86,6 +86,14 @@ ROUND_HEADERS = [
 ]
 
 
+def warn(text: str) -> None:
+    """Styled degrade/advisory line on stderr — the ONE warning surface
+    for opt-in features that must not kill a run (profiling, telemetry):
+    bare print() would interleave with round output and lose the
+    styling contract every other surface honors."""
+    print(style.yellow(text), file=sys.stderr)
+
+
 def knight_color(name: str, text: str) -> str:
     hexcode = KNIGHT_COLORS.get(name)
     return style.rgb(hexcode, text) if hexcode else style.white(text)
