@@ -74,10 +74,11 @@ ATTEMPT_TIMEOUT_S = 780.0  # four engines (bf16, int8, int8+paged, int4)
 MAX_ATTEMPTS = 2
 RETRY_DELAY_S = 20.0
 
-# v5e-1 roofline constants (per chip). Sources: public TPU v5e spec —
-# 819 GB/s HBM bandwidth, 197 bf16 TFLOP/s peak.
-V5E_HBM_GBPS = 819.0
-V5E_BF16_PEAK_TFLOPS = 197.0
+# Roofline constants + ceiling math live in ONE place now (ISSUE 6):
+# utils/perfmodel.py. These re-exports keep the historical bench.py
+# names alive; the drift test pins them to the shared model.
+from theroundtaible_tpu.utils.perfmodel import (V5E_BF16_PEAK_TFLOPS,
+                                                V5E_HBM_GBPS)
 
 PROMPT = (
     "You are taking part in a TheRoundtAIble discussion. Topic: should we "
@@ -152,6 +153,13 @@ def child() -> int:
         if headline:
             detail["winning_config"] = label  # winner of all runs
             detail["anchor_provenance"] = ANCHOR_PROVENANCE
+            # Perf-attribution block (ISSUE 6): roofline gauges, compile
+            # observatory summary (how many compiles the measured runs
+            # actually paid — cache hit vs fresh), memory ledger, span
+            # overheads — the window's numbers arrive with their
+            # explanation attached.
+            from theroundtaible_tpu.utils import perfmodel
+            detail["perf"] = perfmodel.attribution_snapshot()
             if failed:
                 detail["failed_configs"] = failed
         rec = {
@@ -212,9 +220,16 @@ def child() -> int:
         # window's int4 number must be attributable to the kernel, and
         # every decline carries an explicit fallback_reason.
         int4_paths = None
+        int4_fallback_dispatches = None
         if quant == "int4":
             rep = engine.int4_path_report()
             if rep is not None:
+                # Raw per-(spec, shape) dispatch count — the SAME
+                # granularity as the live
+                # roundtable_int4_fallback_dispatches gauge, so the
+                # bench record and the registry can't disagree (the
+                # int4_paths summary below dedupes for readability).
+                int4_fallback_dispatches = len(rep["xla_dequant"])
                 int4_paths = {
                     "pallas_w4a16": sorted(
                         {e["spec"] for e in rep["pallas_w4a16"]}),
@@ -244,23 +259,19 @@ def child() -> int:
             },
         }
         if not on_cpu:
-            # Aggregate ceilings: with TP over n chips each chip streams
-            # param_bytes/n per token (and contributes its own peak
-            # FLOP/s), so both ceilings scale with the mesh size.
-            n_dev = len(devices)
-            decode_ceiling_tps = n_dev * V5E_HBM_GBPS * 1e9 / param_bytes
-            prefill_peak_tps = (n_dev * V5E_BF16_PEAK_TFLOPS * 1e12
-                                / (2.0 * engine.num_params))
-            run["roofline"] = {
-                "decode_ceiling_tps": round(decode_ceiling_tps, 1),
-                "decode_frac": round(
-                    run["decode_tps"] / decode_ceiling_tps, 3),
-                "prefill_mfu": round(
-                    run["prefill_tps"] / prefill_peak_tps, 3),
-                "assumptions": "decode: HBM 819 GB/s / streamed param "
-                               "bytes (KV traffic excluded); prefill: "
-                               "2·params FLOPs/token vs 197 bf16 TFLOP/s",
-            }
+            # The roofline block is PRODUCED by the shared perfmodel
+            # (ISSUE 6): aggregate ceilings scale with the mesh size,
+            # streamed bytes come from the actual quantized tree, and
+            # the same math backs the live bw_utilization/mfu gauges —
+            # bench records and serving gauges can no longer drift.
+            from theroundtaible_tpu.utils import perfmodel
+            run["roofline"] = perfmodel.roofline_block(
+                param_bytes=param_bytes,
+                num_params=engine.num_params,
+                n_devices=len(devices),
+                decode_tps=run["decode_tps"],
+                prefill_tps=run["prefill_tps"],
+                int4_fallbacks=int4_fallback_dispatches)
         return run
 
     # Measure bf16, int8 (the reference's llama.cpp baseline serves

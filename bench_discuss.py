@@ -53,6 +53,14 @@ def _registry_snapshot() -> dict:
     return telemetry.REGISTRY.snapshot_compact()
 
 
+def _perf_block() -> dict:
+    """Perf-attribution block (ISSUE 6): roofline gauges, compile
+    observatory summary, memory ledger, span overheads — every run
+    record explains its own number."""
+    from theroundtaible_tpu.utils import perfmodel
+    return perfmodel.attribution_snapshot()
+
+
 def offered_load_child() -> int:
     """Offered-load sweep (ISSUE 4 satellite): K concurrent 3-knight
     scripted discussions through ONE shared engine + session scheduler,
@@ -228,6 +236,7 @@ def offered_load_child() -> int:
                 # occupancy/fallback/hang counters fleet_health reads,
                 # frozen into the run record.
                 "telemetry": _registry_snapshot(),
+                "perf": _perf_block(),
             },
         }
         print(json.dumps(result_line), flush=True)
@@ -409,6 +418,7 @@ def child() -> int:
             # Unified-registry snapshot (ISSUE 5, the int4_paths
             # pattern): every run record carries the window's counters.
             "telemetry": _registry_snapshot(),
+            "perf": _perf_block(),
         },
     }
     # flush=True: the watchdog salvages a timeout-killed child's stdout,

@@ -49,6 +49,20 @@ GROUP = 64
 ITERS = 50
 ATTEMPT_TIMEOUT_S = 300.0
 
+# The HBM roofline each variant's effective GB/s is judged against
+# comes from the ONE shared model (ISSUE 6) — the v5e 819 GB/s figure
+# this docstring cites used to be a local literal.
+from theroundtaible_tpu.utils import perfmodel as _perfmodel
+
+_DEFAULT_HBM_GBPS = _perfmodel.V5E_HBM_GBPS
+
+
+def _hbm_roofline_gbps(device_kind: str) -> float:
+    """Detected chip's HBM bandwidth, defaulting to v5e (the CPU smoke
+    path — numbers are meaningless there anyway, plumbing runs)."""
+    spec = _perfmodel.chip_spec(device_kind)
+    return spec.hbm_gbps if spec else _DEFAULT_HBM_GBPS
+
 
 def child() -> int:
     from bench_common import install_sigterm_exit
@@ -63,6 +77,7 @@ def child() -> int:
 
     dev = jax.devices()[0]
     platform = dev.platform
+    hbm_gbps = _hbm_roofline_gbps(getattr(dev, "device_kind", ""))
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.standard_normal((E, F), np.float32) * 0.02,
                     jnp.bfloat16)
@@ -135,11 +150,16 @@ def child() -> int:
                 out = chained(out, *args)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / ITERS
+            eff_gbps = streamed_bytes / dt / 1e9
             print(json.dumps({
                 "variant": name, "platform": platform,
                 "us_per_call": round(dt * 1e6, 1),
                 "streamed_mb": round(streamed_bytes / 1e6, 2),
-                "effective_gbps": round(streamed_bytes / dt / 1e9, 1),
+                "effective_gbps": round(eff_gbps, 1),
+                # Shared-roofline attribution (ISSUE 6): fraction of
+                # the chip's HBM bandwidth this variant achieved.
+                "hbm_roofline_gbps": hbm_gbps,
+                "roofline_frac": round(eff_gbps / hbm_gbps, 3),
                 **(extra or {}),
             }), flush=True)
         except Exception as e:  # a variant crashing is itself the data

@@ -43,38 +43,64 @@ PROMPT = ("You are taking part in a TheRoundtAIble discussion. Topic: "
 
 
 def _top_device_ops(trace_dir: str, top_n: int = 14) -> list[dict]:
-    """Aggregate per-op durations from the profiler's chrome trace.
+    """Aggregate per-op durations from the profiler's chrome traces.
 
-    Prefers device pids (named like '/device:TPU:0'); host-only traces
-    (CPU smoke) fall back to all pids minus Python-frame noise."""
+    Multi-device/multi-host profiles emit SEVERAL *.trace.json.gz (one
+    per host/device group) — aggregating only files[0] silently dropped
+    every other chip's ops (ISSUE 6 satellite), so all files aggregate,
+    with the device-pid filter applied PER FILE (pids are file-local).
+    Device pids (named like '/device:TPU:0') are preferred; when no
+    file has any (CPU smoke's host-only trace), all files fall back to
+    all pids minus Python-frame noise."""
     from collections import defaultdict
 
     files = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
                                    "*.trace.json.gz"))
     if not files:
         return []
-    t = json.loads(gzip.open(files[0]).read())
-    events = t.get("traceEvents", [])
-    pid_names = {e["pid"]: e["args"].get("name", "")
-                 for e in events
-                 if e.get("ph") == "M" and e.get("name") == "process_name"
-                 and "args" in e}
-    device_pids = {p for p, n in pid_names.items()
-                   if "device" in n.lower() or "tpu" in n.lower()}
+    # One pass per file, retaining only its AGGREGATE (a multi-host
+    # trace file is hundreds of MB decompressed — holding every file's
+    # event list simultaneously would make peak memory N× one trace).
+    # Each file aggregates under its own mode (device-filtered vs the
+    # host fallback); the merge below keeps only device aggregates
+    # when any file had device pids.
+    per_file = []  # (had_device_pids, {name: [dur, count]})
+    for path in sorted(files):
+        t = json.loads(gzip.open(path).read())
+        events = t.get("traceEvents", [])
+        pid_names = {e["pid"]: e["args"].get("name", "")
+                     for e in events
+                     if e.get("ph") == "M"
+                     and e.get("name") == "process_name"
+                     and "args" in e}
+        device_pids = {p for p, n in pid_names.items()
+                       if "device" in n.lower() or "tpu" in n.lower()}
+        fagg = defaultdict(lambda: [0.0, 0])
+        for e in events:
+            if e.get("ph") != "X" or not e.get("dur"):
+                continue
+            name = e.get("name", "")
+            if device_pids and e.get("pid") not in device_pids:
+                continue
+            if not device_pids and (name.startswith("$")
+                                    or ".py:" in name
+                                    or name.startswith("<")):
+                continue
+            fagg[name][0] += e["dur"]
+            fagg[name][1] += 1
+        per_file.append((bool(device_pids), fagg))
+        del t, events  # only the aggregate survives this iteration
+    any_device = any(had for had, _a in per_file)
 
     agg = defaultdict(lambda: [0.0, 0])
-    for e in events:
-        if e.get("ph") != "X" or not e.get("dur"):
+    for had_device, fagg in per_file:
+        if any_device and not had_device:
+            # Host-only file next to device traces: its fallback
+            # aggregate is Python-frame noise — skip it.
             continue
-        name = e.get("name", "")
-        if device_pids and e.get("pid") not in device_pids:
-            continue
-        if not device_pids and (name.startswith("$")
-                                or ".py:" in name
-                                or name.startswith("<")):
-            continue
-        agg[name][0] += e["dur"]
-        agg[name][1] += 1
+        for name, (dur, count) in fagg.items():
+            agg[name][0] += dur
+            agg[name][1] += count
     total = sum(v[0] for v in agg.values()) or 1.0
     out = []
     for name, (dur, count) in sorted(agg.items(), key=lambda kv: -kv[1][0]):

@@ -124,15 +124,40 @@ def _quant_kernel_guard(request, monkeypatch):
 
 
 @pytest.fixture(autouse=True)
-def _scheduler_guard(request):
+def _compile_watch_isolation():
+    """Steady-state isolation (ISSUE 6): `warmup_complete` flips GLOBAL
+    process state (any later compile counts as a mid-serve recompile),
+    and module-scoped engines outlive their tests — without a per-test
+    reset, one test's warmup would classify every later test's compiles
+    as steady-state violations (and, under the scheduler suite's strict
+    arming, fail them). Cheap: two attribute clears, no jax import."""
+    from theroundtaible_tpu.engine import compile_watch
+
+    compile_watch.reset_steady_state()
+    yield
+    compile_watch.reset_steady_state()
+
+
+@pytest.fixture(autouse=True)
+def _scheduler_guard(request, monkeypatch):
     """Tier-1 guard for @pytest.mark.scheduler (ISSUE 4 satellite): a
     test that CLAIMS continuous-batching coverage must not silently fall
     back to serial serving — if no decode segment during the test ever
     carried >= 2 rows, the sessions were served one-at-a-time and the
     test's concurrency claims are vacuous; fail LOUD. Unit tests of the
-    scheduler's non-batching surfaces mark allow_serial=True."""
+    scheduler's non-batching surfaces mark allow_serial=True.
+
+    Every scheduler-marked test additionally runs with
+    ROUNDTABLE_RECOMPILE_STRICT=1 armed (ISSUE 6): once a test declares
+    warmup complete, a mid-serve recompile RAISES instead of hiding in
+    the latency tail — the pow2-bucket invariant is enforced, not
+    assumed. Tests that never declare steady state are unaffected."""
     marker = request.node.get_closest_marker("scheduler")
-    if marker is None or marker.kwargs.get("allow_serial"):
+    if marker is None:
+        yield
+        return
+    monkeypatch.setenv("ROUNDTABLE_RECOMPILE_STRICT", "1")
+    if marker.kwargs.get("allow_serial"):
         yield
         return
     from theroundtaible_tpu.engine import scheduler as sched_mod
@@ -144,6 +169,35 @@ def _scheduler_guard(request):
         "decode segment carried more than "
         f"{sched_mod.max_rows_seen()} row(s) — continuous batching "
         "never happened (mark allow_serial=True only for unit tests)")
+
+
+@pytest.fixture(autouse=True)
+def _perf_obs_guard(request):
+    """Tier-1 guard for @pytest.mark.perf_obs (ISSUE 6): a test that
+    CLAIMS performance-attribution coverage must actually exercise the
+    observability — if neither the compile observatory recorded an
+    event nor any perf gauge was published during the test, the seams
+    silently no-op'd (uninstalled observatory, disconnected publish
+    path); fail LOUD. allow_quiet=True waives the check for pure-math
+    units (ceiling formulas, span folding)."""
+    marker = request.node.get_closest_marker("perf_obs")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import compile_watch
+    from theroundtaible_tpu.utils import perfmodel
+
+    compile_watch.install()
+    c0 = compile_watch.compiles_seen()
+    g0 = perfmodel.gauges_published()
+    yield
+    if marker.kwargs.get("allow_quiet"):
+        return
+    assert (compile_watch.compiles_seen() > c0
+            or perfmodel.gauges_published() > g0), (
+        "perf_obs-marked test recorded NO compile events and published "
+        "NO perf gauges: the performance-attribution seams silently "
+        "no-op'd (mark allow_quiet=True only for pure-math units)")
 
 
 @pytest.fixture(autouse=True)

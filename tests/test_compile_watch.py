@@ -1,0 +1,210 @@
+"""Compile observatory + steady-state recompile sentinel (ISSUE 6).
+
+Units for engine/compile_watch.py: install modes, label attribution,
+registry/flight-recorder publication, the steady-state sentinel's
+count/dump/strict behaviors, and the enable_compilation_cache
+decision-recording + memoization satellite.
+"""
+
+import glob
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from theroundtaible_tpu.engine import compile_watch
+from theroundtaible_tpu.utils import telemetry
+
+# Each forced compile uses a FRESH shape from this counter: jit caches
+# per (function, shape), and the persistent test XLA cache would turn a
+# repeated shape into silence (no backend compile, no retrieval for the
+# in-process cache) — the observatory correctly sees nothing then.
+_shape = [101]
+
+
+def force_compile():
+    _shape[0] += 1
+    return jax.jit(lambda x: x * 2.5 + _shape[0])(
+        jnp.ones((_shape[0],)))
+
+
+@pytest.fixture(autouse=True)
+def _installed(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR", str(tmp_path))
+    compile_watch.install()
+    compile_watch.reset_steady_state()
+    yield
+    compile_watch.reset_steady_state()
+
+
+@pytest.mark.perf_obs
+class TestObservatory:
+    def test_install_idempotent_and_mode(self):
+        mode = compile_watch.install()
+        assert mode in ("monitoring", "lower-seam")
+        # Second install must not double-register listeners: two
+        # installs then one compile must count each event once.
+        assert compile_watch.install() == mode
+        c0 = compile_watch.compiles_seen()
+        force_compile()
+        delta = compile_watch.compiles_seen() - c0
+        assert delta >= 1
+        c1 = compile_watch.compiles_seen()
+        force_compile()
+        # Same op pattern: a double-registered listener would see ~2x.
+        assert compile_watch.compiles_seen() - c1 <= delta + 1
+
+    def test_label_attribution_and_registry(self):
+        c0 = telemetry.REGISTRY.counter_total(
+            "roundtable_compiles_total", label="unit[labeled]")
+        with compile_watch.label("unit[labeled]", engine="t"):
+            force_compile()
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_compiles_total", label="unit[labeled]") > c0
+        recent = [e for e in compile_watch.history()
+                  if e["label"] == "unit[labeled]"]
+        assert recent and recent[-1]["engine"] == "t"
+        assert recent[-1]["steady_state"] is False
+        # ...and the flight-recorder ring carries the compile event.
+        kinds = [e for e in telemetry.recorder().events()
+                 if e["kind"] == "compile"
+                 and e.get("label") == "unit[labeled]"]
+        assert kinds
+
+    def test_unlabeled_compiles_record_as_unlabeled(self):
+        c0 = telemetry.REGISTRY.counter_total(
+            "roundtable_compiles_total", label="unlabeled")
+        force_compile()
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_compiles_total", label="unlabeled") > c0
+
+
+@pytest.mark.perf_obs
+class TestSteadyStateSentinel:
+    @staticmethod
+    def compile_as(engine_name):
+        """Force a compile inside an engine-attributed window — what
+        the engines' dispatch seams produce; the sentinel keys on the
+        window's engine attr (per-engine enforcement)."""
+        with compile_watch.label("unit[seam]", engine=engine_name):
+            force_compile()
+
+    def test_pre_steady_compiles_are_not_violations(self):
+        self.compile_as("unit-engine")
+        assert compile_watch.steady_state_compiles() == 0
+
+    def test_steady_compile_counts_and_dumps_once(self, tmp_path):
+        compile_watch.warmup_complete("unit-engine")
+        assert compile_watch.steady_state_labels() == ("unit-engine",)
+        d0 = telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile")
+        self.compile_as("unit-engine")
+        self.compile_as("unit-engine")
+        assert compile_watch.steady_state_compiles() >= 2
+        assert telemetry.counter_total(
+            "roundtable_steady_state_compiles_total") >= 2
+        # ONE postmortem per steady period, not one per violation.
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile") == d0 + 1
+        assert glob.glob(
+            str(tmp_path / "flight-steady_state_compile-*.json"))
+
+    def test_dump_once_is_per_engine(self):
+        """Engine B's first violation still ships its postmortem after
+        engine A already dumped — dumped-state is per label, not
+        process-global."""
+        compile_watch.warmup_complete("engine-a")
+        compile_watch.warmup_complete("engine-b")
+        d0 = telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile")
+        self.compile_as("engine-a")
+        self.compile_as("engine-a")
+        self.compile_as("engine-b")
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile") == d0 + 2
+
+    def test_enforcement_is_per_engine(self, monkeypatch):
+        """A multi-engine process (warmup_cmd loops adapters): engine
+        A's declaration must not classify engine B's construction and
+        warmup compiles — or unattributed eager compiles — as
+        violations."""
+        monkeypatch.setenv(compile_watch.STRICT_ENV, "1")
+        compile_watch.warmup_complete("engine-a")
+        self.compile_as("engine-b")   # another engine, still warming
+        force_compile()               # unattributed (construction)
+        assert compile_watch.steady_state_compiles() == 0
+        with pytest.raises(compile_watch.RecompileInSteadyState):
+            self.compile_as("engine-a")
+
+    def test_strict_mode_raises_loud(self, monkeypatch):
+        compile_watch.warmup_complete("unit-engine")
+        monkeypatch.setenv(compile_watch.STRICT_ENV, "1")
+        with pytest.raises(compile_watch.RecompileInSteadyState,
+                           match="no-mid-serve-recompile"):
+            self.compile_as("unit-engine")
+        # Leaving steady state ends enforcement.
+        compile_watch.reset_steady_state()
+        self.compile_as("unit-engine")
+
+    def test_reopen_warmup_reenters_warm_phase(self, monkeypatch):
+        compile_watch.warmup_complete("eng-a")
+        compile_watch.warmup_complete("eng-b")
+        compile_watch.reopen_warmup("eng-a")
+        assert compile_watch.steady_state_labels() == ("eng-b",)
+        compile_watch.reopen_warmup("eng-b")
+        # Fully reopened: compiles are expected again, even STRICT.
+        monkeypatch.setenv(compile_watch.STRICT_ENV, "1")
+        self.compile_as("eng-a")
+        self.compile_as("eng-b")
+        assert compile_watch.steady_state_compiles() == 0
+
+    def test_strict_unarmed_does_not_raise(self, monkeypatch):
+        monkeypatch.delenv(compile_watch.STRICT_ENV, raising=False)
+        compile_watch.warmup_complete("unit-engine")
+        self.compile_as("unit-engine")  # counted, dumped, NOT raised
+        assert compile_watch.steady_state_compiles() >= 1
+
+
+class TestCompilationCacheDecision:
+    """ISSUE 6 satellite: enable_compilation_cache records its decision
+    once and memoizes the CPU no-op (it used to re-probe the backend
+    on every call)."""
+
+    def test_cpu_decision_recorded_and_memoized(self, monkeypatch):
+        from theroundtaible_tpu import engine as engine_pkg
+
+        assert engine_pkg.enable_compilation_cache() is None
+        d = engine_pkg.get_compile_cache_decision()
+        assert d == {"enabled": False, "backend": "cpu", "dir": None,
+                     "reason": d["reason"]}
+        assert "cpu" in d["reason"]
+        # Recorded ONCE per process by design — a registry.reset() in
+        # an earlier test legitimately wipes the gauge, so only its
+        # value (when present) is pinned, not its presence.
+        assert telemetry.REGISTRY.gauge_value(
+            "roundtable_compile_cache_enabled") in (0.0, None)
+        # Memoized: a repeat call must not touch the backend again.
+        monkeypatch.setattr(
+            jax, "default_backend",
+            lambda: (_ for _ in ()).throw(AssertionError("re-probed")))
+        assert engine_pkg.enable_compilation_cache() is None
+
+    def test_decision_lands_in_describe(self):
+        from theroundtaible_tpu.engine.engine import InferenceEngine
+        from theroundtaible_tpu.engine.models.registry import \
+            get_model_config
+
+        eng = InferenceEngine(get_model_config("tiny-gemma",
+                                               max_seq_len=256),
+                              num_slots=2)
+        info = eng.describe()
+        assert info["compile_cache"]["backend"] == "cpu"
+        assert info["compile_observatory"]["mode"] in ("monitoring",
+                                                       "lower-seam")
+        assert info["perf"]["param_bytes"] > 0

@@ -665,3 +665,112 @@ class TestMetricsConcurrency:
             t.join()
         assert sum(len(r.turns) for r in m.rounds) == 200
         m.finish("done")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6 acceptance: the steady-state recompile sentinel on a live
+# scheduler — occupancy drift compiles NOTHING once warmup is declared
+# (enforced: conftest arms ROUNDTABLE_RECOMPILE_STRICT for this suite,
+# so a mid-serve compile would RAISE into the session errors), and an
+# injected non-bucket shape trips strict mode + a flight dump.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.scheduler
+@pytest.mark.perf_obs
+class TestRecompileSentinel:
+    def _submit_all(self, sched, sessions, max_new=70):
+        results, errors = {}, {}
+
+        def run(sid, turns):
+            try:
+                results[sid] = sched.submit(sid, turns,
+                                            max_new_tokens=max_new)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors[sid] = e
+
+        threads = [threading.Thread(target=run, args=(sid, turns))
+                   for sid, turns in sessions.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        return results, errors
+
+    def test_drift_run_compiles_nothing_and_new_shape_trips(
+            self, tmp_path, monkeypatch):
+        from theroundtaible_tpu.engine import compile_watch
+        from theroundtaible_tpu.utils import telemetry
+
+        monkeypatch.setenv("ROUNDTABLE_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("ROUNDTABLE_PERF_CHIP", "v5e")
+        assert compile_watch.install() != "off"
+        engine = make_engine()
+        # Device-program warmup for every bucket the max_rows=4
+        # scheduler can dispatch ({1, 2, 4})...
+        engine.warmup(max_prompt_tokens=256, batch_sizes=(1, 2, 4))
+        # ...then representative SCHEDULED traffic to compile the
+        # scheduler-side shapes (pipelined-segment carries, join with
+        # pinned live rows) warmup's direct calls never touch.
+        # engine.warmup() declared steady state for DIRECT serving;
+        # attaching a scheduler ADDS compile surface, so construction
+        # REOPENS the warmup phase (the sanctioned production escape —
+        # without it this warm traffic would be false violations).
+        assert compile_watch.steady_state_labels() == (engine.cfg.name,)
+        sched = SessionScheduler(engine, max_rows=4, admit_hold_s=0.2)
+        assert compile_watch.steady_state_labels() == ()
+        sched.submit("w-solo", PROMPTS["s0"][:1], max_new_tokens=70)
+        sched.submit("w-pair", PROMPTS["s1"], max_new_tokens=70)
+        _res, errs = self._submit_all(
+            sched, {"s0": PROMPTS["s0"], "s1": PROMPTS["s1"]})
+        assert not errs, f"warm pass failed: {errs}"
+
+        # --- steady state: the compile set is now declared closed ---
+        sched.declare_warmup_complete()
+        assert compile_watch.steady_state_labels() == (
+            engine.cfg.name,)
+        assert compile_watch.steady_state_compiles() == 0
+
+        # Occupancy-DRIFT run: three fresh 2-knight sessions through a
+        # 4-row batch — the third queues, joins as rows retire, rows
+        # hit eos at different steps, so the live-row count drifts
+        # across segments. STRICT is armed (conftest): any compile
+        # would raise RecompileInSteadyState into `errs`.
+        results, errs = self._submit_all(
+            sched, {"d0": PROMPTS["s0"], "d1": PROMPTS["s1"],
+                    "d2": PROMPTS["s2"]})
+        assert not errs, f"drift pass recompiled or failed: {errs}"
+        assert set(results) == {"d0", "d1", "d2"}
+        assert compile_watch.steady_state_compiles() == 0
+        desc = sched.describe()
+        assert desc["max_occupancy"] >= 3
+        assert len(set(desc["occupancy_recent"])) >= 2, \
+            "occupancy never drifted — the run proved nothing"
+
+        # Perf gauges rode along (ISSUE 6 tentpole): per-segment
+        # roofline samples and the per-session KV series, REMOVED at
+        # retirement (uuid-tagged session ids would otherwise grow the
+        # registry one dead series per session ever served).
+        assert telemetry.REGISTRY.gauge_value(
+            "roundtable_bw_utilization", engine=engine.cfg.name,
+            phase="decode") is not None
+        assert telemetry.REGISTRY.gauge_value(
+            "roundtable_session_kv_bytes", engine=engine.cfg.name,
+            session="d0") is None
+
+        # --- injected NEW shape: a 3-wide batch was never warmed
+        # (buckets are {1, 2, 4}; direct generate_batch dispatches the
+        # exact row count) — strict mode must fail it LOUD, with a
+        # flight-recorder postmortem.
+        d0 = telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile")
+        with pytest.raises(compile_watch.RecompileInSteadyState):
+            engine.generate_batch(
+                [("x1", "zig"), ("x2", "zag"), ("x3", "zog")],
+                max_new_tokens=8, session="inject")
+        assert compile_watch.steady_state_compiles() >= 1
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_flight_dumps_total",
+            trigger="steady_state_compile") == d0 + 1
+        sched.close()

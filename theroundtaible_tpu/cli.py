@@ -82,6 +82,10 @@ def build_parser():
     st.add_argument("--telemetry", action="store_true",
                     help="Render the session's telemetry view: registry "
                          "snapshot, span summary, flight-recorder dumps")
+    st.add_argument("--perf", action="store_true",
+                    help="Render live performance attribution: roofline "
+                         "table, compile observatory, memory ledger, "
+                         "span-tree overhead breakdown")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -152,7 +156,8 @@ def dispatch(args) -> int:
     if args.command == "status":
         from .commands.status import status_command
         return status_command(
-            telemetry_view=getattr(args, "telemetry", False))
+            telemetry_view=getattr(args, "telemetry", False),
+            perf_view=getattr(args, "perf", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

@@ -37,13 +37,16 @@ def phase_display(status) -> tuple[str, str, object]:
 
 
 def status_command(project_root: Optional[str] = None,
-                   telemetry_view: bool = False) -> int:
+                   telemetry_view: bool = False,
+                   perf_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
                         'Start one with "roundtable discuss".\n'))
         return 0
+    if perf_view:
+        return perf_status(session)
     if telemetry_view:
         return telemetry_status(session)
 
@@ -153,5 +156,175 @@ def telemetry_status(session) -> int:
         print(style.bold(f"\n  Flight-recorder dumps ({len(dumps)}):"))
         for p in dumps[-5:]:
             print(style.dim(f"    {p}"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --perf` (ISSUE 6) ---
+
+
+def _series_for_perf(session) -> dict[str, float]:
+    """Perf registry series, compact-key → value: the session's
+    metrics.prom export where present, overlaid with the LIVE registry
+    when this process is serving (live values are fresher)."""
+    from ..utils import telemetry
+
+    series: dict[str, float] = {}
+    prom = Path(session.path) / "telemetry" / "metrics.prom"
+    if prom.exists():
+        for ln in prom.read_text(encoding="utf-8").splitlines():
+            if not ln or ln.startswith("#") or "_bucket{" in ln:
+                continue
+            key, _, val = ln.rpartition(" ")
+            try:
+                series[key.replace('"', "")] = float(val)
+            except ValueError:
+                continue
+    series.update(telemetry.REGISTRY.snapshot_compact())
+    return series
+
+
+def _labels(key: str) -> dict[str, str]:
+    if "{" not in key:
+        return {}
+    body = key[key.index("{") + 1:key.rindex("}")]
+    return dict(part.split("=", 1) for part in body.split(",") if "=" in
+                part)
+
+
+def _by_engine(series: dict[str, float],
+               name: str) -> dict[str, tuple[float, dict]]:
+    """{engine: (value, labels)} for one series name."""
+    out: dict[str, tuple[float, dict]] = {}
+    for key, val in series.items():
+        if key.split("{", 1)[0] != name:
+            continue
+        labels = _labels(key)
+        eng = labels.get("engine", "?")
+        out[eng] = (val, labels)
+    return out
+
+
+def perf_status(session) -> int:
+    """`roundtable status --perf` — live performance attribution from
+    the unified registry (ISSUE 6): the per-engine roofline table
+    (ceiling, bw_utilization, MFU), the compile observatory's history
+    and steady-state sentinel state, the memory ledger, and the
+    span-tree overhead breakdown."""
+    from ..utils import perfmodel, telemetry
+
+    print(style.bold(f"\n  Performance — session {session.name}"))
+    series = _series_for_perf(session)
+    perf = perfmodel.perf_series(series)
+
+    # --- roofline table ---
+    ceilings = _by_engine(perf, "roundtable_decode_ceiling_tps")
+    engines = sorted(
+        set(ceilings)
+        | {lb.get("engine", "?") for k in perf
+           for lb in [_labels(k)] if "engine" in lb})
+    if engines and any(k.split("{")[0].startswith(
+            ("roundtable_decode", "roundtable_bw", "roundtable_mfu"))
+            for k in perf):
+        print(style.bold("\n  Roofline (per engine):"))
+        print(style.dim("    engine            ceiling_tps  decode_tps"
+                        "  bw_util    mfu"))
+        for eng in engines:
+            def val(name, phase=None):
+                for key, v in perf.items():
+                    if key.split("{", 1)[0] != name:
+                        continue
+                    lb = _labels(key)
+                    if lb.get("engine") != eng:
+                        continue
+                    if phase and lb.get("phase") != phase:
+                        continue
+                    return v
+                return None
+
+            def fmt(v, pct=False):
+                if v is None:
+                    return "      -"
+                return f"{v * 100:6.1f}%" if pct else f"{v:10.1f}"
+
+            print(style.dim(
+                f"    {eng:<18}{fmt(val('roundtable_decode_ceiling_tps'))}"
+                f"{fmt(val('roundtable_decode_tps'))}"
+                f"  {fmt(val('roundtable_bw_utilization', 'decode'), True)}"
+                f"{fmt(val('roundtable_mfu', 'prefill'), True)}"))
+
+    # --- compile observatory ---
+    from ..engine import compile_watch
+    summary = compile_watch.summary(recent=6)
+    print(style.bold("\n  Compile observatory:"))
+    print(style.dim(
+        f"    mode={summary['mode']}  compiles={summary['compiles']}  "
+        f"cache_hits={summary['cache_hits']}  "
+        f"steady_state={summary['steady_state'] or 'not declared'}  "
+        f"steady_compiles={summary['steady_state_compiles']}"
+        + ("  STRICT" if summary["strict"] else "")))
+    for e in summary.get("recent", []):
+        flag = " [STEADY-STATE]" if e.get("steady_state") else ""
+        hit = " (cache hit)" if e.get("cache_hit") else ""
+        print(style.dim(f"    {e['label']:<32} {e['dur_s']:>8.3f}s"
+                        f"{hit}{flag}"))
+    total = sum(v for k, v in perf.items()
+                if k.split("{")[0] == "roundtable_compiles_total")
+    steady = sum(v for k, v in perf.items()
+                 if k.split("{")[0]
+                 == "roundtable_steady_state_compiles_total")
+    if total:
+        print(style.dim(f"    registry: {total:g} compiles recorded, "
+                        f"{steady:g} in steady state"))
+
+    # --- memory ledger ---
+    mem_keys = [k for k in perf if k.split("{")[0].startswith(
+        ("roundtable_kv_", "roundtable_hbm_"))]
+    if mem_keys:
+        print(style.bold("\n  Memory ledger:"))
+        for k in sorted(mem_keys):
+            print(style.dim(f"    {k} {perf[k]:g}"))
+    sess_keys = [k for k in perf
+                 if k.split("{")[0] == "roundtable_session_kv_bytes"
+                 and perf[k] > 0]
+    if sess_keys:
+        print(style.bold("\n  Per-session KV footprint:"))
+        for k in sorted(sess_keys):
+            lb = _labels(k)
+            print(style.dim(f"    {lb.get('session', '?'):<24}"
+                            f"{perf[k] / 1e6:10.2f} MB"))
+
+    # --- span-tree overheads ---
+    spans = telemetry.recorder().span_events()
+    if not spans:
+        spans_file = Path(session.path) / "telemetry" / "spans.jsonl"
+        if spans_file.exists():
+            import json as _json
+            spans = []
+            for ln in spans_file.read_text(encoding="utf-8").splitlines():
+                try:
+                    spans.append(_json.loads(ln))
+                except ValueError:
+                    continue
+    over = perfmodel.span_overheads(spans) if spans else {}
+    rungs = {k: v for k, v in over.items() if isinstance(v, dict)}
+    if rungs:
+        print(style.bold("\n  Overhead breakdown (per rung):"))
+        print(style.dim("    rung        total_s  dispatch  host_sync"
+                        "   gap"))
+        for rung, a in sorted(rungs.items()):
+            print(style.dim(
+                f"    {rung:<10}{a['total_s']:>9.3f}"
+                f"  {a['dispatch_frac'] * 100:6.1f}%"
+                f"  {a['host_sync_frac'] * 100:7.1f}%"
+                f"  {a['gap_frac'] * 100:5.1f}%"))
+        if "queue_wait_s" in over:
+            print(style.dim(
+                f"    queue wait  {over['queue_wait_s']:.3f}s total"))
+    if not perf and not spans:
+        print(style.dim(
+            "\n  No perf series captured. Serve with "
+            "ROUNDTABLE_TELEMETRY=1 (and on CPU set "
+            "ROUNDTABLE_PERF_CHIP=v5e for an assumed roofline).\n"))
     print("")
     return 0

@@ -20,7 +20,11 @@ from typing import Any
 _engines: dict[str, Any] = {}
 _breakers: dict[str, Any] = {}
 _lock = threading.Lock()
-_compile_cache_enabled = False
+# The one-shot cache decision (ISSUE 6 satellite): memoized for BOTH
+# outcomes — the CPU no-op used to re-probe jax.default_backend() on
+# every call — and recorded once into the telemetry registry and
+# engine.describe() so an operator can see which it was after the fact.
+_compile_cache_decision: dict[str, Any] | None = None
 
 
 def enable_compilation_cache():
@@ -36,13 +40,20 @@ def enable_compilation_cache():
     XLA:CPU AOT cache entries embed host machine features — reloading one
     compiled under different flags/machines warns "could lead to SIGILL".
     The dir is namespaced by backend so mixed-platform runs can't collide.
-    """
-    global _compile_cache_enabled
-    if _compile_cache_enabled:
-        return _compile_cache_enabled
+
+    Returns the cache dir when enabled, None for the no-op — and either
+    way decides exactly ONCE per process (get_compile_cache_decision()
+    exposes the memoized outcome)."""
+    global _compile_cache_decision
+    if _compile_cache_decision is not None:
+        return _compile_cache_decision.get("dir")
     import jax
     backend = jax.default_backend()
     if backend == "cpu":
+        _compile_cache_decision = {
+            "enabled": False, "backend": "cpu", "dir": None,
+            "reason": "cpu no-op (AOT entries embed host features)"}
+        _record_cache_decision()
         return None
     cache_dir = os.path.join(
         os.environ.get(
@@ -56,8 +67,27 @@ def enable_compilation_cache():
     # the default 1s threshold would skip exactly the ones that add up.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    _compile_cache_enabled = cache_dir
+    _compile_cache_decision = {
+        "enabled": True, "backend": backend, "dir": cache_dir}
+    _record_cache_decision()
     return cache_dir
+
+
+def _record_cache_decision() -> None:
+    """One registry gauge + flight event per process for the decision —
+    bench records and status --perf then carry which cold-start regime
+    the numbers were measured under."""
+    from ..utils import telemetry
+    d = _compile_cache_decision or {}
+    telemetry.set_gauge("roundtable_compile_cache_enabled",
+                        1.0 if d.get("enabled") else 0.0)
+    telemetry.recorder().record("compile_cache_decision", **d)
+
+
+def get_compile_cache_decision() -> dict[str, Any] | None:
+    """The memoized enable_compilation_cache outcome (None before the
+    first call) — embedded in engine.describe()."""
+    return _compile_cache_decision
 
 
 def _cache_key(config: dict[str, Any]) -> str:

@@ -114,6 +114,11 @@ class InferenceEngine:
         # later process deserializes (SURVEY.md §7.3 hard part 5).
         from . import enable_compilation_cache
         enable_compilation_cache()
+        # Compile observatory (ISSUE 6): every compile this process does
+        # from here on is recorded (label, duration, cache hit/miss)
+        # and checked against the steady-state recompile sentinel.
+        from . import compile_watch
+        compile_watch.install()
         # devices: indices into jax.devices() — the fleet planner assigns
         # disjoint per-model submeshes this way (engine/fleet.py)
         device_list = None
@@ -618,6 +623,12 @@ class InferenceEngine:
 
             self._scatter_kv_paged = scatter_kv_paged
 
+        # Per-engine roofline model (ISSUE 6): streamed bytes from the
+        # ACTUAL (quantized) tree + chip ceilings, published at event
+        # rate by generate/scheduler seams and embedded in describe().
+        from ..utils import perfmodel
+        self.perf = perfmodel.EnginePerf.from_engine(self)
+
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
                       mesh) -> ModelConfig:
@@ -714,6 +725,13 @@ class InferenceEngine:
         steady-state serving dispatch ~1ms. Returns seconds spent.
         """
         t0 = time.monotonic()
+        # Warming is ALWAYS a sanctioned compile phase: reopen this
+        # label first, so a second same-model engine's warmup (the
+        # sentinel label is the model name — warmup_cmd loops engines
+        # in one process) or a deliberate re-warm never counts its own
+        # compiles as steady-state violations.
+        from . import compile_watch
+        compile_watch.reopen_warmup(self.cfg.name)
         if self.paged_direct and self._paged_replicas > 1:
             # Replica-grouped padding makes the device batch shape
             # R * max(group) — a function of batch COMPOSITION, not just
@@ -806,6 +824,12 @@ class InferenceEngine:
                 self._release_warm_slots()
                 self.generate_batch(turns, max_new_tokens=1)
         self._release_warm_slots()
+        # Warmup IS this engine's steady-state declaration (ISSUE 6):
+        # from here on, any compile is a recorded mid-serve recompile —
+        # counted + flight-dumped always, fatal under
+        # ROUNDTABLE_RECOMPILE_STRICT=1.
+        from . import compile_watch
+        compile_watch.warmup_complete(self.cfg.name)
         return time.monotonic() - t0
 
     def _release_warm_slots(self) -> None:
@@ -932,9 +956,12 @@ class InferenceEngine:
         positions = np.broadcast_to(np.arange(tpad, dtype=np.int32),
                                     (b, tpad))
         lengths = np.asarray([len(t) for t in token_lists], np.int32)
-        logits, caches = self._ring_prefill_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(lengths))
+        from . import compile_watch
+        with compile_watch.label(f"ring_prefill[b={b},t={tpad}]",
+                                 engine=self.cfg.name):
+            logits, caches = self._ring_prefill_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(lengths))
         if self.kv_layout == "paged":
             self.kv.pools = self._scatter_kv_paged(
                 self.kv.pools, jnp.asarray(tables), caches)
@@ -969,33 +996,42 @@ class InferenceEngine:
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                 jnp.asarray(lengths))
 
+        from . import compile_watch
+
         def dispatch(chunk, offs, lengths):
-            if tables is not None:
-                try:
-                    last, pools = paged_prefill(chunk, offs, lengths)
-                except Exception as e:
-                    # Kernel-path failure on a pool-direct engine:
-                    # degrade to the gather-view programs and re-dispatch
-                    # this chunk (inputs are host arrays, pools were not
-                    # consumed by a failed compile). Anything else goes
-                    # to the retry policy / the adapter ladder.
-                    if not (faults.is_kernel_failure(e)
-                            and self._degrade_paged_direct(str(e))):
-                        raise
-                    last, pools = paged_prefill(chunk, offs, lengths)
-                # A watchdog-abandoned dispatch completing late must NOT
-                # commit onto pools the recovery path may have revived
-                # (the guard holds the ticket lock across the commit).
-                with deadlines.commit_guard():
-                    self.kv.pools = pools
-            else:
-                last, layers = self._prefill_step(
-                    self.params, self.kv.layers, slot_idx,
-                    jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                    jnp.asarray(lengths))
-                with deadlines.commit_guard():
-                    self.kv.layers = layers
-            return last
+            # Compile-attribution window (ISSUE 6): a compile fired by
+            # this chunk's program records under its (batch, bucket).
+            with compile_watch.label(
+                    f"prefill[b={chunk.shape[0]},bucket={chunk.shape[1]}]",
+                    engine=self.cfg.name):
+                if tables is not None:
+                    try:
+                        last, pools = paged_prefill(chunk, offs, lengths)
+                    except Exception as e:
+                        # Kernel-path failure on a pool-direct engine:
+                        # degrade to the gather-view programs and
+                        # re-dispatch this chunk (inputs are host arrays,
+                        # pools were not consumed by a failed compile).
+                        # Anything else goes to the retry policy / the
+                        # adapter ladder.
+                        if not (faults.is_kernel_failure(e)
+                                and self._degrade_paged_direct(str(e))):
+                            raise
+                        last, pools = paged_prefill(chunk, offs, lengths)
+                    # A watchdog-abandoned dispatch completing late must
+                    # NOT commit onto pools the recovery path may have
+                    # revived (the guard holds the ticket lock across
+                    # the commit).
+                    with deadlines.commit_guard():
+                        self.kv.pools = pools
+                else:
+                    last, layers = self._prefill_step(
+                        self.params, self.kv.layers, slot_idx,
+                        jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
+                        jnp.asarray(lengths))
+                    with deadlines.commit_guard():
+                        self.kv.layers = layers
+                return last
 
         return chunked_prefill(dispatch, token_lists, offsets,
                                self.kv.max_seq_len, self.tokenizer.pad_id,
@@ -1232,13 +1268,16 @@ class InferenceEngine:
                 budget, temps, top_ks, top_ps, row_budgets, done0,
                 max_new=max_new, greedy=greedy)
 
-        try:
-            out, steps, l2, v2, d2, pools = run()
-        except Exception as e:
-            if not (faults.is_kernel_failure(e)
-                    and self._degrade_paged_direct(str(e))):
-                raise
-            out, steps, l2, v2, d2, pools = run()
+        from . import compile_watch
+        with compile_watch.label(
+                f"decode[b={last.shape[0]},paged]", engine=self.cfg.name):
+            try:
+                out, steps, l2, v2, d2, pools = run()
+            except Exception as e:
+                if not (faults.is_kernel_failure(e)
+                        and self._degrade_paged_direct(str(e))):
+                    raise
+                out, steps, l2, v2, d2, pools = run()
         # A watchdog-abandoned dispatch completing late must NOT commit
         # onto pools the recovery path may have revived.
         with deadlines.commit_guard():
@@ -1249,10 +1288,13 @@ class InferenceEngine:
                                temps, top_ks, top_ps, row_budgets, done0,
                                *, greedy, max_new=DECODE_SEGMENT):
         """Contiguous-layout counterpart of _decode_dispatch_paged."""
-        out, steps, l2, v2, d2, layers = self._decode_loop(
-            self.params, self.kv.layers, slot_idx, last, valid, key,
-            budget, temps, top_ks, top_ps, row_budgets, done0,
-            max_new=max_new, greedy=greedy)
+        from . import compile_watch
+        with compile_watch.label(f"decode[b={last.shape[0]}]",
+                                 engine=self.cfg.name):
+            out, steps, l2, v2, d2, layers = self._decode_loop(
+                self.params, self.kv.layers, slot_idx, last, valid, key,
+                budget, temps, top_ks, top_ps, row_budgets, done0,
+                max_new=max_new, greedy=greedy)
         with deadlines.commit_guard():
             self.kv.layers = layers
         return out, steps, l2, v2, d2
@@ -1428,8 +1470,12 @@ class InferenceEngine:
         # engine-stats store metrics.json/bench already read stays the
         # return value; the registry is the shared spine.
         from . import trace_hooks
-        trace_hooks.publish_gen_stats(stats, self.cfg.name)
+        trace_hooks.publish_gen_stats(stats, self.cfg.name,
+                                      perf=self.perf)
         trace_hooks.publish_int4_paths(stats.int4_paths, self.cfg.name)
+        # Memory ledger at the call boundary (ISSUE 6): slot/page
+        # occupancy, fragmentation, HBM — event-rate host math only.
+        trace_hooks.publish_memory_ledger(self)
         self.last_stats = stats
         return results, stats
 
@@ -1468,4 +1514,10 @@ class InferenceEngine:
         from . import trace_hooks
         info["telemetry"] = trace_hooks.engine_telemetry_view(
             self.cfg.name)
+        # ISSUE 6: live perf attribution — roofline ceilings, the
+        # compile-cache decision, and the compile observatory's state.
+        from . import compile_watch, get_compile_cache_decision
+        info["perf"] = self.perf.describe()
+        info["compile_cache"] = get_compile_cache_decision()
+        info["compile_observatory"] = compile_watch.summary()
         return info

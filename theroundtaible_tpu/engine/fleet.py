@@ -288,7 +288,19 @@ def fleet_health() -> dict[str, Any]:
         # counters, flight-recorder state — so fleet_health is a window
         # onto the SAME registry bench records and status render.
         "telemetry": telemetry.registry_view(),
+        # ISSUE 6: compile-observatory roll-up — is the fleet in steady
+        # state, and has anything recompiled mid-serve since?
+        "perf": _perf_rollup(),
     }
+
+
+def _perf_rollup() -> dict[str, Any]:
+    from .compile_watch import summary
+    s = summary()
+    return {"compile_mode": s["mode"], "compiles": s["compiles"],
+            "steady_state": s["steady_state"],
+            "steady_state_compiles": s["steady_state_compiles"],
+            "strict": s["strict"]}
 
 
 def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:

@@ -149,6 +149,23 @@ class SlotBook:
     def slot_names(self) -> list[str]:
         return list(self._slots)
 
+    def memory_ledger(self) -> dict:
+        """Slot-occupancy accounting for the memory ledger (ISSUE 6):
+        the host-side view trace_hooks.publish_memory_ledger turns
+        into registry gauges. Contiguous layouts pay HBM per SLOT
+        regardless of use, so `cached_tokens` vs capacity is the
+        interesting waste number here."""
+        in_use = len(self._slots)
+        return {
+            "layout": "contiguous",
+            "slots_in_use": in_use,
+            "num_slots": self.num_slots,
+            "slot_occupancy": round(in_use / max(self.num_slots, 1), 3),
+            "cached_tokens": sum(len(s.tokens)
+                                 for s in self._slots.values()),
+            "hbm_bytes": None,  # SlotBook owns no buffers (PP stages do)
+        }
+
     # --- prefix reuse ---
 
     @staticmethod
@@ -293,3 +310,9 @@ class KVCache(SlotBook):
                        for _ in range(self.cfg.num_layers)]
         self.forget_all()
         return True
+
+    def memory_ledger(self) -> dict:
+        led = super().memory_ledger()
+        k, _ = self.layers[0]
+        led["hbm_bytes"] = 2 * k.size * k.dtype.itemsize * len(self.layers)
+        return led

@@ -192,6 +192,36 @@ class PagedKVCache:
     def slot_names(self) -> list[str]:
         return list(self._slots)
 
+    def memory_ledger(self) -> dict:
+        """Paged-pool accounting for the memory ledger (ISSUE 6):
+        pages in use / usable, slot occupancy, and internal
+        FRAGMENTATION — the fraction of held page cells not backing a
+        cached token (decode reserve + tail waste inside each slot's
+        last pages). `pages_in_use` counts pool allocation (aliased
+        shared pages once); `fragmentation` is computed over the
+        per-slot mappings, so COW sharing shows up as utilization > 1
+        being impossible while alias savings still lower pages_in_use."""
+        in_use = self.pages_in_use()
+        usable = self.usable_pages()
+        cached_tokens = sum(len(s.tokens) for s in self._slots.values())
+        held_cells = sum(len(s.pages) for s in
+                         self._slots.values()) * self.page_size
+        frag = (round(1.0 - min(cached_tokens / held_cells, 1.0), 3)
+                if held_cells else 0.0)
+        n_slots = len(self._slots)
+        return {
+            "layout": "paged",
+            "slots_in_use": n_slots,
+            "num_slots": self.num_slots,
+            "slot_occupancy": round(n_slots / max(self.num_slots, 1), 3),
+            "cached_tokens": cached_tokens,
+            "pages_in_use": in_use,
+            "usable_pages": usable,
+            "page_utilization": round(in_use / max(usable, 1), 3),
+            "fragmentation": frag,
+            "hbm_bytes": self.hbm_bytes(),
+        }
+
     def revive_if_dead(self) -> bool:
         """Reallocate the page pools if a failed donated dispatch deleted
         them (KVCache.revive_if_dead's paged counterpart). Every slot,

@@ -262,6 +262,13 @@ class SessionScheduler:
         # LOCKSTEP (_bump), so describe() and the registry can never
         # disagree — the single-source-of-truth migration.
         self._tname = getattr(engine.cfg, "name", "engine")
+        # Attaching a scheduler ADDS compile surface (pipelined-segment
+        # carries, pinned-row joins) to an engine whose warmup() may
+        # already have declared steady state — reopen the warmup phase
+        # so the scheduler's warm traffic compiles freely; the caller
+        # re-declares via declare_warmup_complete() once covered.
+        from . import compile_watch
+        compile_watch.reopen_warmup(self._tname)
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"session-scheduler-{getattr(engine.cfg, 'name', '?')}")
@@ -475,6 +482,16 @@ class SessionScheduler:
             "sessions": sessions,
             "closed": self.closed,
         }
+
+    def declare_warmup_complete(self) -> None:
+        """Declare this scheduler's compile set closed (ISSUE 6): the
+        caller has warmed every bucket composition it intends to serve
+        (engine.warmup(batch_sizes=...) + representative traffic), so
+        any later compile is a mid-serve recompile — counted and
+        flight-dumped always, fatal under ROUNDTABLE_RECOMPILE_STRICT=1
+        (the pow2-bucket invariant, enforced instead of assumed)."""
+        from . import compile_watch
+        compile_watch.warmup_complete(self._tname)
 
     # ------------------------------------------------------------------
     # drain / lifecycle
@@ -804,12 +821,19 @@ class SessionScheduler:
                 # recorder ring rather than any one session's JSONL).
                 with telemetry.span("segment", engine=self._tname,
                                     rows=len(alive), scheduled=True):
-                    self._read_segment(ctx, handles)
+                    steps = self._read_segment(ctx, handles)
             except Exception as e:  # noqa: BLE001 — preempt-isolate
                 self._handle_segment_failure(alive, e)
                 return
             now = time.monotonic()
             self._attribute_wall(counts, now - t_prev)
+            # Live roofline sample at the segment boundary (ISSUE 6):
+            # this segment's aggregate decode rate vs the engine's
+            # weight-streaming ceiling, as a bw_utilization gauge.
+            perf = getattr(self.engine, "perf", None)
+            if perf is not None:
+                perf.publish_decode_sample(steps * len(alive),
+                                           now - t_prev)
             t_prev = now
             if spec_err is not None:
                 still = [r for r in alive
@@ -875,11 +899,18 @@ class SessionScheduler:
         telemetry.set_gauge("roundtable_sched_occupancy", occ,
                             engine=self._tname)
         _note_rows(occ)
+        perf = getattr(self.engine, "perf", None)
         for req, _n in counts.values():
             req.seg_count += 1
             req.occ_sum += occ
             req.occ_max = max(req.occ_max, occ)
             req.sess_max = max(req.sess_max, sessions)
+            if perf is not None:
+                # Per-session KV-footprint series (the memory ledger's
+                # session dimension): cached tokens across the
+                # session's live rows, priced at KV bytes/token.
+                perf.publish_session_kv(
+                    req.session, sum(r.valid for r in req.rows))
         return counts
 
     def _attribute_wall(self, counts: dict, wall: float) -> None:
@@ -1049,10 +1080,11 @@ class SessionScheduler:
         nxt["budgets_max"] = ctx["budgets_max"] - DECODE_SEGMENT
         return nxt
 
-    def _read_segment(self, ctx: dict, handles) -> None:
+    def _read_segment(self, ctx: dict, handles) -> int:
         """Host-read one segment's results (through the watchdog seam —
         this is where a wedged program freezes the host) and fold them
-        into the rows' host state."""
+        into the rows' host state. Returns the steps the segment
+        actually took (the roofline sample's token count)."""
         out, steps, l2, v2, d2 = handles
         plan = ctx["plan"]
 
@@ -1075,6 +1107,7 @@ class SessionScheduler:
             r.last = int(last_np[i])
             r.valid = int(valid_np[i])
             r.done = bool(done_np[i]) or len(r.produced) >= r.max_new
+        return n
 
     # --- failure containment ---
 
@@ -1130,6 +1163,9 @@ class SessionScheduler:
         self._drop_request(req)
         req.error = err
         self._bump("failed")
+        perf = getattr(self.engine, "perf", None)
+        if perf is not None:
+            perf.publish_session_kv(req.session, 0)
         if req.tele is not None:
             req.tele.end(status=f"error:{type(err).__name__}")
             req.tele = None
@@ -1183,7 +1219,14 @@ class SessionScheduler:
                 req.tele.set_attr("occupancy_max", req.occ_max)
                 req.tele.end()
                 req.tele = None
-            trace_hooks.publish_gen_stats(req.stats, self._tname)
+            trace_hooks.publish_gen_stats(
+                req.stats, self._tname,
+                perf=getattr(engine, "perf", None))
+            perf = getattr(engine, "perf", None)
+            if perf is not None:
+                # Retired session's KV series reads empty, not stale.
+                perf.publish_session_kv(req.session, 0)
+            trace_hooks.publish_memory_ledger(engine)
             self._event("retire", session=req.session,
                         decode_tokens=req.stats.decode_tokens,
                         occupancy_max=req.occ_max)

@@ -62,11 +62,17 @@ def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf"),
 
     def attempt_traced():
         # "dispatch" is the span tree's leaf rung (ISSUE 5), mirroring
-        # the budget rung the watchdog times this wait against.
-        if telemetry.ACTIVE:
-            with telemetry.span("dispatch", stage=rung):
-                return attempt()
-        return attempt()
+        # the budget rung the watchdog times this wait against. The
+        # compile-attribution window (ISSUE 6) is a FALLBACK: engines
+        # that already opened a precise (batch, bucket) label keep it;
+        # callers that didn't (PP stage dispatches) still get a
+        # rung-level label instead of "unlabeled".
+        from . import compile_watch
+        with compile_watch.label(f"dispatch[{rung}]", fallback=True):
+            if telemetry.ACTIVE:
+                with telemetry.span("dispatch", stage=rung):
+                    return attempt()
+            return attempt()
 
     if retry is None:
         return attempt_traced()

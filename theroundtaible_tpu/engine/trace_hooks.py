@@ -19,11 +19,16 @@ from ..utils import telemetry
 span = telemetry.span  # re-export: engine call sites read trace_hooks.span
 
 
-def publish_gen_stats(stats, engine_name: str) -> None:
+def publish_gen_stats(stats, engine_name: str, perf=None) -> None:
     """Fold one generate call's GenStats into the registry — the
-    engine-stats store metrics.json/bench records become views of."""
+    engine-stats store metrics.json/bench records become views of.
+    `perf` (utils/perfmodel.EnginePerf) additionally publishes the
+    call's roofline gauges: decode bw_utilization and prefill MFU per
+    engine per phase (ISSUE 6)."""
     if stats is None:
         return
+    if perf is not None:
+        perf.publish_call(stats)
     reg = telemetry.REGISTRY
     if stats.prefill_tokens:
         reg.inc("roundtable_prefill_tokens_total", stats.prefill_tokens,
@@ -72,6 +77,74 @@ def publish_int4_paths(report: Optional[dict],
         # re-publishes per call — a counter would multiply-count.
         reg.set_gauge("roundtable_int4_fallbacks", 1.0,
                       engine=engine_name, reason=reason[:60])
+
+
+def publish_memory_ledger(engine) -> dict[str, Any]:
+    """The memory ledger (ISSUE 6): fold one engine's KV-cache
+    accounting and device HBM state into registry gauges, returning
+    the ledger dict for describe()/tests.
+
+    HBM comes from `device.memory_stats()` where the backend reports
+    it; backends that don't (the axon plugin, CPU) fall back to
+    `fleet.estimate_engine_hbm_bytes` under a gauge name that says so
+    (`_estimated`) — an estimate must never impersonate a measurement.
+    Event-rate cheap: host dict math over slot bookkeeping only."""
+    reg = telemetry.REGISTRY
+    name = engine.cfg.name
+    ledger: dict[str, Any] = {}
+    led_fn = getattr(engine.kv, "memory_ledger", None)
+    if led_fn is not None:
+        ledger = led_fn()
+        reg.set_gauge("roundtable_kv_slots_in_use",
+                      ledger["slots_in_use"], engine=name)
+        reg.set_gauge("roundtable_kv_slot_occupancy",
+                      ledger["slot_occupancy"], engine=name)
+        reg.set_gauge("roundtable_kv_cached_tokens",
+                      ledger["cached_tokens"], engine=name)
+        if ledger.get("layout") == "paged":
+            reg.set_gauge("roundtable_kv_pages_in_use",
+                          ledger["pages_in_use"], engine=name)
+            reg.set_gauge("roundtable_kv_pages_total",
+                          ledger["usable_pages"], engine=name)
+            reg.set_gauge("roundtable_kv_page_utilization",
+                          ledger["page_utilization"], engine=name)
+            reg.set_gauge("roundtable_kv_fragmentation",
+                          ledger["fragmentation"], engine=name)
+        if ledger.get("hbm_bytes") is not None:
+            reg.set_gauge("roundtable_kv_hbm_bytes",
+                          ledger["hbm_bytes"], engine=name)
+    stats = None
+    try:
+        stats = engine.mesh.devices.flatten()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — unsupported backends return/raise
+        stats = None
+    if stats and stats.get("bytes_in_use") is not None:
+        reg.set_gauge("roundtable_hbm_bytes_in_use",
+                      stats["bytes_in_use"], engine=name)
+        if stats.get("bytes_limit"):
+            reg.set_gauge("roundtable_hbm_bytes_limit",
+                          stats["bytes_limit"], engine=name)
+        ledger["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+    else:
+        try:
+            from .fleet import estimate_engine_hbm_bytes
+            cfg_dict: dict[str, Any] = {
+                "max_seq_len": engine.max_seq_len,
+                "num_slots": engine.kv.num_slots,
+                "kv_layout": getattr(engine, "kv_layout", "contiguous"),
+            }
+            if getattr(engine, "quant", "none") != "none":
+                cfg_dict["quant"] = engine.quant
+            est = estimate_engine_hbm_bytes(cfg_dict,
+                                            model_cfg=engine.cfg)
+            reg.set_gauge("roundtable_hbm_bytes_estimated", est,
+                          engine=name)
+            ledger["hbm_bytes_estimated"] = est
+        except Exception:  # noqa: BLE001 — the ledger is best-effort
+            pass
+    from ..utils import perfmodel
+    perfmodel.note_published(1)
+    return ledger
 
 
 def _engine_labeled(key: str, engine_name: str) -> bool:

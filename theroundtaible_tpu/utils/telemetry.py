@@ -133,6 +133,16 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[key] = float(value)
 
+    def remove_gauge(self, name: str, **labels) -> None:
+        """Drop one labeled gauge series. For per-entity series whose
+        entities RETIRE (per-session KV footprints): a long-lived
+        serving process must not accumulate one dead series per
+        session ever served — zeroing would keep the label set (and
+        the metrics.prom export) growing without bound."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges.pop(key, None)
+
     def observe(self, name: str, value: float, **labels) -> None:
         key = (name, _label_key(labels))
         with self._lock:
@@ -326,6 +336,15 @@ class FlightRecorder:
         }
         if extra:
             payload["extra"] = extra
+        try:
+            # Perf-attribution block (ISSUE 6): roofline/memory series,
+            # span overheads, compile-observatory summary. Lazy import —
+            # telemetry stays importable standalone, and an attribution
+            # failure must never cost the postmortem its write.
+            from . import perfmodel
+            payload["perf"] = perfmodel.attribution_snapshot()
+        except Exception:  # noqa: BLE001 — the dump itself comes first
+            pass
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -678,6 +697,9 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "schedulers": "roundtable_sched_* series, engine-labeled",
         "queued_sessions": "roundtable_sched_queue_depth gauge sum",
         "telemetry": "registry snapshot view (this module)",
+        "perf": "roundtable_compiles_total / "
+                "roundtable_steady_state_compiles_total series "
+                "(engine/compile_watch summary roll-up)",
     },
     "scheduler_describe": {
         "admitted": "roundtable_sched_admitted_total",
