@@ -25,6 +25,14 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            batch-occupancy %, p50/p95 turn latency, and the scheduler's
            decision provenance embedded like int4_paths.
            ROUNDTABLE_BENCH_LOAD_KS=1,2,4 overrides the sweep.)
+       ROUNDTABLE_BENCH_PREFIX_REUSE=1 .. (prefix-reuse sweep, ISSUE 7:
+           the offered-load run twice on a PAGED engine — cross-session
+           prefix cache ON then OFF — emitting one JSON line per mode
+           with the reused-token fraction, prefill tok/s EFFECTIVE
+           (total prompt tokens / prefill wall — what the user feels)
+           vs COMPUTED (actually-prefilled tokens / wall — what the
+           chip did), the memory ledger's shared-page split, and the
+           estimated max resident sessions before refusal.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -243,6 +251,203 @@ def offered_load_child() -> int:
     return 0
 
 
+def prefix_reuse_child() -> int:
+    """Prefix-reuse sweep (ISSUE 7 satellite): the K-session scripted
+    discussion load served twice on ONE paged-engine config — with the
+    cross-session prefix cache on, then off — so the run record carries
+    the reuse the radix tree actually delivered, not a projection.
+    Recorded per mode: reused-token fraction, effective vs computed
+    prefill tok/s, shared/exclusive page split, and the estimated max
+    resident sessions before admission refusal (pool pages / per-session
+    exclusive footprint — the capacity multiplier the tentpole claims)."""
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import statistics
+    import tempfile
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.adapters.tpu_llm import TpuLlmAdapter
+    from theroundtaible_tpu.core.orchestrator import run_discussion
+    from theroundtaible_tpu.core.types import (ConsensusBlock, KnightConfig,
+                                               RoundtableConfig, RulesConfig)
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = "tiny-gemma" if on_cpu else "gemma-2b-it"
+    max_seq = 1024 if on_cpu else 2048
+    max_new = 32 if on_cpu else 96
+    rounds = 2
+    num_slots = 12
+    k = int(os.environ.get("ROUNDTABLE_BENCH_REUSE_K", "3"))
+    # Arrival stagger between sessions: simultaneous (lockstep) arrivals
+    # would admit every session before any peer COMMITS, so the index
+    # would have nothing to serve — production arrivals are a process in
+    # time, and the stagger is what lets session i+1 match the pages
+    # session i just committed.
+    stagger_s = float(os.environ.get(
+        "ROUNDTABLE_BENCH_REUSE_STAGGER_S", "2.0" if on_cpu else "5.0"))
+
+    class Scripted(TpuLlmAdapter):
+        def parse_consensus(self, response, round_num):
+            score = 9.5 if round_num >= rounds else 6.0
+            return ConsensusBlock(
+                knight=self.name, round=round_num, consensus_score=score,
+                agrees_with=[], pending_issues=[], proposal="bench",
+                files_to_modify=["bench.md"] if score >= 9 else [])
+
+    def make_config():
+        return RoundtableConfig(
+            version="1.0", project="bench", language="en",
+            knights=[KnightConfig(name=f"Knight-{c}", adapter="tpu-llm",
+                                  capabilities=[], priority=i + 1)
+                     for i, c in enumerate("ABC")],
+            rules=RulesConfig(max_rounds=rounds, consensus_threshold=9,
+                              timeout_per_turn_seconds=300,
+                              escalate_to_user_after=4, auto_execute=False,
+                              parallel_rounds=True),
+            chronicle="chronicle.md", adapter_config={"tpu-llm": {}})
+
+    for cache_on in (True, False):
+        # Drop the previous mode's memoized engine BEFORE building this
+        # one: the get_engine cache would otherwise pin BOTH full
+        # engines (weights + paged pool) resident through the cache-off
+        # half — ~2x HBM on a real chip, OOM risk during exactly the
+        # run meant to be the fair comparison.
+        from theroundtaible_tpu.engine import reset_engines
+        reset_engines()
+        engine_cfg = {"model": model, "max_seq_len": max_seq,
+                      "num_slots": num_slots, "kv_layout": "paged",
+                      "prefix_cache": cache_on, "kv_offload": cache_on,
+                      "sampling": {"temperature": 0.0,
+                                   "max_new_tokens": max_new}}
+        base = Scripted("tpu-llm", engine_cfg)
+        engine = base._get_engine()
+        t_warm = time.monotonic()
+        engine.warmup(max_prompt_tokens=max_seq - 256, batch_sizes=(1, 3))
+        warmup_s = time.monotonic() - t_warm
+        sched = SessionScheduler(engine, admit_hold_s=0.25)
+        config = make_config()
+        entries, session_errors = [], []
+        with tempfile.TemporaryDirectory() as root:
+            # One root PER SESSION: every discussion runs the IDENTICAL
+            # topic (that is the whole point — the radix tree can only
+            # match identical token prefixes, and serve fans one topic
+            # into K sessions exactly like this), so the session-dir
+            # slug dedup must come from the root, not a topic prefix
+            # that would destroy the shared head.
+            def run_one(i, root=root, config=config, sched=sched,
+                        cache_on=cache_on):
+                try:
+                    sroot = os.path.join(root, f"s{i}")
+                    os.makedirs(os.path.join(sroot, ".roundtable",
+                                             "sessions"))
+                    adapter = Scripted("tpu-llm", engine_cfg)
+                    adapter.attach_scheduler(
+                        sched, session=f"pr{int(cache_on)}s{i}")
+                    t0 = time.monotonic()
+                    result = run_discussion(TOPIC, config,
+                                            {"tpu-llm": adapter}, sroot,
+                                            read_source_code=False)
+                    entries.append((result, time.monotonic() - t0))
+                except Exception as e:  # noqa: BLE001 — reported below
+                    session_errors.append((i, e))
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=run_one, args=(i,))
+                       for i in range(k)]
+            for i, th in enumerate(threads):
+                if i and stagger_s:
+                    time.sleep(stagger_s)
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.monotonic() - t0
+
+            prefill_tokens = reused = prefix_reused = 0
+            prefill_seconds = 0.0
+            for result, _w in entries:
+                metrics = json.loads(open(os.path.join(
+                    result.session_path, "metrics.json")).read())
+                for r in metrics["rounds"]:
+                    for t in r["turns"]:
+                        eng_stats = t.get("engine") or {}
+                        prefill_tokens += eng_stats.get(
+                            "prefill_tokens", 0)
+                        reused += eng_stats.get("reused_tokens", 0)
+                        prefix_reused += eng_stats.get(
+                            "prefix_reused_tokens", 0)
+                        prefill_seconds += eng_stats.get(
+                            "prefill_seconds", 0.0)
+        provenance = sched.describe()
+        sched.close()
+        if session_errors:
+            raise RuntimeError(
+                f"prefix-reuse cache_on={cache_on}: "
+                f"{len(session_errors)}/{k} session(s) failed: "
+                + "; ".join(f"s{i}: {e}" for i, e in session_errors))
+        assert len(entries) == k
+        led = engine.kv.memory_ledger()
+        total_prompt = prefill_tokens + reused
+        # Max resident sessions before refusal: the pool's usable pages
+        # over the mean EXCLUSIVE per-session footprint — sharing makes
+        # the denominator shrink, which IS the capacity multiplier.
+        excl_per_session = max(
+            (led["exclusive_pages"]) / max(k, 1), 1e-9)
+        max_resident_est = int(led["usable_pages"] // excl_per_session)
+        result_line = {
+            "metric": (f"prefix_reuse_discuss[{model}]"
+                       f"[cache={'on' if cache_on else 'off'}]"),
+            "value": round(reused / max(total_prompt, 1), 4),
+            "unit": "reused_token_fraction",
+            "detail": {
+                "sessions": k,
+                "rounds_per_session": rounds,
+                "wall_s": round(wall, 2),
+                "prompt_tokens_total": total_prompt,
+                "prefill_tokens_computed": prefill_tokens,
+                "reused_tokens": reused,
+                "prefix_cache_reused_tokens": prefix_reused,
+                "prefill_tok_s_effective": round(
+                    total_prompt / max(prefill_seconds, 1e-9), 1),
+                "prefill_tok_s_computed": round(
+                    prefill_tokens / max(prefill_seconds, 1e-9), 1),
+                "max_resident_sessions_est": max_resident_est,
+                "memory_ledger": {kk: led[kk] for kk in (
+                    "pages_in_use", "usable_pages", "shared_pages",
+                    "exclusive_pages", "prefix_cache_pages")},
+                "prefix_cache": (engine.prefix_cache.describe()
+                                 if engine.prefix_cache is not None
+                                 else None),
+                "kv_offload": (engine.kv_offload.describe()
+                               if engine.kv_offload is not None
+                               else None),
+                "warmup_s": round(warmup_s, 1),
+                "platform": jax.devices()[0].platform,
+                "scheduler": {kk: vv for kk, vv in provenance.items()
+                              if kk != "events"},
+                "telemetry": _registry_snapshot(),
+                "perf": _perf_block(),
+            },
+        }
+        print(json.dumps(result_line), flush=True)
+        # Drop every strong reference to this mode's engine before the
+        # next iteration's reset_engines(): loop locals outliving the
+        # memo would keep both full engines resident — exactly the
+        # 2x-HBM risk the reset exists to prevent.
+        base = engine = sched = led = None  # noqa: F841
+    return 0
+
+
 def child() -> int:
     from bench_common import install_sigterm_exit
 
@@ -429,16 +634,19 @@ def child() -> int:
 
 def main() -> int:
     from bench_common import run_watchdogged
-    # The offered-load sweep runs up to 1+2+4+8 scripted discussions in
-    # one child — give it a wider attempt window than the single run.
+    # The offered-load / prefix-reuse sweeps run many scripted
+    # discussions in one child — wider attempt window than the single run.
     attempt_s = (2 * ATTEMPT_TIMEOUT_S
                  if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD")
+                 or os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE")
                  else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
                            attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE"):
+        return prefix_reuse_child()
     if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD"):
         return offered_load_child()
     return child()

@@ -201,6 +201,31 @@ def _perf_obs_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _prefix_cache_guard(request):
+    """Tier-1 guard for @pytest.mark.prefix_cache (ISSUE 7 satellite):
+    a test that CLAIMS cross-session prefix-cache coverage must not
+    silently run cache-off serving — if no attach() hit was recorded
+    during the test, every row prefilled from scratch and the test's
+    reuse claims are vacuous; fail LOUD. Eviction/miss/offload unit
+    tests (which legitimately serve cold) mark allow_cold=True."""
+    marker = request.node.get_closest_marker("prefix_cache")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import prefix_cache as pc
+
+    pc.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_cold"):
+        return
+    assert pc.hits_seen() > 0, (
+        "prefix_cache-marked test recorded ZERO cache attach hits: the "
+        "cross-session prefix cache silently served nothing (cache-off "
+        "fallback?) — mark allow_cold=True only for eviction/miss/"
+        "offload units")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

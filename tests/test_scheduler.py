@@ -186,6 +186,41 @@ class TestSessionNamespace:
         assert scoped_slot("sB", "lancelot") in names
         assert "lancelot" not in names
 
+    def test_failed_session_release_never_frees_shared_pages(self):
+        """ISSUE 7 isolation satellite: _fail_request's per-row release
+        (and any preemption cleanup) UNREFS — a page the sick session
+        shared through the cross-session prefix cache must survive for
+        the session still referencing it, bit-for-bit addressable."""
+        from theroundtaible_tpu.engine.paging import PagedKVCache
+        from theroundtaible_tpu.engine.prefix_cache import PrefixCache
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        kv = PagedKVCache(cfg, num_slots=4, max_seq_len=256,
+                          page_size=64, copy_pages_fn=lambda p, s, d: p)
+        kv.prefix_cache = PrefixCache(kv, engine="iso")
+        shared = list(range(128))          # 2 complete pages
+        a = scoped_slot("sessA", "lancelot")
+        b = scoped_slot("sessB", "lancelot")
+        kv.acquire(a)
+        kv.ensure_capacity(a, 192, write_from=0)
+        kv.commit(a, shared)               # indexed cross-session
+        kv.acquire(b)
+        got = kv.prefix_cache.attach(b, shared + [500])
+        assert got == 128
+        shared_pages = list(kv._slots[b].pages)
+        assert shared_pages == kv._slots[a].pages[:2]
+        # session A faults: the scheduler releases its rows' slots
+        kv.release(a)
+        # B's mapping is intact and the pages are still allocated
+        assert kv._slots[b].pages == shared_pages
+        for p in shared_pages:
+            assert kv.refcount(p) >= 1
+            assert p not in kv._free_by_replica[0]
+        # and B's own release finally unrefs down to the index's hold
+        kv.release(b)
+        for p in shared_pages:
+            assert kv.refcount(p) == 1     # the index alone
+            assert p not in kv._free_by_replica[0]
+
 
 # ---------------------------------------------------------------------------
 # tentpole acceptance: concurrency, parity, occupancy, fault isolation

@@ -297,6 +297,9 @@ class TpuLlmAdapter(BaseAdapter):
             "model": engine.cfg.name,
             "prefill_tokens": stats.prefill_tokens,
             "reused_tokens": stats.reused_tokens,
+            # Of which the CROSS-SESSION prefix cache served (ISSUE 7) —
+            # 0 on contiguous / cache-off engines.
+            "prefix_reused_tokens": stats.prefix_reused_tokens,
             "decode_tokens": stats.decode_tokens,
             "prefill_seconds": round(stats.prefill_seconds, 3),
             "decode_seconds": round(stats.decode_seconds, 3),
@@ -454,6 +457,7 @@ class TpuLlmAdapter(BaseAdapter):
             total.sched = stats.sched
             total.prefill_tokens += stats.prefill_tokens
             total.reused_tokens += stats.reused_tokens
+            total.prefix_reused_tokens += stats.prefix_reused_tokens
             total.decode_tokens += stats.decode_tokens
             total.prefill_seconds += stats.prefill_seconds
             total.decode_seconds += stats.decode_seconds

@@ -86,6 +86,10 @@ def build_parser():
                     help="Render live performance attribution: roofline "
                          "table, compile observatory, memory ledger, "
                          "span-tree overhead breakdown")
+    st.add_argument("--kv", action="store_true",
+                    help="Render the KV-tier view: memory ledger with "
+                         "the cross-session sharing split, prefix-cache "
+                         "hit/miss series, host-RAM offload state")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -157,7 +161,8 @@ def dispatch(args) -> int:
         from .commands.status import status_command
         return status_command(
             telemetry_view=getattr(args, "telemetry", False),
-            perf_view=getattr(args, "perf", False))
+            perf_view=getattr(args, "perf", False),
+            kv_view=getattr(args, "kv", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

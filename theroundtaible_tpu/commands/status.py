@@ -38,13 +38,16 @@ def phase_display(status) -> tuple[str, str, object]:
 
 def status_command(project_root: Optional[str] = None,
                    telemetry_view: bool = False,
-                   perf_view: bool = False) -> int:
+                   perf_view: bool = False,
+                   kv_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
                         'Start one with "roundtable discuss".\n'))
         return 0
+    if kv_view:
+        return kv_status(session)
     if perf_view:
         return perf_status(session)
     if telemetry_view:
@@ -156,6 +159,60 @@ def telemetry_status(session) -> int:
         print(style.bold(f"\n  Flight-recorder dumps ({len(dumps)}):"))
         for p in dumps[-5:]:
             print(style.dim(f"    {p}"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --kv` (ISSUE 7) ---
+
+
+def kv_status(session) -> int:
+    """`roundtable status --kv` — the KV-tier view: the paged-pool
+    memory ledger with its cross-session sharing split (shared pages
+    counted once), the prefix cache's hit/miss/eviction series, the
+    host-RAM offload tier's spill state, and per-session KV footprints.
+    Same sourcing as --perf: the session's metrics.prom export overlaid
+    with this process's live registry."""
+    print(style.bold(f"\n  KV tiers — session {session.name}"))
+    series = _series_for_perf(session)
+
+    def section(title: str, prefixes: tuple[str, ...]) -> bool:
+        keys = sorted(k for k in series
+                      if k.split("{")[0].startswith(prefixes))
+        if not keys:
+            return False
+        print(style.bold(f"\n  {title}:"))
+        for k in keys:
+            print(style.dim(f"    {k} {series[k]:g}"))
+        return True
+
+    any_out = section("Memory ledger (HBM tier)", (
+        "roundtable_kv_slots", "roundtable_kv_slot_",
+        "roundtable_kv_cached", "roundtable_kv_pages",
+        "roundtable_kv_page_", "roundtable_kv_fragmentation",
+        "roundtable_kv_shared_pages", "roundtable_kv_exclusive_pages",
+        "roundtable_kv_hbm_bytes", "roundtable_hbm_"))
+    any_out |= section("Prefix cache (cross-session index)",
+                       ("roundtable_prefix_",))
+    any_out |= section("Host-RAM offload tier", (
+        "roundtable_kv_spill", "roundtable_kv_restores",
+        "roundtable_kv_spilled_sessions", "roundtable_kv_host_bytes"))
+
+    sess_keys = [k for k in series
+                 if k.split("{")[0] == "roundtable_session_kv_bytes"
+                 and series[k] > 0]
+    if sess_keys:
+        print(style.bold("\n  Per-session KV footprint:"))
+        for k in sorted(sess_keys):
+            lb = _labels(k)
+            print(style.dim(f"    {lb.get('session', '?'):<24}"
+                            f"{series[k] / 1e6:10.2f} MB"))
+        any_out = True
+    if not any_out:
+        print(style.dim(
+            "\n  No KV series captured. Serve a paged engine with "
+            "ROUNDTABLE_TELEMETRY=1 (kv_layout: paged) to populate the "
+            "ledger, prefix-cache and offload series.\n"))
     print("")
     return 0
 

@@ -96,7 +96,8 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "seq_parallel", "long_scheme", "long_threshold",
                  "devices", "attn", "num_slots", "sampling", "seed",
                  "kv_layout", "page_size", "num_pages", "n_micro",
-                 "quant", "dcn_axis")}
+                 "quant", "dcn_axis", "prefix_cache",
+                 "prefix_cache_pages", "kv_offload")}
     return json.dumps(relevant, sort_keys=True)
 
 

@@ -66,6 +66,10 @@ def summarize_int4_paths(dispatches: dict) -> dict:
 class GenStats:
     prefill_tokens: int = 0
     reused_tokens: int = 0
+    # Of reused_tokens, how many the CROSS-SESSION prefix cache served
+    # (ISSUE 7) — own-slot LCP hits and intra-session donation make up
+    # the rest. 0 on contiguous / cache-off engines.
+    prefix_reused_tokens: int = 0
     decode_tokens: int = 0
     prefill_seconds: float = 0.0
     decode_seconds: float = 0.0
@@ -104,7 +108,10 @@ class InferenceEngine:
                  devices: Optional[list[int]] = None,
                  kv_layout: str = "contiguous", page_size: int = 128,
                  num_pages: Optional[int] = None, quant: str = "none",
-                 dcn_axis: Optional[str] = None):
+                 dcn_axis: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_pages: Optional[int] = None,
+                 kv_offload: Optional[bool] = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -623,6 +630,24 @@ class InferenceEngine:
 
             self._scatter_kv_paged = scatter_kv_paged
 
+        # Cross-session prefix cache + host-RAM offload tier (ISSUE 7):
+        # both are paged-pool subsystems — the contiguous layout has no
+        # page-granular sharing unit. The cache attaches to the pool
+        # (commit-inserts, alloc-reclaims ride the kv object); the tier
+        # needs the engine (mesh, compile labels), so it lives here.
+        self.prefix_cache = None
+        self.kv_offload = None
+        if kv_layout == "paged":
+            from .prefix_cache import PrefixCache, cache_enabled
+            if cache_enabled(prefix_cache):
+                self.prefix_cache = PrefixCache(
+                    self.kv, engine=model_cfg.name,
+                    max_pages=prefix_cache_pages)
+                self.kv.prefix_cache = self.prefix_cache
+            from .kv_offload import HostOffloadTier, offload_enabled
+            if offload_enabled(kv_offload):
+                self.kv_offload = HostOffloadTier(self)
+
         # Per-engine roofline model (ISSUE 6): streamed bytes from the
         # ACTUAL (quantized) tree + chip ceilings, published at event
         # rate by generate/scheduler seams and embedded in describe().
@@ -699,6 +724,11 @@ class InferenceEngine:
                        if config.get("num_pages") else None),
             quant=config.get("quant", "none"),
             dcn_axis=config.get("dcn_axis"),
+            prefix_cache=config.get("prefix_cache"),
+            prefix_cache_pages=(int(config["prefix_cache_pages"])
+                                if config.get("prefix_cache_pages")
+                                else None),
+            kv_offload=config.get("kv_offload"),
         )
         # Set by fleet.check_fleet_fits when it flips an unpinned config
         # to int8: surfaced via describe() so the degrade is visible
@@ -824,6 +854,11 @@ class InferenceEngine:
                 self._release_warm_slots()
                 self.generate_batch(turns, max_new_tokens=1)
         self._release_warm_slots()
+        # Warm the offload tier's fetch/write programs (ONE fixed shape
+        # each, ISSUE 7): a first idle-session spill/restore in steady
+        # state must compile nothing under ROUNDTABLE_RECOMPILE_STRICT.
+        if self.kv_offload is not None:
+            self.kv_offload.warm()
         # Warmup IS this engine's steady-state declaration (ISSUE 6):
         # from here on, any compile is a recorded mid-serve recompile —
         # counted + flight-dumped always, fatal under
@@ -874,7 +909,14 @@ class InferenceEngine:
         (the adapter's serial-retry rung calls this so 'batched → serial'
         recovery also holds for failures that surface AFTER donation
         consumed the cache). True iff fresh buffers were allocated."""
-        return self.kv.revive_if_dead()
+        revived = self.kv.revive_if_dead()
+        if revived and self.kv_offload is not None:
+            # Spilled records reference pages of the DEAD pools (kept
+            # shared pages) — they cannot be restored into the fresh
+            # ones. Host bytes go with them: revive semantics are "all
+            # cached content lost", tiers included.
+            self.kv_offload.drop_all()
+        return revived
 
     def _degrade_paged_direct(self, reason: str) -> bool:
         """Route paged serving off the pool-direct Pallas kernels onto
@@ -1148,6 +1190,12 @@ class InferenceEngine:
         first_np (ORIGINAL row order), prefill_tokens, reused_tokens.
         """
         pinned = tuple(name for name, _ in turns) + tuple(extra_pinned)
+        if self.kv_offload is not None:
+            # A spilled session resumes HERE, before reuse_plan acquires
+            # its slots: the restored tokens/pages make the LCP pass see
+            # the full committed prefix, so the turn prefills only its
+            # real delta — no re-prefill across the idle gap (ISSUE 7).
+            self.kv_offload.restore_for([n for n, _ in turns], pinned)
         slot_ids, offsets, all_tokens = [], [], []
         for name, prompt in turns:
             # A list of ids is accepted as a pre-tokenized prompt (warmup
@@ -1167,6 +1215,16 @@ class InferenceEngine:
             all_tokens.append(tokens)
 
         names = [name for name, _ in turns]
+        # Cross-SESSION prefix cache (ISSUE 7): the content-addressed
+        # index extends each row's reuse frontier past its own slot
+        # history by aliasing pages committed by ANY earlier session —
+        # the radix match is exact token equality, so this can never
+        # serve wrong bytes. Warmup rows are excluded: they are crafted
+        # to defeat sharing so the real prefill programs compile.
+        prefix_reused = 0
+        if self.prefix_cache is not None:
+            prefix_reused = self.prefix_cache.attach_rows(
+                names, all_tokens, offsets, pinned)
         # Cross-knight shared-prefix reuse raises offsets by copying (or,
         # paged, aliasing) other slots' K/V; only the per-knight deltas
         # remain to prefill.
@@ -1250,6 +1308,7 @@ class InferenceEngine:
             "top_ks": top_ks, "top_ps": top_ps, "greedy": greedy,
             "first_np": first_np, "prefill_tokens": prefill_tokens,
             "reused_tokens": reused_tokens,
+            "prefix_reused_tokens": prefix_reused,
         }
 
     def _decode_dispatch_paged(self, tables, last, valid, key, budget,
@@ -1401,6 +1460,7 @@ class InferenceEngine:
             _psp.set_attr("reused_tokens", prep["reused_tokens"])
         stats.prefill_tokens = prep["prefill_tokens"]
         stats.reused_tokens = prep["reused_tokens"]
+        stats.prefix_reused_tokens = prep["prefix_reused_tokens"]
         stats.prefill_seconds = time.monotonic() - t0
 
         plan = prep["plan"]
@@ -1502,6 +1562,11 @@ class InferenceEngine:
             info["kv_hbm_bytes"] = self.kv.hbm_bytes()
             info["paged_decode"] = ("pool-direct" if self.paged_direct
                                     else "gather-view")
+            # ISSUE 7: the cross-session sharing subsystems' state.
+            if self.prefix_cache is not None:
+                info["prefix_cache"] = self.prefix_cache.describe()
+            if self.kv_offload is not None:
+                info["kv_offload"] = self.kv_offload.describe()
         # Continuous-batching scheduler provenance (ISSUE 4): attached by
         # engine/scheduler.SessionScheduler — admit/queue/refuse counts,
         # queue depth, per-segment batch occupancy.

@@ -322,9 +322,14 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
        `timeout_s` — acquisition IS the proof that in-flight work
        finished — and, holding it, flush every per-knight slot through
        the cache's normal release path (SlotBook.flush: paged pools
-       decref/free their pages; contiguous slots return to the free
-       list). An engine whose in-flight turn outlives the timeout is
-       reported `in_flight_drained: False` and left unflushed.
+       decref/free their pages — including the cross-session prefix
+       cache's index, which UNREFS its held pages rather than
+       force-freeing (ISSUE 7), so a drained paged pool reads zero
+       pages in use; contiguous slots return to the free list). An
+       engine whose in-flight turn outlives the timeout is reported
+       `in_flight_drained: False` and left unflushed. Host-RAM spill
+       records (kv_offload) survive a drain — a resumed fleet restores
+       idle sessions without re-prefill.
 
     Admission stays closed after drain() returns (the caller is shutting
     down, checkpointing, or re-seating); `resume()` re-opens it. Returns
@@ -365,6 +370,15 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
                     # must not abandon the remaining engines mid-drain.
                     try:
                         entry["flushed_slots"] = eng.kv.flush()
+                        # Spilled sessions' kept-resident pages are the
+                        # only thing left between a flushed paged pool
+                        # and zero pages in use — evacuate them to host
+                        # RAM (ISSUE 7): the drain claim stays true and
+                        # the sessions still resume without re-prefill
+                        # after fleet.resume().
+                        tier = getattr(eng, "kv_offload", None)
+                        if tier is not None:
+                            entry["evacuated_pages"] = tier.evacuate()
                     except Exception as e:  # noqa: BLE001
                         entry["flush_error"] = str(e)
                         report["clean"] = False

@@ -163,12 +163,28 @@ class PagedKVCache:
             list(range(r * per_replica + 1, (r + 1) * per_replica))
             for r in range(self.data_size)]
         self._refs: dict[int, int] = {}
+        # Cross-session prefix cache (engine/prefix_cache.py, ISSUE 7):
+        # attached by the engine after construction. The allocator's only
+        # couplings are (a) commit() publishes complete pages into it,
+        # (b) _alloc_page reclaims its refcount-0 pages before declaring
+        # exhaustion, (c) flush()/revive drop it with the slots.
+        self.prefix_cache = None
 
     # --- introspection / accounting ---
 
     def pages_in_use(self) -> int:
         free = sum(len(f) for f in self._free_by_replica)
         return self.num_pages - self.data_size - free
+
+    def free_pages(self, replica: Optional[int] = None) -> int:
+        """Immediately-allocatable pages (one replica's range, or all).
+        Excludes everything reclaimable-under-pressure (idle evictable
+        slots, refcount-0 prefix-cache nodes) — the scheduler's spill
+        policy keys off this to spill idle sessions BEFORE the allocator
+        destroys their caches."""
+        if replica is not None:
+            return len(self._free_by_replica[replica])
+        return sum(len(f) for f in self._free_by_replica)
 
     def usable_pages(self) -> int:
         """Total non-scratch pages across every replica range."""
@@ -193,21 +209,52 @@ class PagedKVCache:
         return list(self._slots)
 
     def memory_ledger(self) -> dict:
-        """Paged-pool accounting for the memory ledger (ISSUE 6):
+        """Paged-pool accounting for the memory ledger (ISSUE 6/7):
         pages in use / usable, slot occupancy, and internal
         FRAGMENTATION — the fraction of held page cells not backing a
         cached token (decode reserve + tail waste inside each slot's
         last pages). `pages_in_use` counts pool allocation (aliased
-        shared pages once); `fragmentation` is computed over the
-        per-slot mappings, so COW sharing shows up as utilization > 1
-        being impossible while alias savings still lower pages_in_use."""
+        shared pages once). Fragmentation is REFCOUNT-AWARE (ISSUE 7
+        satellite): computed over DISTINCT pages with each page's
+        covered cells taken once (the max over the slots mapping it),
+        so a page shared by N sessions never counts N times and the
+        ledger's shared/exclusive split is honest across sessions."""
         in_use = self.pages_in_use()
         usable = self.usable_pages()
         cached_tokens = sum(len(s.tokens) for s in self._slots.values())
-        held_cells = sum(len(s.pages) for s in
-                         self._slots.values()) * self.page_size
-        frag = (round(1.0 - min(cached_tokens / held_cells, 1.0), 3)
-                if held_cells else 0.0)
+        ps = self.page_size
+        # page -> covered cells (max over the slots mapping it): shared
+        # pages counted ONCE.
+        covered: dict[int, int] = {}
+        map_counts: dict[int, int] = {}
+        for s in self._slots.values():
+            for j, p in enumerate(s.pages):
+                map_counts[p] = map_counts.get(p, 0) + 1
+                cov = max(0, min(len(s.tokens) - j * ps, ps))
+                if cov > covered.get(p, -1):
+                    covered[p] = cov
+        held_cells = len(covered) * ps
+        frag = (round(1.0 - min(sum(covered.values()) / held_cells, 1.0),
+                      3) if held_cells else 0.0)
+        # "Shared" means DEDUPLICATED bytes: ≥2 slot mappings, or a
+        # non-index external holder (offload tier / earlier spill). The
+        # index's own bookkeeping ref is not sharing — one session with
+        # the cache on would otherwise report every committed page as
+        # shared and inflate the capacity-multiplier estimate the bench
+        # derives from the exclusive count (review finding).
+        pc = self.prefix_cache
+
+        def _is_shared(p: int) -> bool:
+            if map_counts.get(p, 0) >= 2:
+                return True
+            extra = self._refs.get(p, 1) - map_counts.get(p, 0)
+            if pc is not None and pc.holds_page(p):
+                extra -= 1
+            return extra >= 1
+
+        shared = sum(1 for p in covered if _is_shared(p))
+        cache_pages = (self.prefix_cache.page_count()
+                       if self.prefix_cache is not None else 0)
         n_slots = len(self._slots)
         return {
             "layout": "paged",
@@ -219,6 +266,15 @@ class PagedKVCache:
             "usable_pages": usable,
             "page_utilization": round(in_use / max(usable, 1), 3),
             "fragmentation": frag,
+            # ISSUE 7: the cross-session sharing split. `shared_pages`
+            # are slot-mapped pages with >1 holder (other slots, the
+            # prefix cache, the offload tier); `prefix_cache_pages` is
+            # the index's own footprint (overlaps slot-mapped pages
+            # while both reference them — pool allocation still counts
+            # each page once via pages_in_use).
+            "shared_pages": shared,
+            "exclusive_pages": len(covered) - shared,
+            "prefix_cache_pages": cache_pages,
             "hbm_bytes": self.hbm_bytes(),
         }
 
@@ -237,6 +293,10 @@ class PagedKVCache:
         self._free_by_replica = [
             list(range(r * per + 1, (r + 1) * per))
             for r in range(self.data_size)]
+        if self.prefix_cache is not None:
+            # The indexed bytes died with the pools; drop the nodes
+            # WITHOUT unref (the refs table was just cleared).
+            self.prefix_cache.clear(unref=False)
         return True
 
     # --- slot lifecycle (KVCache-compatible surface) ---
@@ -277,11 +337,16 @@ class PagedKVCache:
     def flush(self) -> int:
         """Release every per-knight slot (graceful drain's KV flush,
         fleet.drain — SlotBook.flush's paged counterpart): each slot's
-        pages decref and free back to their replica ranges. Returns how
-        many slots were flushed."""
+        pages decref and free back to their replica ranges, and the
+        prefix cache drops its index the same way — every holder UNREFS
+        (never force-frees), so a page momentarily shared between a slot
+        and the index frees exactly when the last reference goes.
+        Returns how many slots were flushed."""
         names = list(self._slots)
         for name in names:
             self.release(name)
+        if self.prefix_cache is not None:
+            self.prefix_cache.drop_all()
         return len(names)
 
     def reset_slot(self, name: str) -> None:
@@ -309,19 +374,91 @@ class PagedKVCache:
     def _shared(self, page: int) -> bool:
         return self._refs.get(page, 1) > 1
 
+    def _index_only_share(self, page: int) -> bool:
+        """True when `page`'s only holder besides the mapping slot is
+        the prefix-cache index (refcount exactly 2 with an index hold).
+        The write paths then make the page exclusive by FORGETTING the
+        index entry instead of copy-on-write: the slot's divergence is
+        invalidating that entry's continuation anyway, and the forget
+        costs zero pages and zero dispatches where a COW under a full
+        pool can be the allocation that doesn't exist (observed: a
+        16-page pool serving one 16-page sequence died COWing page 0
+        against the index's hold)."""
+        return (self.prefix_cache is not None
+                and self._refs.get(page, 1) == 2
+                and self.prefix_cache.holds_page(page))
+
+    # Public refcount surface (ISSUE 7): the prefix cache and the host
+    # offload tier hold references of their own, so a page shared by N
+    # sessions plus the index is stored once and only ever FREES when
+    # every holder has unref'd — release/flush/retire paths decref, never
+    # force-free.
+
+    def ref(self, page: int) -> None:
+        """Take one reference on `page` (index/offload-tier holders)."""
+        self._incref(page)
+
+    def unref(self, page: int) -> None:
+        """Drop one reference; the page frees to its replica range only
+        when the LAST holder lets go."""
+        self._decref(page)
+
+    def refcount(self, page: int) -> int:
+        """Current holder count (1 = exactly one holder)."""
+        return self._refs.get(page, 1)
+
+    def replica_of_page(self, page: int) -> int:
+        """The data replica whose range physically holds `page`."""
+        return page // self._per_replica
+
+    def cow_page(self, name: str, j: int,
+                 pinned: tuple[str, ...] = ()) -> int:
+        """Copy-on-write primitive: give `name` exclusive ownership of
+        its logical page j, device-copying the shared original into a
+        fresh page on the slot's replica. No-op (returns the existing
+        id) when the page is already exclusive."""
+        state = self._slots[name]
+        p = state.pages[j]
+        if not self._shared(p):
+            return p
+        if self._index_only_share(p):
+            self.prefix_cache.forget_page(p)
+            return p
+        pinned = tuple(pinned) + (name,)
+        fresh = self._alloc_page(pinned, state.replica)
+        self._decref(p)
+        state.pages[j] = fresh
+        self.pools = self._copy_pages_fn(
+            self.pools, jnp.asarray([p], jnp.int32),
+            jnp.asarray([fresh], jnp.int32))
+        return fresh
+
     def _alloc_page(self, pinned_names: tuple[str, ...],
                     replica: int = 0) -> int:
         free = self._free_by_replica[replica]
+        if not free and self.prefix_cache is not None:
+            # CHEAPEST first: reclaim LRU refcount-0 prefix-cache nodes
+            # on this replica (pages held ONLY by the index — a node
+            # some live slot still aliases is never touched). With the
+            # cache on, evicting a slot first would free almost nothing
+            # (its complete pages stay index-held) while destroying the
+            # slot's record — the loop could wipe every idle slot on
+            # the replica before one pure-cache page was even tried.
+            self.prefix_cache.reclaim(replica=replica)
         if not free:
             # Evict LRU slots (dict order = recency) until a page frees
             # ON THIS REPLICA — victims on other replicas free pages this
             # slot cannot use, so destroying their caches would cost
-            # reuse without unblocking anything.
+            # reuse without unblocking anything. A released victim's
+            # index-held pages drop to refcount-0: reclaim between
+            # victims so each eviction actually yields its pages.
             for victim in list(self._slots):
                 if (victim in pinned_names
                         or self._slots[victim].replica != replica):
                     continue
                 self.release(victim)
+                if not free and self.prefix_cache is not None:
+                    self.prefix_cache.reclaim(replica=replica)
                 if free:
                     break
         if not free:
@@ -365,6 +502,15 @@ class PagedKVCache:
         state = self.acquire(name)
         state.tokens = list(tokens)
         self._trim_pages(state, len(tokens))
+        if (self.prefix_cache is not None
+                and not name.startswith("__warmup_")):
+            # Publish the slot's COMPLETE pages into the content-
+            # addressed index (ISSUE 7): the next session whose prompt
+            # starts with the same token blocks aliases them instead of
+            # re-prefilling. Warmup slots are excluded — warm rows are
+            # crafted to defeat prefix sharing so every (batch, bucket)
+            # program actually compiles.
+            self.prefix_cache.insert(state)
 
     def best_donor(self, name: str,
                    tokens: list[int]) -> tuple[Optional[PagedSlot], int]:
@@ -406,20 +552,16 @@ class PagedKVCache:
         need = -(-upto_tokens // self.page_size)
         while len(state.pages) < need:
             state.pages.append(self._alloc_page(pinned, state.replica))
-        first_write_page = write_from // self.page_size
-        cow_src, cow_dst = [], []
-        for j in range(first_write_page, len(state.pages)):
-            p = state.pages[j]
-            if self._shared(p):
-                fresh = self._alloc_page(pinned, state.replica)
-                cow_src.append(p)
-                cow_dst.append(fresh)
-                self._decref(p)
-                state.pages[j] = fresh
-        if cow_src:
-            self.pools = self._copy_pages_fn(
-                self.pools, jnp.asarray(cow_src, jnp.int32),
-                jnp.asarray(cow_dst, jnp.int32))
+        # ONE definition of the fork policy (cow_page): index-only
+        # shares go exclusive by forgetting the index entry (no copy,
+        # no alloc — under a full pool the COW alloc may be the page
+        # that doesn't exist), real shares device-copy into a fresh
+        # page. Write ranges are typically 0-1 shared pages (the attach
+        # frontier is page-aligned), so per-page dispatch costs nothing
+        # measurable.
+        for j in range(write_from // self.page_size, len(state.pages)):
+            if self._shared(state.pages[j]):
+                self.cow_page(name, j, pinned)
 
     def alias_span(self, src_name: str, dst_name: str, lo: int,
                    hi: int, pinned: tuple[str, ...] = ()) -> None:
@@ -487,6 +629,80 @@ class PagedKVCache:
             self.pools = self._copy_pages_fn(
                 self.pools, jnp.asarray(cow_src, jnp.int32),
                 jnp.asarray(cow_dst, jnp.int32))
+
+    def adopt_span(self, dst_name: str, src_pages: list[int], lo: int,
+                   hi: int, pinned: tuple[str, ...] = ()) -> None:
+        """alias_span's slot-free counterpart: give dst the K/V for
+        positions [lo, hi) from an EXPLICIT page list covering [0, hi)
+        at page granularity — the prefix cache's content-addressed pages
+        (ISSUE 7). Whole pages on dst's replica alias (refcount++);
+        pages physically on another replica, and the partial boundary
+        page at lo, are device-copied into dst-owned pages. `hi` must be
+        page-aligned (the index only ever matches complete blocks).
+
+        Every source page is guard-ref'd for the duration: the COW/copy
+        allocations below may trigger slot eviction and prefix-cache
+        reclaim, and a refcount-0 source node freed mid-span would be
+        resurrected from the free list — silent corruption once a later
+        alloc hands the same page to another slot."""
+        ps = self.page_size
+        if hi % ps:
+            raise ValueError("adopt_span: hi must be page-aligned")
+        pinned = tuple(pinned) + (dst_name,)
+        dst = self.acquire(dst_name, pinned)
+        lo_page, hi_page = lo // ps, hi // ps
+        self._trim_pages(dst, lo)
+        if len(dst.pages) < lo_page:
+            raise RuntimeError("adopt_span: dst does not cover up to lo")
+        guards = {j: src_pages[j] for j in range(lo_page, hi_page)}
+        for p in guards.values():
+            self._incref(p)
+        transferred: set[int] = set()
+        cow_src, cow_dst = [], []
+
+        def copy_into_dst(j: int) -> None:
+            if j < len(dst.pages):
+                if (self._shared(dst.pages[j])
+                        and not self._index_only_share(dst.pages[j])):
+                    fresh = self._alloc_page(pinned, dst.replica)
+                    self._decref(dst.pages[j])
+                    dst.pages[j] = fresh
+                elif self._shared(dst.pages[j]):
+                    # Index-only share about to be overwritten by the
+                    # adopted copy: forgetting it is exclusive-for-free.
+                    self.prefix_cache.forget_page(dst.pages[j])
+            else:
+                dst.pages.append(self._alloc_page(pinned, dst.replica))
+            cow_src.append(src_pages[j])
+            cow_dst.append(dst.pages[j])
+
+        try:
+            if lo % ps and lo_page < hi_page:
+                # dst's partial boundary page holds tokens [lo_page*ps,
+                # lo) — the source's full page is a superset update
+                # (token streams agree on [0, hi), the caller's LCP
+                # contract).
+                copy_into_dst(lo_page)
+                lo_page += 1
+            for j in range(lo_page, hi_page):
+                if self.replica_of_page(src_pages[j]) == dst.replica:
+                    if j < len(dst.pages):
+                        self._decref(dst.pages[j])
+                        dst.pages[j] = src_pages[j]
+                    else:
+                        dst.pages.append(src_pages[j])
+                    # The guard ref becomes dst's mapping reference.
+                    transferred.add(j)
+                else:
+                    copy_into_dst(j)
+            if cow_src:
+                self.pools = self._copy_pages_fn(
+                    self.pools, jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(cow_dst, jnp.int32))
+        finally:
+            for j, p in guards.items():
+                if j not in transferred:
+                    self._decref(p)
 
     # --- device tables ---
 

@@ -87,7 +87,9 @@ class PPEngine:
                  kv_layout: str = "contiguous", page_size: int = 128,
                  num_pages: Optional[int] = None, attn: str = "auto",
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
-                 devices: Optional[list[int]] = None):
+                 devices: Optional[list[int]] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_pages: Optional[int] = None):
         import dataclasses
 
         if quant not in ("none", "int8", "int4"):
@@ -291,6 +293,19 @@ class PPEngine:
 
             self._gather_view = gather_view
             self._scatter_view = scatter_view
+            # Cross-session prefix cache (ISSUE 7): the stage-stacked
+            # pool is still one PagedKVCache page space, so the
+            # content-addressed index works unchanged — commit inserts,
+            # the prepare path attaches, _alloc_page reclaims. The host
+            # offload tier stays main-engine-only (its idle policy lives
+            # in the session scheduler, which serves InferenceEngine).
+            from .prefix_cache import PrefixCache, cache_enabled
+            self.prefix_cache = None
+            if cache_enabled(prefix_cache):
+                self.prefix_cache = PrefixCache(
+                    self.kv, engine=model_cfg.name,
+                    max_pages=prefix_cache_pages)
+                self.kv.prefix_cache = self.prefix_cache
         else:
             cache_shape = (n_stages, per, num_slots,
                            self.max_seq_len) + kd
@@ -302,6 +317,7 @@ class PPEngine:
             self.kc = self._make_contig()
             self.vc = self._make_contig()
             self.kv = SlotBook(num_slots)
+            self.prefix_cache = None
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._chars_per_token: Optional[float] = None
@@ -783,6 +799,10 @@ class PPEngine:
             sampling=sampling,
             seed=int(config.get("seed", 0)),
             devices=config.get("devices"),
+            prefix_cache=config.get("prefix_cache"),
+            prefix_cache_pages=(int(config["prefix_cache_pages"])
+                                if config.get("prefix_cache_pages")
+                                else None),
         )
         # Fleet auto-degrade marker — surfaced via describe() (advisor r3).
         engine.quant_auto_degraded = bool(
@@ -1085,12 +1105,22 @@ class PPEngine:
             offsets.append(reuse)
             all_tokens.append(tokens)
 
+        # Cross-session prefix cache (ISSUE 7): same consult the main
+        # engine's _prepare_batch runs (prefix_cache.attach_rows — one
+        # definition, so the warmup-exclusion rule and accounting can
+        # never drift between the serving paths).
+        prefix_reused = 0
+        if getattr(self, "prefix_cache", None) is not None:
+            prefix_reused = self.prefix_cache.attach_rows(
+                list(pinned), all_tokens, offsets, pinned)
+
         offsets, extra_prefill = self._share_prefixes(
             list(pinned), slot_ids, all_tokens, offsets, deadline,
             budget=pre_budget)
         # Copied donor spans count as reused (same accounting as the main
         # engine); the leader's extra span was genuinely prefilled.
         stats.reused_tokens = sum(offsets) - extra_prefill
+        stats.prefix_reused_tokens = prefix_reused
         stats.prefill_tokens = extra_prefill + sum(
             len(t) - o for t, o in zip(all_tokens, offsets))
 
@@ -1258,6 +1288,9 @@ class PPEngine:
         }
         if self.quant == "int4":
             info["int4_paths"] = self.int4_path_report()
+        # ISSUE 7: cross-session prefix-cache state (paged layouts).
+        if getattr(self, "prefix_cache", None) is not None:
+            info["prefix_cache"] = self.prefix_cache.describe()
         # ISSUE 5: the unified registry's per-engine view.
         info["telemetry"] = trace_hooks.engine_telemetry_view(
             self.cfg.name)

@@ -221,7 +221,8 @@ class SessionScheduler:
     still serialize correctly against scheduled work."""
 
     def __init__(self, engine, *, admit_hold_s: float = 0.0,
-                 max_rows: Optional[int] = None):
+                 max_rows: Optional[int] = None,
+                 idle_spill_s: Optional[float] = None):
         # The continuous-batching loop recomposes rows at the decode
         # SEGMENT seam — it needs the single-program engine's compiled
         # closures. PPEngine has no such seam (stage-pipelined decode).
@@ -236,6 +237,17 @@ class SessionScheduler:
         self.admit_hold_s = admit_hold_s
         self.max_rows = min(max_rows or engine.kv.num_slots,
                             engine.kv.num_slots)
+        # Host-RAM KV offload policy (ISSUE 7): per-session last-activity
+        # drives spill decisions — under page pressure at admission an
+        # idle session's KV moves to host RAM (kv_offload tier) INSTEAD
+        # of the allocator destroying it by eviction; with idle_spill_s
+        # set, sessions idle longer than that spill proactively each
+        # tick. Spilled sessions restore transparently on their next
+        # submit (engine._prepare_batch's restore seam) with no
+        # re-prefill. None = pressure-driven only.
+        self.idle_spill_s = idle_spill_s
+        self._last_active: dict[str, float] = {}
+        self.spills = 0
         self._queue: deque[_Request] = deque()
         self._active: list[_Row] = []         # rows, admission order
         self._active_reqs: list[_Request] = []
@@ -346,6 +358,7 @@ class SessionScheduler:
                 raise SchedulerClosed("scheduler is closed")
             self._queue.append(req)
             self.queued_peak = max(self.queued_peak, len(self._queue))
+            self._last_active[session] = time.monotonic()
             self._cv.notify_all()
         return req
 
@@ -462,6 +475,11 @@ class SessionScheduler:
             "occupancy_mean": (round(sum(occ) / len(occ), 2)
                                if occ else 0.0),
             "occupancy_recent": occ[-32:],
+            "spills": self.spills,
+            "spilled_sessions": len(getattr(
+                self.engine, "kv_offload", None).spilled_sessions())
+            if getattr(self.engine, "kv_offload", None) is not None
+            else 0,
             "events": events,
         }
 
@@ -475,6 +493,15 @@ class SessionScheduler:
         for req in list(self._active_reqs):
             live = sum(1 for r in req.rows if not r.done)
             sessions[req.session] = f"active({live} live rows)"
+        # Spilled-session state only for LIVE schedulers: a closed
+        # scheduler's engine may outlive it (module fixtures, the engine
+        # cache), and its snapshot claiming host-RAM sessions would make
+        # fleet_health point operators at a scheduler that serves
+        # nothing.
+        tier = getattr(self.engine, "kv_offload", None)
+        if tier is not None and not self.closed:
+            for s in tier.spilled_sessions():
+                sessions.setdefault(s, "spilled(host RAM)")
         return {
             "engine": getattr(self.engine.cfg, "name", "?"),
             "queued": len(self._queue),
@@ -550,7 +577,8 @@ class SessionScheduler:
         while True:
             with self._cv:
                 while (not self._queue and not self._active
-                       and not self._stop):
+                       and not self._stop
+                       and not self._idle_spill_due()):
                     self._cv.wait(timeout=0.25)
                 if self._stop and not self._active and not self._queue:
                     break
@@ -574,6 +602,8 @@ class SessionScheduler:
             self.reject_queued(SchedulerClosed("scheduler closed"))
         self._check_request_health()
         self._sweep_queue()
+        self._prune_last_active()
+        self._spill_idle_by_age()
         self._admit_queued()
         live = [r for r in self._active if not r.done]
         if live:
@@ -709,6 +739,133 @@ class SessionScheduler:
                 return False
         return True
 
+    # --- host-RAM KV offload policy (ISSUE 7) ---
+
+    def _spillable_sessions(self, exclude: set[str]) -> list[str]:
+        """Sessions whose slots sit idle in the pool: namespaced, not
+        actively decoding, not queued, not excluded — ordered least-
+        recently-active first."""
+        from .kvcache import session_of
+        busy = {session_of(r.name) for r in self._active}
+        with self._cv:
+            busy |= {r.session for r in self._queue}
+        busy |= exclude
+        seen: dict[str, None] = {}
+        for n in self.engine.kv.slot_names():
+            s = session_of(n)
+            if s and s not in busy:
+                seen.setdefault(s)
+        return sorted(seen, key=lambda s: self._last_active.get(s, 0.0))
+
+    def _spill_sessions(self, sessions: list[str], reason: str,
+                        want_pages: Optional[int] = None) -> int:
+        tier = getattr(self.engine, "kv_offload", None)
+        if tier is None:
+            return 0
+        kv = self.engine.kv
+        spilled = 0
+        for s in sessions:
+            free0 = kv.free_pages()
+            n = tier.spill_session(s)
+            if n:
+                spilled += 1
+                with self._cv:
+                    self._bump("spills")
+                self._event("spill", session=s, reason=reason,
+                            slots=n, pages_freed=kv.free_pages() - free0)
+            if want_pages is not None and kv.free_pages() >= want_pages:
+                break
+        return spilled
+
+    _LAST_ACTIVE_PRUNE_AT = 1024
+
+    def _prune_last_active(self) -> None:
+        """Bound the last-activity map: a long-lived scheduler admits a
+        fresh uuid-tagged session id per discussion, and an entry per
+        dead session forever is the same slow leak the per-session KV
+        gauges already had to fix (PR 6's remove_gauge). Entries whose
+        session holds no pool slots, no spill record, and is neither
+        active nor queued are gone for good — drop them once the map
+        outgrows the threshold (amortized: one sweep per ~1024 dead
+        sessions, host dict math only)."""
+        if len(self._last_active) <= self._LAST_ACTIVE_PRUNE_AT:
+            return
+        from .kvcache import session_of
+        keep = {session_of(n) for n in self.engine.kv.slot_names()}
+        tier = getattr(self.engine, "kv_offload", None)
+        if tier is not None:
+            keep |= set(tier.spilled_sessions())
+        keep |= {r.session for r in self._active_reqs}
+        # The sweep holds the cv: submit() threads insert new sessions
+        # into this dict under the same lock, and a resize mid-iteration
+        # would raise out of _tick and fail every in-flight request.
+        with self._cv:
+            keep |= {r.session for r in self._queue}
+            for s in [s for s in self._last_active if s not in keep]:
+                del self._last_active[s]
+
+    def _idle_spill_due(self) -> bool:
+        """True when the proactive idle policy has work — the loop's
+        idle wait must wake for it, or an otherwise-quiet scheduler
+        would never run the spill tick."""
+        if (self.idle_spill_s is None
+                or getattr(self.engine, "kv_offload", None) is None):
+            return False
+        now = time.monotonic()
+        return any(now - self._last_active.get(s, now)
+                   >= self.idle_spill_s
+                   for s in self._spillable_sessions(set()))
+
+    def _spill_idle_by_age(self) -> None:
+        """Proactive idle spill (idle_spill_s set): a session that has
+        not submitted for idle_spill_s releases its HBM pages to host
+        RAM — a consensus round can sit for minutes while humans type,
+        and resident-but-idle KV is exactly the capacity ceiling this
+        tier lifts."""
+        if (self.idle_spill_s is None
+                or getattr(self.engine, "kv_offload", None) is None):
+            return
+        now = time.monotonic()
+        idle = [s for s in self._spillable_sessions(set())
+                if now - self._last_active.get(s, now)
+                >= self.idle_spill_s]
+        if not idle:
+            return
+        self._acquire_engine()
+        try:
+            self._spill_sessions(idle, reason="idle")
+        finally:
+            if not self._active:
+                self._release_engine()
+
+    def _spill_for_pressure(self, req: _Request) -> None:
+        """Admission-time pressure valve: when the pool's FREE pages
+        cannot cover the incoming request's estimate, spill idle
+        sessions (least-recently-active first) BEFORE _prepare_batch
+        runs — otherwise the allocator's LRU eviction would destroy
+        exactly the idle caches that make those sessions' next turns
+        cheap. The admission itself then proceeds instead of queueing
+        behind capacity that idle sessions were hoarding."""
+        engine = self.engine
+        if (getattr(engine, "kv_offload", None) is None
+                or engine.kv_layout != "paged"):
+            return
+        # NEW-page demand, not the whole-prompt estimate: in steady
+        # state a session's next turn is mostly its own committed
+        # transcript, already paged in under its scoped slots — counting
+        # those pages as demand would declare pressure on every
+        # admission past ~half occupancy and churn idle sessions
+        # through spill/restore for pages the turn never needed.
+        scoped = [scoped_slot(req.session, n) for n, _ in req.turns]
+        need = (self._pages_needed(req.turns, req.max_new)
+                - engine.kv.pages_held(scoped))
+        free = engine.kv.free_pages()
+        if need <= free:
+            return
+        self._spill_sessions(
+            self._spillable_sessions(exclude={req.session}),
+            reason="pressure", want_pages=need)
+
     def _start_request(self, req: _Request) -> None:
         """Admission: the engine's own pre-decode phase
         (InferenceEngine._prepare_batch — reuse-plan → intra-session
@@ -736,6 +893,7 @@ class SessionScheduler:
         max_new, max_new_padded = clamp_max_new(req.max_new,
                                                 engine.max_seq_len)
 
+        self._spill_for_pressure(req)
         active_names = tuple(r.name for r in self._active)
         scoped_turns = [(scoped_slot(req.session, n), p)
                         for n, p in req.turns]
@@ -744,6 +902,7 @@ class SessionScheduler:
             req.sampling_per_turn, extra_pinned=active_names)
         stats.prefill_tokens = prep["prefill_tokens"]
         stats.reused_tokens = prep["reused_tokens"]
+        stats.prefix_reused_tokens = prep["prefix_reused_tokens"]
         stats.prefill_seconds = time.monotonic() - t0
 
         eos = engine.tokenizer.eos_id
@@ -1161,6 +1320,7 @@ class SessionScheduler:
                 except Exception:  # noqa: BLE001 — the error wins
                     pass
         self._drop_request(req)
+        self._last_active[req.session] = time.monotonic()
         req.error = err
         self._bump("failed")
         perf = getattr(self.engine, "perf", None)
@@ -1211,6 +1371,7 @@ class SessionScheduler:
                 "sessions_max": req.sess_max,
             }
             self._drop_request(req)
+            self._last_active[req.session] = time.monotonic()
             req.result = (texts, req.stats)
             self._bump("completed")
             if req.tele is not None:

@@ -110,9 +110,30 @@ def publish_memory_ledger(engine) -> dict[str, Any]:
                           ledger["page_utilization"], engine=name)
             reg.set_gauge("roundtable_kv_fragmentation",
                           ledger["fragmentation"], engine=name)
+            # ISSUE 7: the cross-session sharing split — shared pages
+            # counted ONCE in pages_in_use; this makes the dedup
+            # visible (and auditable) on a dashboard.
+            reg.set_gauge("roundtable_kv_shared_pages",
+                          ledger.get("shared_pages", 0), engine=name)
+            reg.set_gauge("roundtable_kv_exclusive_pages",
+                          ledger.get("exclusive_pages", 0), engine=name)
+            reg.set_gauge("roundtable_prefix_cache_pages",
+                          ledger.get("prefix_cache_pages", 0),
+                          engine=name)
         if ledger.get("hbm_bytes") is not None:
             reg.set_gauge("roundtable_kv_hbm_bytes",
                           ledger["hbm_bytes"], engine=name)
+    # ISSUE 7: the host-RAM offload tier's footprint rides the same
+    # ledger publish (sessions parked out of HBM + what they cost in
+    # host bytes).
+    tier = getattr(engine, "kv_offload", None)
+    if tier is not None:
+        ledger["spilled_sessions"] = len(tier.spilled_sessions())
+        ledger["host_bytes"] = tier.host_bytes()
+        reg.set_gauge("roundtable_kv_spilled_sessions",
+                      ledger["spilled_sessions"], engine=name)
+        reg.set_gauge("roundtable_kv_host_bytes",
+                      ledger["host_bytes"], engine=name)
     stats = None
     try:
         stats = engine.mesh.devices.flatten()[0].memory_stats()

@@ -431,6 +431,7 @@ PERF_SERIES_PREFIXES = (
     "roundtable_decode_tps",
     "roundtable_compile", "roundtable_steady_state",
     "roundtable_kv_", "roundtable_hbm_", "roundtable_session_kv_",
+    "roundtable_prefix_",   # ISSUE 7: prefix-cache hit/miss/size series
 )
 
 

@@ -717,6 +717,9 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "max_occupancy": "max over roundtable_sched_occupancy gauge",
         "occupancy_mean": "mean over roundtable_sched_occupancy gauge",
         "occupancy_recent": "ring view (flight recorder carries events)",
+        "spills": "roundtable_sched_spills_total",
+        "spilled_sessions": "roundtable_kv_spilled_sessions gauge "
+                            "(kv_offload tier)",
         "events": "flight recorder ring (sched_* kinds)",
     },
 }
