@@ -22,8 +22,12 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            K ∈ {1,2,4,8} concurrent scripted discussions through the
            continuous-batching session scheduler on ONE shared engine;
            emits one JSON line per K with aggregate decode tok/s,
-           batch-occupancy %, p50/p95 turn latency, and the scheduler's
-           decision provenance embedded like int4_paths.
+           batch-occupancy %, p50/p95 turn latency, p50/p95 TTFT per
+           round under concurrent admission (ISSUE 8 — served off a
+           PAGED engine so ragged chunk-interleaved admission and the
+           prefix cache are in play; ragged-path provenance embedded;
+           ROUNDTABLE_RAGGED_ATTN=0 A/Bs the PR-4 prologue), and the
+           scheduler's decision provenance embedded like int4_paths.
            ROUNDTABLE_BENCH_LOAD_KS=1,2,4 overrides the sweep.)
        ROUNDTABLE_BENCH_PREFIX_REUSE=1 .. (prefix-reuse sweep, ISSUE 7:
            the offered-load run twice on a PAGED engine — cross-session
@@ -101,11 +105,22 @@ def offered_load_child() -> int:
     on_cpu = jax.devices()[0].platform == "cpu"
     model = "tiny-gemma" if on_cpu else "gemma-2b-it"
     max_seq = 1024 if on_cpu else 2048
-    max_new = 32 if on_cpu else 96
+    # Decode-representative turns (ISSUE 8): real discussion turns run
+    # ~160 tokens (BASELINE.md); 32-token CPU turns made the sweep
+    # prefill-dominated, which hid exactly the admission stall the
+    # TTFT percentiles exist to measure.
+    max_new = 96
     rounds = 2
     num_slots = 12  # up to 4 concurrent 3-knight sessions resident
     ks = [int(x) for x in os.environ.get(
         "ROUNDTABLE_BENCH_LOAD_KS", "1,2,4,8").split(",")]
+    # Arrival stagger (ISSUE 8): offered load means sessions ARRIVE
+    # over time — session i starts i*stagger seconds in, so later
+    # sessions are LATE JOINERS admitted against a live decode batch
+    # (the admission-stall shape the TTFT percentiles measure). 0
+    # restores the PR-4 all-at-once burst.
+    stagger_s = float(os.environ.get(
+        "ROUNDTABLE_BENCH_LOAD_STAGGER_S", "1.0"))
 
     class Scripted(TpuLlmAdapter):
         """Real serving; scripted consensus scores terminate each
@@ -119,8 +134,17 @@ def offered_load_child() -> int:
                 agrees_with=[], pending_issues=[], proposal="bench",
                 files_to_modify=["bench.md"] if score >= 9 else [])
 
+    # Paged pool (ISSUE 8): the offered-load sweep measures the MODERN
+    # serving shape — prefix cache + ragged chunk-interleaved admission
+    # both ride the paged engines; ROUNDTABLE_RAGGED_ATTN=0 serves the
+    # same sweep through the PR-4 prologue for A/B TTFT comparisons.
     engine_cfg = {"model": model, "max_seq_len": max_seq,
-                  "num_slots": num_slots,
+                  "num_slots": num_slots, "kv_layout": "paged",
+                  # Contiguous-equal pool: the sweep HOLDS K sessions
+                  # resident concurrently — the default half-budget
+                  # pool would serve admission backpressure, not the
+                  # scheduling behavior this sweep measures.
+                  "num_pages": num_slots * max_seq // 128,
                   "sampling": {"temperature": 0.0,
                                "max_new_tokens": max_new}}
 
@@ -153,6 +177,7 @@ def offered_load_child() -> int:
 
             def run_one(i, k=k, root=root, config=config, sched=sched):
                 try:
+                    time.sleep(i * stagger_s)
                     adapter = Scripted("tpu-llm", engine_cfg)
                     adapter.attach_scheduler(sched, session=f"k{k}s{i}")
                     # Disambiguator goes FIRST: slugify truncates topics
@@ -175,11 +200,56 @@ def offered_load_child() -> int:
                        for i in range(k)]
             for th in threads:
                 th.start()
+
+            # Late-join probe stream (ISSUE 8): fresh single-knight
+            # sessions keep ARRIVING while the K discussions hold the
+            # decode batch — the "new user hits a busy server" shape.
+            # Their TTFT is the admission-stall number ragged
+            # chunk-interleaved admission exists to move; the prologue
+            # path serializes each probe's prefill against the live
+            # batch and any concurrent admissions.
+            probe_ttfts = []
+            probe_errors = []
+            probe_stop = threading.Event()
+
+            def probe_loop(k=k, sched=sched):
+                base = ("A new petitioner arrives at the castle and "
+                        "lays out the matter before the court. ")
+                i = 0
+                while not probe_stop.is_set():
+                    # ~400 fresh tokens per probe: a cold prefill (past
+                    # any prefix-cache hit) is the admission stall under
+                    # measurement.
+                    prompt = (base * 16
+                              + f" Petition {i} of load {k}: advise.")
+                    try:
+                        _texts, stats = sched.submit(
+                            f"probe-k{k}-{i}",
+                            [("petitioner", prompt)],
+                            max_new_tokens=16, timeout_s=120.0)
+                        tt = (stats.sched or {}).get("ttft_s")
+                        if tt is not None:
+                            probe_ttfts.append(tt)
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        # A refused/timed-out probe IS a late-join
+                        # datapoint (the record must not read "instant
+                        # TTFT" when admission was saturated) — count
+                        # it and keep probing.
+                        probe_errors.append(type(e).__name__)
+                        if len(probe_errors) >= 8:
+                            break
+                    i += 1
+                    probe_stop.wait(0.25)
+
+            prober = threading.Thread(target=probe_loop)
+            prober.start()
             for th in threads:
                 th.join()
+            probe_stop.set()
+            prober.join(timeout=130)
             wall = time.monotonic() - t0
 
-            turn_walls, queue_waits = [], []
+            turn_walls, queue_waits, ttfts = [], [], []
             decode_tokens = 0
             occupancies = []
             for result, _sess_wall in entries:
@@ -195,6 +265,15 @@ def offered_load_child() -> int:
                         if t.get("engine"):
                             decode_tokens += t["engine"].get(
                                 "decode_tokens", 0)
+                            # TTFT (ISSUE 8): submit → every row of the
+                            # round sampled its first token, straight
+                            # from the scheduler's sched stats — the
+                            # admission-stall number ragged admission
+                            # moves.
+                            tt = (t["engine"].get("sched") or {}).get(
+                                "ttft_s")
+                            if tt is not None:
+                                ttfts.append(tt)
         provenance = sched.describe()
         sched.close()
         if session_errors:
@@ -206,12 +285,17 @@ def offered_load_child() -> int:
         assert all(r.consensus for r, _ in entries), \
             "every scripted discussion must reach consensus"
         turn_walls.sort()
+        ttfts.sort()
+        probe_ttfts.sort()
+
+        def _pct_of(vals, p):
+            if not vals:
+                return 0.0
+            idx = min(int(p / 100 * len(vals)), len(vals) - 1)
+            return round(vals[idx], 3)
 
         def pct(p):
-            if not turn_walls:
-                return 0.0
-            idx = min(int(p / 100 * len(turn_walls)), len(turn_walls) - 1)
-            return round(turn_walls[idx], 3)
+            return _pct_of(turn_walls, p)
 
         result_line = {
             "metric": f"offered_load_discuss[{model}][K={k}]",
@@ -220,11 +304,29 @@ def offered_load_child() -> int:
             "detail": {
                 "sessions": k,
                 "rounds_per_session": rounds,
+                "arrival_stagger_s": stagger_s,
                 "wall_s": round(wall, 2),
                 "decode_tokens": decode_tokens,
                 "p50_turn_s": pct(50),
                 "p95_turn_s": pct(95),
                 "turn_count": len(turn_walls),
+                # Time-to-first-token per round under concurrent
+                # admission — the headline number ragged
+                # chunk-interleaved admission moves (ISSUE 8).
+                "p50_ttft_s": _pct_of(ttfts, 50),
+                "p95_ttft_s": _pct_of(ttfts, 95),
+                "ttft_count": len(ttfts),
+                # The late-join probe stream's TTFT — sessions arriving
+                # at the already-busy batch (the headline this PR
+                # moves; see probe_loop above). None (never 0.0) when
+                # no probe completed — an empty stream must not read
+                # as instant admission.
+                "p50_ttft_late_join_s": (_pct_of(probe_ttfts, 50)
+                                         if probe_ttfts else None),
+                "p95_ttft_late_join_s": (_pct_of(probe_ttfts, 95)
+                                         if probe_ttfts else None),
+                "late_join_count": len(probe_ttfts),
+                "late_join_errors": probe_errors,
                 "queue_wait_mean_s": (
                     round(statistics.mean(queue_waits), 3)
                     if queue_waits else 0.0),
@@ -240,6 +342,11 @@ def offered_load_child() -> int:
                 # record, the int4_paths pattern (ISSUE 4).
                 "scheduler": {kk: vv for kk, vv in provenance.items()
                               if kk != "events"},
+                # Ragged-path provenance (ISSUE 8): dispatch counts and
+                # fallback reasons, so the TTFT numbers are attributable
+                # to the mixed-dispatch path (or its absence).
+                "ragged": engine.ragged_describe(),
+                "kv_layout": "paged",
                 # Unified-registry snapshot (ISSUE 5): the same
                 # occupancy/fallback/hang counters fleet_health reads,
                 # frozen into the run record.
@@ -248,6 +355,142 @@ def offered_load_child() -> int:
             },
         }
         print(json.dumps(result_line), flush=True)
+    return 0
+
+
+def late_join_child() -> int:
+    """Late-join TTFT A/B (ISSUE 8 acceptance): K fresh sessions submit
+    while a resident session is DEEP IN DECODE — the admission-stall
+    scenario ragged chunk-interleaved admission exists to kill — served
+    twice on one paged config, ragged ON then OFF (the
+    prefix_reuse_child on/off pattern), so the record carries the
+    measured p50/p95 TTFT delta, not a projection. Direct scheduler
+    submissions (no orchestrator): the measurement is the scheduler's
+    admission path itself. Emits ONE JSON line with both modes, the
+    deltas, greedy token parity across modes, and the ragged-path
+    provenance (dispatch counts, fallback reasons) embedded."""
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = "tiny-gemma" if on_cpu else "gemma-2b-it"
+    max_seq = 1024 if on_cpu else 2048
+    k = int(os.environ.get("ROUNDTABLE_BENCH_LATE_JOIN_K", "3"))
+    bg_tokens = 256
+    join_new = 48
+    cfg = get_model_config(model, max_seq_len=max_seq)
+    kw = {}
+    if on_cpu:
+        # Tests/CI expose 8 virtual devices; tiny-gemma's heads don't
+        # partition an 8-way model axis, which would (correctly)
+        # decline the kernel — measure the kernel path.
+        kw["mesh_shape"] = {"data": 1, "model": 1}
+
+    joiner_prompt = ("A new petitioner arrives at the castle and lays "
+                     "out the matter before the court in great detail. "
+                     * 16)
+
+    def run_mode(ragged: bool) -> dict:
+        eng = InferenceEngine(
+            cfg, num_slots=k + 2, kv_layout="paged",
+            num_pages=(k + 2) * max_seq // 128, ragged_attn=ragged,
+            **kw)
+        warm_s = eng.warmup(max_prompt_tokens=512, batch_sizes=(1, 2))
+        sched = SessionScheduler(eng)
+        results: dict = {}
+        errors: list = []
+
+        def background():
+            try:
+                results["bg"] = sched.submit(
+                    "bg", [("scribe", "The scribe recounts the history "
+                                      "of the order at great length.")],
+                    max_new_tokens=bg_tokens)
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append(("bg", e))
+
+        def joiner(i):
+            try:
+                while not sched._active:
+                    time.sleep(0.005)
+                time.sleep(0.15 * i)
+                results[f"j{i}"] = sched.submit(
+                    f"j{i}", [("petitioner",
+                               joiner_prompt + f" Petition {i}.")],
+                    max_new_tokens=join_new)
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append((f"j{i}", e))
+
+        threads = [threading.Thread(target=background)] + [
+            threading.Thread(target=joiner, args=(i,)) for i in range(k)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        wall = time.monotonic() - t0
+        if errors:
+            raise RuntimeError(f"late-join mode ragged={ragged}: "
+                               + "; ".join(f"{s}: {e}"
+                                           for s, e in errors))
+        ttfts = sorted(results[f"j{i}"][1].sched["ttft_s"]
+                       for i in range(k))
+        provenance = sched.describe()
+        sched.close()
+
+        def pct(p):
+            idx = min(int(p / 100 * len(ttfts)), len(ttfts) - 1)
+            return round(ttfts[idx], 3)
+
+        return {
+            "ttfts_s": ttfts, "p50_ttft_s": pct(50),
+            "p95_ttft_s": pct(95), "wall_s": round(wall, 2),
+            "warmup_s": round(warm_s, 1),
+            "texts": {s: results[s][0] for s in results},
+            "ragged": eng.ragged_describe(),
+            "scheduler": {kk: vv for kk, vv in provenance.items()
+                          if kk != "events"},
+        }
+
+    on = run_mode(True)
+    off = run_mode(False)
+    parity = on.pop("texts") == off.pop("texts")
+    result_line = {
+        "metric": f"late_join_ttft[{model}][K={k}]",
+        "value": on["p95_ttft_s"],
+        "unit": "p95_ttft_s_ragged_on",
+        "detail": {
+            "late_joiners": k,
+            "bg_decode_tokens": bg_tokens,
+            "ragged_on": on,
+            "prologue": off,
+            "p95_ttft_improvement_s": round(
+                off["p95_ttft_s"] - on["p95_ttft_s"], 3),
+            "p50_ttft_improvement_s": round(
+                off["p50_ttft_s"] - on["p50_ttft_s"], 3),
+            # Greedy outputs must not depend on the admission path —
+            # the kill-switch byte-identity acceptance, measured here.
+            "token_parity_on_vs_off": parity,
+            "platform": jax.devices()[0].platform,
+            "telemetry": _registry_snapshot(),
+        },
+    }
+    print(json.dumps(result_line), flush=True)
     return 0
 
 
@@ -645,6 +888,8 @@ def main() -> int:
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_LATE_JOIN"):
+        return late_join_child()
     if os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE"):
         return prefix_reuse_child()
     if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD"):

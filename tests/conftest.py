@@ -226,6 +226,35 @@ def _prefix_cache_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _ragged_attn_guard(request):
+    """Tier-1 guard for @pytest.mark.ragged_attn (ISSUE 8 satellite): a
+    test that CLAIMS ragged mixed-dispatch coverage must not silently
+    serve the prologue or the XLA fallback — if the provenance sink
+    recorded ZERO ragged KERNEL dispatches during the test, the ragged
+    path never ran (kill-switch left on, shape silently declined, join
+    never deferred); fail LOUD. XLA-fallback units mark
+    allow_fallback=True, which still requires SOME ragged dispatch."""
+    marker = request.node.get_closest_marker("ragged_attn")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine.pallas import attention as pattn
+
+    pattn.reset_ragged_counters()
+    yield
+    if marker.kwargs.get("allow_fallback"):
+        assert (pattn.ragged_kernel_dispatches()
+                + pattn.ragged_fallback_dispatches()) > 0, (
+            "ragged_attn-marked test issued NO ragged dispatches at "
+            "all — the mixed-dispatch path silently never ran")
+        return
+    assert pattn.ragged_kernel_dispatches() > 0, (
+        "ragged_attn-marked test recorded ZERO ragged-kernel "
+        "dispatches: the ragged path silently fell back or never ran "
+        "(mark allow_fallback=True only for XLA-path units)")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

@@ -200,3 +200,82 @@ def test_paged_spmd_lowers_pool_direct(pool_replicas):
         return out
 
     _lower_tpu(g, qp, kp, vp, table, offs, valid)
+
+
+# --- ragged paged attention (ISSUE 8) ---
+
+
+def _ragged_args(t_blocks=4, n_seq=3, pages_per_seq=4, pool_pages=16):
+    """A mixed flat buffer: seq 0 a 2-block prefill chunk, seq 1 a
+    decode token (1 real row), the rest inert — the composition one
+    ragged dispatch serves."""
+    t = t_blocks * pattn.RAGGED_BLOCK_Q
+    q = jnp.zeros((t, H, D), jnp.bfloat16)
+    kp = jnp.zeros((pool_pages, PAGE, K, D), jnp.bfloat16)
+    vp = jnp.zeros((pool_pages, PAGE, K, D), jnp.bfloat16)
+    tables = jnp.zeros((n_seq, pages_per_seq), jnp.int32)
+    seq_of_block = jnp.asarray(
+        np.array([0, 0, 1, 2], np.int32)[:t_blocks])
+    block_qstart = jnp.asarray(
+        np.array([0, 8, 0, 0], np.int32)[:t_blocks])
+    query_offsets = jnp.asarray(np.array([128, 200, 0], np.int32))
+    kv_valid = jnp.asarray(np.array([144, 201, 1], np.int32))
+    return q, kp, vp, tables, seq_of_block, block_qstart, \
+        query_offsets, kv_valid
+
+
+# (None, None) = llama/qwen; softcap = gemma-2; window = mistral —
+# same flag matrix as the batched kernels: each switches real kernel
+# code (tanh, window masks) inside the shared accumulate.
+@pytest.mark.ragged_attn
+@pytest.mark.parametrize("softcap,window", [(None, None), (30.0, None),
+                                            (None, 64)])
+def test_ragged_kernel_lowers(softcap, window):
+    args = _ragged_args()
+
+    def f(*a):
+        return pattn.ragged_paged_attention(
+            *a, sliding_window=window, softcap=softcap,
+            interpret=False)
+
+    _lower_tpu(f, *args)
+
+
+@pytest.mark.ragged_attn
+def test_ragged_spmd_lowers_on_model_mesh():
+    """The SPMD head-sharded variant: kv heads on 'model', flat buffer
+    and metadata replicated — the flash_attention_spmd pattern over the
+    ragged kernel."""
+    mesh = _mesh((1, 4), ("data", "model"))
+    args = _ragged_args()
+
+    def f(*a):
+        out = pattn.ragged_paged_spmd(mesh, *a, interpret=False)
+        assert out is not None, "ragged spmd declined supported layout"
+        return out
+
+    _lower_tpu(f, *args)
+
+
+def test_ragged_spmd_declines_data_axis_and_bad_heads():
+    """Fallback-decline units: a data-sharded mesh (the pool's page
+    axis shards there — a flat buffer cannot mix replicas' rows) and a
+    non-dividing head layout both return None, never a mis-sharded
+    kernel; the engine records the reason and serves the prologue."""
+    args = _ragged_args()
+    mesh = _mesh((2, 2), ("data", "model"))
+    assert pattn.ragged_paged_spmd(mesh, *args, interpret=False) is None
+    mesh3 = _mesh((1, 3), ("data", "model"))
+    assert pattn.ragged_paged_spmd(mesh3, *args,
+                                   interpret=False) is None
+
+
+def test_ragged_vmem_budget_declines_not_mosaic():
+    """Oversized pool shapes must decline with a machine-readable
+    reason BEFORE any pallas_call is emitted — the same no-Mosaic-
+    failure-on-chip guarantee as the int4 plans."""
+    assert pattn.ragged_decline_reason(PAGE, D, K, H // K) is None
+    r = pattn.ragged_decline_reason(512, 512, 16, 16)
+    assert r is not None and r.startswith("vmem:")
+    r = pattn.ragged_decline_reason(96, D)
+    assert r is not None and r.startswith("page_size:")

@@ -97,7 +97,7 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "devices", "attn", "num_slots", "sampling", "seed",
                  "kv_layout", "page_size", "num_pages", "n_micro",
                  "quant", "dcn_axis", "prefix_cache",
-                 "prefix_cache_pages", "kv_offload")}
+                 "prefix_cache_pages", "kv_offload", "ragged_attn")}
     return json.dumps(relevant, sort_keys=True)
 
 

@@ -111,7 +111,8 @@ class InferenceEngine:
                  dcn_axis: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_pages: Optional[int] = None,
-                 kv_offload: Optional[bool] = None):
+                 kv_offload: Optional[bool] = None,
+                 ragged_attn: Optional[bool] = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -648,6 +649,88 @@ class InferenceEngine:
             if offload_enabled(kv_offload):
                 self.kv_offload = HostOffloadTier(self)
 
+        # Ragged paged attention (ISSUE 8): mixed prefill/decode in ONE
+        # dispatch over a flat token buffer — the scheduler's chunk-
+        # interleaved admission path. Paged pools only (the flat buffer
+        # addresses pages); data-sharded pools decline (a flat buffer
+        # cannot mix replicas' rows) with the reason recorded. Within an
+        # enabled engine, the KERNEL path needs the pool shape + head
+        # layout to fit — otherwise every ragged dispatch runs the XLA
+        # fallback and records `fallback_reason`, the int4_paths
+        # pattern. ROUNDTABLE_RAGGED_ATTN=0 kills the whole seam: the
+        # scheduler then serves the PR-4 admission prologue unchanged.
+        from collections import deque as _deque
+        self.ragged_enabled = False
+        self.ragged_path: Optional[str] = None
+        self.ragged_reason: Optional[str] = None
+        self.ragged_fallback_reason: Optional[str] = None
+        self.ragged_tokens = 0
+        self.ragged_shapes: tuple[int, ...] = ()
+        self.ragged_defer_min = 0
+        self._ragged_dispatches: dict[str, int] = {}
+        self._ragged_recent = _deque(maxlen=32)
+        if kv_layout == "paged":
+            from .prefix_cache import env_flag
+            from .pallas import attention as _pattn
+            from .serving_loop import ragged_token_budget
+            n_model = dict(self.mesh.shape).get("model", 1)
+            kh_l = model_cfg.num_kv_heads
+            if self.mesh.devices.size > 1 and kh_l % max(n_model, 1) == 0:
+                kh_l //= max(n_model, 1)
+            group = model_cfg.num_heads // model_cfg.num_kv_heads
+            if not env_flag(ragged_attn, "ROUNDTABLE_RAGGED_ATTN"):
+                self.ragged_reason = "disabled:config/env"
+            elif dict(self.mesh.shape).get("data", 1) > 1:
+                # The pool's page axis shards over "data" on these
+                # meshes; a flat buffer mixing replicas' rows cannot.
+                self.ragged_reason = "mesh:data-axis"
+            else:
+                from .serving_loop import (ragged_defer_min,
+                                           ragged_shape_grid)
+                self.ragged_enabled = True
+                self.ragged_tokens = ragged_token_budget(num_slots)
+                self.ragged_shapes = ragged_shape_grid(self.ragged_tokens)
+                self.ragged_defer_min = ragged_defer_min()
+                if attn == "dense":
+                    decline = "attn=dense"
+                elif (self.mesh.devices.size > 1
+                      and not _pattn.spmd_partitionable(
+                          model_cfg.num_heads, model_cfg.num_kv_heads,
+                          n_model)):
+                    decline = "heads:model-axis"
+                else:
+                    decline = _pattn.ragged_decline_reason(
+                        page_size, model_cfg.head_dim, kh_l, group)
+                self.ragged_path = ("pallas_ragged" if decline is None
+                                    else "xla_ragged")
+                self.ragged_fallback_reason = decline
+
+            @partial(jax.jit, donate_argnums=(1,),
+                     static_argnames=("greedy", "attn_path"))
+            def ragged_step(params, pools, tables, tokens, positions,
+                            token_pages, token_offs, token_seq,
+                            seq_of_block, block_qstart, query_offsets,
+                            kv_valid, last_rows, key, temps, top_ks,
+                            top_ps, greedy, attn_path):
+                from .paged_forward import forward_ragged
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+                    logits, new_pools = forward_ragged(
+                        params, cfg,
+                        tokens, positions, pools, tables, seq_of_block,
+                        block_qstart, query_offsets, kv_valid,
+                        token_pages, token_offs, token_seq, last_rows,
+                        attn_path=attn_path)
+                    lf = logits.astype(jnp.float32)
+                    if greedy:
+                        nxt = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = sample_token_batch(
+                            lf, key, temps, top_ks,
+                            top_ps).astype(jnp.int32)
+                return host_read(nxt), new_pools
+
+            self._ragged_step = ragged_step
+
         # Per-engine roofline model (ISSUE 6): streamed bytes from the
         # ACTUAL (quantized) tree + chip ceilings, published at event
         # rate by generate/scheduler seams and embedded in describe().
@@ -729,6 +812,7 @@ class InferenceEngine:
                                 if config.get("prefix_cache_pages")
                                 else None),
             kv_offload=config.get("kv_offload"),
+            ragged_attn=config.get("ragged_attn"),
         )
         # Set by fleet.check_fleet_fits when it flips an unpinned config
         # to int8: surfaced via describe() so the degrade is visible
@@ -854,6 +938,12 @@ class InferenceEngine:
                 self._release_warm_slots()
                 self.generate_batch(turns, max_new_tokens=1)
         self._release_warm_slots()
+        # Warm the ragged mixed-dispatch program (ISSUE 8): ONE compiled
+        # shape per (budget, sampling mode) serves every prefill/decode
+        # composition, so two dispatches reach its layout fixpoint and
+        # scheduler joins compile nothing in steady state.
+        if self.ragged_enabled:
+            self._warm_ragged()
         # Warm the offload tier's fetch/write programs (ONE fixed shape
         # each, ISSUE 7): a first idle-session spill/restore in steady
         # state must compile nothing under ROUNDTABLE_RECOMPILE_STRICT.
@@ -866,6 +956,47 @@ class InferenceEngine:
         from . import compile_watch
         compile_watch.warmup_complete(self.cfg.name)
         return time.monotonic() - t0
+
+    def _warm_ragged(self) -> None:
+        """Compile-and-stabilize the ragged mixed dispatch: a two-seq
+        flat buffer (one prefill chunk + one decode-shaped row) through
+        the REAL _ragged_dispatch seam, twice for the donated-pool
+        layout fixpoint — in the engine-default sampling mode plus
+        greedy (the scheduler's parity/STRICT mode) when they differ.
+        The decode-shaped row attends warm garbage; outputs are
+        discarded, the compiled program is the point."""
+        from .serving_loop import RaggedSeq, build_ragged_batch
+        names = ("__warmup_0", "__warmup_1")
+        if self.kv.num_slots < 2:
+            return
+        self._release_warm_slots()
+        pinned = names
+        self.kv.ensure_capacity(names[0], 32, write_from=0,
+                                pinned=pinned)
+        self.kv.ensure_capacity(names[1], 16, write_from=0,
+                                pinned=pinned)
+        t0 = self.kv.table_for([names[0]])[0]
+        t1 = self.kv.table_for([names[1]])[0]
+        bos = self.tokenizer.bos_id
+        modes = {True}
+        if self.sampling.temperature > 0.0:
+            modes.add(False)
+        for greedy in sorted(modes, reverse=True):
+            temp = 0.0 if greedy else max(self.sampling.temperature, 0.1)
+            seqs = [RaggedSeq([bos] + [5] * 23, 0, t0, temperature=temp),
+                    RaggedSeq([7], 8, t1, temperature=temp)]
+            for shape in self.ragged_shapes:
+                batch = build_ragged_batch(
+                    seqs, t_budget=shape,
+                    s_max=self.kv.num_slots + 1,
+                    pages_per_seq=self.kv.pages_per_seq,
+                    scratch_page=self.kv.scratch_page(0),
+                    pad_id=self.tokenizer.pad_id,
+                    page_size=self.kv.page_size)
+                for _ in range(2):
+                    nxt = self._ragged_dispatch(batch)
+                    np.asarray(nxt)  # force completion
+        self._release_warm_slots()
 
     def _release_warm_slots(self) -> None:
         """Release every __warmup_* slot so each warm batch re-acquires
@@ -943,6 +1074,107 @@ class InferenceEngine:
         self._prefill_step_paged = self._prefill_step_paged_gather
         self._decode_loop_paged = self._decode_loop_paged_gather
         return True
+
+    def _degrade_ragged(self, reason: str) -> bool:
+        """Route ragged dispatches off the Pallas kernel onto the XLA
+        fallback path, permanently for this engine — the same rung as
+        _degrade_paged_direct for a kernel that compile-checked clean
+        but fails on chip. Returns False when already on the fallback
+        (caller re-raises)."""
+        if self.ragged_path != "pallas_ragged":
+            return False
+        import warnings
+        warnings.warn(
+            f"ragged paged attention degraded to XLA fallback: {reason}",
+            stacklevel=3)
+        from ..utils import telemetry
+        telemetry.inc("roundtable_degradations_total",
+                      rung="ragged_xla")
+        telemetry.recorder().record(
+            "ladder_escalation", rung="ragged_xla",
+            engine=self.cfg.name, error=reason[:200])
+        self.ragged_path = "xla_ragged"
+        self.ragged_fallback_reason = f"degraded:{reason[:120]}"
+        return True
+
+    def _ragged_dispatch(self, batch: dict):
+        """One mixed prefill/decode dispatch over a flat token buffer
+        (serving_loop.build_ragged_batch output) — the scheduler's
+        chunk-interleaved admission seam. Runs the resolved ragged path
+        (Pallas kernel, or the XLA fallback with its recorded reason)
+        through the kernel-degradation rung, commits the donated pools
+        under commit_guard, and records per-dispatch provenance into
+        the engine's ragged sink (the int4_paths pattern). Returns the
+        per-sequence next-token DEVICE array [S_max]; the caller
+        host-reads it through its own watchdog seam."""
+        from .pallas import attention as pattn
+
+        def run(path):
+            if path == "pallas_ragged" and faults.ARMED:
+                faults.maybe_inject("mosaic_compile")
+            return self._ragged_step(
+                self.params, self.kv.pools,
+                jnp.asarray(batch["tables"]),
+                jnp.asarray(batch["tokens"]),
+                jnp.asarray(batch["positions"]),
+                jnp.asarray(batch["token_pages"]),
+                jnp.asarray(batch["token_offs"]),
+                jnp.asarray(batch["token_seq"]),
+                jnp.asarray(batch["seq_of_block"]),
+                jnp.asarray(batch["block_qstart"]),
+                jnp.asarray(batch["query_offsets"]),
+                jnp.asarray(batch["kv_valid"]),
+                jnp.asarray(batch["last_rows"]), self._next_key(),
+                jnp.asarray(batch["temps"]),
+                jnp.asarray(batch["top_ks"]),
+                jnp.asarray(batch["top_ps"]),
+                greedy=batch["greedy"],
+                attn_path=("kernel" if path == "pallas_ragged"
+                           else "xla"))
+
+        from . import compile_watch
+        with compile_watch.label(
+                f"ragged[t={len(batch['tokens'])}]",
+                engine=self.cfg.name):
+            try:
+                nxt, pools = run(self.ragged_path)
+            except Exception as e:
+                if not (faults.is_kernel_failure(e)
+                        and self._degrade_ragged(str(e))):
+                    raise
+                nxt, pools = run(self.ragged_path)
+        # A watchdog-abandoned dispatch completing late must NOT commit
+        # onto pools the recovery path may have revived.
+        with deadlines.commit_guard():
+            self.kv.pools = pools
+        path = self.ragged_path
+        self._ragged_dispatches[path] = \
+            self._ragged_dispatches.get(path, 0) + 1
+        entry = {"path": path, "tokens": int(batch["n_tokens"]),
+                 "seqs": int(batch["n_seqs"])}
+        if path != "pallas_ragged":
+            entry["fallback_reason"] = (self.ragged_fallback_reason
+                                        or "unknown")
+        self._ragged_recent.append(entry)
+        pattn.note_ragged_dispatch(kernel=path == "pallas_ragged")
+        return nxt
+
+    def ragged_describe(self) -> dict[str, Any]:
+        """Ragged-path provenance (ISSUE 8): the resolved path, why the
+        seam or the kernel declined, the per-dispatch counts and the
+        recent-dispatch ring — embedded in describe() and bench
+        records the way int4_paths is."""
+        return {
+            "enabled": self.ragged_enabled,
+            "path": self.ragged_path,
+            "reason": self.ragged_reason,
+            "fallback_reason": self.ragged_fallback_reason,
+            "tokens_budget": self.ragged_tokens,
+            "shapes": list(self.ragged_shapes),
+            "defer_min_tokens": self.ragged_defer_min,
+            "dispatches": dict(self._ragged_dispatches),
+            "recent": list(self._ragged_recent)[-8:],
+        }
 
     def chars_per_token(self) -> float:
         if self._chars_per_token is None:
@@ -1105,8 +1337,8 @@ class InferenceEngine:
     def _share_prefixes(self, names: list[str], slot_ids: list[int],
                         all_tokens: list[list[int]], offsets: list[int],
                         deadline: float, budget=None,
-                        extra_pinned: tuple[str, ...] = ()
-                        ) -> tuple[list[int], int]:
+                        extra_pinned: tuple[str, ...] = (),
+                        defer_span=None) -> tuple[list[int], int]:
         """Cross-knight shared-prefix reuse (SURVEY.md §7.3 hard part 2;
         reference prompt assembly src/orchestrator.ts:397-425 makes all
         knights share the giant context+transcript preamble, which the
@@ -1170,11 +1402,12 @@ class InferenceEngine:
             self.kv, names, all_tokens, offsets,
             min_shared=MIN_SHARED_PREFIX, add_share=add_share,
             flush_shares=flush_shares, prefill_span=prefill_span,
-            extra_pinned=extra_pinned)
+            extra_pinned=extra_pinned, defer_span=defer_span)
 
     def _prepare_batch(self, turns, max_new_padded, deadline, pre_budget,
                        sampling_per_turn=None,
-                       extra_pinned: tuple[str, ...] = ()) -> dict:
+                       extra_pinned: tuple[str, ...] = (),
+                       defer_prefill: bool = False) -> dict:
         """The pre-decode phase, ONE definition shared by
         generate_batch and the session scheduler's admission
         (engine/scheduler.py) so the two can never drift on token
@@ -1188,7 +1421,15 @@ class InferenceEngine:
         (post-share), plan, tables_np (plan-padded when plan is set),
         per_row, temps/top_ks/top_ps (plan-scattered), greedy,
         first_np (ORIGINAL row order), prefill_tokens, reused_tokens.
-        """
+
+        `defer_prefill` (ISSUE 8, the mixed-dispatch seam): stop after
+        the host/aliasing work — everything above EXCEPT the chunked
+        prefill and first-token sample. The per-row suffixes
+        (all_tokens[i][offsets[i]:]) stay unprefilled; the scheduler
+        feeds them through ragged mixed dispatches interleaved with the
+        live decode segment instead of this blocking prologue
+        (first_np is None in the returned dict). Paged, replica-free
+        engines only — the flat buffer cannot mix pool replicas."""
         pinned = tuple(name for name, _ in turns) + tuple(extra_pinned)
         if self.kv_offload is not None:
             # A spilled session resumes HERE, before reuse_plan acquires
@@ -1225,20 +1466,49 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             prefix_reused = self.prefix_cache.attach_rows(
                 names, all_tokens, offsets, pinned)
+        if defer_prefill:
+            # Deferral pays off only for COLD prefills: after own-slot
+            # reuse and the prefix-cache attach, a warm join's leftover
+            # is often a few dozen tokens — one tiny bucket dispatch,
+            # cheaper blocking than spread across segment-gated ragged
+            # ticks. Resolve the mode HERE (callers read first_np is
+            # None); the share passes below then defer (or not) with it.
+            est = sum(len(t) - o for t, o in zip(all_tokens, offsets))
+            if est < self.ragged_defer_min:
+                defer_prefill = False
         # Cross-knight shared-prefix reuse raises offsets by copying (or,
         # paged, aliasing) other slots' K/V; only the per-knight deltas
-        # remain to prefill.
+        # remain to prefill. Under defer_prefill the LEADER pass defers
+        # too (ISSUE 8 — it was the last blocking prologue dispatch):
+        # the span is recorded here and the scheduler aliases the
+        # laggards once the leader's ragged chunks have written it.
+        share_plan: list[dict] = []
+        defer_span = None
+        if defer_prefill:
+            def defer_span(m, lo, hi, followers):  # noqa: F811
+                share_plan.append({"leader": m, "lo": lo, "hi": hi,
+                                   "followers": followers})
         offsets, leader_prefill = self._share_prefixes(
             names, slot_ids, all_tokens, offsets, deadline,
-            budget=pre_budget, extra_pinned=tuple(extra_pinned))
+            budget=pre_budget, extra_pinned=tuple(extra_pinned),
+            defer_span=defer_span)
         plan = None
         tables_np = None
         if self.kv_layout == "paged":
             # Allocate pages for the whole call (prompt + padded decode)
             # and copy-on-write any shared page in the write range, so
             # the jit'd programs below never allocate or touch aliased
-            # pages.
+            # pages. Deferred-share LAGGARDS skip this: their span pages
+            # arrive by ALIAS once the leader's chunks write them —
+            # allocating exclusive pages now would transiently demand
+            # more pool than the prologue path ever did (the alias
+            # would immediately replace them), and their tail capacity
+            # is ensured at alias time (scheduler._apply_share_plans).
+            deferred_followers = {i for p in share_plan
+                                  for i, _lo in p["followers"]}
             for i, name in enumerate(names):
+                if i in deferred_followers:
+                    continue
                 self.kv.ensure_capacity(
                     name, len(all_tokens[i]) + max_new_padded,
                     write_from=offsets[i], pinned=pinned)
@@ -1258,6 +1528,28 @@ class InferenceEngine:
         prefill_tokens = leader_prefill + sum(len(s) for s in suffixes)
         # "reused" counts both own-slot LCP hits and copied donor spans.
         reused_tokens = sum(len(t) for t in all_tokens) - prefill_tokens
+        if defer_prefill:
+            if plan is not None:
+                raise RuntimeError(
+                    "defer_prefill requires a replica-free paged pool "
+                    "(the ragged flat buffer cannot mix pool replicas)")
+            per_row = sampling_per_turn or [self.sampling] * len(turns)
+            if len(per_row) != len(turns):
+                raise ValueError(
+                    f"sampling_per_turn has {len(per_row)} entries for "
+                    f"{len(turns)} turns")
+            return {
+                "names": names, "slot_ids": slot_ids,
+                "all_tokens": all_tokens, "offsets": offsets,
+                "plan": None, "tables_np": tables_np,
+                "per_row": per_row, "temps": None, "top_ks": None,
+                "top_ps": None,
+                "greedy": all(p.temperature <= 0.0 for p in per_row),
+                "first_np": None, "prefill_tokens": prefill_tokens,
+                "reused_tokens": reused_tokens,
+                "prefix_reused_tokens": prefix_reused,
+                "share_plan": share_plan,
+            }
         p_offsets = offsets
         if plan is not None:
             suffixes = plan.scatter_list(suffixes,
@@ -1567,6 +1859,8 @@ class InferenceEngine:
                 info["prefix_cache"] = self.prefix_cache.describe()
             if self.kv_offload is not None:
                 info["kv_offload"] = self.kv_offload.describe()
+            # ISSUE 8: ragged mixed-dispatch path provenance.
+            info["ragged"] = self.ragged_describe()
         # Continuous-batching scheduler provenance (ISSUE 4): attached by
         # engine/scheduler.SessionScheduler — admit/queue/refuse counts,
         # queue depth, per-segment batch occupancy.

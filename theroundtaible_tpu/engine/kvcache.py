@@ -222,8 +222,8 @@ class SlotBook:
 
 def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
                    add_share, flush_shares, prefill_span,
-                   extra_pinned: tuple[str, ...] = ()
-                   ) -> tuple[list[int], int]:
+                   extra_pinned: tuple[str, ...] = (),
+                   defer_span=None) -> tuple[list[int], int]:
     """Two-pass cross-knight shared-prefix reuse — THE algorithm, used by
     both serving engines so the donor cap, batch-common-prefix fold,
     l_shared clamp, laggard threshold and extra_prefill accounting cannot
@@ -246,6 +246,18 @@ def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
     `extra_pinned`: slot names OUTSIDE this batch that must survive any
     eviction the passes trigger — the session scheduler pins every
     actively-decoding row while a joining batch runs its passes.
+
+    `defer_span(m, lo, hi, followers)` (ISSUE 8, ragged admission):
+    when given and the leader's cache does NOT yet cover the common
+    span, the leader pass DISPATCHES NOTHING — the leader's offset
+    stays at its own coverage (its span joins the live decode segment
+    as ragged chunks), the laggards' offsets still raise to the span
+    end, and the callback records (leader index, leader coverage, span
+    end, [(laggard, its pre-raise coverage), ...]) so the caller can
+    alias the laggards AFTER the leader's chunks have written the span
+    (aliasing unwritten pages would be copy-on-write'd away by the
+    leader's own write-exclusivity). A leader that already covers the
+    span aliases immediately — the content exists.
 
     Returns (updated offsets, leader-prefilled token count)."""
     b = len(names)
@@ -274,6 +286,12 @@ def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
     if not laggards:
         return offsets, extra_prefill
     if offsets[m] < l_shared:
+        if defer_span is not None:
+            defer_span(m, offsets[m], l_shared,
+                       [(i, offsets[i]) for i in laggards])
+            for i in laggards:
+                offsets[i] = l_shared
+            return offsets, extra_prefill
         prefill_span(m, offsets[m], l_shared)
         extra_prefill += l_shared - offsets[m]
         offsets[m] = l_shared

@@ -39,9 +39,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .models.common import (ModelConfig, Params, _einsum, _softcap,
-                            current_spmd_mesh, embed_tokens, gather_rows,
-                            project_qkv, rms_norm, transformer_block)
+from .models.common import (MASK_VALUE, ModelConfig, Params, _einsum,
+                            _softcap, current_spmd_mesh, embed_tokens,
+                            gather_rows, project_qkv, rms_norm,
+                            transformer_block)
 from .pallas import attention as pattn
 
 
@@ -138,3 +139,120 @@ def forward_paged(
     logits = _einsum("bte,ve->btv", x, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
     return logits, new_pools
+
+
+# --- ragged mixed prefill/decode forward (ISSUE 8) ---
+
+
+def _ragged_xla_attention(q, k_pool, v_pool, tables, token_seq,
+                          positions, kv_valid, cfg: ModelConfig):
+    """XLA fallback for the ragged kernel: per-token dense attention
+    against each token's sequence slice of the gather view. Memory-
+    heavy ([T, L, K, D] — the gather view's budget times the buffer's
+    sequence fan-in) and FLOP-dense where the kernel would skip beyond
+    the frontier: this is the recorded degrade path for pools the
+    kernel declines (head_dim, page_size, VMEM), never the serving
+    default. q [T, H, D] → [T, H, D]."""
+    t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    s, pp = tables.shape
+    length = pp * page_size
+    kg = k_pool[tables].reshape(s, length, kh, d)
+    vg = v_pool[tables].reshape(s, length, kh, d)
+    kt = kg[token_seq]                                # [T, L, K, D]
+    vt = vg[token_seq]
+    if cfg.kv_repeat > 1:
+        kt = jnp.repeat(kt, cfg.kv_repeat, axis=2)    # [T, L, H, D]
+        vt = jnp.repeat(vt, cfg.kv_repeat, axis=2)
+    logits = jnp.einsum("thd,tlhd->thl", q, kt,
+                        preferred_element_type=jnp.float32)
+    logits = _softcap(logits, cfg.attn_logit_softcap)
+    l_pos = jnp.arange(length)[None, :]
+    mask = (l_pos <= positions[:, None]) \
+        & (l_pos < kv_valid[token_seq][:, None])
+    if cfg.sliding_window is not None:
+        mask &= l_pos > positions[:, None] - cfg.sliding_window
+    logits = jnp.where(mask[:, None, :], logits, MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("thl,tlhd->thd", probs, vt).astype(q.dtype)
+
+
+def forward_ragged(
+    params: Params, cfg: ModelConfig,
+    tokens: jax.Array,            # [T] flat token buffer
+    positions: jax.Array,         # [T] absolute positions
+    pools: list,                  # per-layer (k_pool, v_pool) [P,ps,K,D]
+    tables: jax.Array,            # [S, pages_per_seq] int32
+    seq_of_block: jax.Array,      # [T/8] sequence id per q block
+    block_qstart: jax.Array,      # [T/8] block start row within its seq
+    query_offsets: jax.Array,     # [S] absolute position of seq's row 0
+    kv_valid: jax.Array,          # [S] valid entries AFTER this call
+    token_pages: jax.Array,       # [T] pool page per token (pads→scratch)
+    token_offs: jax.Array,        # [T] in-page offset per token
+    token_seq: jax.Array,         # [T] owning sequence per token
+    last_rows: jax.Array,         # [S] flat row of each seq's last token
+    attn_path: str = "kernel",    # "kernel" | "xla" (static)
+) -> tuple[jax.Array, list]:
+    """One MIXED prefill/decode step over the flat token buffer
+    (serving_loop.build_ragged_batch layout): every sequence's chunk or
+    decode token runs in the SAME dispatch — the admission prologue's
+    replacement. Each layer scatters the buffer's K/V into the owning
+    sequences' pages (pads land on the scratch page, never read), then
+    attends through the ragged page-table kernel — or, with
+    attn_path="xla", the dense per-token fallback the engine records a
+    fallback_reason for. Returns (per-sequence last-token logits
+    [S, V], new_pools); pad sequence rows carry garbage the caller
+    drops. Block wiring comes from transformer_block's attn_fn hook,
+    exactly like forward_paged."""
+    x = embed_tokens(params["embedding"], tokens[None])     # [1, T, E]
+    if cfg.scale_embeddings:
+        x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
+    pos2 = positions[None]
+
+    new_pools = []
+    for layer, (k_pool, v_pool) in zip(params["layers"], pools):
+        def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool):
+            q, k, v = project_qkv(h, layer, cfg, pos2)      # [1,T,H,D]
+            k_pool2 = k_pool.at[token_pages, token_offs].set(k[0])
+            v_pool2 = v_pool.at[token_pages, token_offs].set(v[0])
+            if attn_path == "kernel":
+                mesh = current_spmd_mesh()
+                if mesh is not None and mesh.size > 1:
+                    out = pattn.ragged_paged_spmd(
+                        mesh, q[0], k_pool2, v_pool2, tables,
+                        seq_of_block, block_qstart, query_offsets,
+                        kv_valid, sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
+                    if out is None:
+                        # The engine gates ragged_path on
+                        # partitionability at build time — reaching
+                        # here is direct misuse, fail loudly.
+                        raise ValueError(
+                            "ragged kernel cannot partition this head "
+                            "layout — engine should have resolved "
+                            "attn_path='xla'")
+                else:
+                    out = pattn.ragged_paged_attention(
+                        q[0], k_pool2, v_pool2, tables, seq_of_block,
+                        block_qstart, query_offsets, kv_valid,
+                        sliding_window=cfg.sliding_window,
+                        softcap=cfg.attn_logit_softcap)
+            else:
+                out = _ragged_xla_attention(
+                    q[0], k_pool2, v_pool2, tables, token_seq,
+                    positions, kv_valid, cfg)
+            out = _einsum("bthd,hde->bte", out[None], layer["o_proj"],
+                          tp="row").astype(h.dtype)
+            return out, (k_pool2, v_pool2)
+
+        x, new_pool = transformer_block(
+            x, layer, cfg, pos2, None, None, None, attn_fn=attn_fn)
+        new_pools.append(new_pool)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps,
+                 cfg.rmsnorm_unit_offset)
+    sel = x[0, last_rows][None]                             # [1, S, E]
+    head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
+    logits = _einsum("bte,ve->btv", sel, head, tp="col")
+    logits = _softcap(logits, cfg.final_logit_softcap)
+    return logits[0], new_pools

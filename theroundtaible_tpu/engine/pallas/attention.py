@@ -867,6 +867,291 @@ def paged_decode_attention(
     return out.reshape(b, 1, h, d)
 
 
+# --- ragged paged attention (ISSUE 8) ---
+#
+# Mixed prefill chunks and decode tokens in ONE dispatch (arxiv
+# 2604.15464 "Ragged Paged Attention"): the query is a FLAT token buffer
+# [T, H, D] carved into per-sequence row runs, each sequence attending
+# its own page-table pages. The flat axis is blocked at RAGGED_BLOCK_Q=8
+# — the MXU sublane minimum, so a decode token (a 1-row sequence)
+# occupies exactly one hardware tile — and the host builder
+# (serving_loop.build_ragged_batch) aligns every sequence's run to that
+# granularity. Scalar-prefetched per-BLOCK metadata maps each q block to
+# its sequence, so the kv index map walks that sequence's pages only:
+# one compiled program serves every prefill/decode mix of a fixed token
+# budget, which is what retires the scheduler's pow2 row buckets on this
+# path.
+#
+# RAGGED_BLOCK_Q has ONE owner (serving_loop): the host builder aligns
+# runs and sizes seq_of_block/block_qstart with it, and the kernel grid
+# + VMEM estimate here must agree — two definitions would let a lone
+# tuning change silently mis-map blocks to sequences.
+from ..serving_loop import RAGGED_BLOCK_Q  # noqa: E402
+
+# Test-visibility counters (tests/conftest.py `ragged_attn` marker
+# guard): how many ragged dispatches the engine seam issued since the
+# last reset, split kernel vs XLA fallback. A guard that sees zero
+# kernel dispatches on a marked test knows the ragged path silently fell
+# back (or never ran). The kernel wrapper also counts its own traces so
+# direct-kernel unit tests register without an engine.
+import threading as _threading
+
+_ragged_lock = _threading.Lock()
+_ragged_kernel_count = 0
+_ragged_fallback_count = 0
+
+
+def reset_ragged_counters() -> None:
+    global _ragged_kernel_count, _ragged_fallback_count
+    with _ragged_lock:
+        _ragged_kernel_count = 0
+        _ragged_fallback_count = 0
+
+
+def note_ragged_dispatch(kernel: bool) -> None:
+    global _ragged_kernel_count, _ragged_fallback_count
+    with _ragged_lock:
+        if kernel:
+            _ragged_kernel_count += 1
+        else:
+            _ragged_fallback_count += 1
+
+
+def ragged_kernel_dispatches() -> int:
+    return _ragged_kernel_count
+
+
+def ragged_fallback_dispatches() -> int:
+    return _ragged_fallback_count
+
+
+def ragged_decline_reason(page_size: int, d: int, kh: int = 1,
+                          group: int = 1) -> Optional[str]:
+    """Why the ragged kernel cannot serve this pool shape, or None when
+    it can — the machine-readable `fallback_reason` the engine records
+    per dispatch (the int4mm plan_reason pattern). Pass the LOCAL
+    kv-head count under SPMD."""
+    if page_size not in (512, 256, 128, 64, 32, 16, 8):
+        return f"page_size:{page_size}"
+    if _paged_vmem_est(page_size, d, kh, group,
+                       RAGGED_BLOCK_Q) > _VMEM_BUDGET:
+        return f"vmem:ps={page_size},d={d},kh={kh},g={group}"
+    if not _interpret() and d % 128 != 0:
+        return f"head_dim:{d}"
+    return None
+
+
+def ragged_supported(page_size: int, d: int, kh: int = 1,
+                     group: int = 1) -> bool:
+    return ragged_decline_reason(page_size, d, kh, group) is None
+
+
+def _ragged_kernel(table_ref, blkseq_ref, blkq_ref, qoffs_ref, valid_ref,
+                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   page_size: int, num_page_blocks: int, kh: int,
+                   group: int, sliding_window: Optional[int],
+                   softcap: Optional[float]):
+    # Grid (q_blocks, pages_per_seq). Identical online-softmax math to
+    # _paged_prefill_kernel (shared _prefill_accumulate, all kv heads on
+    # one pool block with a static head loop — see _paged_decode_kernel
+    # for why per-head pool blocks are Mosaic-illegal); the ragged
+    # difference is WHICH sequence a q block serves: blkseq_ref maps the
+    # flat-buffer block to its sequence, whose page table / causal
+    # frontier then drive the kv index map exactly like the batched
+    # kernels' row index. Rows past a sequence's real length are pad
+    # rows: they attend the sequence's valid prefix (finite garbage —
+    # MASK_VALUE is a large finite negative, so even an all-masked row
+    # exponentiates to finite junk) and the host drops their outputs.
+    qb = pl.program_id(0)
+    sb = pl.program_id(1)
+
+    @pl.when(sb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq = blkseq_ref[qb]
+    q_start = qoffs_ref[seq] + blkq_ref[qb]
+    valid = valid_ref[seq]
+    lo, hi = _prefill_blk_bounds(q_start, valid, RAGGED_BLOCK_Q,
+                                 page_size, sliding_window)
+
+    @pl.when((sb >= lo) & (sb <= hi))
+    def _compute():
+        for khi in range(kh):
+            m_scr[khi], l_scr[khi], acc_scr[khi] = _prefill_accumulate(
+                q_ref[khi].reshape(group * RAGGED_BLOCK_Q, -1),
+                k_ref[0, :, khi, :], v_ref[0, :, khi, :], q_start,
+                sb * page_size, valid,
+                (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
+                block_q=RAGGED_BLOCK_Q, block_kv=page_size,
+                sliding_window=sliding_window, softcap=softcap)
+
+    @pl.when(sb == num_page_blocks - 1)
+    def _finish():
+        d = o_ref.shape[-1]
+        for khi in range(kh):
+            l = jnp.maximum(l_scr[khi, :, :1], 1e-30)
+            o_ref[khi] = (acc_scr[khi] / l).astype(o_ref.dtype) \
+                .reshape(group, RAGGED_BLOCK_Q, d)
+
+
+def ragged_paged_attention(
+    q: jax.Array,                 # [T, H, D] flat token buffer
+    k_pool: jax.Array,            # [P, page_size, K, D] page pool
+    v_pool: jax.Array,            # [P, page_size, K, D]
+    tables: jax.Array,            # [S, pages_per_seq] int32 page tables
+    seq_of_block: jax.Array,      # [T/8] sequence id of each q block
+    block_qstart: jax.Array,      # [T/8] block start row WITHIN its seq
+    query_offsets: jax.Array,     # [S] absolute position of seq's row 0
+    kv_valid: jax.Array,          # [S] valid kv entries AFTER this call
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Mixed prefill/decode attention over a flat token buffer, straight
+    off the page pool.
+
+    The flat buffer holds each sequence's query tokens as a contiguous
+    run aligned to RAGGED_BLOCK_Q rows (the host builder pads runs with
+    inert rows); row j of sequence s has absolute position
+    query_offsets[s] + (row within the run), causal within the segment.
+    The caller must have scattered every real token's K/V into its
+    sequence's frontier pages already (engine/paged_forward.py). One
+    compiled shape serves every prefill/decode composition of the same
+    T — the no-recompile property the scheduler's ragged segments rely
+    on. Returns [T, H, D] in q's dtype; pad-row outputs are garbage and
+    must be dropped by the caller.
+    """
+    t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    group = h // kh
+    pages_per_seq = tables.shape[1]
+    if t % RAGGED_BLOCK_Q:
+        raise ValueError(
+            f"flat buffer T={t} must be a multiple of {RAGGED_BLOCK_Q}")
+    reason = ragged_decline_reason(page_size, d, kh, group)
+    if reason is not None:
+        raise ValueError(f"unsupported ragged shape: {reason}")
+    interpret = _interpret() if interpret is None else interpret
+    # Wrapper-level count (trace time under jit, per call eagerly):
+    # lets direct-kernel unit tests satisfy the ragged_attn guard; the
+    # engine seam's per-dispatch count is the exact provenance.
+    note_ragged_dispatch(kernel=True)
+
+    # [T, H, D] → [K, G, T, D]: q heads grouped by their kv head, flat
+    # token axis blocked at RAGGED_BLOCK_Q.
+    qt = q.reshape(t, kh, group, d).transpose(1, 2, 0, 3)
+    num_blocks = t // RAGGED_BLOCK_Q
+
+    def kv_index(qb, sb, table_ref, blkseq_ref, blkq_ref, qoffs_ref,
+                 valid_ref):
+        seq = blkseq_ref[qb]
+        q_start = qoffs_ref[seq] + blkq_ref[qb]
+        lo_blk, hi_blk = _prefill_blk_bounds(
+            q_start, valid_ref[seq], RAGGED_BLOCK_Q, page_size,
+            sliding_window)
+        sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
+        return (table_ref[seq, sb], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(num_blocks, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((kh, group, RAGGED_BLOCK_Q, d),
+                         lambda qb, sb, t_, b_, s_, o_, v_:
+                         (0, 0, qb, 0)),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
+            pl.BlockSpec((1, page_size, kh, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (kh, group, RAGGED_BLOCK_Q, d),
+            lambda qb, sb, t_, b_, s_, o_, v_: (0, 0, qb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kh, group * RAGGED_BLOCK_Q, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group * RAGGED_BLOCK_Q, _LANES), jnp.float32),
+            pltpu.VMEM((kh, group * RAGGED_BLOCK_Q, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel, page_size=page_size,
+        num_page_blocks=pages_per_seq, kh=kh, group=group,
+        sliding_window=sliding_window, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), seq_of_block.astype(jnp.int32),
+      block_qstart.astype(jnp.int32), query_offsets.astype(jnp.int32),
+      kv_valid.astype(jnp.int32), qt, k_pool, v_pool)
+    return out.transpose(2, 0, 1, 3).reshape(t, h, d)
+
+
+def ragged_paged_spmd(
+    mesh,
+    q: jax.Array,                 # [T, H, D] flat token buffer
+    k_pool: jax.Array, v_pool: jax.Array,
+    tables: jax.Array, seq_of_block: jax.Array,
+    block_qstart: jax.Array, query_offsets: jax.Array,
+    kv_valid: jax.Array,
+    *,
+    sliding_window: Optional[int] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> Optional[jax.Array]:
+    """ragged_paged_attention under a model-axis mesh via shard_map —
+    the flash_attention_spmd head-sharding pattern: kv heads ride
+    "model" (matching the pool's sharding), q heads follow their kv
+    head, and the flat token buffer plus every metadata array stays
+    replicated (attention is embarrassingly parallel over kv heads, so
+    the body needs no collectives). Returns None when the head layout
+    doesn't partition, or when the mesh has a data axis — the pool's
+    page axis shards over "data" on those meshes and a flat buffer
+    mixing replicas' rows cannot (the engine then serves the prologue
+    path and records the reason)."""
+    from ..compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    t, h, d = q.shape
+    page_size, kh = k_pool.shape[1], k_pool.shape[2]
+    axes = dict(mesh.shape)
+    if axes.get("data", 1) > 1:
+        return None
+    n_model = axes.get("model", 1)
+    if not spmd_partitionable(h, kh, n_model):
+        return None
+    kv_head_ax = "model" if n_model > 1 and kh % n_model == 0 else None
+    head_ax = "model" if n_model > 1 else None
+    kh_local = kh // n_model if kv_head_ax else kh
+    if not ragged_supported(page_size, d, kh_local, h // kh):
+        return None
+
+    q_spec = P(None, head_ax, None)
+    pool_spec = P(None, None, kv_head_ax, None)
+    meta2 = P(None, None)
+    meta1 = P(None)
+
+    def body(ql, kp, vp, tl, bl, bq, qo, vl):
+        return ragged_paged_attention(
+            ql, kp, vp, tl, bl, bq, qo, vl,
+            sliding_window=sliding_window, softcap=softcap,
+            interpret=interpret)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(q_spec, pool_spec, pool_spec, meta2,
+                             meta1, meta1, meta1, meta1),
+                   out_specs=q_spec, axis_names=_manual_axes(mesh),
+                   check_vma=False)
+    return fn(q, k_pool, v_pool, tables.astype(jnp.int32),
+              seq_of_block.astype(jnp.int32),
+              block_qstart.astype(jnp.int32),
+              query_offsets.astype(jnp.int32),
+              kv_valid.astype(jnp.int32))
+
+
 def ragged_decode_attention(
     q: jax.Array,                 # [B, 1, H, D] this step's query
     k: jax.Array,                 # [B, S, K, D] cache incl. this step's K

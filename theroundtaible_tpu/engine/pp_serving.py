@@ -1076,30 +1076,27 @@ class PPEngine:
             min_shared=MIN_SHARED_PREFIX, add_share=add_share,
             flush_shares=flush_shares, prefill_span=prefill_span)
 
-    def _generate_locked(self, turns, max_new_tokens, timeout_s,
-                         sampling_per_turn=None, budget=None):
-        stats = GenStats()
-        # Turn budget node (engine/deadlines.py) — same rung structure
-        # as the main engine; the float deadline feeds the legacy
-        # checks. (`budget` is re-bound below for the prompt-token
-        # budget — the Budget node keeps its own name.)
-        turn_budget = budget if budget is not None \
-            else deadlines.Budget.root(timeout_s, rung="turn")
-        deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
-        pre_budget = turn_budget.child("prefill")
-        from .serving_loop import clamp_max_new
-        max_new, max_new_padded = clamp_max_new(
-            max_new_tokens or self.sampling.max_new_tokens,
-            self.max_seq_len)
-
+    def _prepare_batch(self, turns, max_new_padded, deadline, pre_budget,
+                       stats) -> dict:
+        """The PP pre-PREFILL phase — tokenize + tail-truncate →
+        own-slot reuse_plan → prefix-cache attach → cross-knight
+        share_prefixes → paged capacity/COW + tables/gather-view — as
+        ONE seam mirroring InferenceEngine._prepare_batch's
+        defer_prefill contract (ISSUE 8, the mixed-dispatch seam): the
+        returned suffixes (all_tokens[i][offsets[i]:]) are NOT yet
+        prefilled, so a caller can feed them through a mixed dispatch
+        instead of the blocking prologue. _generate_locked is today's
+        only consumer (PP's stage-pipelined programs have no ragged
+        program yet) and runs the chunked prologue over the same dict."""
         pinned = tuple(name for name, _ in turns)
         slot_ids, offsets, all_tokens = [], [], []
         for name, prompt in turns:
             tokens = (list(prompt) if isinstance(prompt, list)
                       else self.tokenizer.encode(prompt))
-            budget = prompt_budget(self.max_seq_len, max_new_padded)
-            if len(tokens) > budget:
-                tokens = tokens[:1] + tokens[len(tokens) - budget + 1:]
+            budget_tok = prompt_budget(self.max_seq_len, max_new_padded)
+            if len(tokens) > budget_tok:
+                tokens = (tokens[:1]
+                          + tokens[len(tokens) - budget_tok + 1:])
             slot_id, reuse = self.kv.reuse_plan(name, tokens, pinned)
             slot_ids.append(slot_id)
             offsets.append(reuse)
@@ -1143,6 +1140,33 @@ class PPEngine:
                                                      tables)
                 gathered = True
             slot_ids = list(range(len(turns)))
+        return {"pinned": pinned, "slot_ids": slot_ids,
+                "offsets": offsets, "all_tokens": all_tokens,
+                "tables": tables, "gathered": gathered}
+
+    def _generate_locked(self, turns, max_new_tokens, timeout_s,
+                         sampling_per_turn=None, budget=None):
+        stats = GenStats()
+        # Turn budget node (engine/deadlines.py) — same rung structure
+        # as the main engine; the float deadline feeds the legacy
+        # checks.
+        turn_budget = budget if budget is not None \
+            else deadlines.Budget.root(timeout_s, rung="turn")
+        deadline = min(turn_budget.deadline, time.monotonic() + timeout_s)
+        pre_budget = turn_budget.child("prefill")
+        from .serving_loop import clamp_max_new
+        max_new, max_new_padded = clamp_max_new(
+            max_new_tokens or self.sampling.max_new_tokens,
+            self.max_seq_len)
+
+        prep = self._prepare_batch(turns, max_new_padded, deadline,
+                                   pre_budget, stats)
+        pinned = prep["pinned"]
+        slot_ids = prep["slot_ids"]
+        offsets = prep["offsets"]
+        all_tokens = prep["all_tokens"]
+        tables = prep["tables"]
+        gathered = prep["gathered"]
 
         try:
             # Chunked bucketed prefill (shared serving_loop host loop

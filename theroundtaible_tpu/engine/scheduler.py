@@ -78,7 +78,8 @@ from ..utils import telemetry
 from . import deadlines, faults, trace_hooks
 from .kvcache import scoped_slot
 from .sampling import SamplingParams, sampling_arrays
-from .serving_loop import (DECODE_SEGMENT, ReplicaGroupPlan,
+from .serving_loop import (DECODE_SEGMENT, RAGGED_BLOCK_Q, RaggedSeq,
+                           ReplicaGroupPlan, build_ragged_batch,
                            clamp_max_new, eos_trim, host_sync,
                            pow2_bucket, prompt_budget, run_dispatch)
 
@@ -163,6 +164,17 @@ class _Row:
     last: int = 0
     valid: int = 0
     done: bool = False
+    # Ragged chunk-interleaved admission (ISSUE 8): prompt tokens not
+    # yet prefilled — fed as chunks of the live decode segment's ragged
+    # dispatches; `pos` is the next write position. A row with pending
+    # tokens is FILLING, never dispatched for decode; its first sampled
+    # token arrives with the dispatch consuming its last chunk. A
+    # `blocked` filling row is a deferred-share LAGGARD: its chunks wait
+    # until the round's leader has written the common span, at which
+    # point the span aliases in and the row unblocks (_apply_share_plans).
+    pending: list[int] = field(default_factory=list)
+    pos: int = 0
+    blocked: bool = False
 
 
 class _Request:
@@ -173,7 +185,8 @@ class _Request:
                  "enqueued", "admitted_at", "rows", "stats", "deadline",
                  "turn_budget", "dec_budget", "abandoned", "seg_count",
                  "occ_sum", "occ_max", "sess_max", "requeues",
-                 "fits_below", "tele_ctx", "tele")
+                 "fits_below", "tele_ctx", "tele", "first_token_at",
+                 "share_plans")
 
     def __init__(self, session, turns, sampling_per_turn, max_new,
                  timeout_s, budget, stats):
@@ -200,6 +213,15 @@ class _Request:
         self.sess_max = 0
         self.requeues = 0        # admissions undone on pool exhaustion
         self.fits_below = None   # re-admit only once active rows < this
+        # TTFT (ISSUE 8): when the LAST of this request's rows got its
+        # first sampled token — the moment every knight of the round
+        # has tokens flowing. sched stats report it against `enqueued`.
+        self.first_token_at: Optional[float] = None
+        # Deferred leader-span share plans (ragged admission): the
+        # laggards alias the common span once the leader's chunks have
+        # written it. [{"leader": _Row, "hi": int,
+        # "followers": [(_Row, lo), ...]}]
+        self.share_plans: list[dict] = []
         # Telemetry (ISSUE 5): the submitter thread's span context, so
         # this request's "turn" span parents into ITS discussion trace
         # even though the scheduler thread emits it; `tele` is that
@@ -267,6 +289,15 @@ class SessionScheduler:
         self.segments = 0
         self.max_occupancy = 0
         self.queued_peak = 0
+        # Ragged chunk-interleaved admission provenance (ISSUE 8):
+        # mixed dispatches issued, joins that prefilled through them,
+        # and the per-phase token split of every segment (ragged AND
+        # while-loop) — bumped in lockstep with their registry series
+        # like every other counter here.
+        self.ragged_segments = 0
+        self.ragged_joins = 0
+        self.segment_prefill_tokens = 0
+        self.segment_decode_tokens = 0
         self._occupancy: deque[int] = deque(maxlen=_OCCUPANCY_LOG_CAP)
         self._events: deque[dict] = deque(maxlen=_EVENT_LOG_CAP)
         # Registry label for this scheduler's series (ISSUE 5): every
@@ -468,6 +499,10 @@ class SessionScheduler:
             "rejected_other": self.rejected_other,
             "preemptions": self.preemptions,
             "segments": self.segments,
+            "ragged_segments": self.ragged_segments,
+            "ragged_joins": self.ragged_joins,
+            "segment_prefill_tokens": self.segment_prefill_tokens,
+            "segment_decode_tokens": self.segment_decode_tokens,
             "queued": len(self._queue),
             "queued_peak": self.queued_peak,
             "active_rows": len(self._active),
@@ -605,8 +640,18 @@ class SessionScheduler:
         self._prune_last_active()
         self._spill_idle_by_age()
         self._admit_queued()
-        live = [r for r in self._active if not r.done]
-        if live:
+        live = [r for r in self._active
+                if not r.done and not r.pending]
+        filling = [r for r in self._active
+                   if not r.done and r.pending]
+        if filling:
+            # Chunk-interleaved admission (ISSUE 8): while any row is
+            # still prefilling, segments are RAGGED mixed dispatches —
+            # every live row decodes one token while the filling rows'
+            # chunks ride the same program. Steady state (no filling
+            # rows) keeps the pipelined while-loop segments.
+            self._run_ragged_segment(live, filling)
+        elif live:
             self._run_segment(live)
         self._retire_finished()
         self._check_request_health()
@@ -897,9 +942,29 @@ class SessionScheduler:
         active_names = tuple(r.name for r in self._active)
         scoped_turns = [(scoped_slot(req.session, n), p)
                         for n, p in req.turns]
+        # Chunk-interleaved admission (ISSUE 8): with live rows decoding
+        # and the engine's ragged path on, the prologue's chunked
+        # prefill is DEFERRED — admission does only the host/aliasing
+        # work, and the suffixes join the live decode segment as ragged
+        # chunks. An empty batch keeps the prologue (there is no decode
+        # to interleave with, and the bucketed chunks are bigger).
+        # ROUNDTABLE_RAGGED_ATTN=0 restores the prologue unconditionally.
+        # Defer only onto the KERNEL path: an engine whose pool the
+        # kernel declined at build time (xla_ragged — the memory-heavy
+        # dense fallback, "never the serving default") keeps the
+        # prologue for joins; the fallback still serves fills already
+        # in flight when a mid-serve degrade flips the path.
+        deferred = (getattr(engine, "ragged_path", None)
+                    == "pallas_ragged" and bool(self._active))
         prep = engine._prepare_batch(
             scoped_turns, max_new_padded, deadline, pre_budget,
-            req.sampling_per_turn, extra_pinned=active_names)
+            req.sampling_per_turn, extra_pinned=active_names,
+            defer_prefill=deferred)
+        # The engine may resolve a WARM join back to the prologue
+        # (suffix below ragged_defer_min — blocking one tiny bucket
+        # dispatch beats segment-gated chunk ticks); first_np says
+        # which mode actually served.
+        deferred = prep["first_np"] is None
         stats.prefill_tokens = prep["prefill_tokens"]
         stats.reused_tokens = prep["reused_tokens"]
         stats.prefix_reused_tokens = prep["prefix_reused_tokens"]
@@ -914,22 +979,55 @@ class SessionScheduler:
             # the call-level request (serving_loop.row_budget_fn rule).
             row_cap = (min(per_row[i].max_new_tokens, max_new)
                        if req.sampling_per_turn else max_new)
-            tok = int(prep["first_np"][i])
-            rows.append(_Row(
-                name=scoped, tokens=prep["all_tokens"][i],
-                sampling=per_row[i], max_new=row_cap,
-                slot_id=prep["slot_ids"][i], produced=[tok],
-                last=tok, valid=len(prep["all_tokens"][i]),
-                done=(tok == eos)))
+            toks = prep["all_tokens"][i]
+            if deferred:
+                off = prep["offsets"][i]
+                if off >= len(toks):
+                    # Full-prefix cache hit: re-feed the last prompt
+                    # token (identical K/V bytes at its own position)
+                    # so the join still samples a first token; COW the
+                    # rewritten cell out of any shared page first.
+                    off = len(toks) - 1
+                    engine.kv.ensure_capacity(
+                        scoped, len(toks), write_from=off,
+                        pinned=tuple(prep["names"]) + active_names)
+                rows.append(_Row(
+                    name=scoped, tokens=toks, sampling=per_row[i],
+                    max_new=row_cap, slot_id=prep["slot_ids"][i],
+                    pending=list(toks[off:]), pos=off, valid=off))
+            else:
+                tok = int(prep["first_np"][i])
+                rows.append(_Row(
+                    name=scoped, tokens=toks,
+                    sampling=per_row[i], max_new=row_cap,
+                    slot_id=prep["slot_ids"][i], produced=[tok],
+                    last=tok, valid=len(toks),
+                    done=(tok == eos)))
         req.rows = rows
+        if deferred:
+            # Deferred leader-span plans (the last prologue dispatch,
+            # gone): laggard rows BLOCK until the leader's chunks write
+            # the common span, then alias it in (_apply_share_plans).
+            req.share_plans = [
+                {"leader": rows[p["leader"]], "hi": p["hi"],
+                 "followers": [(rows[i], lo) for i, lo in
+                               p["followers"]]}
+                for p in prep.get("share_plan", [])]
+            for plan in req.share_plans:
+                for f, _lo in plan["followers"]:
+                    f.blocked = True
         req.turn_budget = turn_budget
         req.dec_budget = turn_budget.child("decode")
         req.deadline = deadline
+        if not deferred:
+            req.first_token_at = time.monotonic()
         self._active.extend(rows)
         self._active_reqs.append(req)
         for r in rows:
             self._row_req[id(r)] = req
         self._bump("admitted")
+        if deferred:
+            self._bump("ragged_joins")
         if telemetry.ACTIVE:
             # The request's "turn" span: lives across segments (ended at
             # retire/fail), parented to the SUBMITTER's trace so spans
@@ -940,7 +1038,8 @@ class SessionScheduler:
                 queue_wait_s=round(req.admitted_at - req.enqueued, 3))
         self._event("admit", session=req.session, rows=len(rows),
                     queue_wait_s=round(req.admitted_at - req.enqueued, 3),
-                    reused_tokens=stats.reused_tokens)
+                    reused_tokens=stats.reused_tokens,
+                    ragged_join=deferred)
 
     # --- the decode segment ---
 
@@ -986,6 +1085,10 @@ class SessionScheduler:
                 return
             now = time.monotonic()
             self._attribute_wall(counts, now - t_prev)
+            # Per-phase token split (ISSUE 8): a while-loop segment is
+            # pure decode — counted into the same series the ragged
+            # mixed segments split, so the two paths share one ledger.
+            self._note_segment_tokens(0, steps * len(alive))
             # Live roofline sample at the segment boundary (ISSUE 6):
             # this segment's aggregate decode rate vs the engine's
             # weight-streaming ceiling, as a bw_utilization gauge.
@@ -1004,6 +1107,268 @@ class SessionScheduler:
                 return
             ctx, handles = spec_ctx, spec_handles
 
+    # --- the ragged mixed segment (ISSUE 8) ---
+
+    def _note_segment_tokens(self, prefill: int, decode: int) -> None:
+        """Per-phase token split of a consumed segment — the counters
+        AND their registry series move together (the _bump rule), so
+        describe() and the drift lint stay honest for mixed batches."""
+        if prefill:
+            self.segment_prefill_tokens += prefill
+            telemetry.inc("roundtable_segment_prefill_tokens_total",
+                          prefill, engine=self._tname)
+        if decode:
+            self.segment_decode_tokens += decode
+            telemetry.inc("roundtable_segment_decode_tokens_total",
+                          decode, engine=self._tname)
+
+    def _apply_share_plans(self) -> None:
+        """Alias deferred leader spans whose leader chunks have written
+        the common span (kvcache.share_prefixes defer_span contract):
+        laggards' tables take the leader's span pages (whole pages
+        alias, boundary pages device-copy — the same one-shape padded
+        copier admission aliasing uses) and the rows unblock, their
+        pending already trimmed to the post-span tail at admission."""
+        for req in list(self._active_reqs):
+            if not req.share_plans:
+                continue
+            remaining = []
+            failed: Optional[BaseException] = None
+            for plan in req.share_plans:
+                leader = plan["leader"]
+                if leader.pos < plan["hi"]:
+                    remaining.append(plan)
+                    continue
+                pinned = tuple(r.name for r in self._active)
+                _max_new, padded = clamp_max_new(
+                    req.max_new, self.engine.max_seq_len)
+                try:
+                    for f, lo in plan["followers"]:
+                        self.engine.kv.alias_span(
+                            leader.name, f.name, lo, plan["hi"], pinned)
+                        # Tail capacity (deferred from admission so the
+                        # span pages arrive SHARED, not as transient
+                        # exclusive allocations the alias would
+                        # replace).
+                        self.engine.kv.ensure_capacity(
+                            f.name, len(f.tokens) + padded,
+                            write_from=plan["hi"], pinned=pinned)
+                        f.blocked = False
+                except Exception as e:  # noqa: BLE001 — contain per req
+                    # Pool exhaustion mid-join (the prologue path's
+                    # equivalent was a requeue at admission): fail ONLY
+                    # this request into its adapter ladder — an escape
+                    # to _loop's catch-all would take every in-flight
+                    # session down with it.
+                    failed = e
+                    break
+                self._event("share_alias", session=req.session,
+                            hi=plan["hi"],
+                            followers=len(plan["followers"]))
+            if failed is not None:
+                self._fail_request(req, failed)
+                continue
+            req.share_plans = remaining
+
+    def _run_ragged_segment(self, live: list[_Row],
+                            filling: list[_Row]) -> None:
+        """One RAGGED mixed dispatch: every live decode row advances one
+        token while the filling rows' next prefill chunks ride the SAME
+        program — the admission prologue's replacement (arxiv
+        2604.15464; RTP-LLM's chunked-prefill-joins-the-decode-batch
+        shape). The flat buffer is token-budgeted, not row-bucketed:
+        one compiled shape serves every composition, so occupancy drift
+        and chunk interleaving compile nothing. The loop runs one
+        dispatch per _tick so joins/retires/admissions interleave at
+        every boundary."""
+        engine = self.engine
+        budget_slots = engine.ragged_tokens
+        # A leader that finished its span in the previous dispatch
+        # unblocks its laggards BEFORE packing, so their chunks join
+        # this very segment.
+        self._apply_share_plans()
+        filling = [r for r in filling if not r.done and r.pending
+                   and not r.blocked]
+        if not filling:
+            if live:
+                self._run_segment(live)
+            return
+        # A decode row costs one RAGGED_BLOCK_Q tile; keep at least one
+        # block of chunk room or the mix degenerates.
+        if RAGGED_BLOCK_Q * (len(live) + 1) > budget_slots:
+            # Flat buffer cannot carry every live row plus prefill work
+            # — decode this segment on the compiled bucket path instead
+            # (recorded; prefill continues next tick, never silently
+            # stalled).
+            self._event("ragged_overflow", rows=len(live))
+            if live:
+                self._run_segment(live)
+            return
+        reqs = self._reqs_of(live + filling)
+        remaining = min((req.turn_budget.remaining() for req in reqs),
+                        default=float("inf"))
+        seg_budget = deadlines.Budget.root(
+            None if remaining == float("inf") else remaining,
+            rung="decode")
+        deadline = min((req.deadline for req in reqs),
+                       default=float("inf"))
+
+        # Pick the smallest warmed flat-buffer shape that fits the REAL
+        # work (serving_loop.ragged_shape_grid): a dispatch computes its
+        # whole static buffer, so a lone decode step + tail chunk must
+        # not pay the full budget's compute.
+        from .serving_loop import ragged_pick_shape
+        want = RAGGED_BLOCK_Q * len(live) + sum(
+            -(-len(r.pending) // RAGGED_BLOCK_Q) * RAGGED_BLOCK_Q
+            for r in filling)
+        shape = ragged_pick_shape(engine.ragged_shapes,
+                                  min(want, budget_slots))
+        seqs: list[RaggedSeq] = []
+        rows_in: list[tuple[str, _Row, int]] = []
+        for r in live:
+            seqs.append(RaggedSeq(
+                [r.last], r.valid, engine.kv.table_for([r.name])[0],
+                temperature=r.sampling.temperature,
+                top_k=r.sampling.top_k, top_p=r.sampling.top_p))
+            rows_in.append(("decode", r, 1))
+        slots_left = shape - RAGGED_BLOCK_Q * len(live)
+        for r in filling:
+            if slots_left < RAGGED_BLOCK_Q:
+                break
+            take = min(len(r.pending), slots_left)
+            seqs.append(RaggedSeq(
+                list(r.pending[:take]), r.pos,
+                engine.kv.table_for([r.name])[0],
+                temperature=r.sampling.temperature,
+                top_k=r.sampling.top_k, top_p=r.sampling.top_p))
+            rows_in.append(("prefill", r, take))
+            slots_left -= -(-take // RAGGED_BLOCK_Q) * RAGGED_BLOCK_Q
+        batch = build_ragged_batch(
+            seqs, t_budget=shape, s_max=engine.kv.num_slots + 1,
+            pages_per_seq=engine.kv.pages_per_seq,
+            scratch_page=engine.kv.scratch_page(0),
+            pad_id=engine.tokenizer.pad_id,
+            page_size=engine.kv.page_size)
+
+        t0 = time.monotonic()
+        try:
+            with telemetry.span("segment", engine=self._tname,
+                                rows=len(seqs), scheduled=True,
+                                ragged=True):
+                handles = run_dispatch(
+                    lambda: engine._ragged_dispatch(batch),
+                    engine.retry, deadline, budget=seg_budget)
+                nxt = host_sync(lambda: np.asarray(handles), seg_budget,
+                                "decode")
+        except Exception as e:  # noqa: BLE001 — preempt-isolate ladder
+            self._handle_ragged_failure(live, filling, e)
+            return
+        wall = time.monotonic() - t0
+
+        eos = engine.tokenizer.eos_id
+        now = time.monotonic()
+        n_prefill = n_decode = 0
+        for i, (kind, r, take) in enumerate(rows_in):
+            tok = int(nxt[i])
+            req = self._row_req.get(id(r))
+            if kind == "decode":
+                r.produced.append(tok)
+                r.last = tok
+                r.valid += 1
+                r.done = (tok == eos) or len(r.produced) >= r.max_new
+                n_decode += 1
+            else:
+                del r.pending[:take]
+                r.pos += take
+                n_prefill += take
+                if not r.pending:
+                    # Join complete: the chunk that finished the prompt
+                    # also sampled the row's first token (the prologue's
+                    # first_np, one dispatch earlier than it ever was).
+                    r.produced = [tok]
+                    r.last = tok
+                    r.valid = r.pos
+                    r.done = (tok == eos)
+                    if (req is not None and req.first_token_at is None
+                            and all(not rr.pending for rr in req.rows)):
+                        req.first_token_at = now
+                        self._event(
+                            "join_complete", session=req.session,
+                            ttft_s=round(now - req.enqueued, 3))
+
+        # Provenance + attribution: the mixed dispatch splits its wall
+        # by per-row token counts — decode rows' share lands in their
+        # requests' decode_seconds, chunk tokens in prefill_seconds —
+        # and the perfmodel gauges get the same split (a mixed batch
+        # must not mislabel its roofline fraction).
+        self.ragged_segments += 1
+        telemetry.inc("roundtable_sched_ragged_segments_total",
+                      engine=self._tname)
+        self._note_segment_tokens(n_prefill, n_decode)
+        occ = len(seqs)
+        self.max_occupancy = max(self.max_occupancy, occ)
+        with self._cv:
+            self._occupancy.append(occ)
+        telemetry.set_gauge("roundtable_sched_occupancy", occ,
+                            engine=self._tname)
+        _note_rows(occ)
+        total = max(n_prefill + n_decode, 1)
+        sessions = len(reqs)
+        for kind, r, take in rows_in:
+            req = self._row_req.get(id(r))
+            if req is None:
+                continue
+            share = wall * take / total
+            if kind == "decode":
+                req.stats.decode_seconds += share
+            else:
+                req.stats.prefill_seconds += share
+        for req in reqs:
+            req.seg_count += 1
+            req.occ_sum += occ
+            req.occ_max = max(req.occ_max, occ)
+            req.sess_max = max(req.sess_max, sessions)
+        perf = getattr(engine, "perf", None)
+        if perf is not None:
+            perf.publish_mixed_sample(n_prefill, n_decode, wall)
+            for req in reqs:
+                perf.publish_session_kv(
+                    req.session, sum(r.valid for r in req.rows))
+
+    def _handle_ragged_failure(self, live: list[_Row],
+                               filling: list[_Row],
+                               err: BaseException) -> None:
+        """A ragged mixed dispatch failed. Donation-death first (shared
+        pools — everyone fails into their adapter ladders); otherwise
+        PREEMPT: requests with rows mid-prefill fail alone (their pages
+        hold a half-written chunk; the adapter ladder re-prefills from
+        the prompt), while decode-only sessions re-dispatch through the
+        compiled segment path from intact host+KV state."""
+        if self._after_engine_failure(err):
+            return
+        self._bump("preemptions")
+        self._event("preempt_isolate", error=str(err)[:200], ragged=True,
+                    sessions=[req.session
+                              for req in self._reqs_of(live + filling)])
+        for req in self._reqs_of(live + filling):
+            if req not in self._active_reqs:
+                continue
+            if any(r.pending for r in req.rows):
+                self._fail_request(req, err)
+                continue
+            mine = [r for r in live if r in req.rows and not r.done]
+            if not mine:
+                continue
+            t0 = time.monotonic()
+            try:
+                self._dispatch_rows(mine)
+            except Exception as e:  # noqa: BLE001 — per-session contain
+                if self._after_engine_failure(e):
+                    return
+                self._fail_request(req, e)
+                continue
+            req.stats.decode_seconds += time.monotonic() - t0
+
     def _may_speculate(self, ctx: dict) -> bool:
         """Queue the next segment before reading this one ONLY when the
         composition is certain to survive it: no queued session (a join
@@ -1012,6 +1377,11 @@ class SessionScheduler:
         delay it), work plausibly remaining, nothing cancelled, and the
         deadline not passed (decode_segments' own speculation rules)."""
         if self._stop or deadlines.DRAINING:
+            return False
+        if any(r.pending for r in self._active):
+            # Ragged fills are waiting (overflow fallback segment, or a
+            # blocked laggard about to unblock) — a speculative segment
+            # would starve their chunks for another whole segment.
             return False
         if ctx["budgets_max"] <= DECODE_SEGMENT:
             return False  # this segment may finish everything
@@ -1370,6 +1740,12 @@ class SessionScheduler:
                 "occupancy_max": req.occ_max,
                 "sessions_max": req.sess_max,
             }
+            if req.first_token_at is not None:
+                # TTFT (ISSUE 8): submit → every row of the round has
+                # its first sampled token. The offered-load bench's
+                # headline percentile reads this from metrics.json.
+                req.stats.sched["ttft_s"] = round(
+                    req.first_token_at - req.enqueued, 3)
             self._drop_request(req)
             self._last_active[req.session] = time.monotonic()
             req.result = (texts, req.stats)

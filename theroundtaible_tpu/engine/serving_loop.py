@@ -25,6 +25,64 @@ PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 MAX_PREFILL_CHUNK = 2048
 DECODE_SEGMENT = 64  # tokens per decode program; timeout checks in between
 
+# Ragged mixed prefill/decode dispatch (ISSUE 8): the flat token
+# buffer's row granularity (the MXU sublane minimum — one decode token
+# occupies one 8-row tile) and the default per-dispatch token budget.
+# ONE compiled ragged program per budget serves every prefill/decode
+# composition, so the budget is the whole "shape grid" on this path —
+# a small fixed set of max-token shapes, not per-occupancy buckets.
+RAGGED_BLOCK_Q = 8
+RAGGED_TOKENS_ENV = "ROUNDTABLE_RAGGED_TOKENS"
+RAGGED_DEFER_MIN_ENV = "ROUNDTABLE_RAGGED_DEFER_MIN"
+
+
+def ragged_token_budget(num_slots: int) -> int:
+    """Flat-buffer capacity per ragged dispatch: big enough that a
+    typical cold join's leader span streams in ONE dispatch — chunk
+    throughput must be bucket-class or deferral just slows the joiner
+    down — floored so every resident row's 8-row decode block still
+    leaves chunk room. ROUNDTABLE_RAGGED_TOKENS overrides (rounded up
+    to a block multiple)."""
+    import os
+    forced = int(os.environ.get(RAGGED_TOKENS_ENV, "0") or 0)
+    if forced > 0:
+        return -(-forced // RAGGED_BLOCK_Q) * RAGGED_BLOCK_Q
+    return max(1024, RAGGED_BLOCK_Q * num_slots + 64)
+
+
+def ragged_defer_min() -> int:
+    """Suffix-token threshold below which a join keeps the PROLOGUE
+    even on a ragged engine: with the prefix cache attached, a warm
+    join's remaining prefill is often a few dozen tokens — blocking the
+    batch for one tiny bucket dispatch is cheaper than spreading the
+    same work across segment-gated ragged ticks. Only genuinely COLD
+    prefills (the admission stall the ragged path exists to kill) are
+    worth deferring. ROUNDTABLE_RAGGED_DEFER_MIN overrides."""
+    import os
+    return int(os.environ.get(RAGGED_DEFER_MIN_ENV, "256") or 256)
+
+
+def ragged_shape_grid(budget: int) -> tuple[int, ...]:
+    """The SMALL FIXED GRID of flat-buffer shapes (ISSUE 8): a dispatch
+    compiles (and computes) its whole static buffer, pads included, so
+    a lone decode step + 30-token tail chunk must not pay for the full
+    budget's compute. Shapes {64, 256, 1024, budget} (deduped, capped
+    at the budget) — every shape is warmed once, the dispatcher picks
+    the smallest that fits the real work, and occupancy drift within a
+    shape still compiles nothing. This is shape discipline by MAX-TOKEN
+    grid, not per-occupancy row buckets — the grid stays this size
+    regardless of max_rows."""
+    return tuple(sorted({s for s in (64, 256, 1024, budget)
+                         if s <= budget}))
+
+
+def ragged_pick_shape(grid: tuple[int, ...], want: int) -> int:
+    """Smallest grid shape >= want (the last shape when none is)."""
+    for s in grid:
+        if want <= s:
+            return s
+    return grid[-1]
+
 
 def run_dispatch(dispatch: Callable, retry, deadline: float = float("inf"),
                  budget=None, rung: str = "dispatch"):
@@ -409,6 +467,118 @@ def decode_segments(
         cur = nxt
     return (np.concatenate(segments, axis=1) if segments
             else np.zeros((b, 0), np.int32))
+
+
+class RaggedSeq:
+    """One sequence's slice of a ragged dispatch: the tokens it feeds
+    this call (a prefill chunk, or the single last-sampled token of a
+    decode row), the absolute position of the first one, its page
+    table row, and its sampling params. Host-side description only —
+    build_ragged_batch turns a list of these into device inputs."""
+
+    __slots__ = ("tokens", "pos", "table", "temperature", "top_k",
+                 "top_p")
+
+    def __init__(self, tokens: list[int], pos: int, table: np.ndarray,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
+        self.tokens = tokens
+        self.pos = pos
+        self.table = table
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+
+
+def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
+                       s_max: int, pages_per_seq: int, scratch_page: int,
+                       pad_id: int, page_size: int) -> dict:
+    """Device inputs for one ragged mixed prefill/decode dispatch.
+
+    Every array has a STATIC shape derived from (t_budget, s_max) alone
+    — the composition (how many sequences, how the budget splits
+    between prefill chunks and decode tokens) lives entirely in the
+    VALUES, so occupancy drift and chunk interleaving never compile a
+    new program (the property that retires the pow2 row buckets on this
+    path). Each sequence occupies a RAGGED_BLOCK_Q-aligned run of the
+    flat buffer; the last slot of s_max is the INERT sequence every pad
+    row/block points at (kv_valid=1 over the scratch page, one page of
+    throwaway compute per unused block). Pad tokens scatter their K/V
+    to the scratch page, which no real sequence ever reads.
+
+    Returns the dict the engine's _ragged_dispatch consumes: flat
+    tokens/positions/token_pages/token_offs/token_seq [t_budget],
+    per-block seq_of_block/block_qstart [t_budget/8], per-seq
+    tables/query_offsets/kv_valid/last_rows/temps/top_ks/top_ps
+    [s_max, ...], `greedy`, and the accounting fields n_seqs/n_tokens.
+    """
+    bq = RAGGED_BLOCK_Q
+    if t_budget % bq:
+        raise ValueError(f"t_budget {t_budget} not a multiple of {bq}")
+    nb = t_budget // bq
+    inert = s_max - 1
+    if len(seqs) > inert:
+        raise ValueError(
+            f"{len(seqs)} sequences > {inert} (one slot is the inert "
+            "pad sequence)")
+    tokens = np.full(t_budget, pad_id, np.int32)
+    positions = np.zeros(t_budget, np.int32)
+    token_pages = np.full(t_budget, scratch_page, np.int32)
+    token_offs = np.zeros(t_budget, np.int32)
+    token_seq = np.full(t_budget, inert, np.int32)
+    seq_of_block = np.full(nb, inert, np.int32)
+    block_qstart = np.zeros(nb, np.int32)
+    tables = np.full((s_max, pages_per_seq), scratch_page, np.int32)
+    query_offsets = np.zeros(s_max, np.int32)
+    kv_valid = np.ones(s_max, np.int32)
+    last_rows = np.zeros(s_max, np.int32)
+    temps = np.ones(s_max, np.float32)
+    top_ks = np.zeros(s_max, np.int32)
+    top_ps = np.ones(s_max, np.float32)
+
+    row = 0
+    n_tokens = 0
+    for i, s in enumerate(seqs):
+        n = len(s.tokens)
+        if n < 1:
+            raise ValueError("RaggedSeq needs at least one token")
+        span = -(-n // bq) * bq
+        if row + span > t_budget:
+            raise ValueError(
+                f"sequences overflow the {t_budget}-token budget")
+        tokens[row:row + n] = s.tokens
+        # Pad rows inside the span continue the position run — their
+        # outputs are dropped, the positions only steer (harmless)
+        # causal frontiers.
+        positions[row:row + span] = s.pos + np.arange(span)
+        pos_n = s.pos + np.arange(n)
+        token_pages[row:row + n] = s.table[pos_n // page_size]
+        token_offs[row:row + n] = pos_n % page_size
+        token_seq[row:row + span] = i
+        b0 = row // bq
+        for k in range(span // bq):
+            seq_of_block[b0 + k] = i
+            block_qstart[b0 + k] = k * bq
+        tables[i] = s.table
+        query_offsets[i] = s.pos
+        kv_valid[i] = s.pos + n
+        last_rows[i] = row + n - 1
+        temps[i] = s.temperature
+        top_ks[i] = s.top_k
+        top_ps[i] = s.top_p
+        row += span
+        n_tokens += n
+    return {
+        "tokens": tokens, "positions": positions,
+        "token_pages": token_pages, "token_offs": token_offs,
+        "token_seq": token_seq, "seq_of_block": seq_of_block,
+        "block_qstart": block_qstart, "tables": tables,
+        "query_offsets": query_offsets, "kv_valid": kv_valid,
+        "last_rows": last_rows, "temps": temps, "top_ks": top_ks,
+        "top_ps": top_ps,
+        "greedy": all(s.temperature <= 0.0 for s in seqs),
+        "n_seqs": len(seqs), "n_tokens": n_tokens,
+    }
 
 
 def eos_trim(ids: list[int], eos_id: int, max_new: int) -> list[int]:

@@ -326,6 +326,37 @@ class EnginePerf:
                             engine=self.engine_name, phase="decode")
         note_published(1)
 
+    def publish_mixed_sample(self, prefill_tokens: int,
+                             decode_tokens: int,
+                             seconds: float) -> None:
+        """Per-RAGGED-segment attribution (ISSUE 8): a mixed dispatch
+        carries both prefill chunks and decode tokens, so the roofline
+        gauges split by per-row token counts instead of classifying the
+        whole dispatch as one phase — decode_tokens/wall against the
+        weight-streaming ceiling, prefill_tokens/wall against the
+        compute peak. Both rates run over the FULL wall (the phases
+        genuinely shared it), so each gauge is a conservative
+        lower-bound utilization and their information adds up to the
+        real mix — a pure-decode segment degenerates to exactly
+        publish_decode_sample."""
+        if self.decode_ceiling is None or seconds <= 0:
+            return
+        n = 0
+        if decode_tokens > 0:
+            telemetry.set_gauge(
+                "roundtable_bw_utilization",
+                (decode_tokens / seconds) / self.decode_ceiling,
+                engine=self.engine_name, phase="decode")
+            n += 1
+        if prefill_tokens > 0:
+            telemetry.set_gauge(
+                "roundtable_mfu",
+                (prefill_tokens / seconds) / self.prefill_peak,
+                engine=self.engine_name, phase="prefill")
+            n += 1
+        if n:
+            note_published(n)
+
     def publish_session_kv(self, session: str, cached_tokens: int) -> None:
         """Per-session KV-footprint gauge (the memory ledger's
         per-session series). Retirement passes 0, which REMOVES the

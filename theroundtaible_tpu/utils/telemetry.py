@@ -710,6 +710,12 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "rejected_other": "roundtable_sched_rejected_other_total",
         "preemptions": "roundtable_sched_preemptions_total",
         "segments": "roundtable_sched_segments_total",
+        "ragged_segments": "roundtable_sched_ragged_segments_total",
+        "ragged_joins": "roundtable_sched_ragged_joins_total",
+        "segment_prefill_tokens":
+            "roundtable_segment_prefill_tokens_total",
+        "segment_decode_tokens":
+            "roundtable_segment_decode_tokens_total",
         "requeues": "roundtable_sched_requeues_total",
         "queued": "roundtable_sched_queue_depth gauge",
         "queued_peak": "max over roundtable_sched_queue_depth",
