@@ -37,6 +37,14 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            vs COMPUTED (actually-prefilled tokens / wall — what the
            chip did), the memory ledger's shared-page split, and the
            estimated max resident sessions before refusal.)
+       ROUNDTABLE_BENCH_SPEC_DECODE=1 ..  (speculation A/B, ISSUE 9: a
+           scripted multi-round discussion served spec-ON then
+           spec-OFF on one paged+ragged engine, in ONE record —
+           accepted tok/s, acceptance rate BY ROUND (the transcript is
+           the drafter's corpus, so later rounds should accept more),
+           mean accepted tokens per verify dispatch, p50/p95 turn
+           latency, and the greedy token-parity bit across modes.
+           ROUNDTABLE_BENCH_SPEC_ROUNDS overrides the round count.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -494,6 +502,150 @@ def late_join_child() -> int:
     return 0
 
 
+def spec_decode_child() -> int:
+    """Speculation A/B (ISSUE 9 acceptance): a scripted multi-round
+    discussion — each round's turn prompt carries the WHOLE transcript
+    so far, the roundtable shape that makes self-drafting work — served
+    twice on one paged+ragged config, speculation ON then OFF (the
+    late_join_child on/off pattern). Emits ONE JSON line with both
+    modes, acceptance rate by round (the transcript is the drafter's
+    corpus: later rounds should accept more), mean accepted tokens per
+    verify dispatch, accepted tok/s, p50/p95 turn latency, the greedy
+    token-parity bit across modes, and the spec/ragged provenance
+    embedded. One session serves at a time, so accepted-per-dispatch is
+    exact: each verify dispatch carries exactly one row."""
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = "tiny-gemma" if on_cpu else "gemma-2b-it"
+    max_seq = 1024 if on_cpu else 2048
+    rounds = int(os.environ.get("ROUNDTABLE_BENCH_SPEC_ROUNDS", "4"))
+    knights = 2
+    max_new = 48 if on_cpu else 64
+    cfg = get_model_config(model, max_seq_len=max_seq)
+    kw = {}
+    if on_cpu:
+        # Tests/CI expose 8 virtual devices; tiny-gemma's heads don't
+        # partition an 8-way model axis — measure the kernel path.
+        kw["mesh_shape"] = {"data": 1, "model": 1}
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return round(xs[min(int(p / 100 * len(xs)), len(xs) - 1)], 3)
+
+    def run_mode(spec: bool) -> dict:
+        eng = InferenceEngine(
+            cfg, num_slots=4, kv_layout="paged",
+            num_pages=4 * max_seq // 128, spec_decode=spec, **kw)
+        warm_s = eng.warmup(max_prompt_tokens=512, batch_sizes=(1, 2))
+        sched = SessionScheduler(eng)
+        transcript = ("The roundtable convenes to score the proposal. "
+                      "Each knight quotes the proposal verbatim before "
+                      "scoring it. ")
+        by_round = []
+        turn_walls: list[float] = []
+        texts: list[str] = []
+        dec_tok = 0
+        dec_sec = 0.0
+        try:
+            for rnd in range(rounds):
+                d0, a0 = eng._spec_drafted, eng._spec_accepted
+                v0 = eng._spec_dispatches
+                r_tok, r_sec = 0, 0.0
+                for k in range(knights):
+                    prompt = (transcript
+                              + f"\nKnight {k} now speaks in turn: ")
+                    t0 = time.monotonic()
+                    txts, stats = sched.submit(
+                        "bench", [(f"knight{k}", prompt)],
+                        max_new_tokens=max_new)
+                    turn_walls.append(time.monotonic() - t0)
+                    texts.append(txts[0])
+                    transcript += f"\nKnight {k}: {txts[0]}"
+                    r_tok += stats.decode_tokens
+                    r_sec += stats.decode_seconds
+                dec_tok += r_tok
+                dec_sec += r_sec
+                dd = eng._spec_drafted - d0
+                da = eng._spec_accepted - a0
+                dv = eng._spec_dispatches - v0
+                by_round.append({
+                    "round": rnd,
+                    "drafted": dd, "accepted": da,
+                    "verify_dispatches": dv,
+                    "acceptance_rate": (round(da / dd, 3) if dd
+                                        else None),
+                    "accepted_tok_s": (round(r_tok / r_sec, 1)
+                                       if r_sec else None),
+                })
+            info = eng.spec_describe()
+            sched_d = sched.describe()
+        finally:
+            sched.close()
+        disp = info["verify_dispatches"]
+        return {
+            "spec": info,
+            "by_round": by_round,
+            # Tokens COMMITTED per verify dispatch: the guaranteed 1
+            # (correction/bonus) plus every accepted draft — exact
+            # here because each dispatch carries one row.
+            "mean_accepted_tokens_per_verify_dispatch": (
+                round(1.0 + info["accepted_tokens"] / disp, 3)
+                if disp else None),
+            "accepted_tok_s": (round(dec_tok / dec_sec, 1)
+                               if dec_sec else None),
+            "decode_tokens": dec_tok,
+            "p50_turn_s": pct(turn_walls, 50),
+            "p95_turn_s": pct(turn_walls, 95),
+            "warmup_s": round(warm_s, 1),
+            "texts": texts,
+            "ragged": eng.ragged_describe(),
+            "scheduler": {k: v for k, v in sched_d.items()
+                          if k != "events"},
+        }
+
+    on = run_mode(True)
+    off = run_mode(False)
+    parity = on.pop("texts") == off.pop("texts")
+    result_line = {
+        "metric": f"spec_decode[{model}][rounds={rounds}]",
+        "value": on["mean_accepted_tokens_per_verify_dispatch"],
+        "unit": "accepted_tokens_per_verify_dispatch",
+        "detail": {
+            "rounds": rounds, "knights": knights,
+            "max_new_tokens": max_new,
+            "spec_on": on,
+            "spec_off": off,
+            "accepted_tok_s_speedup": (
+                round(on["accepted_tok_s"] / off["accepted_tok_s"], 3)
+                if on["accepted_tok_s"] and off["accepted_tok_s"]
+                else None),
+            # Greedy outputs must not depend on speculation — the
+            # kill-switch byte-identity acceptance, measured here.
+            "token_parity_on_vs_off": parity,
+            "platform": jax.devices()[0].platform,
+            "telemetry": _registry_snapshot(),
+            "perf": _perf_block(),
+        },
+    }
+    print(json.dumps(result_line), flush=True)
+    return 0
+
+
 def prefix_reuse_child() -> int:
     """Prefix-reuse sweep (ISSUE 7 satellite): the K-session scripted
     discussion load served twice on ONE paged-engine config — with the
@@ -882,12 +1034,15 @@ def main() -> int:
     attempt_s = (2 * ATTEMPT_TIMEOUT_S
                  if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD")
                  or os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE")
+                 or os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE")
                  else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
                            attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE"):
+        return spec_decode_child()
     if os.environ.get("ROUNDTABLE_BENCH_LATE_JOIN"):
         return late_join_child()
     if os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE"):

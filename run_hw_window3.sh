@@ -50,6 +50,11 @@ run_step "bench_microquant.py (fused kernels)" python bench_microquant.py
 run_step "bench.py (config 1, int4 kernel path)" python bench.py
 run_step "bench_suite.py (configs 3-5)" python bench_suite.py all
 run_step "bench_profile.py" python bench_profile.py
+# Speculative decoding A/B (ISSUE 9): scripted multi-round discussion
+# spec-on vs spec-off on chip — acceptance by round, accepted tok/s,
+# greedy parity bit. Every perf claim needs its window-3 baseline.
+run_step "bench_discuss.py (spec-decode A/B)" \
+  env ROUNDTABLE_BENCH_SPEC_DECODE=1 python bench_discuss.py
 # 1500 s: the 900 s budget SIGTERMed twice — host-side training alone
 # is ~330 s and first-time tunnel compiles are 20-40 s per prefill
 # shape bucket. Still LAST so even a hang costs no core measurement.

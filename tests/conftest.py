@@ -255,6 +255,33 @@ def _ragged_attn_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _spec_decode_guard(request):
+    """Tier-1 guard for @pytest.mark.spec_decode (ISSUE 9 satellite):
+    a test that CLAIMS speculative-decoding coverage must not silently
+    serve 1-token decode — if no verify dispatch during the test ever
+    ACCEPTED a drafted token, speculation either never ran (kill-switch
+    left on, drafter never proposed) or never paid off, and the test's
+    multi-token claims are vacuous; fail LOUD. Rejection/throttle unit
+    tests (which legitimately accept nothing) mark allow_cold=True."""
+    marker = request.node.get_closest_marker("spec_decode")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import spec_decode as spec_mod
+
+    spec_mod.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_cold"):
+        return
+    assert spec_mod.accepted_seen() > 0, (
+        "spec_decode-marked test never ACCEPTED a drafted token "
+        f"({spec_mod.dispatches_seen()} verify dispatches, "
+        f"{spec_mod.drafted_seen()} drafted): speculation silently "
+        "served 1-token decode — mark allow_cold=True only for "
+        "rejection/throttle units")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

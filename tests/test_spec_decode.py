@@ -1,0 +1,573 @@
+"""Speculative decoding on the shared batch (ISSUE 9).
+
+Covers the tentpole end to end: the n-gram self-drafter, the acceptance
+rule, the static-width verify program on the PR-8 ragged seam, the
+scheduler's speculative phase, the adaptive throttle, and the
+acceptance-criteria sweep — greedy token parity spec-on vs spec-off vs
+direct (including a mid-run join, a hang-preemption with other
+sessions' accepted history intact, and a prefix-cache attach of a
+transcript partially produced by accepted drafts), STRICT no-compile
+across acceptance drift, and the kill-switch's zero-spec-dispatch
+restoration.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theroundtaible_tpu.engine import deadlines, faults
+from theroundtaible_tpu.engine import spec_decode as sd
+from theroundtaible_tpu.engine.engine import InferenceEngine
+from theroundtaible_tpu.engine.kvcache import scoped_slot
+from theroundtaible_tpu.engine.models.registry import get_model_config
+from theroundtaible_tpu.engine.sampling import SamplingParams
+from theroundtaible_tpu.engine.scheduler import SessionScheduler
+from theroundtaible_tpu.engine.serving_loop import (RaggedSeq,
+                                                    build_ragged_batch)
+from theroundtaible_tpu.engine.spec_decode import (NGramDrafter, RowSpec,
+                                                   accept_prefix)
+from theroundtaible_tpu.utils import telemetry
+
+MODEL_KW = dict(max_seq_len=512)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.end_drain()
+    yield
+    faults.disarm()
+    deadlines.reset_rungs()
+    deadlines.disarm_watchdog()
+    deadlines.end_drain()
+
+
+def make_engine(**kw):
+    cfg = get_model_config("tiny-gemma", **MODEL_KW)
+    kw.setdefault("num_slots", 8)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("mesh_shape", {"data": 1, "model": 1})
+    eng = InferenceEngine(cfg, **kw)
+    eng.ragged_defer_min = 1  # tiny prompts must still defer (PR 8)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def spec_engine():
+    eng = make_engine()
+    assert eng.spec_decode, eng.spec_reason
+    return eng
+
+
+@pytest.fixture(scope="module")
+def nospec_engine():
+    """spec_decode=False config — the ROUNDTABLE_SPEC_DECODE=0
+    kill-switch baseline (1-token decode, PR-8 behavior)."""
+    eng = make_engine(spec_decode=False)
+    assert not eng.spec_decode
+    assert eng.spec_reason == "disabled:config/env"
+    return eng
+
+
+PROMPTS = {
+    "s0": [("lancelot", "The round table met at dawn to discuss the "
+                        "castle walls and the eastern gate.")],
+    "s1": [("galahad", "A different discussion entirely, about dragons "
+                       "and the kingdom's gold reserves."),
+           ("percival", "A different discussion entirely, about dragons "
+                        "and the kingdom's gold reserves. Percival "
+                        "counts the coins.")],
+    "s2": [("tristan", "Third topic: the harvest festival planning "
+                       "session and the tournament.")],
+}
+
+
+def _join_mid_decode(sched, sessions, max_new=70, **submit_kw):
+    """Later sessions submit only once the first has LIVE rows — a
+    deterministic mid-decode join (the test_ragged_attn pattern)."""
+    results, errors = {}, {}
+
+    def run(sid, wait_active):
+        try:
+            if wait_active:
+                deadline = time.monotonic() + 60
+                while not sched._active and time.monotonic() < deadline:
+                    time.sleep(0.005)
+            results[sid] = sched.submit(sid, PROMPTS[sid],
+                                        max_new_tokens=max_new,
+                                        **submit_kw)
+        except Exception as e:  # noqa: BLE001 — asserted by callers
+            errors[sid] = e
+
+    threads = [threading.Thread(target=run, args=(sid, i > 0))
+               for i, sid in enumerate(sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# drafter / acceptance / throttle units (host-only)
+# ---------------------------------------------------------------------------
+
+
+class TestDrafter:
+    def test_prompt_lookup_continuation(self):
+        d = NGramDrafter([1, 2, 3, 4, 5, 1, 2, 3])
+        # tail gram (1,2,3) last occurred ending at 3 → what followed.
+        assert d.draft(4) == [4, 5, 1, 2]
+        assert d.draft(2) == [4, 5]
+
+    def test_backoff_to_shorter_grams(self):
+        d = NGramDrafter([7, 1, 9, 2, 9])
+        # (2,9) never occurred before; (9,) did, ending at 3 → [2, 9].
+        assert d.draft(3) == [2, 9]
+
+    def test_tail_self_occurrence_needs_prior(self):
+        # The tail gram's own occurrence carries no continuation — a
+        # corpus where it never occurred earlier must not draft.
+        d = NGramDrafter([1, 2, 3])
+        assert d.draft(4) == []
+
+    def test_incremental_sync_matches_fresh_build(self):
+        base = [5, 6, 7, 5, 6]
+        inc = NGramDrafter(base)
+        inc.sync_parts(base, [7, 8, 5, 6])
+        fresh = NGramDrafter(base + [7, 8, 5, 6])
+        for n in (1, 2, 3, 4):
+            assert inc.draft(n) == fresh.draft(n)
+
+    def test_empty_and_bounds(self):
+        assert NGramDrafter([]).draft(4) == []
+        assert NGramDrafter([1, 1]).draft(0) == []
+        # Single repeated token: (1,) ends at 1 (prior) → continuation.
+        assert NGramDrafter([1, 1]).draft(3) == [1]
+
+
+class TestAcceptance:
+    def test_accept_prefix_rules(self):
+        # Full acceptance rides the bonus token.
+        assert accept_prefix([4, 5], [4, 5, 9]) == ([4, 5, 9], 2)
+        # First mismatch emits the correction, drops the tail.
+        assert accept_prefix([4, 5, 9], [4, 5, 1, 7]) == ([4, 5, 1], 2)
+        # No drafts: plain 1-token decode.
+        assert accept_prefix([], [7]) == ([7], 0)
+        # Immediate mismatch: exactly the 1-token-decode output.
+        assert accept_prefix([4], [8, 3]) == ([8], 0)
+
+    def test_throttle_trips_below_floor_once(self):
+        rs = RowSpec([1, 2, 3])
+        tripped = []
+        for _ in range(sd.SPEC_MIN_DISPATCHES + 2):
+            tripped.append(rs.note(4, 0))
+        assert tripped.count(True) == 1, "throttle must trip exactly once"
+        assert rs.disabled
+        assert rs.rate() == 0.0
+
+    def test_throttle_spares_accepting_rows(self):
+        rs = RowSpec([1, 2, 3])
+        for _ in range(sd.SPEC_WINDOW):
+            assert not rs.note(4, 3)
+        assert not rs.disabled
+        assert rs.rate() == pytest.approx(0.75)
+
+    def test_zero_draft_dispatches_do_not_count(self):
+        rs = RowSpec([])
+        for _ in range(20):
+            assert not rs.note(0, 0)
+        assert not rs.disabled and not rs.recent
+
+
+# ---------------------------------------------------------------------------
+# batch builder: the static-width score gather
+# ---------------------------------------------------------------------------
+
+
+class TestScoreRows:
+    def _batch(self, seqs, score_width, t_budget=64, s_max=5):
+        table = np.zeros(4, np.int32)
+        for s in seqs:
+            s.table = table
+        return build_ragged_batch(
+            seqs, t_budget=t_budget, s_max=s_max, pages_per_seq=4,
+            scratch_page=0, pad_id=0, page_size=16,
+            score_width=score_width)
+
+    def test_sample_rows_point_at_trailing_tokens(self):
+        seqs = [RaggedSeq([9, 4, 5, 6, 7], 0, None, n_scores=5),
+                RaggedSeq([3], 2, None, n_scores=1),
+                RaggedSeq([1, 2, 3], 1, None, n_scores=2)]
+        b = self._batch(seqs, score_width=5)
+        sr = b["sample_rows"]
+        assert sr.shape == (5, 5)  # (s_max, score_width) ALONE
+        assert list(sr[0]) == [0, 1, 2, 3, 4]
+        # 1-token row at flat row 8: pad columns repeat the last row.
+        assert list(sr[1]) == [8] * 5
+        # n_scores=2 of a 3-token run at rows 16..18: last two rows.
+        assert list(sr[2]) == [17, 18, 18, 18, 18]
+        assert b["score_width"] == 5
+
+    def test_shape_is_composition_independent(self):
+        one = self._batch([RaggedSeq([9, 4], 0, None, n_scores=2)], 5)
+        many = self._batch([RaggedSeq([9, 4, 5, 6, 7], 0, None,
+                                      n_scores=5),
+                            RaggedSeq([3], 2, None)], 5)
+        for key in ("tokens", "sample_rows", "tables", "kv_valid"):
+            assert one[key].shape == many[key].shape, key
+
+    def test_plain_batch_carries_no_sample_rows(self):
+        b = self._batch([RaggedSeq([9, 4], 0, None)], 0)
+        assert "sample_rows" not in b and b["score_width"] == 0
+
+    def test_n_scores_validation(self):
+        with pytest.raises(ValueError, match="n_scores"):
+            self._batch([RaggedSeq([9], 0, None, n_scores=2)], 5)
+        with pytest.raises(ValueError, match="score_width"):
+            self._batch([RaggedSeq([9] * 8, 0, None, n_scores=7)], 5)
+
+
+# ---------------------------------------------------------------------------
+# engine resolution / kill-switch plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_spec_describe_on_paged_engine(self, spec_engine):
+        info = spec_engine.describe()["spec_decode"]
+        assert info["enabled"] and info["reason"] is None
+        assert info["drafter"] == "ngram"
+        assert info["max_draft"] == sd.DEFAULT_MAX_DRAFT
+
+    def test_kill_switch_config(self, nospec_engine):
+        info = nospec_engine.describe()["spec_decode"]
+        assert not info["enabled"]
+        assert info["reason"] == "disabled:config/env"
+
+    def test_env_kill_switch_decision(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_DECODE", "0")
+        assert not sd.spec_enabled(None)
+        assert sd.spec_enabled(True)  # explicit config wins over env
+        monkeypatch.delenv("ROUNDTABLE_SPEC_DECODE")
+        assert sd.spec_enabled(None)  # default ON
+
+    def test_contiguous_engine_declines(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        eng = InferenceEngine(cfg, num_slots=2,
+                              mesh_shape={"data": 1, "model": 1})
+        assert not eng.spec_decode
+        assert eng.spec_reason == "kv_layout:contiguous"
+
+    def test_spec_max_draft_validation(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        for bad in (0, 8):
+            with pytest.raises(ValueError, match="spec_max_draft"):
+                InferenceEngine(cfg, num_slots=2, kv_layout="paged",
+                                mesh_shape={"data": 1, "model": 1},
+                                spec_max_draft=bad)
+
+    def test_from_config_zero_draft_surfaces_error(self):
+        # spec_max_draft: 0 must raise like the constructor does, not
+        # silently run with the default (falsy-check review finding).
+        with pytest.raises(ValueError, match="spec_max_draft"):
+            InferenceEngine.from_config({
+                "model": "tiny-gemma", "max_seq_len": 512,
+                "kv_layout": "paged", "num_slots": 2,
+                "mesh": {"data": 1, "model": 1}, "spec_max_draft": 0})
+
+    def test_accept_floor_env_override(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_ACCEPT_FLOOR", "0.9")
+        rs = RowSpec([1, 2, 3])
+        # 50% acceptance sits above the default floor but below 0.9:
+        # the raised floor throttles (the high-RTT operator lever).
+        tripped = [rs.note(4, 2) for _ in range(sd.SPEC_MIN_DISPATCHES)]
+        assert tripped[-1] is True and rs.disabled
+        monkeypatch.setenv("ROUNDTABLE_SPEC_ACCEPT_FLOOR", "bogus")
+        assert sd.accept_floor() == sd.SPEC_ACCEPT_FLOOR
+
+
+# ---------------------------------------------------------------------------
+# the scheduled speculative phase
+# ---------------------------------------------------------------------------
+
+
+class TestScheduledSpec:
+    def _direct(self, engine, max_new=70):
+        return {sid: engine.generate_batch(turns, max_new_tokens=max_new,
+                                           session=sid)
+                for sid, turns in PROMPTS.items()}
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode
+    def test_greedy_parity_on_vs_off_and_direct(self, spec_engine,
+                                                nospec_engine):
+        """The acceptance-criteria core: 3 sessions (later ones JOIN
+        mid-decode), speculation on vs off vs direct generate_batch —
+        byte-identical greedy outputs, with real multi-token
+        acceptance recorded in the provenance sink."""
+        direct = self._direct(nospec_engine)
+        sched_off = SessionScheduler(nospec_engine)
+        try:
+            off, err = _join_mid_decode(sched_off, ["s0", "s1", "s2"])
+            assert not err, err
+        finally:
+            sched_off.close()
+        sched_on = SessionScheduler(spec_engine)
+        try:
+            on, err = _join_mid_decode(sched_on, ["s0", "s1", "s2"])
+            assert not err, err
+            for sid in PROMPTS:
+                assert on[sid][0] == off[sid][0], f"{sid} on/off diverged"
+                assert on[sid][0] == direct[sid], f"{sid} vs direct"
+            d = sched_on.describe()
+            assert d["spec_segments"] >= 1
+            assert d["completed"] == 3 and d["failed"] == 0
+            info = spec_engine.spec_describe()
+            assert info["accepted_tokens"] > 0
+            assert info["verify_dispatches"] >= d["spec_segments"]
+            # Per-request provenance rode the stats out.
+            spec_stats = [on[sid][1].sched.get("spec") for sid in PROMPTS]
+            assert any(s and s["accepted"] > 0 for s in spec_stats)
+            # The acceptance-rate gauge is live in the registry.
+            snap = telemetry.REGISTRY.snapshot_compact()
+            assert any(k.startswith("roundtable_spec_acceptance_rate")
+                       for k in snap), snap.keys()
+        finally:
+            sched_on.close()
+
+    @pytest.mark.scheduler
+    def test_kill_switch_serves_zero_spec_dispatches(self,
+                                                     nospec_engine):
+        """spec_decode off: ZERO verify dispatches, zero spec segments,
+        no spec entries in the ragged provenance — current (PR-8)
+        dispatch behavior restored exactly."""
+        before = dict(nospec_engine._ragged_dispatches)
+        sched = SessionScheduler(nospec_engine)
+        try:
+            results, err = _join_mid_decode(sched, ["s0", "s2"])
+            assert not err, err
+            assert sched.describe()["spec_segments"] == 0
+        finally:
+            sched.close()
+        info = nospec_engine.spec_describe()
+        assert info["verify_dispatches"] == 0
+        assert info["drafted_tokens"] == 0
+        # Every ragged dispatch this run issued was a PLAIN one: the
+        # spec flag never appears in the recent ring.
+        assert all("spec" not in e
+                   for e in nospec_engine.ragged_describe()["recent"])
+        assert before.keys() == \
+            nospec_engine._ragged_dispatches.keys()
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode
+    def test_strict_no_compile_across_acceptance_drift(self):
+        """Verify shapes come from the existing ragged token-budget
+        grid + the STATIC score_width: after warmup + warm spec
+        traffic, a run with different prompts (different acceptance
+        patterns, mixed draft widths, throttle-eligible rows) compiles
+        NOTHING (STRICT armed by the scheduler marker)."""
+        from theroundtaible_tpu.engine import compile_watch
+
+        assert compile_watch.install() != "off"
+        engine = make_engine(num_slots=4)
+        engine.warmup(max_prompt_tokens=256, batch_sizes=(1, 2, 4))
+        sched = SessionScheduler(engine, max_rows=4)
+        try:
+            warm, errs = _join_mid_decode(sched, ["s0", "s1"])
+            assert not errs, f"warm pass failed: {errs}"
+            sched.declare_warmup_complete()
+            assert compile_watch.steady_state_compiles() == 0
+            results, errs = _join_mid_decode(sched, ["s0", "s1", "s2"])
+            assert not errs, f"drift pass recompiled or failed: {errs}"
+            assert compile_watch.steady_state_compiles() == 0
+            assert sched.describe()["spec_segments"] >= 1
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode
+    @pytest.mark.chaos
+    def test_hang_preemption_keeps_accepted_history(self, spec_engine,
+                                                    nospec_engine):
+        """A hang fault during the speculative phase preempt-isolates
+        exactly like a decode failure: the drafts in flight are
+        discarded, every session re-dispatches from intact host state —
+        including tokens earlier verify dispatches ACCEPTED — and the
+        final outputs stay byte-identical to spec-off serving."""
+        serial = {}
+        for sid in ("s0", "s1"):
+            serial[sid] = nospec_engine.generate_batch(
+                PROMPTS[sid], max_new_tokens=150, session=sid)
+        sched = SessionScheduler(spec_engine, admit_hold_s=0.3)
+        try:
+            reqs = {sid: sched.submit_async(sid, PROMPTS[sid],
+                                            max_new_tokens=150)
+                    for sid in ("s0", "s1")}
+            deadline = time.monotonic() + 120
+            while sched.admitted < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sched.admitted == 2, "sessions never co-admitted"
+            # Let speculation make progress, then wedge one dispatch.
+            while (sched.spec_segments < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            faults.arm("hang", count=1, delay_s=0.1)
+            out = {sid: sched.wait(req) for sid, req in reqs.items()}
+            for sid in ("s0", "s1"):
+                assert out[sid][0] == serial[sid], f"{sid} diverged"
+            d = sched.describe()
+            assert d["failed"] == 0
+            assert d["preemptions"] >= 1, (
+                "hang never hit a shared dispatch — raced retirement")
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode
+    @pytest.mark.prefix_cache
+    def test_prefix_cache_attach_of_drafted_transcript(self,
+                                                       spec_engine,
+                                                       nospec_engine):
+        """A transcript partially PRODUCED by accepted drafts commits
+        pages the cross-session prefix cache may serve — and a new
+        session attaching them decodes byte-identical to the spec-off
+        world (no stale rejected bytes can be attached: commit only
+        publishes pages covered by the literal committed tokens)."""
+        def two_phase(engine):
+            sched = SessionScheduler(engine)
+            try:
+                first, err = _join_mid_decode(sched, ["s1"], max_new=60)
+                assert not err, err
+                # The committed transcript (prompt + fed outputs) of
+                # one knight — on the spec engine much of it was
+                # written by verify dispatches.
+                committed = list(engine.kv._slots[
+                    scoped_slot("s1", "galahad")].tokens)
+                follow, err = {}, {}
+
+                def go():
+                    try:
+                        follow["x"] = sched.submit(
+                            "fresh", [("newknight", committed)],
+                            max_new_tokens=40)
+                    except Exception as e:  # noqa: BLE001
+                        err["x"] = e
+
+                t = threading.Thread(target=go)
+                t.start()
+                t.join(timeout=240)
+                assert not err, err
+                return committed, follow["x"]
+            finally:
+                sched.close()
+
+        committed_on, (texts_on, stats_on) = two_phase(spec_engine)
+        committed_off, (texts_off, _off) = two_phase(nospec_engine)
+        assert committed_on == committed_off, \
+            "spec changed the committed transcript"
+        assert texts_on == texts_off
+        assert stats_on.prefix_reused_tokens > 0, \
+            "the drafted transcript's pages never attached"
+        assert spec_engine.spec_describe()["accepted_tokens"] > 0
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode(allow_cold=True)
+    def test_throttle_disables_non_accepting_row(self, monkeypatch):
+        """A drafter that is always wrong trips the per-row adaptive
+        throttle: a flight-recorder event fires, the row falls back to
+        1-token decode, and the output is still byte-identical (every
+        correction token IS the plain-decode token)."""
+        engine = make_engine(num_slots=4)
+        baseline = engine.generate_batch(PROMPTS["s0"],
+                                         max_new_tokens=90,
+                                         session="base")
+        bad = engine.cfg.vocab_size - 1
+
+        def wrong_draft(self, max_n):
+            return [bad] * max_n if len(self) else []
+
+        monkeypatch.setattr(NGramDrafter, "draft", wrong_draft)
+        events = []
+        rec = telemetry.recorder()
+        orig = rec.record
+
+        def spy(kind, **fields):
+            if kind == "spec_throttle":
+                events.append(fields)
+            return orig(kind, **fields)
+
+        monkeypatch.setattr(rec, "record", spy)
+        sched = SessionScheduler(engine)
+        try:
+            out, err = _join_mid_decode(sched, ["s0", "s2"], max_new=90)
+            assert not err, err
+            assert out["s0"][0] == baseline, "corrections diverged"
+        finally:
+            sched.close()
+        info = engine.spec_describe()
+        assert info["throttled_rows"] >= 1, "throttle never tripped"
+        assert info["accepted_tokens"] == 0
+        assert events, "no spec_throttle flight event"
+        assert sd.accepted_seen() == 0  # allow_cold justified
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode(allow_cold=True)
+    def test_sampled_mode_serves_through_verify(self, spec_engine):
+        """Non-greedy rows run the exact-rejection-sampling verify
+        program (per-position sample_token_batch) — the run completes
+        and the spec path was exercised; distribution preservation is
+        the module docstring's point-mass argument, asserted here only
+        as 'serves without parity violations or recompiles'."""
+        sp = [SamplingParams(temperature=0.8, top_k=20,
+                             max_new_tokens=40)]
+        sched = SessionScheduler(spec_engine)
+        try:
+            out, err = _join_mid_decode(
+                sched, ["s0", "s2"], max_new=40,
+                sampling_per_turn=sp)
+            assert not err, err
+            assert all(out[s][0] for s in out)
+        finally:
+            sched.close()
+
+
+# ---------------------------------------------------------------------------
+# perfmodel attribution (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf_obs
+def test_publish_mixed_sample_splits_accepted_vs_dispatch(monkeypatch):
+    """A 3x-accepting verify dispatch must not report 300% bandwidth
+    utilization: the roofline gauge prices the DISPATCH tokens (one
+    per row per forward), the accepted rate publishes separately."""
+    from theroundtaible_tpu.utils import perfmodel
+
+    perf = perfmodel.EnginePerf(
+        "spec-test", param_bytes=10**9, num_params=5 * 10**8,
+        chip=perfmodel.V5E, kv_token_bytes=1)
+    # 2 rows, 6 accepted tokens in 0.01 s: accepted tps 600, dispatch
+    # tps 200.
+    perf.publish_mixed_sample(0, 6, 0.01, decode_dispatch_tokens=2)
+    snap = telemetry.REGISTRY.snapshot_compact()
+    bw = next(v for k, v in snap.items()
+              if k.startswith("roundtable_bw_utilization")
+              and "spec-test" in k)
+    assert bw == pytest.approx((2 / 0.01) / perf.decode_ceiling)
+    acc = next(v for k, v in snap.items()
+               if k.startswith("roundtable_spec_accepted_tps")
+               and "spec-test" in k)
+    assert acc == pytest.approx(600.0)
+    # The plain ragged path (counts coincide) publishes no spec gauge.
+    telemetry.REGISTRY.remove_gauge("roundtable_spec_accepted_tps",
+                                    engine="spec-test")
+    perf.publish_mixed_sample(0, 4, 0.01)
+    snap = telemetry.REGISTRY.snapshot_compact()
+    assert not any(k.startswith("roundtable_spec_accepted_tps")
+                   and "spec-test" in k for k in snap)

@@ -97,7 +97,8 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "devices", "attn", "num_slots", "sampling", "seed",
                  "kv_layout", "page_size", "num_pages", "n_micro",
                  "quant", "dcn_axis", "prefix_cache",
-                 "prefix_cache_pages", "kv_offload", "ragged_attn")}
+                 "prefix_cache_pages", "kv_offload", "ragged_attn",
+                 "spec_decode", "spec_max_draft")}
     return json.dumps(relevant, sort_keys=True)
 
 

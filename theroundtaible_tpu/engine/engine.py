@@ -112,7 +112,9 @@ class InferenceEngine:
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_pages: Optional[int] = None,
                  kv_offload: Optional[bool] = None,
-                 ragged_attn: Optional[bool] = None):
+                 ragged_attn: Optional[bool] = None,
+                 spec_decode: Optional[bool] = None,
+                 spec_max_draft: Optional[int] = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -706,12 +708,14 @@ class InferenceEngine:
                 self.ragged_fallback_reason = decline
 
             @partial(jax.jit, donate_argnums=(1,),
-                     static_argnames=("greedy", "attn_path"))
+                     static_argnames=("greedy", "attn_path",
+                                      "score_width"))
             def ragged_step(params, pools, tables, tokens, positions,
                             token_pages, token_offs, token_seq,
                             seq_of_block, block_qstart, query_offsets,
                             kv_valid, last_rows, key, temps, top_ks,
-                            top_ps, greedy, attn_path):
+                            top_ps, sample_rows=None, greedy=True,
+                            attn_path="kernel", score_width=0):
                 from .paged_forward import forward_ragged
                 with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
                     logits, new_pools = forward_ragged(
@@ -719,9 +723,27 @@ class InferenceEngine:
                         tokens, positions, pools, tables, seq_of_block,
                         block_qstart, query_offsets, kv_valid,
                         token_pages, token_offs, token_seq, last_rows,
-                        attn_path=attn_path)
+                        attn_path=attn_path,
+                        sample_rows=(sample_rows if score_width
+                                     else None))
                     lf = logits.astype(jnp.float32)
-                    if greedy:
+                    if score_width:
+                        # Speculative verify (ISSUE 9): per-position
+                        # tokens [S, R] — greedy argmax, or an exact
+                        # per-position sample through the SAME
+                        # sample_token_batch the decode loop uses (one
+                        # categorical key draws S*R independent rows).
+                        s, r, v = lf.shape
+                        if greedy:
+                            nxt = jnp.argmax(lf, axis=-1)
+                        else:
+                            nxt = sample_token_batch(
+                                lf.reshape(s * r, v), key,
+                                jnp.repeat(temps, r),
+                                jnp.repeat(top_ks, r),
+                                jnp.repeat(top_ps, r)).reshape(s, r)
+                        nxt = nxt.astype(jnp.int32)
+                    elif greedy:
                         nxt = jnp.argmax(lf, axis=-1).astype(jnp.int32)
                     else:
                         nxt = sample_token_batch(
@@ -730,6 +752,43 @@ class InferenceEngine:
                 return host_read(nxt), new_pools
 
             self._ragged_step = ragged_step
+
+        # Speculative decoding (ISSUE 9): self-drafting verify folded
+        # into the scheduler's ragged segment loop. The verify dispatch
+        # IS a ragged dispatch (a draft run is a short multi-token row
+        # in the flat buffer), so spec resolves ON only where the
+        # ragged seam did — the scheduler then drafts per row on the
+        # host and the static score_width program scores every draft
+        # position in one forward. ROUNDTABLE_SPEC_DECODE=0 /
+        # spec_decode: False restores 1-token decode byte-identically.
+        from .spec_decode import (DEFAULT_MAX_DRAFT, spec_enabled)
+        self.spec_decode = False
+        self.spec_reason: Optional[str] = None
+        self.spec_max_draft = (DEFAULT_MAX_DRAFT if spec_max_draft is None
+                               else int(spec_max_draft))
+        from .serving_loop import RAGGED_BLOCK_Q
+        if not 1 <= self.spec_max_draft <= RAGGED_BLOCK_Q - 1:
+            # draft+1 must fit one flat-buffer tile, so a speculating
+            # batch packs exactly like a plain ragged decode batch and
+            # the overflow rules stay one rule.
+            raise ValueError(
+                f"spec_max_draft must be 1..{RAGGED_BLOCK_Q - 1} "
+                f"(verify run = drafts+1 tokens in one "
+                f"{RAGGED_BLOCK_Q}-row block), got {self.spec_max_draft}")
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_throttled = 0
+        self._spec_dispatches = 0
+        self._spec_recent = _deque(maxlen=32) if kv_layout == "paged" \
+            else None
+        if kv_layout != "paged":
+            self.spec_reason = "kv_layout:contiguous"
+        elif not spec_enabled(spec_decode):
+            self.spec_reason = "disabled:config/env"
+        elif not self.ragged_enabled:
+            self.spec_reason = f"ragged:{self.ragged_reason}"
+        else:
+            self.spec_decode = True
 
         # Per-engine roofline model (ISSUE 6): streamed bytes from the
         # ACTUAL (quantized) tree + chip ceilings, published at event
@@ -813,6 +872,13 @@ class InferenceEngine:
                                 else None),
             kv_offload=config.get("kv_offload"),
             ragged_attn=config.get("ragged_attn"),
+            spec_decode=config.get("spec_decode"),
+            # `is not None`, not truthiness: spec_max_draft: 0 must
+            # surface the constructor's ValueError, not silently run
+            # with the default.
+            spec_max_draft=(int(config["spec_max_draft"])
+                            if config.get("spec_max_draft") is not None
+                            else None),
         )
         # Set by fleet.check_fleet_fits when it flips an unpinned config
         # to int8: surfaced via describe() so the degrade is visible
@@ -985,17 +1051,32 @@ class InferenceEngine:
             temp = 0.0 if greedy else max(self.sampling.temperature, 0.1)
             seqs = [RaggedSeq([bos] + [5] * 23, 0, t0, temperature=temp),
                     RaggedSeq([7], 8, t1, temperature=temp)]
-            for shape in self.ragged_shapes:
-                batch = build_ragged_batch(
-                    seqs, t_budget=shape,
-                    s_max=self.kv.num_slots + 1,
-                    pages_per_seq=self.kv.pages_per_seq,
-                    scratch_page=self.kv.scratch_page(0),
-                    pad_id=self.tokenizer.pad_id,
-                    page_size=self.kv.page_size)
-                for _ in range(2):
-                    nxt = self._ragged_dispatch(batch)
-                    np.asarray(nxt)  # force completion
+            batches = [(seqs, 0)]
+            if self.spec_decode:
+                # Speculative verify programs (ISSUE 9): ONE extra
+                # compiled variant per (shape, mode) — score_width is
+                # the static spec_max_draft+1, so acceptance drift and
+                # per-row throttle flips (mixed 1-draft/k-draft rows)
+                # change only values in steady state.
+                r = self.spec_max_draft + 1
+                batches.append((
+                    [RaggedSeq([7] * r, 8, t1, temperature=temp,
+                               n_scores=r),
+                     RaggedSeq([9], 4, t0, temperature=temp,
+                               n_scores=1)], r))
+            for warm_seqs, score_width in batches:
+                for shape in self.ragged_shapes:
+                    batch = build_ragged_batch(
+                        warm_seqs, t_budget=shape,
+                        s_max=self.kv.num_slots + 1,
+                        pages_per_seq=self.kv.pages_per_seq,
+                        scratch_page=self.kv.scratch_page(0),
+                        pad_id=self.tokenizer.pad_id,
+                        page_size=self.kv.page_size,
+                        score_width=score_width)
+                    for _ in range(2):
+                        nxt = self._ragged_dispatch(batch)
+                        np.asarray(nxt)  # force completion
         self._release_warm_slots()
 
     def _release_warm_slots(self) -> None:
@@ -1109,6 +1190,8 @@ class InferenceEngine:
         host-reads it through its own watchdog seam."""
         from .pallas import attention as pattn
 
+        score_width = int(batch.get("score_width", 0) or 0)
+
         def run(path):
             if path == "pallas_ragged" and faults.ARMED:
                 faults.maybe_inject("mosaic_compile")
@@ -1128,9 +1211,12 @@ class InferenceEngine:
                 jnp.asarray(batch["temps"]),
                 jnp.asarray(batch["top_ks"]),
                 jnp.asarray(batch["top_ps"]),
+                sample_rows=(jnp.asarray(batch["sample_rows"])
+                             if score_width else None),
                 greedy=batch["greedy"],
                 attn_path=("kernel" if path == "pallas_ragged"
-                           else "xla"))
+                           else "xla"),
+                score_width=score_width)
 
         from . import compile_watch
         with compile_watch.label(
@@ -1152,6 +1238,8 @@ class InferenceEngine:
             self._ragged_dispatches.get(path, 0) + 1
         entry = {"path": path, "tokens": int(batch["n_tokens"]),
                  "seqs": int(batch["n_seqs"])}
+        if score_width:
+            entry["spec"] = True
         if path != "pallas_ragged":
             entry["fallback_reason"] = (self.ragged_fallback_reason
                                         or "unknown")
@@ -1174,6 +1262,62 @@ class InferenceEngine:
             "defer_min_tokens": self.ragged_defer_min,
             "dispatches": dict(self._ragged_dispatches),
             "recent": list(self._ragged_recent)[-8:],
+        }
+
+    def note_spec_dispatch(self, drafted: int, accepted: int,
+                           rows: int) -> None:
+        """Record one verify dispatch's acceptance outcome (the
+        scheduler computes it host-side after the read): engine-owned
+        provenance sink + the registry counter/gauge series — the
+        int4_paths/ragged pattern, ISSUE 9 telemetry satellite."""
+        from . import spec_decode as _sd
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        self._spec_dispatches += 1
+        if self._spec_recent is not None:
+            self._spec_recent.append(
+                {"drafted": drafted, "accepted": accepted, "rows": rows,
+                 "path": self.ragged_path})
+        _sd.note_spec_dispatch(drafted, accepted)
+        from ..utils import telemetry
+        name = self.cfg.name
+        if drafted:
+            telemetry.inc("roundtable_spec_drafted_tokens_total",
+                          drafted, engine=name)
+            telemetry.inc("roundtable_spec_rejected_tokens_total",
+                          drafted - accepted, engine=name)
+        if accepted:
+            telemetry.inc("roundtable_spec_accepted_tokens_total",
+                          accepted, engine=name)
+        if self._spec_drafted:
+            telemetry.set_gauge(
+                "roundtable_spec_acceptance_rate",
+                self._spec_accepted / self._spec_drafted, engine=name)
+
+    def note_spec_throttle(self) -> None:
+        self._spec_throttled += 1
+
+    def spec_describe(self) -> dict[str, Any]:
+        """Speculative-decoding provenance (ISSUE 9): the resolved
+        state, the drafter, cumulative drafted/accepted counts and the
+        recent per-dispatch ring — embedded in describe() and bench
+        records the way int4_paths/ragged are."""
+        rate = (self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else None)
+        return {
+            "enabled": self.spec_decode,
+            "reason": self.spec_reason,
+            "drafter": "ngram" if self.spec_decode else None,
+            "max_draft": self.spec_max_draft,
+            "verify_dispatches": self._spec_dispatches,
+            "drafted_tokens": self._spec_drafted,
+            "accepted_tokens": self._spec_accepted,
+            "rejected_tokens": self._spec_drafted - self._spec_accepted,
+            "acceptance_rate": (round(rate, 3)
+                                if rate is not None else None),
+            "throttled_rows": self._spec_throttled,
+            "recent": (list(self._spec_recent)[-8:]
+                       if self._spec_recent is not None else []),
         }
 
     def chars_per_token(self) -> float:
@@ -1861,6 +2005,9 @@ class InferenceEngine:
                 info["kv_offload"] = self.kv_offload.describe()
             # ISSUE 8: ragged mixed-dispatch path provenance.
             info["ragged"] = self.ragged_describe()
+            # ISSUE 9: speculative-decoding provenance (drafter,
+            # per-dispatch drafted/accepted, throttle state).
+            info["spec_decode"] = self.spec_describe()
         # Continuous-batching scheduler provenance (ISSUE 4): attached by
         # engine/scheduler.SessionScheduler — admit/queue/refuse counts,
         # queue depth, per-segment batch occupancy.

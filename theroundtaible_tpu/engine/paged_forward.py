@@ -192,6 +192,7 @@ def forward_ragged(
     token_seq: jax.Array,         # [T] owning sequence per token
     last_rows: jax.Array,         # [S] flat row of each seq's last token
     attn_path: str = "kernel",    # "kernel" | "xla" (static)
+    sample_rows: Optional[jax.Array] = None,  # [S, R] rows to score
 ) -> tuple[jax.Array, list]:
     """One MIXED prefill/decode step over the flat token buffer
     (serving_loop.build_ragged_batch layout): every sequence's chunk or
@@ -203,7 +204,15 @@ def forward_ragged(
     fallback_reason for. Returns (per-sequence last-token logits
     [S, V], new_pools); pad sequence rows carry garbage the caller
     drops. Block wiring comes from transformer_block's attn_fn hook,
-    exactly like forward_paged."""
+    exactly like forward_paged.
+
+    `sample_rows` [S, R] (ISSUE 9, the speculative verify): score R
+    flat-buffer rows per sequence instead of one — each speculating
+    row's whole ``[last, drafts...]`` run gets logits in this single
+    forward, and the causal mask makes each position's logits EXACTLY
+    what 1-token decode would compute given the accepted prefix (the
+    output-invariance core). Returns ([S, R, V], new_pools); the lm
+    head still runs on S*R gathered rows, never the full buffer."""
     x = embed_tokens(params["embedding"], tokens[None])     # [1, T, E]
     if cfg.scale_embeddings:
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
@@ -251,8 +260,14 @@ def forward_ragged(
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,
                  cfg.rmsnorm_unit_offset)
-    sel = x[0, last_rows][None]                             # [1, S, E]
+    if sample_rows is not None:
+        s, r = sample_rows.shape
+        sel = x[0, sample_rows.reshape(-1)][None]           # [1, S*R, E]
+    else:
+        sel = x[0, last_rows][None]                         # [1, S, E]
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
     logits = _einsum("bte,ve->btv", sel, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
+    if sample_rows is not None:
+        return logits[0].reshape(s, r, -1), new_pools
     return logits[0], new_pools

@@ -175,6 +175,9 @@ class _Row:
     pending: list[int] = field(default_factory=list)
     pos: int = 0
     blocked: bool = False
+    # Speculative decoding (ISSUE 9): per-row drafter + adaptive
+    # throttle (engine/spec_decode.RowSpec); None on spec-off engines.
+    spec: Optional[Any] = None
 
 
 class _Request:
@@ -186,7 +189,7 @@ class _Request:
                  "turn_budget", "dec_budget", "abandoned", "seg_count",
                  "occ_sum", "occ_max", "sess_max", "requeues",
                  "fits_below", "tele_ctx", "tele", "first_token_at",
-                 "share_plans")
+                 "share_plans", "spec_drafted", "spec_accepted")
 
     def __init__(self, session, turns, sampling_per_turn, max_new,
                  timeout_s, budget, stats):
@@ -222,6 +225,10 @@ class _Request:
         # written it. [{"leader": _Row, "hi": int,
         # "followers": [(_Row, lo), ...]}]
         self.share_plans: list[dict] = []
+        # Speculation provenance (ISSUE 9): this request's drafted /
+        # accepted totals — lands in GenStats.sched["spec"] at retire.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # Telemetry (ISSUE 5): the submitter thread's span context, so
         # this request's "turn" span parents into ITS discussion trace
         # even though the scheduler thread emits it; `tele` is that
@@ -298,6 +305,9 @@ class SessionScheduler:
         self.ragged_joins = 0
         self.segment_prefill_tokens = 0
         self.segment_decode_tokens = 0
+        # Speculative verify dispatches issued (ISSUE 9) — bumped in
+        # lockstep with its registry series like every counter here.
+        self.spec_segments = 0
         self._occupancy: deque[int] = deque(maxlen=_OCCUPANCY_LOG_CAP)
         self._events: deque[dict] = deque(maxlen=_EVENT_LOG_CAP)
         # Registry label for this scheduler's series (ISSUE 5): every
@@ -501,6 +511,7 @@ class SessionScheduler:
             "segments": self.segments,
             "ragged_segments": self.ragged_segments,
             "ragged_joins": self.ragged_joins,
+            "spec_segments": self.spec_segments,
             "segment_prefill_tokens": self.segment_prefill_tokens,
             "segment_decode_tokens": self.segment_decode_tokens,
             "queued": len(self._queue),
@@ -652,7 +663,14 @@ class SessionScheduler:
             # rows) keeps the pipelined while-loop segments.
             self._run_ragged_segment(live, filling)
         elif live:
-            self._run_segment(live)
+            # Speculative phase (ISSUE 9): with no fills pending and
+            # drafts available, one verify dispatch advances every row
+            # by 1..spec_max_draft+1 tokens; otherwise the pipelined
+            # while-loop segments serve. One dispatch per tick, so
+            # joins/retires recompose at every boundary — the
+            # _may_speculate composition rules by construction.
+            if not self._run_spec_segment(live):
+                self._run_segment(live)
         self._retire_finished()
         self._check_request_health()
 
@@ -1004,6 +1022,15 @@ class SessionScheduler:
                     last=tok, valid=len(toks),
                     done=(tok == eos)))
         req.rows = rows
+        if engine.spec_decode:
+            # Per-row self-drafters (ISSUE 9): the corpus is the row's
+            # OWN prompt — which carries the whole transcript and any
+            # prefix-cache-attached context — extended incrementally as
+            # output tokens commit (RowSpec.drafter.sync before every
+            # draft). Host dict work only, O(prompt) once per admission.
+            from .spec_decode import RowSpec
+            for r in rows:
+                r.spec = RowSpec(list(r.tokens))
         if deferred:
             # Deferred leader-span plans (the last prologue dispatch,
             # gone): laggard rows BLOCK until the leader's chunks write
@@ -1054,12 +1081,18 @@ class SessionScheduler:
         the batch must recompose (join pending, a request fully done,
         budgets/deadline/drain) and _tick takes over."""
         ctx = self._build_batch(live)
+        # The clock starts BEFORE the first dispatch (ISSUE 9 perfmodel
+        # satellite): on synchronous backends the jit call itself runs
+        # the compute, so starting after it attributed ~zero decode
+        # seconds to every single-segment turn — and its 'tok/s' then
+        # read as thousands. Dispatch-issue time is part of the
+        # segment's wall on async backends too.
+        t_prev = time.monotonic()
         try:
             handles = self._dispatch(ctx)
         except Exception as e:  # noqa: BLE001 — preempt-isolate ladder
             self._handle_segment_failure(live, e)
             return
-        t_prev = time.monotonic()
         while True:
             spec_ctx = spec_handles = spec_err = None
             if self._may_speculate(ctx):
@@ -1369,6 +1402,212 @@ class SessionScheduler:
                 continue
             req.stats.decode_seconds += time.monotonic() - t0
 
+    # --- the speculative verify segment (ISSUE 9) ---
+
+    def _spec_drafts(self, live: list[_Row],
+                     probe: bool = False) -> Optional[dict]:
+        """Per-row draft proposals for one verify dispatch: each
+        spec-enabled, unthrottled row's n-gram continuation, capped by
+        its remaining token budget (a verify commits up to drafts+1
+        tokens, so a row with <= 1 remaining never drafts). Returns
+        None when NO row drafts — the tick then serves the plain
+        pipelined segments, which is exactly the 1-token-decode
+        fallback the adaptive throttle promises (a non-accepting batch
+        must never pay more dispatches than plain decode)."""
+        engine = self.engine
+        if (not getattr(engine, "spec_decode", False)
+                or not engine.ragged_enabled):
+            return None
+        if RAGGED_BLOCK_Q * len(live) > engine.ragged_tokens:
+            return None  # flat buffer cannot carry every live row
+        drafts: dict[int, list[int]] = {}
+        any_draft = False
+        for r in live:
+            d: list[int] = []
+            if r.spec is not None and not r.spec.disabled:
+                cap = min(engine.spec_max_draft,
+                          r.max_new - len(r.produced) - 1)
+                if cap >= 1:
+                    r.spec.drafter.sync_parts(r.tokens, r.produced)
+                    d = r.spec.drafter.draft(cap)
+            drafts[id(r)] = d
+            if d:
+                any_draft = True
+                if probe:
+                    # The _may_speculate caller only asks WHETHER a
+                    # verify tick exists — don't compute the rest of
+                    # the batch's proposals just to discard them (the
+                    # real segment recomputes from fresh host state
+                    # next tick anyway).
+                    return drafts
+        return drafts if any_draft else None
+
+    def _run_spec_segment(self, live: list[_Row]) -> bool:
+        """One speculative verify dispatch over the live rows (ISSUE 9
+        tentpole): every speculating row packs ``[last, drafts...]`` as
+        a short multi-token run of the PR-8 flat buffer (throttled /
+        draftless rows ride as plain 1-token runs — mixed widths are
+        VALUES, not shapes), forward_ragged scores every draft position
+        in one forward via the static score_width gather, and the host
+        commits the longest accepted prefix plus the correction/bonus
+        token. Greedy rows are byte-identical to 1-token decode by the
+        argmax-prefix rule; sampled rows follow exact rejection
+        sampling (engine/spec_decode docstring). Returns False WITHOUT
+        dispatching when no row drafts; a dispatch failure is handled
+        exactly like a ragged decode failure (drafts discarded, the
+        preempt-isolate ladder re-dispatches from intact host state)."""
+        engine = self.engine
+        drafts_of = self._spec_drafts(live)
+        if drafts_of is None:
+            return False
+        reqs = self._reqs_of(live)
+        remaining = min((req.turn_budget.remaining() for req in reqs),
+                        default=float("inf"))
+        seg_budget = deadlines.Budget.root(
+            None if remaining == float("inf") else remaining,
+            rung="decode")
+        deadline = min((req.deadline for req in reqs),
+                       default=float("inf"))
+
+        from .serving_loop import ragged_pick_shape
+        want = sum(
+            -(-(1 + len(drafts_of[id(r)])) // RAGGED_BLOCK_Q)
+            * RAGGED_BLOCK_Q for r in live)
+        shape = ragged_pick_shape(engine.ragged_shapes,
+                                  min(want, engine.ragged_tokens))
+        seqs: list[RaggedSeq] = []
+        for r in live:
+            d = drafts_of[id(r)]
+            seqs.append(RaggedSeq(
+                [r.last] + d, r.valid, engine.kv.table_for([r.name])[0],
+                temperature=r.sampling.temperature,
+                top_k=r.sampling.top_k, top_p=r.sampling.top_p,
+                n_scores=len(d) + 1))
+        batch = build_ragged_batch(
+            seqs, t_budget=shape, s_max=engine.kv.num_slots + 1,
+            pages_per_seq=engine.kv.pages_per_seq,
+            scratch_page=engine.kv.scratch_page(0),
+            pad_id=engine.tokenizer.pad_id,
+            page_size=engine.kv.page_size,
+            score_width=engine.spec_max_draft + 1)
+
+        t0 = time.monotonic()
+        try:
+            with telemetry.span("segment", engine=self._tname,
+                                rows=len(seqs), scheduled=True,
+                                spec=True):
+                handles = run_dispatch(
+                    lambda: engine._ragged_dispatch(batch),
+                    engine.retry, deadline, budget=seg_budget)
+                nxt = host_sync(lambda: np.asarray(handles), seg_budget,
+                                "decode")
+        except Exception as e:  # noqa: BLE001 — preempt-isolate ladder
+            # Indistinguishable from a decode failure: host state is
+            # untouched (the drafts are discarded with the dispatch),
+            # so the ragged failure path's donation-death check +
+            # per-session re-dispatch applies verbatim.
+            self._handle_ragged_failure(live, [], e)
+            return True
+        wall = time.monotonic() - t0
+
+        eos = engine.tokenizer.eos_id
+        from .spec_decode import accept_prefix
+        n_emit = 0
+        drafted_tot = 0
+        accepted_tot = 0
+        emits: dict[int, tuple[_Request, int]] = {}
+        for i, r in enumerate(live):
+            d = drafts_of[id(r)]
+            props = [int(x) for x in nxt[i, :len(d) + 1]]
+            emit, a = accept_prefix(d, props)
+            # EOS inside an accepted prefix truncates exactly as
+            # eos_trim does: tokens past the eos are never committed
+            # (plain decode would never have produced them).
+            if eos in emit:
+                emit = emit[:emit.index(eos) + 1]
+            room = r.max_new - len(r.produced)
+            if len(emit) > room:
+                emit = emit[:room]
+            r.produced.extend(emit)
+            r.last = emit[-1]
+            r.valid += len(emit)
+            r.done = (r.last == eos) or len(r.produced) >= r.max_new
+            # Accepted-for-accounting = drafts actually COMMITTED:
+            # eos/budget truncation can drop matched drafts, and every
+            # acceptance metric must equal served work (a fully-matched
+            # [A, eos, B, C] draft commits 2 tokens, not 4). min(a,
+            # len(emit)) also covers the eos-was-a-draft case, where
+            # every emitted token is a matched draft and none is the
+            # free correction.
+            acc = min(a, len(emit))
+            req = self._row_req.get(id(r))
+            if req is not None:
+                prev = emits.get(id(req))
+                emits[id(req)] = (req,
+                                  (prev[1] if prev else 0) + len(emit))
+                if d:
+                    req.spec_drafted += len(d)
+                    req.spec_accepted += acc
+            n_emit += len(emit)
+            if d and r.spec is not None:
+                drafted_tot += len(d)
+                accepted_tot += acc
+                tripped = r.spec.note(len(d), acc)
+                # Gauge AFTER note: the window now includes this
+                # dispatch, so the first drafted dispatch reports its
+                # real rate instead of a false 0.0 (and later values
+                # never lag a dispatch behind).
+                telemetry.set_gauge(
+                    "roundtable_spec_row_acceptance_rate",
+                    round(r.spec.rate(), 4),
+                    engine=self._tname, row=r.name)
+                if tripped:
+                    # Adaptive throttle tripped: this row decodes
+                    # 1-token from here on — one flight event, the
+                    # ISSUE 9 telemetry satellite.
+                    engine.note_spec_throttle()
+                    telemetry.recorder().record(
+                        "spec_throttle", engine=self._tname,
+                        session=req.session if req else "",
+                        row=r.name, rate=round(r.spec.rate(), 3))
+                    self._event("spec_throttle", row=r.name,
+                                rate=round(r.spec.rate(), 3))
+        engine.note_spec_dispatch(drafted_tot, accepted_tot,
+                                  rows=len(live))
+
+        self.spec_segments += 1
+        telemetry.inc("roundtable_sched_spec_segments_total",
+                      engine=self._tname)
+        self._note_segment_tokens(0, n_emit)
+        occ = len(seqs)
+        self.max_occupancy = max(self.max_occupancy, occ)
+        with self._cv:
+            self._occupancy.append(occ)
+        telemetry.set_gauge("roundtable_sched_occupancy", occ,
+                            engine=self._tname)
+        _note_rows(occ)
+        sessions = len(reqs)
+        for req, n in emits.values():
+            req.stats.decode_seconds += wall * n / max(n_emit, 1)
+        for req in reqs:
+            req.seg_count += 1
+            req.occ_sum += occ
+            req.occ_max = max(req.occ_max, occ)
+            req.sess_max = max(req.sess_max, sessions)
+        perf = getattr(engine, "perf", None)
+        if perf is not None:
+            # Accepted vs dispatch tokens split (ISSUE 9 perfmodel
+            # satellite): the forward streamed weights ONCE for
+            # len(live) rows — that is the roofline-relevant count; the
+            # accepted total is the user-visible rate and must not
+            # report >100% bandwidth utilization.
+            perf.publish_mixed_sample(0, n_emit, wall,
+                                      decode_dispatch_tokens=len(live))
+            for req in reqs:
+                perf.publish_session_kv(
+                    req.session, sum(r.valid for r in req.rows))
+        return True
+
     def _may_speculate(self, ctx: dict) -> bool:
         """Queue the next segment before reading this one ONLY when the
         composition is certain to survive it: no queued session (a join
@@ -1390,6 +1629,15 @@ class SessionScheduler:
         with self._cv:
             if self._queue:
                 return False
+        if self._spec_drafts([r for r in ctx["rows"] if not r.done],
+                             probe=True) is not None:
+            # A verify tick is available (ISSUE 9): pipelining another
+            # whole 64-token segment would decode past it at 1
+            # token/forward — exit the mini-loop so _tick runs the
+            # speculative phase at the next boundary. Probe mode: this
+            # check runs per mini-loop iteration AFTER the cheap exits
+            # and stops at the first draftable row.
+            return False
         for req in ctx["reqs"]:
             if req not in self._active_reqs or req.abandoned:
                 return False
@@ -1708,6 +1956,14 @@ class SessionScheduler:
             self._active_reqs.remove(req)
         for r in req.rows:
             self._row_req.pop(id(r), None)
+            if r.spec is not None and r.spec.drafted:
+                # Row-labeled acceptance gauges die with the row:
+                # session-scoped names are uuid-tagged per serve call,
+                # so a kept series per row ever served would grow the
+                # registry without bound (the PR-6 remove_gauge lesson).
+                telemetry.REGISTRY.remove_gauge(
+                    "roundtable_spec_row_acceptance_rate",
+                    engine=self._tname, row=r.name)
         self._active = [r for r in self._active if r not in req.rows]
 
     # --- retirement ---
@@ -1746,6 +2002,15 @@ class SessionScheduler:
                 # headline percentile reads this from metrics.json.
                 req.stats.sched["ttft_s"] = round(
                     req.first_token_at - req.enqueued, 3)
+            if req.spec_drafted:
+                # Speculation provenance (ISSUE 9): rides adapter
+                # stats into metrics.json like queue_wait/ttft do.
+                req.stats.sched["spec"] = {
+                    "drafted": req.spec_drafted,
+                    "accepted": req.spec_accepted,
+                    "acceptance_rate": round(
+                        req.spec_accepted / req.spec_drafted, 3),
+                }
             self._drop_request(req)
             self._last_active[req.session] = time.monotonic()
             req.result = (texts, req.stats)

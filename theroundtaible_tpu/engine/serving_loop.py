@@ -471,28 +471,33 @@ def decode_segments(
 
 class RaggedSeq:
     """One sequence's slice of a ragged dispatch: the tokens it feeds
-    this call (a prefill chunk, or the single last-sampled token of a
-    decode row), the absolute position of the first one, its page
-    table row, and its sampling params. Host-side description only —
+    this call (a prefill chunk, the single last-sampled token of a
+    decode row, or a speculative ``[last, drafts...]`` verify run), the
+    absolute position of the first one, its page table row, and its
+    sampling params. `n_scores` is how many TRAILING token rows the
+    dispatch must score (ISSUE 9): 1 for plain rows (the last-token
+    sample), drafts+1 for a verify run. Host-side description only —
     build_ragged_batch turns a list of these into device inputs."""
 
     __slots__ = ("tokens", "pos", "table", "temperature", "top_k",
-                 "top_p")
+                 "top_p", "n_scores")
 
     def __init__(self, tokens: list[int], pos: int, table: np.ndarray,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, n_scores: int = 1):
         self.tokens = tokens
         self.pos = pos
         self.table = table
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
+        self.n_scores = n_scores
 
 
 def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
                        s_max: int, pages_per_seq: int, scratch_page: int,
-                       pad_id: int, page_size: int) -> dict:
+                       pad_id: int, page_size: int,
+                       score_width: int = 0) -> dict:
     """Device inputs for one ragged mixed prefill/decode dispatch.
 
     Every array has a STATIC shape derived from (t_budget, s_max) alone
@@ -511,6 +516,14 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
     per-block seq_of_block/block_qstart [t_budget/8], per-seq
     tables/query_offsets/kv_valid/last_rows/temps/top_ks/top_ps
     [s_max, ...], `greedy`, and the accounting fields n_seqs/n_tokens.
+
+    `score_width` > 0 (ISSUE 9, the speculative verify): the dict also
+    carries `sample_rows` [s_max, score_width] — for each sequence, the
+    flat-buffer rows of its LAST n_scores tokens (pad columns repeat
+    the last row; their scores are computed and discarded). The shape
+    is a function of (s_max, score_width) alone — score_width is the
+    STATIC spec_max_draft+1, so acceptance drift and per-row throttle
+    flips change only values, never the compiled program.
     """
     bq = RAGGED_BLOCK_Q
     if t_budget % bq:
@@ -535,6 +548,8 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
     temps = np.ones(s_max, np.float32)
     top_ks = np.zeros(s_max, np.int32)
     top_ps = np.ones(s_max, np.float32)
+    sample_rows = (np.zeros((s_max, score_width), np.int32)
+                   if score_width > 0 else None)
 
     row = 0
     n_tokens = 0
@@ -542,6 +557,12 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
         n = len(s.tokens)
         if n < 1:
             raise ValueError("RaggedSeq needs at least one token")
+        if s.n_scores < 1 or s.n_scores > n:
+            raise ValueError(
+                f"n_scores {s.n_scores} outside 1..{n} (run length)")
+        if score_width and s.n_scores > score_width:
+            raise ValueError(
+                f"n_scores {s.n_scores} > score_width {score_width}")
         span = -(-n // bq) * bq
         if row + span > t_budget:
             raise ValueError(
@@ -563,6 +584,10 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
         query_offsets[i] = s.pos
         kv_valid[i] = s.pos + n
         last_rows[i] = row + n - 1
+        if sample_rows is not None:
+            first = row + n - s.n_scores
+            for j in range(score_width):
+                sample_rows[i, j] = min(first + j, row + n - 1)
         temps[i] = s.temperature
         top_ks[i] = s.top_k
         top_ps[i] = s.top_p
@@ -578,6 +603,9 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
         "top_ps": top_ps,
         "greedy": all(s.temperature <= 0.0 for s in seqs),
         "n_seqs": len(seqs), "n_tokens": n_tokens,
+        "score_width": score_width,
+        **({"sample_rows": sample_rows} if sample_rows is not None
+           else {}),
     }
 
 

@@ -1,0 +1,261 @@
+"""Self-drafting speculative decoding on the shared batch (ISSUE 9).
+
+int8 decode sits at 0.63-0.69 of the HBM-streaming ceiling — past
+kernel wins the only way above the roofline is accepting more than one
+token per forward pass. This module is the HOST side of that: a
+zero-model drafter over each row's own token history, the acceptance
+rule, and the per-row adaptive throttle. The DEVICE side is the PR-8
+ragged seam: a verify dispatch packs each speculating row's drafts as a
+short multi-token run in the flat token buffer and scores every draft
+position in ONE forward (engine._ragged_dispatch with a static
+`score_width` — build_ragged_batch shapes stay a function of the token
+budget alone, so mixed 1-draft/4-draft compositions compile nothing).
+
+Why a drafter with no model works here: roundtable transcripts are
+unusually repetitive — quoted proposals, score scaffolding, and knight
+boilerplate recur verbatim across rounds — so an n-gram lookup over the
+row's OWN prompt (which carries the whole transcript) plus its
+committed output proposes long runs that the target model then verifies
+wholesale. RTP-LLM (PAPERS.md) ships the same composition — speculation
+folded into continuous batching — in production.
+
+Acceptance (the output-invariance contract):
+
+- The verify run for a row is ``[last, d_0, ..., d_{k-1}]`` fed at
+  positions ``valid..valid+k``. The causal mask means the scored logits
+  at the row of ``last`` are EXACTLY what plain decode would compute,
+  the logits at ``d_0`` are exact given ``d_0`` in context, and so on.
+- Greedy: the device returns per-position argmax ``t_0..t_k``; the
+  accepted prefix is the longest ``j`` with ``d_j == t_j`` and the row
+  commits ``t_0..t_a`` (the first mismatch — or the bonus token after a
+  fully-accepted draft — rides free). Byte-identical to 1-token decode
+  by construction.
+- Sampled: the device SAMPLES ``t_j`` from each position's filtered
+  distribution (the same sample_token_batch the decode loop uses) and
+  the host accepts while ``d_j == t_j``. For a DETERMINISTIC drafter
+  (point mass at ``d_j``) this is exactly standard rejection sampling:
+  acceptance fires with probability ``p(d_j)``, and the first
+  mismatching ``t_j`` is distributed as the renormalized residual — so
+  the emitted stream is an exact ancestral sample of the target model.
+
+Rollback is free: rejected tail tokens only wrote K/V at positions
+beyond the new committed ``valid``; every later dispatch's ``kv_valid``
+stops at committed+written, so stale cells are never read and are
+overwritten in place when real tokens reach those positions. The prefix
+cache can never attach them either — PagedKVCache.commit publishes only
+pages fully covered by the LITERAL committed token list (the paging
+refcount surface), and rejected bytes live past it by definition.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from .prefix_cache import env_flag
+
+SPEC_ENV = "ROUNDTABLE_SPEC_DECODE"
+
+# Drafts per row per verify dispatch (config `spec_max_draft`). The
+# default keeps a row's verify run (1 + drafts) inside ONE
+# RAGGED_BLOCK_Q tile, so a speculating batch packs exactly like a
+# plain ragged decode batch.
+DEFAULT_MAX_DRAFT = 4
+
+# Longest n-gram the drafter keys on; it backs off to shorter grams
+# when the longer suffix has no prior occurrence.
+NGRAM_MAX = 3
+
+# Adaptive throttle: after at least SPEC_MIN_DISPATCHES verify
+# dispatches, a row whose windowed acceptance rate (accepted drafts /
+# drafted) sits below the floor stops drafting — drafting must never
+# cost a slow row more dispatches than plain decode buys back.
+# ROUNDTABLE_SPEC_ACCEPT_FLOOR raises/lowers the floor: on a high-RTT
+# tunnel, where a verify dispatch's host round-trip is dearer than the
+# pipelined while-loop's hidden one, a modest-acceptance row can be
+# net-slower than plain decode without ever dropping below the default
+# — the operator lever until the on-chip A/B settles the break-even.
+SPEC_WINDOW = 16
+SPEC_MIN_DISPATCHES = 6
+SPEC_ACCEPT_FLOOR = 0.2
+
+
+def accept_floor() -> float:
+    import os
+    raw = os.environ.get("ROUNDTABLE_SPEC_ACCEPT_FLOOR")
+    try:
+        return float(raw) if raw else SPEC_ACCEPT_FLOOR
+    except ValueError:
+        return SPEC_ACCEPT_FLOOR
+
+
+def spec_enabled(flag: Optional[bool]) -> bool:
+    """The speculative-decode on/off decision for a paged+ragged engine
+    (explicit config wins, then the env kill-switch, then default ON —
+    the prefix_cache/ragged_attn precedent: the fast path is the
+    serving path, not an experiment)."""
+    return env_flag(flag, SPEC_ENV)
+
+
+class NGramDrafter:
+    """Hash-indexed n-gram / prompt-lookup proposer over ONE row's
+    corpus: its (transcript-carrying, prefix-cache-attached) prompt plus
+    every committed output token, indexed incrementally as tokens
+    retire.
+
+    For each gram order n in NGRAM_MAX..1 the index maps the token
+    tuple to the END positions of its two most recent occurrences. A
+    draft looks up the context's tail gram and proposes the tokens that
+    FOLLOWED it last time; the second-most-recent slot exists because
+    the tail gram's own occurrence is always the most recent one and
+    carries no continuation."""
+
+    __slots__ = ("_toks", "_index")
+
+    def __init__(self, tokens: Optional[list[int]] = None):
+        self._toks: list[int] = []
+        # gram tuple -> (last_end, prev_end); end = index AFTER the gram.
+        self._index: dict[tuple, tuple[int, int]] = {}
+        if tokens:
+            self.extend(tokens)
+
+    def __len__(self) -> int:
+        return len(self._toks)
+
+    def extend(self, tokens: list[int]) -> None:
+        """Append committed tokens and index every new gram."""
+        toks = self._toks
+        start = len(toks)
+        toks.extend(tokens)
+        idx = self._index
+        for end in range(start + 1, len(toks) + 1):
+            for n in range(1, NGRAM_MAX + 1):
+                if end < n:
+                    break
+                key = tuple(toks[end - n:end])
+                prev = idx.get(key)
+                if prev is None:
+                    idx[key] = (end, -1)
+                elif prev[0] != end:
+                    idx[key] = (end, prev[0])
+
+    def sync(self, context: list[int]) -> None:
+        """Bring the index up to `context` (prompt + produced): extends
+        with the suffix past what is already indexed, so the scheduler
+        can call this before every draft regardless of which serving
+        path appended the tokens."""
+        if len(context) > len(self._toks):
+            self.extend(context[len(self._toks):])
+
+    def sync_parts(self, prompt: list[int], produced: list[int]) -> None:
+        """sync(prompt + produced) without materializing the
+        concatenation — the per-dispatch hot call (the prompt was
+        indexed at construction, so only produced's tail is new)."""
+        have = len(self._toks)
+        need = len(prompt) + len(produced)
+        if need > have:
+            self.extend(produced[have - len(prompt):])
+
+    def draft(self, max_n: int) -> list[int]:
+        """Up to `max_n` candidate continuation tokens of the indexed
+        context, from the most recent PRIOR occurrence of the longest
+        matching tail gram; [] when nothing matches (the row then runs
+        plain 1-token decode this step)."""
+        toks = self._toks
+        if max_n < 1 or not toks:
+            return []
+        for n in range(min(NGRAM_MAX, len(toks)), 0, -1):
+            entry = self._index.get(tuple(toks[len(toks) - n:]))
+            if entry is None:
+                continue
+            last, prev = entry
+            # The tail gram itself is always the most recent occurrence;
+            # a continuation needs an occurrence that ENDS before the
+            # corpus does.
+            pos = last if last < len(toks) else prev
+            if pos is not None and 0 < pos < len(toks):
+                return list(toks[pos:pos + max_n])
+        return []
+
+
+class RowSpec:
+    """Per-row speculation state: the drafter plus the adaptive
+    throttle's acceptance window."""
+
+    __slots__ = ("drafter", "drafted", "accepted", "recent", "disabled")
+
+    def __init__(self, prompt_tokens: list[int]):
+        self.drafter = NGramDrafter(prompt_tokens)
+        self.drafted = 0
+        self.accepted = 0
+        # (drafted, accepted) per verify dispatch that actually drafted.
+        self.recent: deque = deque(maxlen=SPEC_WINDOW)
+        self.disabled = False
+
+    def rate(self) -> float:
+        d = sum(x for x, _ in self.recent)
+        return (sum(a for _, a in self.recent) / d) if d else 0.0
+
+    def note(self, drafted: int, accepted: int) -> bool:
+        """Record one verify dispatch's outcome. Returns True when THIS
+        call tripped the throttle (the caller emits the one flight
+        event)."""
+        if drafted <= 0:
+            return False
+        self.drafted += drafted
+        self.accepted += accepted
+        self.recent.append((drafted, accepted))
+        if (not self.disabled
+                and len(self.recent) >= SPEC_MIN_DISPATCHES
+                and self.rate() < accept_floor()):
+            self.disabled = True
+            return True
+        return False
+
+
+def accept_prefix(drafts: list[int],
+                  proposals: list[int]) -> tuple[list[int], int]:
+    """The acceptance rule: `proposals` are the device's per-position
+    tokens for the run ``[last, d_0, ..., d_{k-1}]`` (len == k+1).
+    Returns (emit, accepted): the committed tokens ``t_0..t_a`` —
+    accepted drafts plus the correction/bonus token — and the accepted
+    draft count a."""
+    a = 0
+    while a < len(drafts) and drafts[a] == proposals[a]:
+        a += 1
+    return list(proposals[:a + 1]), a
+
+
+# --- test-visibility counters (tests/conftest.py `spec_decode` guard) ---
+
+_lock = threading.Lock()
+_drafted = 0
+_accepted = 0
+_dispatches = 0
+
+
+def reset_test_counters() -> None:
+    global _drafted, _accepted, _dispatches
+    with _lock:
+        _drafted = _accepted = _dispatches = 0
+
+
+def note_spec_dispatch(drafted: int, accepted: int) -> None:
+    global _drafted, _accepted, _dispatches
+    with _lock:
+        _drafted += drafted
+        _accepted += accepted
+        _dispatches += 1
+
+
+def drafted_seen() -> int:
+    return _drafted
+
+
+def accepted_seen() -> int:
+    return _accepted
+
+
+def dispatches_seen() -> int:
+    return _dispatches

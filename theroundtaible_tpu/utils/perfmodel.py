@@ -328,7 +328,9 @@ class EnginePerf:
 
     def publish_mixed_sample(self, prefill_tokens: int,
                              decode_tokens: int,
-                             seconds: float) -> None:
+                             seconds: float,
+                             decode_dispatch_tokens: Optional[int] = None,
+                             ) -> None:
         """Per-RAGGED-segment attribution (ISSUE 8): a mixed dispatch
         carries both prefill chunks and decode tokens, so the roofline
         gauges split by per-row token counts instead of classifying the
@@ -338,16 +340,38 @@ class EnginePerf:
         genuinely shared it), so each gauge is a conservative
         lower-bound utilization and their information adds up to the
         real mix — a pure-decode segment degenerates to exactly
-        publish_decode_sample."""
+        publish_decode_sample.
+
+        `decode_dispatch_tokens` (ISSUE 9): a SPECULATIVE verify
+        dispatch commits more decode tokens than it streamed weights
+        for — the forward reads the weight tree once per ROW, not once
+        per accepted token. The roofline gauge must use the dispatch
+        count (1 per row per forward, what a 1-token decode would have
+        produced) or a 3x-accepting run reports 300% bandwidth
+        utilization; the ACCEPTED rate publishes separately as the
+        user-visible `roundtable_spec_accepted_tps`. None (the plain
+        ragged path) means the two counts coincide."""
         if self.decode_ceiling is None or seconds <= 0:
             return
         n = 0
         if decode_tokens > 0:
+            roofline_tokens = (decode_tokens
+                               if decode_dispatch_tokens is None
+                               else decode_dispatch_tokens)
             telemetry.set_gauge(
                 "roundtable_bw_utilization",
-                (decode_tokens / seconds) / self.decode_ceiling,
+                (roofline_tokens / seconds) / self.decode_ceiling,
                 engine=self.engine_name, phase="decode")
             n += 1
+            if decode_dispatch_tokens is not None:
+                # Published on EVERY speculative sample, including the
+                # zero-accept case where the two counts coincide — a
+                # gauge updated only on acceptance would stay frozen at
+                # the last good rate exactly when acceptance collapses.
+                telemetry.set_gauge(
+                    "roundtable_spec_accepted_tps",
+                    decode_tokens / seconds, engine=self.engine_name)
+                n += 1
         if prefill_tokens > 0:
             telemetry.set_gauge(
                 "roundtable_mfu",
@@ -463,6 +487,7 @@ PERF_SERIES_PREFIXES = (
     "roundtable_compile", "roundtable_steady_state",
     "roundtable_kv_", "roundtable_hbm_", "roundtable_session_kv_",
     "roundtable_prefix_",   # ISSUE 7: prefix-cache hit/miss/size series
+    "roundtable_spec_",     # ISSUE 9: speculation accept/rate series
 )
 
 

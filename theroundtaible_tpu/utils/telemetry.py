@@ -712,6 +712,7 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "segments": "roundtable_sched_segments_total",
         "ragged_segments": "roundtable_sched_ragged_segments_total",
         "ragged_joins": "roundtable_sched_ragged_joins_total",
+        "spec_segments": "roundtable_sched_spec_segments_total",
         "segment_prefill_tokens":
             "roundtable_segment_prefill_tokens_total",
         "segment_decode_tokens":
@@ -727,6 +728,19 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "spilled_sessions": "roundtable_kv_spilled_sessions gauge "
                             "(kv_offload tier)",
         "events": "flight recorder ring (sched_* kinds)",
+    },
+    # engine.describe()["spec_decode"] (ISSUE 9): the speculation
+    # provenance sink's registry bindings — drafted/accepted/rejected
+    # counters move in lockstep with the describe() totals
+    # (engine.note_spec_dispatch is the one writer for both).
+    "engine_spec_decode": {
+        "drafted_tokens": "roundtable_spec_drafted_tokens_total",
+        "accepted_tokens": "roundtable_spec_accepted_tokens_total",
+        "rejected_tokens": "roundtable_spec_rejected_tokens_total",
+        "acceptance_rate": "roundtable_spec_acceptance_rate gauge",
+        "throttled_rows": "spec_throttle flight events (one per trip)",
+        "verify_dispatches": "roundtable_sched_spec_segments_total "
+                             "(+ warmup dispatches)",
     },
 }
 
