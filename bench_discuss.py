@@ -45,6 +45,17 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            mean accepted tokens per verify dispatch, p50/p95 turn
            latency, and the greedy token-parity bit across modes.
            ROUNDTABLE_BENCH_SPEC_ROUNDS overrides the round count.)
+       ROUNDTABLE_BENCH_LORA=1 ..        (multi-LoRA persona A/B,
+           ISSUE 10: the same K-knight scripted load served (a) as K
+           LoRA personas co-batched on ONE shared base engine vs (b)
+           as a K-checkpoint fleet (one engine per distinct seed — the
+           pre-LoRA diversity recipe), in ONE record — aggregate
+           decode tok/s, resident HBM bytes per mode (the acceptance
+           bar: shared-base K personas < 1.5x a single base vs ~Kx for
+           the fleet), per-knight next-token distribution divergence
+           (personas must be DIFFERENT models, measurably), the
+           mixed-vs-alone token-parity bit, and the lora store/path
+           provenance embedded. ROUNDTABLE_BENCH_LORA_K overrides K.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -1027,6 +1038,350 @@ def child() -> int:
     return 0
 
 
+
+
+def lora_child() -> int:
+    """Multi-LoRA persona A/B (ISSUE 10 acceptance): the same K-knight
+    scripted multi-round load served two ways on the same base model —
+
+    (a) SHARED BASE: one engine + K LoRA persona adapters, all K
+        knights co-batched through the session scheduler (mixed-adapter
+        decode segments on one resident base);
+    (b) K-CHECKPOINT FLEET: K engines with distinct seeds (the
+        pre-LoRA diversity recipe — each persona costs a full resident
+        model), each serving its knight concurrently.
+
+    Emits ONE JSON line with both modes: aggregate decode tok/s,
+    resident HBM bytes (weights + KV + adapter stacks — the acceptance
+    bar is shared-base < 1.5x a single base vs ~Kx for the fleet),
+    per-knight NEXT-TOKEN DISTRIBUTION divergence (mean pairwise total
+    variation on a probe prompt — personas must be measurably distinct
+    models, not labels), the mixed-vs-alone token-parity bit, and the
+    lora store/path provenance embedded (the int4_paths pattern)."""
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = "tiny-gemma" if on_cpu else "gemma-2b-it"
+    max_seq = 1024 if on_cpu else 2048
+    k = int(os.environ.get("ROUNDTABLE_BENCH_LORA_K", "3"))
+    rounds = 3
+    max_new = 32 if on_cpu else 64
+    kw = {}
+    if on_cpu:
+        kw["mesh_shape"] = {"data": 1, "model": 1}
+    personas = {f"persona{i}": {"seed": 11 + i, "init_std": 0.5}
+                for i in range(k)}
+    lora_scale = 4.0
+    checkpoint = ""
+    lora_dir = os.environ.get("ROUNDTABLE_BENCH_LORA_DIR")
+    if lora_dir:
+        # TRAINED personas (bench_realweights --train-lora npzs) in
+        # place of the random self-contained defaults — fitted at
+        # apply scale 1.0 against the REALWEIGHTS tiny-llama
+        # checkpoint, so this mode serves that exact base (A/B shapes
+        # are model-shaped; a different base would reject them).
+        import glob
+        npzs = sorted(glob.glob(os.path.join(lora_dir, "*.npz")))[:k]
+        ckpt = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".cache", "realweights_ckpt")
+        if npzs and os.path.exists(os.path.join(ckpt, "config.json")):
+            personas = {os.path.splitext(os.path.basename(f_))[0]:
+                        {"path": f_} for f_ in npzs}
+            k = len(personas)
+            lora_scale = 1.0
+            model = "tiny-llama"
+            max_seq = 512
+            checkpoint = ckpt
+    names = list(personas)
+    cfg = get_model_config(model, max_seq_len=max_seq)
+    lora_cfg = {"rank": 8, "max_adapters": k, "scale": lora_scale,
+                "adapters": personas}
+    probe = ("The roundtable convenes; the knight weighs the proposal "
+             "and begins to speak:")
+
+    def turn_prompt(i: int, rnd: int, transcript: str) -> str:
+        return (f"{transcript}\nRound {rnd}, knight {i} argues the "
+                "proposal on its merits: ")
+
+    def hbm_resident(engines) -> int:
+        total = 0
+        for e in engines:
+            total += e.perf.param_bytes + e.kv.hbm_bytes()
+            if getattr(e, "lora", None) is not None:
+                total += e.lora.stack_bytes()
+        return total
+
+    def probe_divergence(dists: list[np.ndarray]) -> float:
+        """Mean pairwise total-variation distance between the knights'
+        next-token distributions — 0 = identical models, 1 = disjoint
+        support. The measurable persona-diversity claim."""
+        tv = []
+        for i in range(len(dists)):
+            for j in range(i + 1, len(dists)):
+                tv.append(0.5 * float(np.abs(dists[i]
+                                             - dists[j]).sum()))
+        return round(sum(tv) / max(len(tv), 1), 4)
+
+    def lora_probe_dist(eng, adapter) -> np.ndarray:
+        """Next-token distribution of the probe prompt under one
+        persona (the engine's own forward with the lora scope — the
+        exact serving math, eagerly)."""
+        from theroundtaible_tpu.engine.lora import lora_scope
+        from theroundtaible_tpu.engine.models.common import forward
+        toks = jnp.asarray([eng.tokenizer.encode(probe)], jnp.int32)
+        pos = jnp.arange(toks.shape[1], dtype=jnp.int32)[None]
+        valid = jnp.asarray([toks.shape[1]], jnp.int32)
+        last = valid - 1
+        slot = 0 if adapter is None else eng.lora.slot_of(adapter)
+        ids = jnp.full((1,), slot, jnp.int32)
+        with lora_scope((eng.lora.stacked, ids)):
+            logits, _ = forward(eng.params, eng.cfg, toks, pos, None,
+                                None, valid, last_pos=last)
+        p = jax.nn.softmax(logits[0, 0].astype(jnp.float32))
+        return np.asarray(p)
+
+    def base_probe_dist(eng) -> np.ndarray:
+        from theroundtaible_tpu.engine.models.common import forward
+        toks = jnp.asarray([eng.tokenizer.encode(probe)], jnp.int32)
+        pos = jnp.arange(toks.shape[1], dtype=jnp.int32)[None]
+        valid = jnp.asarray([toks.shape[1]], jnp.int32)
+        logits, _ = forward(eng.params, eng.cfg, toks, pos, None, None,
+                            valid, last_pos=valid - 1)
+        return np.asarray(jax.nn.softmax(
+            logits[0, 0].astype(jnp.float32)))
+
+    def run_shared() -> dict:
+        eng = InferenceEngine(
+            cfg, checkpoint=checkpoint, num_slots=k + 1,
+            kv_layout="paged", num_pages=(k + 1) * max_seq // 128,
+            lora=lora_cfg, **kw)
+        warm_s = eng.warmup(max_prompt_tokens=256, batch_sizes=(1,))
+        sched = SessionScheduler(eng, admit_hold_s=0.25)
+        results: dict = {}
+        errors: list = []
+        dec = {"tokens": 0}
+        lock = threading.Lock()
+
+        def knight(i):
+            transcript = ""
+            try:
+                for rnd in range(rounds):
+                    txts, stats = sched.submit(
+                        f"s{i}", [(f"knight{i}",
+                                   turn_prompt(i, rnd, transcript))],
+                        max_new_tokens=max_new,
+                        adapters_per_turn=[names[i]])
+                    transcript += f"\nKnight {i}: {txts[0]}"
+                    with lock:
+                        dec["tokens"] += stats.decode_tokens
+                results[i] = transcript
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=knight, args=(i,))
+                   for i in range(k)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.monotonic() - t0
+        if errors:
+            raise RuntimeError(f"shared-base mode: {errors}")
+        # Token parity: round-0 turn re-served ALONE per persona must
+        # match what the mixed co-batched run emitted.
+        parity = True
+        for i in range(k):
+            alone = eng.generate_batch(
+                [(f"knight{i}", turn_prompt(i, 0, ""))],
+                max_new_tokens=max_new, session=f"alone{i}",
+                adapters_per_turn=[names[i]])[0]
+            if not results[i].startswith(f"\nKnight {i}: {alone}"):
+                parity = False
+        dists = [lora_probe_dist(eng, names[i]) for i in range(k)]
+        sched_d = sched.describe()
+        out = {
+            "engines": 1,
+            "decode_tokens": dec["tokens"],
+            "wall_s": round(wall, 2),
+            "aggregate_decode_tok_s": round(dec["tokens"]
+                                            / max(wall, 1e-9), 1),
+            "hbm_resident_bytes": hbm_resident([eng]),
+            "weights_bytes": eng.perf.param_bytes,
+            "kv_bytes": eng.kv.hbm_bytes(),
+            "adapter_stack_bytes": eng.lora.stack_bytes(),
+            "divergence_tv": probe_divergence(dists),
+            "mixed_vs_alone_parity": parity,
+            "warmup_s": round(warm_s, 1),
+            "max_occupancy": sched_d["max_occupancy"],
+            "lora": eng.lora_describe(),
+        }
+        sched.close()
+        return out, hbm_resident([eng]) - eng.lora.stack_bytes()
+
+    def run_fleet() -> dict:
+        engines = [InferenceEngine(
+            cfg, checkpoint=checkpoint, num_slots=2, kv_layout="paged",
+            num_pages=2 * max_seq // 128, seed=11 + i, **kw)
+            for i in range(k)]
+        warm_s = sum(e.warmup(max_prompt_tokens=256, batch_sizes=(1,))
+                     for e in engines)
+        errors: list = []
+        dec = {"tokens": 0}
+        lock = threading.Lock()
+
+        def knight(i):
+            transcript = ""
+            try:
+                for rnd in range(rounds):
+                    txts, stats = engines[i].generate_batch_with_stats(
+                        [(f"knight{i}",
+                          turn_prompt(i, rnd, transcript))],
+                        max_new_tokens=max_new, session=f"f{i}")
+                    transcript += f"\nKnight {i}: {txts[0]}"
+                    with lock:
+                        dec["tokens"] += stats.decode_tokens
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=knight, args=(i,))
+                   for i in range(k)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.monotonic() - t0
+        if errors:
+            raise RuntimeError(f"fleet mode: {errors}")
+        dists = [base_probe_dist(e) for e in engines]
+        return {
+            "engines": k,
+            "decode_tokens": dec["tokens"],
+            "wall_s": round(wall, 2),
+            "aggregate_decode_tok_s": round(dec["tokens"]
+                                            / max(wall, 1e-9), 1),
+            "hbm_resident_bytes": hbm_resident(engines),
+            "weights_bytes": sum(e.perf.param_bytes for e in engines),
+            "kv_bytes": sum(e.kv.hbm_bytes() for e in engines),
+            # ONE fleet engine's residency — the honest "single base"
+            # denominator for the headline ratios (the shared engine's
+            # own bytes include a K-session KV pool, which would
+            # inflate the denominator and flatter both ratios).
+            "single_base_bytes": hbm_resident(engines[:1]),
+            "divergence_tv": probe_divergence(dists),
+            "warmup_s": round(warm_s, 1),
+        }
+
+    shared, shared_minus_stack = run_shared()
+    # Trained-persona mode serves ONE real checkpoint — there is no
+    # distinct-seed fleet to honestly compare against, so the A/B leg
+    # runs only for the self-contained random-persona default.
+    fleet = (run_fleet() if not checkpoint
+             else {"skipped": "single trained checkpoint"})
+    # Single-base denominator: one FLEET-shaped engine where the A/B
+    # leg ran (its KV pool is single-session-sized); the shared
+    # engine's own residency minus adapter stacks is the fallback —
+    # conservative for the shared ratio (its pool serves K sessions).
+    single_base_bytes = fleet.get("single_base_bytes",
+                                  shared_minus_stack)
+    result_line = {
+        "metric": f"multi_lora_personas[{model}][K={k}]",
+        "value": shared["aggregate_decode_tok_s"],
+        "unit": "aggregate_decode_tok_s_shared_base",
+        "detail": {
+            "personas": k,
+            "rounds": rounds,
+            "shared_base_k_adapters": shared,
+            "per_checkpoint_fleet": fleet,
+            # The acceptance bar: K personas on one base must stay
+            # under 1.5x a single base's residency; the fleet pays ~Kx.
+            "single_base_bytes": single_base_bytes,
+            # The persona-cost axis, KV factored out: serving K
+            # personas costs (weights + adapter stacks) / weights of
+            # ONE base — the model-size-independent claim (KV pools
+            # scale with SESSIONS SERVED on either design, and on a
+            # tiny CPU model they dwarf the weights; on a real 2B+
+            # model weights dominate and the total ratio converges to
+            # this one).
+            "weights_ratio_shared_vs_single_base": round(
+                (shared["weights_bytes"]
+                 + shared["adapter_stack_bytes"])
+                / max(shared["weights_bytes"], 1), 3),
+            "weights_ratio_fleet_vs_single_base": float(k),
+            # The ISSUE 10 acceptance bar, stated against THIS record:
+            # on the persona-cost axis it holds here; the total-
+            # residency form is weights-dominated only on real chips
+            # (this CPU record's pools dwarf the tiny weights), so its
+            # on-chip value is the window-3 measurement.
+            "acceptance": {
+                "criterion": "K-persona resident HBM < 1.5x "
+                             "single-base (vs ~Kx per-checkpoint)",
+                "weights_axis_ratio": round(
+                    (shared["weights_bytes"]
+                     + shared["adapter_stack_bytes"])
+                    / max(shared["weights_bytes"], 1), 3),
+                "meets_on_weights_axis": (
+                    shared["weights_bytes"]
+                    + shared["adapter_stack_bytes"])
+                < 1.5 * shared["weights_bytes"],
+                "total_ratio_this_platform": round(
+                    shared["hbm_resident_bytes"]
+                    / max(single_base_bytes, 1), 3),
+                "total_ratio_note": (
+                    "KV pools dominate tiny CPU models; on 2B+ "
+                    "weights the total converges to the weights "
+                    "axis — measured by the window-3 step"),
+            },
+            "single_base_def": ("one_fleet_engine"
+                                if "single_base_bytes" in fleet
+                                else "shared_minus_adapter_stacks"),
+            "hbm_ratio_shared_vs_single_base": round(
+                shared["hbm_resident_bytes"]
+                / max(single_base_bytes, 1), 3),
+            "hbm_ratio_fleet_vs_single_base": (round(
+                fleet["hbm_resident_bytes"]
+                / max(single_base_bytes, 1), 3)
+                if "hbm_resident_bytes" in fleet else None),
+            "hbm_saved_bytes_vs_fleet": (
+                fleet["hbm_resident_bytes"]
+                - shared["hbm_resident_bytes"]
+                if "hbm_resident_bytes" in fleet else None),
+            # CPU walls favor the fleet: K tiny engines decode with no
+            # scheduler tick/hold overhead, while the shared batch pays
+            # per-segment host round-trips that dwarf tiny-model
+            # compute (the SPEC_r09 caveat verbatim). The on-chip claim
+            # is the HBM column: K personas resident for ~1x one base
+            # vs the fleet's ~Kx — the chip count it frees IS the
+            # throughput multiplier at fleet scale.
+            "cpu_wall_caveat": on_cpu,
+            "platform": jax.devices()[0].platform,
+            "telemetry": _registry_snapshot(),
+        },
+    }
+    print(json.dumps(result_line), flush=True)
+    return 0
+
+
 def main() -> int:
     from bench_common import run_watchdogged
     # The offered-load / prefix-reuse sweeps run many scripted
@@ -1035,12 +1390,15 @@ def main() -> int:
                  if os.environ.get("ROUNDTABLE_BENCH_OFFERED_LOAD")
                  or os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE")
                  or os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE")
+                 or os.environ.get("ROUNDTABLE_BENCH_LORA")
                  else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
                            attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_LORA"):
+        return lora_child()
     if os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE"):
         return spec_decode_child()
     if os.environ.get("ROUNDTABLE_BENCH_LATE_JOIN"):

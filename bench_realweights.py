@@ -53,6 +53,7 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent
 ARTIFACT = ROOT / "REALWEIGHTS_r05.json"
 CKPT_DIR = ROOT / ".cache" / "realweights_ckpt"
+LORA_DIR = ROOT / ".cache" / "realweights_lora"
 
 VOCAB = 512  # registry tiny-llama shape — the adapter serves it as-is
 BOS, EOS, PAD = 1, 2, 0
@@ -386,6 +387,153 @@ def measure_served(min_turns: int = 20, budget=None,
     return snapshot(partial=False)
 
 
+
+
+# --- tiny per-persona LoRA training (ISSUE 10 satellite) ---
+
+# Persona flavors for --train-lora: each gets a reply corpus skewed to
+# its temperament (openers + score mass), so the fitted A/B pair steers
+# the SERVED distribution measurably — real trained personas, not
+# random deltas, for the multi-LoRA bench (bench_discuss
+# ROUNDTABLE_BENCH_LORA=1 reads the npzs via ROUNDTABLE_BENCH_LORA_DIR).
+PERSONA_STYLES = {
+    "optimist": {"openers": [
+        "The plan is sound but the details matter.",
+        "This approach fits the constraints we named.",
+        "The tradeoff is acceptable at this scale."],
+        "scores": [9, 10, 9, 8]},
+    "skeptic": {"openers": [
+        "I remain skeptical of one part of this.",
+        "My objection from last round still stands.",
+        "I have weighed the proposal carefully."],
+        "scores": [3, 5, 2, 5]},
+    "pragmatist": {"openers": [
+        "The tradeoff is acceptable at this scale.",
+        "I have weighed the proposal carefully.",
+        "The plan is sound but the details matter."],
+        "scores": [7, 8, 7, 9]},
+}
+
+
+def _persona_corpus(name: str, n: int, rng: random.Random) -> list[str]:
+    style = PERSONA_STYLES[name]
+    out = []
+    for _ in range(n):
+        score = rng.choice(style["scores"])
+        parts = {"consensus_score": score,
+                 "agrees_with": (rng.sample(AGREES, 2) if score >= 7
+                                 else []),
+                 "pending_issues": ([] if score >= 9
+                                    else rng.sample(ISSUES, 1))}
+        out.append(f"{rng.choice(TOPICS)}\n"
+                   f"{rng.choice(style['openers'])}\n"
+                   f"```json\n{json.dumps(parts)}\n```\n")
+    return out
+
+
+def train_lora_personas(steps: int = 60, rank: int = 8,
+                        seq_len: int = 96, batch: int = 8) -> dict:
+    """Fit one tiny LoRA pair per persona against the CACHED realweights
+    checkpoint, by SGD through the ENGINE's own forward under a
+    lora_scope — the exact serving math (models/common._einsum tagged
+    seams), so what training steers is literally what serving applies.
+    Saves engine/lora.save_pair_tree npzs under LORA_DIR (trained at
+    apply scale 1.0 — serve them with `lora: {"scale": 1.0}`)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from theroundtaible_tpu.engine.checkpoint import load_hf_checkpoint
+    from theroundtaible_tpu.engine.lora import (lora_dims, lora_scope,
+                                                save_pair_tree)
+    from theroundtaible_tpu.engine.models.common import forward
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.tokenizer import load_tokenizer
+
+    t0 = time.time()
+    cfg = get_model_config("tiny-llama", max_seq_len=512)
+    params = load_hf_checkpoint(str(CKPT_DIR), cfg, jnp.float32)
+    tok = load_tokenizer(str(CKPT_DIR))
+    dims = lora_dims(cfg)
+    LORA_DIR.mkdir(parents=True, exist_ok=True)
+
+    def batches(texts: list[str], rng: np.random.Generator):
+        ids = [([BOS] + tok.encode(t, add_bos=False))[:seq_len]
+               for t in texts]
+        while True:
+            pick = rng.integers(0, len(ids), size=batch)
+            arr = np.full((batch, seq_len), PAD, np.int32)
+            lens = np.zeros(batch, np.int32)
+            for j, i in enumerate(pick):
+                arr[j, :len(ids[i])] = ids[i]
+                lens[j] = len(ids[i])
+            yield jnp.asarray(arr), jnp.asarray(lens)
+
+    def stack_of(ab):
+        # slot 0 = zero base, slot 1 = the trainable pair — the exact
+        # stacked layout the serving store uses.
+        return {key: {"a": jnp.stack([jnp.zeros_like(a), a]),
+                      "b": jnp.stack([jnp.zeros_like(b), b])}
+                for key, (a, b) in ab.items()}
+
+    ids1 = jnp.ones((batch,), jnp.int32)
+    positions = jnp.broadcast_to(
+        jnp.arange(seq_len, dtype=jnp.int32), (batch, seq_len))
+
+    def loss_fn(ab, tokens, lens):
+        with lora_scope((stack_of(ab), ids1)):
+            logits, _ = forward(params, cfg, tokens, positions, None,
+                                None, lens)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        tgt = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                   axis=-1)[..., 0]
+        mask = (jnp.arange(seq_len - 1)[None, :]
+                < (lens - 1)[:, None]).astype(jnp.float32)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    @jax.jit
+    def step(ab, vel, tokens, lens, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(ab, tokens, lens)
+        vel = jax.tree_util.tree_map(
+            lambda v, g: 0.9 * v + g, vel, grads)
+        ab = jax.tree_util.tree_map(
+            lambda p_, v: p_ - lr * v, ab, vel)
+        return ab, vel, loss
+
+    report = {}
+    for pi, name in enumerate(sorted(PERSONA_STYLES)):
+        rng = np.random.default_rng(100 + pi)
+        key = jax.random.PRNGKey(100 + pi)
+        ab = {}
+        for ki, (leaf, (c, o, _tp)) in enumerate(sorted(dims.items())):
+            ka, _ = jax.random.split(jax.random.fold_in(key, ki))
+            # classic LoRA init UNDER TRAINING: A random, B zero — the
+            # delta starts exactly 0 and the gradient shapes it.
+            ab[leaf] = (jax.random.normal(ka, (rank, c), jnp.float32)
+                        * (c ** -0.5),
+                        jnp.zeros((rank, o), jnp.float32))
+        vel = jax.tree_util.tree_map(jnp.zeros_like, ab)
+        gen = batches(_persona_corpus(name, 64, random.Random(7 + pi)),
+                      rng)
+        first = last = None
+        for i in range(steps):
+            tokens, lens = next(gen)
+            ab, vel, loss = step(ab, vel, tokens, lens,
+                                 jnp.float32(0.05))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        save_pair_tree(str(LORA_DIR / f"{name}.npz"),
+                       {k: (np.asarray(a), np.asarray(b))
+                        for k, (a, b) in ab.items()})
+        report[name] = {"loss_first": round(first, 4),
+                        "loss_last": round(last, 4)}
+    return {"personas": report, "rank": rank, "steps": steps,
+            "dir": str(LORA_DIR),
+            "train_seconds": round(time.time() - t0, 1)}
+
+
 def main() -> int:
     # Clean SIGTERM exit (sys.exit → atexit → PJRT teardown): this bench
     # runs under `timeout` in the window scripts, and a hard-killed JAX
@@ -406,7 +554,31 @@ def main() -> int:
                     help="train/cache the checkpoint and exit — the "
                          "OFF-WINDOW half of the run (the on-window "
                          "half is then pure load-and-serve)")
+    ap.add_argument("--train-lora", action="store_true",
+                    help="fit tiny per-persona LoRA pairs on the "
+                         "cached checkpoint and exit (ISSUE 10): "
+                         "saves npzs under .cache/realweights_lora "
+                         "for the ROUNDTABLE_BENCH_LORA bench "
+                         "(serve with lora scale 1.0)")
+    ap.add_argument("--lora-steps", type=int, default=60)
     args = ap.parse_args()
+
+    if args.train_lora:
+        if not (CKPT_DIR / "config.json").exists():
+            print(json.dumps({
+                "metric": "realweights_train_lora", "value": 0.0,
+                "unit": "status", "status": "no_cached_checkpoint",
+                "detail": {"fix": "run bench_realweights.py "
+                                  "--train-only first"}}), flush=True)
+            return 0
+        rep = train_lora_personas(steps=args.lora_steps)
+        print(json.dumps({
+            "metric": "realweights_train_lora",
+            "value": min(p["loss_last"]
+                         for p in rep["personas"].values()),
+            "unit": "final_nll",
+            "detail": rep}), flush=True)
+        return 0
 
     from theroundtaible_tpu.engine import deadlines
     budget = deadlines.Budget.root(

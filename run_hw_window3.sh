@@ -55,6 +55,12 @@ run_step "bench_profile.py" python bench_profile.py
 # greedy parity bit. Every perf claim needs its window-3 baseline.
 run_step "bench_discuss.py (spec-decode A/B)" \
   env ROUNDTABLE_BENCH_SPEC_DECODE=1 python bench_discuss.py
+# Multi-LoRA persona A/B (ISSUE 10): the K-knight load as K LoRA
+# personas co-batched on ONE shared base vs a K-checkpoint fleet —
+# aggregate tok/s, resident HBM per mode (the < 1.5x-single-base bar),
+# persona distribution divergence, mixed-vs-alone parity bit.
+run_step "bench_discuss.py (multi-LoRA A/B)" \
+  env ROUNDTABLE_BENCH_LORA=1 python bench_discuss.py
 # 1500 s: the 900 s budget SIGTERMed twice — host-side training alone
 # is ~330 s and first-time tunnel compiles are 20-40 s per prefill
 # shape bucket. Still LAST so even a hang costs no core measurement.

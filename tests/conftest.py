@@ -282,6 +282,34 @@ def _spec_decode_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _lora_guard(request):
+    """Tier-1 guard for @pytest.mark.lora (ISSUE 10 satellite): a test
+    that CLAIMS multi-LoRA co-batching coverage must not silently serve
+    one adapter (or the base) at a time — if no dispatch during the
+    test ever carried >= 2 DISTINCT non-base adapters in one program,
+    the grouped-batched path never actually mixed personas and the
+    test's co-batching claims are vacuous; fail LOUD. Store/evict/
+    kernel unit tests (which legitimately run single-adapter) mark
+    allow_single=True."""
+    marker = request.node.get_closest_marker("lora")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import lora as lora_mod
+
+    lora_mod.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_single"):
+        return
+    assert lora_mod.max_mixed_seen() >= 2, (
+        "lora-marked test never mixed >= 2 distinct adapters in one "
+        f"dispatch (max {lora_mod.max_mixed_seen()} across "
+        f"{lora_mod.dispatches_seen()} dispatches): grouped batched "
+        "LoRA silently served per-adapter — mark allow_single=True "
+        "only for store/evict/kernel units")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

@@ -42,6 +42,14 @@ MIN_AVAILABLE_TOKENS = 2000
 BATCH_BUDGET_FRACTION = 0.6
 
 
+def _engine_serves_lora(engine) -> bool:
+    """True when this engine resolved an adapter store (ISSUE 10).
+    Gates the adapters_per_turn kwarg: the PP engine's
+    generate_batch_with_stats has no such parameter, and a lora-off
+    engine serves base regardless — both must decline gracefully."""
+    return getattr(engine, "lora", None) is not None
+
+
 class TpuLlmAdapter(BaseAdapter):
     """BaseAdapter over an EngineHandle (theroundtaible_tpu.engine)."""
 
@@ -56,6 +64,12 @@ class TpuLlmAdapter(BaseAdapter):
         # one resident engine never collide — and routes rounds through
         # the attached continuous-batching scheduler when one is set.
         self.session = session
+        # Persona adapter id (ISSUE 10): the LoRA adapter this knight
+        # speaks through on a shared-base engine — `lora_adapter` is
+        # the adapter-level default, `knight_adapters: {name: id}`
+        # overrides per seat (the knight_sampling pattern). None (or a
+        # lora-off engine) serves the base model.
+        self.persona_adapter = engine_config.get("lora_adapter")
         self._scheduler = None
         self._engine = None
         self._engine_error: Optional[str] = None
@@ -205,6 +219,20 @@ class TpuLlmAdapter(BaseAdapter):
     def supports_batched_rounds(self) -> bool:
         return True
 
+    def _adapter_for(self, knight_name: str) -> Optional[str]:
+        """The LoRA persona adapter id for a seat: per-knight
+        `knight_adapters` map first, then the adapter-level
+        `lora_adapter` default."""
+        overrides = self.engine_config.get("knight_adapters", {})
+        return overrides.get(knight_name, self.persona_adapter)
+
+    def _adapters_for(self, turns) -> Optional[list]:
+        """Per-turn adapter ids for one round, or None when every
+        seat serves the base model (the common non-persona fleet keeps
+        its exact pre-LoRA call signature)."""
+        ads = [self._adapter_for(t.knight_name) for t in turns]
+        return ads if any(a is not None for a in ads) else None
+
     def _sampling_for(self, knight_name: str):
         """Per-knight SamplingParams: `knight_sampling: {name: {...}}` in
         the adapter config overrides the engine default per seat —
@@ -337,6 +365,16 @@ class TpuLlmAdapter(BaseAdapter):
         kwargs: dict[str, Any] = {
             "timeout_s": max(batch_budget.remaining(), 0.0),
             "budget": batch_budget}
+        ads = self._adapters_for(turns)
+        if ads is not None and _engine_serves_lora(engine):
+            # Persona adapters ride the round into the engine /
+            # scheduler (ISSUE 10); co-batched knights with DIFFERENT
+            # personas decode in one mixed-adapter segment. Engines
+            # without a lora store — the PP engine, a kill-switched or
+            # config-less InferenceEngine — serve the base model
+            # instead of choking on an unknown kwarg (the
+            # ROUNDTABLE_LORA=0 byte-identity contract).
+            kwargs["adapters_per_turn"] = ads
         if per_turn is not None:
             kwargs["sampling_per_turn"] = per_turn
             # call-level cap = the LARGEST per-knight budget, so a
@@ -433,6 +471,9 @@ class TpuLlmAdapter(BaseAdapter):
             kwargs: dict[str, Any] = {
                 "timeout_s": max(knight_budget.remaining(), 0.0),
                 "budget": knight_budget}
+            ad = self._adapter_for(t.knight_name)
+            if ad is not None and _engine_serves_lora(engine):
+                kwargs["adapters_per_turn"] = [ad]
             if per_turn is not None:
                 kwargs["sampling_per_turn"] = [per_turn[i]]
                 kwargs["max_new_tokens"] = per_turn[i].max_new_tokens

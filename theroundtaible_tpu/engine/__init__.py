@@ -98,7 +98,7 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "kv_layout", "page_size", "num_pages", "n_micro",
                  "quant", "dcn_axis", "prefix_cache",
                  "prefix_cache_pages", "kv_offload", "ragged_attn",
-                 "spec_decode", "spec_max_draft")}
+                 "spec_decode", "spec_max_draft", "lora")}
     return json.dumps(relevant, sort_keys=True)
 
 
@@ -166,3 +166,19 @@ def reset_engines() -> None:
     with _lock:
         _engines.clear()
         _breakers.clear()
+
+
+# Public multi-LoRA surface (ISSUE 10 satellite): `from
+# theroundtaible_tpu.engine import LoraStore` without deep paths.
+# PEP 562 lazy export — engine/__init__ must stay importable without
+# pulling jax at module load (bench parents import it pre-backend).
+_LORA_EXPORTS = ("LoraStore", "lora_enabled", "lora_dims",
+                 "save_pair_tree")
+
+
+def __getattr__(name: str):
+    if name in _LORA_EXPORTS:
+        from . import lora as _lora
+        return getattr(_lora, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -114,7 +114,8 @@ class InferenceEngine:
                  kv_offload: Optional[bool] = None,
                  ragged_attn: Optional[bool] = None,
                  spec_decode: Optional[bool] = None,
-                 spec_max_draft: Optional[int] = None):
+                 spec_max_draft: Optional[int] = None,
+                 lora: Optional[dict] = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -151,6 +152,24 @@ class InferenceEngine:
         # populated as each (batch, bucket) program traces, summarized
         # by int4_path_report()/describe().
         self._int4_dispatches: dict = {}
+        # Multi-LoRA provenance sink (ISSUE 10): the trace-time lora
+        # routing log (engine/lora.apply_current records into it via
+        # the lora_scope every jit program below opens) — the
+        # int4_paths pattern, summarized by lora_describe(). The store
+        # itself resolves AFTER the compiled closures are defined (it
+        # needs the sharded mesh + quant mode); self.lora stays None
+        # on lora-off engines and every `lora=` program argument is
+        # then None, keeping those programs byte-identical.
+        self._lora_dispatches: dict = {}
+        self._lora_quant = "none"
+        self.lora = None
+        self.lora_reason: Optional[str] = None
+        self._lora_tokens = 0
+        self._lora_share_suppressed = 0
+        # adapter-id label per slot NAME (engine-side): prefix sharing
+        # and the cross-session cache must never move K/V between
+        # slots served under different adapters (the bytes differ).
+        self._slot_adapters: dict[str, Optional[str]] = {}
 
         if checkpoint:
             from .checkpoint import load_hf_checkpoint
@@ -335,10 +354,14 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache_layers, slot_idx, tokens, offsets,
-                         lengths):
+                         lengths, lora=None):
             # spmd_mesh is a TRACE-time context: it tells attention() which
             # mesh to shard_map the Pallas kernels over (models/common.py).
-            with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+            # `lora` ((stacked, per-row ids) or None) rides the same
+            # pattern: adapter identity is a VALUE argument, so swaps
+            # and mixed-adapter batches compile nothing (ISSUE 10).
+            with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
+                    self._lora_scope(lora):
                 caches_b = [(k[slot_idx], v[slot_idx])
                             for k, v in cache_layers]
                 t = tokens.shape[1]
@@ -356,7 +379,7 @@ class InferenceEngine:
 
         def decode_while(step_fn, caches, first_token, start_valid, key,
                          budget, temps, top_ks, top_ps, row_budgets,
-                         done0, max_new, greedy):
+                         done0, max_new, greedy, lora=None):
             """The decode while_loop, ONCE for all three cache layouts
             (contiguous, paged gather-view, paged pool-direct) —
             `step_fn(last, valid, caches) -> (logits [B,1,V], caches)` is
@@ -407,7 +430,8 @@ class InferenceEngine:
 
             state = (jnp.int32(0), first_token, start_valid, done, out,
                      caches, key)
-            with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+            with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
+                    self._lora_scope(lora):
                 step, last, valid, done, out, caches, _ = \
                     jax.lax.while_loop(cond, body, state)
             step, last, valid, done, out = host_read(
@@ -425,7 +449,7 @@ class InferenceEngine:
                  static_argnames=("max_new", "greedy"))
         def decode_loop(params, cache_layers, slot_idx, first_token,
                         start_valid, key, budget, temps, top_ks, top_ps,
-                        row_budgets, done0, max_new, greedy):
+                        row_budgets, done0, max_new, greedy, lora=None):
             # The all-done guard skips the per-layer slot gather/scatter
             # too (not just the while_loop) — an all-done segment (the
             # pipelined speculative dispatch's discard case) would
@@ -436,7 +460,7 @@ class InferenceEngine:
                 out, step, last, valid, done, caches_b = decode_while(
                     cached_step(params), caches_b, first_token,
                     start_valid, key, budget, temps, top_ks, top_ps,
-                    row_budgets, done0, max_new, greedy)
+                    row_budgets, done0, max_new, greedy, lora=lora)
                 new_layers = [
                     (k.at[slot_idx].set(nk), v.at[slot_idx].set(nv))
                     for (k, v), (nk, nv) in zip(cache_layers, caches_b)]
@@ -525,8 +549,9 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step_paged(params, pools, tables, tokens, offsets,
-                                   lengths):
-                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+                                   lengths, lora=None):
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
+                        self._lora_scope(lora):
                     b, t = tokens.shape
                     caches_b = gather_view(pools, tables, b)
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
@@ -539,9 +564,10 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step_paged_direct(params, pools, tables, tokens,
-                                          offsets, lengths):
+                                          offsets, lengths, lora=None):
                 from .paged_forward import forward_paged
-                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
+                        self._lora_scope(lora):
                     t = tokens.shape[1]
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
                     valid = offsets + lengths
@@ -564,7 +590,7 @@ class InferenceEngine:
             def decode_loop_paged(params, pools, tables, first_token,
                                   start_valid, key, budget, temps, top_ks,
                                   top_ps, row_budgets, done0, max_new,
-                                  greedy):
+                                  greedy, lora=None):
                 b = first_token.shape[0]
 
                 # All-done guard: skip the full gather view + scatter
@@ -575,7 +601,7 @@ class InferenceEngine:
                     out, step, last, valid, done, caches_b = decode_while(
                         cached_step(params), caches_b, first_token,
                         start_valid, key, budget, temps, top_ks, top_ps,
-                        row_budgets, done0, max_new, greedy)
+                        row_budgets, done0, max_new, greedy, lora=lora)
                     new_pools = scatter_view(pools, tables, caches_b, b)
                     return out, step, last, valid, done, new_pools
 
@@ -591,7 +617,8 @@ class InferenceEngine:
             def decode_loop_paged_direct(params, pools, tables, first_token,
                                          start_valid, key, budget, temps,
                                          top_ks, top_ps, row_budgets,
-                                         done0, max_new, greedy):
+                                         done0, max_new, greedy,
+                                         lora=None):
                 from .paged_forward import forward_paged
 
                 def step_fn(last, valid, pools):
@@ -602,7 +629,7 @@ class InferenceEngine:
                 return decode_while(
                     step_fn, pools, first_token, start_valid, key, budget,
                     temps, top_ks, top_ps, row_budgets, done0, max_new,
-                    greedy)
+                    greedy, lora=lora)
 
             self._decode_loop_paged_gather = decode_loop_paged
             self._decode_loop_paged = (decode_loop_paged_direct
@@ -715,9 +742,11 @@ class InferenceEngine:
                             seq_of_block, block_qstart, query_offsets,
                             kv_valid, last_rows, key, temps, top_ks,
                             top_ps, sample_rows=None, greedy=True,
-                            attn_path="kernel", score_width=0):
+                            attn_path="kernel", score_width=0,
+                            lora=None):
                 from .paged_forward import forward_ragged
-                with spmd_mesh(mesh, int4_sink=self._int4_dispatches):
+                with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
+                        self._lora_scope(lora):
                     logits, new_pools = forward_ragged(
                         params, cfg,
                         tokens, positions, pools, tables, seq_of_block,
@@ -795,6 +824,42 @@ class InferenceEngine:
         # rate by generate/scheduler seams and embedded in describe().
         from ..utils import perfmodel
         self.perf = perfmodel.EnginePerf.from_engine(self)
+
+        # Multi-LoRA knight personas (ISSUE 10): K personas as LoRA
+        # deltas over this ONE resident base. The store holds stacked
+        # per-target A/B tensors whose SHAPES are config-static; every
+        # serving program above takes (stacked, adapter ids) as a
+        # VALUE argument, so mixed-adapter batches, hot-swaps and
+        # occupancy drift compile nothing. Requires an explicit
+        # `lora:` config block; ROUNDTABLE_LORA=0 restores base-only
+        # serving byte-identically (the programs get lora=None and the
+        # tagged _einsum sites short-circuit on the inert scope).
+        from .lora import (DEFAULT_MAX_ADAPTERS, DEFAULT_RANK,
+                           DEFAULT_SCALE, LoraStore, lora_enabled)
+        if not lora:
+            self.lora_reason = "disabled:config"
+        elif not lora_enabled(lora):
+            self.lora_reason = "disabled:env"
+        elif seq_parallel and seq_parallel > 1:
+            # The ring prefill program has no lora seam: serving a
+            # persona row through it would bake UN-lora'd K/V that
+            # decode then reads — a silent parity break, so the whole
+            # feature declines instead (the decline table names it).
+            self.lora_reason = "seq_parallel:ring-prefill"
+        else:
+            lora_cfg = lora if isinstance(lora, dict) else {}
+            self.lora = LoraStore(
+                model_cfg, self.mesh,
+                max_adapters=int(lora_cfg.get("max_adapters",
+                                              DEFAULT_MAX_ADAPTERS)),
+                rank=int(lora_cfg.get("rank", DEFAULT_RANK)),
+                scale=float(lora_cfg.get("scale", DEFAULT_SCALE)),
+                dtype=dtype,
+                quant=lora_cfg.get("quant", "none"),
+                adapters=lora_cfg.get("adapters"),
+                targets=lora_cfg.get("targets"),
+                engine_name=model_cfg.name, perf=self.perf)
+            self._lora_quant = self.lora.quant
 
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
@@ -879,6 +944,7 @@ class InferenceEngine:
             spec_max_draft=(int(config["spec_max_draft"])
                             if config.get("spec_max_draft") is not None
                             else None),
+            lora=config.get("lora"),
         )
         # Set by fleet.check_fleet_fits when it flips an unpinned config
         # to int8: surfaced via describe() so the degrade is visible
@@ -912,6 +978,12 @@ class InferenceEngine:
         # compiles as steady-state violations.
         from . import compile_watch
         compile_watch.reopen_warmup(self.cfg.name)
+        # Warm the adapter store's slot setters FIRST (ISSUE 10): a
+        # steady-state hot-swap must compile nothing under STRICT, and
+        # the serving warms below should trace against setter-produced
+        # stacked layouts — exactly what steady-state swaps feed them.
+        if self.lora is not None:
+            self.lora.warm()
         if self.paged_direct and self._paged_replicas > 1:
             # Replica-grouped padding makes the device batch shape
             # R * max(group) — a function of batch COMPOSITION, not just
@@ -1105,6 +1177,57 @@ class InferenceEngine:
         return ((self.kv.pages_per_replica() // max(rows, 1))
                 * self.kv.page_size - DECODE_SEGMENT)
 
+    def _lora_scope(self, lora):
+        """The trace-time lora context every compiled program opens
+        (engine/lora.lora_scope): inert when `lora` is None — lora-off
+        engines and base-only dispatches trace exactly as before."""
+        from .lora import lora_scope
+        return lora_scope(lora, sink=self._lora_dispatches,
+                          quant=self._lora_quant)
+
+    def _lora_args(self, ids):
+        """Device argument pair (stacked, adapter ids) for one
+        dispatch, or None on lora-off engines. `ids` is per-ROW for
+        batched programs and per-TOKEN for ragged dispatches; the
+        module test counter records each dispatch's adapter mix for
+        the conftest `lora` guard."""
+        if self.lora is None:
+            return None
+        from . import lora as lora_mod
+        ids_np = np.asarray(ids, np.int32)
+        lora_mod.note_dispatch_ids(ids_np)
+        return (self.lora.stacked, jnp.asarray(ids_np))
+
+    def note_lora_tokens(self, n: int) -> None:
+        """Account tokens served THROUGH a persona adapter (ISSUE 10
+        telemetry satellite) — bumped by the serving paths where they
+        already count tokens, so the counter moves with real work."""
+        if n <= 0:
+            return
+        self._lora_tokens += n
+        from ..utils import telemetry
+        telemetry.inc("roundtable_lora_apply_tokens_total", n,
+                      engine=self.cfg.name)
+
+    def lora_describe(self) -> dict[str, Any]:
+        """Multi-LoRA provenance (ISSUE 10): the resolved state, the
+        adapter store's residency/accounting, per-leaf routing paths
+        (grouped kernel vs XLA grouped BMM, with machine-readable
+        decline reasons) — embedded in describe() the way
+        int4_paths/ragged/spec_decode are."""
+        from .lora import summarize_lora_paths
+        info: dict[str, Any] = {
+            "enabled": self.lora is not None,
+            "reason": self.lora_reason,
+            "apply_tokens": self._lora_tokens,
+            "share_suppressed": self._lora_share_suppressed,
+        }
+        if self.lora is not None:
+            info["store"] = self.lora.describe()
+            info["lora_paths"] = summarize_lora_paths(
+                self._lora_dispatches)
+        return info
+
     def int4_path_report(self) -> Optional[dict]:
         """Which path each int4 einsum dispatch COMPILED to (ISSUE 3):
         {"pallas_w4a16": [...], "xla_dequant": [{..., "fallback_reason"}]}
@@ -1216,7 +1339,9 @@ class InferenceEngine:
                 greedy=batch["greedy"],
                 attn_path=("kernel" if path == "pallas_ragged"
                            else "xla"),
-                score_width=score_width)
+                score_width=score_width,
+                lora=self._lora_args(batch["token_adapter"])
+                if self.lora is not None else None)
 
         from . import compile_watch
         with compile_watch.label(
@@ -1335,7 +1460,7 @@ class InferenceEngine:
     def _prefill(self, slot_ids: list[int], token_lists: list[list[int]],
                  offsets: list[int], deadline: float = float("inf"),
                  tables: Optional[np.ndarray] = None,
-                 budget=None) -> jax.Array:
+                 budget=None, lora_ids=None) -> jax.Array:
         """Prefill dispatch: fresh long prompts go to the sequence-parallel
         ring program; everything else (short prompts, delta prefills on a
         reused prefix) takes the chunked bucketed path."""
@@ -1353,10 +1478,14 @@ class InferenceEngine:
             # not an error.
             if tpad and (self.kv_layout != "paged"
                          or tpad % self.kv.page_size == 0):
+                # (lora engines never build a ring program — the
+                # constructor declines the feature on seq-parallel
+                # engines, so lora_ids cannot reach this branch.)
                 return self._prefill_ring(slot_ids, token_lists, tpad,
                                           tables)
         return self._prefill_chunked(slot_ids, token_lists, offsets,
-                                     deadline, tables, budget)
+                                     deadline, tables, budget,
+                                     lora_ids=lora_ids)
 
     def _prefill_ring(self, slot_ids: list[int],
                       token_lists: list[list[int]], tpad: int,
@@ -1393,7 +1522,7 @@ class InferenceEngine:
                          token_lists: list[list[int]], offsets: list[int],
                          deadline: float = float("inf"),
                          tables: Optional[np.ndarray] = None,
-                         budget=None) -> jax.Array:
+                         budget=None, lora_ids=None) -> jax.Array:
         """Chunked, bucketed prefill for B rows (serving_loop loop with
         this engine's step program). Returns last-token logits [B, V].
 
@@ -1405,6 +1534,14 @@ class InferenceEngine:
             tables = jnp.asarray(tables)
         else:
             tables = None
+        # Per-row adapter slots for the whole call (ISSUE 10): chunk
+        # composition varies, the ids do not — one device arg serves
+        # every chunk dispatch.
+        lora_arg = None
+        if self.lora is not None:
+            lora_arg = self._lora_args(
+                lora_ids if lora_ids is not None
+                else [0] * len(token_lists))
 
         def paged_prefill(chunk, offs, lengths):
             if self.paged_direct and faults.ARMED:
@@ -1412,7 +1549,7 @@ class InferenceEngine:
             return self._prefill_step_paged(
                 self.params, self.kv.pools, tables,
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                jnp.asarray(lengths))
+                jnp.asarray(lengths), lora=lora_arg)
 
         from . import compile_watch
 
@@ -1446,7 +1583,7 @@ class InferenceEngine:
                     last, layers = self._prefill_step(
                         self.params, self.kv.layers, slot_idx,
                         jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
-                        jnp.asarray(lengths))
+                        jnp.asarray(lengths), lora=lora_arg)
                     with deadlines.commit_guard():
                         self.kv.layers = layers
                 return last
@@ -1482,7 +1619,8 @@ class InferenceEngine:
                         all_tokens: list[list[int]], offsets: list[int],
                         deadline: float, budget=None,
                         extra_pinned: tuple[str, ...] = (),
-                        defer_span=None) -> tuple[list[int], int]:
+                        defer_span=None, row_adapters=None,
+                        row_lora_slots=None) -> tuple[list[int], int]:
         """Cross-knight shared-prefix reuse (SURVEY.md §7.3 hard part 2;
         reference prompt assembly src/orchestrator.ts:397-425 makes all
         knights share the giant context+transcript preamble, which the
@@ -1522,6 +1660,8 @@ class InferenceEngine:
             copies.clear()
 
         def prefill_span(m, lo, hi):
+            l_ids = ([row_lora_slots[m]] if row_lora_slots is not None
+                     else None)
             if paged:
                 self.kv.ensure_capacity(names[m], hi, write_from=lo,
                                         pinned=pinned)
@@ -1536,22 +1676,40 @@ class InferenceEngine:
                     table = p.pad_table(table, self.kv.scratch_page)
                     toks = p.scatter_list(toks, [self.tokenizer.pad_id])
                     offs = p.scatter_list(offs, 0)
+                    if l_ids is not None:
+                        l_ids = p.scatter_list(l_ids, 0)
                 self._prefill([slot_ids[m]], toks, offs, deadline,
-                              tables=table, budget=budget)
+                              tables=table, budget=budget,
+                              lora_ids=l_ids)
             else:
                 self._prefill([slot_ids[m]], [all_tokens[m][lo:hi]],
-                              [lo], deadline, budget=budget)
+                              [lo], deadline, budget=budget,
+                              lora_ids=l_ids)
+
+        # Adapter-identity donor filter (ISSUE 10): K/V baked under one
+        # adapter is WRONG under another, so a donor only serves rows
+        # whose adapter label matches — conservative (a filtered best
+        # donor is dropped rather than re-searched; the prefill it
+        # saves is small next to serving wrong bytes).
+        donor_ok = None
+        if row_adapters is not None:
+            labels = self._slot_adapters
+
+            def donor_ok(donor, i):
+                return labels.get(donor.name) == row_adapters[i]
 
         return share_prefixes(
             self.kv, names, all_tokens, offsets,
             min_shared=MIN_SHARED_PREFIX, add_share=add_share,
             flush_shares=flush_shares, prefill_span=prefill_span,
-            extra_pinned=extra_pinned, defer_span=defer_span)
+            extra_pinned=extra_pinned, defer_span=defer_span,
+            donor_ok=donor_ok)
 
     def _prepare_batch(self, turns, max_new_padded, deadline, pre_budget,
                        sampling_per_turn=None,
                        extra_pinned: tuple[str, ...] = (),
-                       defer_prefill: bool = False) -> dict:
+                       defer_prefill: bool = False,
+                       adapters=None) -> dict:
         """The pre-decode phase, ONE definition shared by
         generate_batch and the session scheduler's admission
         (engine/scheduler.py) so the two can never drift on token
@@ -1565,6 +1723,13 @@ class InferenceEngine:
         (post-share), plan, tables_np (plan-padded when plan is set),
         per_row, temps/top_ks/top_ps (plan-scattered), greedy,
         first_np (ORIGINAL row order), prefill_tokens, reused_tokens.
+
+        `adapters` (ISSUE 10): per-turn LoRA adapter ids (None =
+        base), already acquire()'d by the caller so residency cannot
+        change under this call. Drives the per-row slot ids the
+        compiled programs consume, the adapter-flip slot guard, the
+        prefix-cache base-rows-only filter and the mixed-adapter
+        share suppression.
 
         `defer_prefill` (ISSUE 8, the mixed-dispatch seam): stop after
         the host/aliasing work — everything above EXCEPT the chunked
@@ -1581,6 +1746,55 @@ class InferenceEngine:
             # the full committed prefix, so the turn prefills only its
             # real delta — no re-prefill across the idle gap (ISSUE 7).
             self.kv_offload.restore_for([n for n, _ in turns], pinned)
+        ad: Optional[list] = None
+        lora_slots: Optional[list[int]] = None
+        if self.lora is not None:
+            ad = (list(adapters) if adapters is not None
+                  else [None] * len(turns))
+            if len(ad) != len(turns):
+                raise ValueError(
+                    f"adapters has {len(ad)} entries for "
+                    f"{len(turns)} turns")
+            lora_slots = []
+            for a in ad:
+                if a is None:
+                    lora_slots.append(0)
+                    continue
+                slot = self.lora.slot_of(a)
+                if slot is None:
+                    raise RuntimeError(
+                        f"lora adapter {a!r} is not resident — callers "
+                        "acquire() adapters before _prepare_batch")
+                lora_slots.append(slot)
+            # Adapter-flip guard: a slot re-served under a DIFFERENT
+            # adapter must never reuse K/V computed under the old one
+            # (the bytes differ) — release forces a fresh prefill.
+            # AFTER the offload restore above, or a flip across a
+            # spill gap would release a non-resident name (no-op) and
+            # the restore would resurrect the old adapter's bytes.
+            # Base rows label None, so "never seen" needs a distinct
+            # sentinel: base→persona flips must release too, while a
+            # genuinely fresh slot must not.
+            unset = object()
+            for (name, _p), a in zip(turns, ad):
+                prev = self._slot_adapters.get(name, unset)
+                if prev is not unset and prev != a:
+                    self.kv.release(name)
+                self._slot_adapters[name] = a
+            if len(self._slot_adapters) > 4 * self.kv.num_slots:
+                # Keep labels whose K/V still EXISTS anywhere — pool
+                # slots, this batch, or sessions parked in the offload
+                # tier (their slots leave kv.slot_names() but their
+                # bytes come back via restore_for, and a label dropped
+                # here would make a later flip undetectable).
+                from .kvcache import session_of
+                live = set(self.kv.slot_names()) \
+                    | {name for name, _ in turns}
+                spilled = (set(self.kv_offload.spilled_sessions())
+                           if self.kv_offload is not None else set())
+                self._slot_adapters = {
+                    n: a_ for n, a_ in self._slot_adapters.items()
+                    if n in live or session_of(n) in spilled}
         slot_ids, offsets, all_tokens = [], [], []
         for name, prompt in turns:
             # A list of ids is accepted as a pre-tokenized prompt (warmup
@@ -1608,8 +1822,24 @@ class InferenceEngine:
         # to defeat sharing so the real prefill programs compile.
         prefix_reused = 0
         if self.prefix_cache is not None:
-            prefix_reused = self.prefix_cache.attach_rows(
-                names, all_tokens, offsets, pinned)
+            if lora_slots is None or not any(lora_slots):
+                prefix_reused = self.prefix_cache.attach_rows(
+                    names, all_tokens, offsets, pinned)
+            else:
+                # Cross-session cache content is BASE-adapter K/V: a
+                # persona row must neither consume it nor feed it
+                # (commit gates the feed side symmetrically), so only
+                # the base rows of this batch consult the index.
+                base_idx = [i for i, sl in enumerate(lora_slots)
+                            if sl == 0]
+                if base_idx:
+                    sub_off = [offsets[i] for i in base_idx]
+                    prefix_reused = self.prefix_cache.attach_rows(
+                        [names[i] for i in base_idx],
+                        [all_tokens[i] for i in base_idx],
+                        sub_off, pinned)
+                    for j, i in enumerate(base_idx):
+                        offsets[i] = sub_off[j]
         if defer_prefill:
             # Deferral pays off only for COLD prefills: after own-slot
             # reuse and the prefix-cache attach, a warm join's leftover
@@ -1632,10 +1862,18 @@ class InferenceEngine:
             def defer_span(m, lo, hi, followers):  # noqa: F811
                 share_plan.append({"leader": m, "lo": lo, "hi": hi,
                                    "followers": followers})
-        offsets, leader_prefill = self._share_prefixes(
-            names, slot_ids, all_tokens, offsets, deadline,
-            budget=pre_budget, extra_pinned=tuple(extra_pinned),
-            defer_span=defer_span)
+        if lora_slots is not None and len(set(lora_slots)) > 1:
+            # Mixed-adapter batch: no donor/leader span is valid
+            # across rows with different adapters, so the share passes
+            # are suppressed outright (lora_describe() counts it).
+            self._lora_share_suppressed += 1
+            leader_prefill = 0
+        else:
+            offsets, leader_prefill = self._share_prefixes(
+                names, slot_ids, all_tokens, offsets, deadline,
+                budget=pre_budget, extra_pinned=tuple(extra_pinned),
+                defer_span=defer_span, row_adapters=ad,
+                row_lora_slots=lora_slots)
         plan = None
         tables_np = None
         if self.kv_layout == "paged":
@@ -1693,15 +1931,19 @@ class InferenceEngine:
                 "reused_tokens": reused_tokens,
                 "prefix_reused_tokens": prefix_reused,
                 "share_plan": share_plan,
+                "lora_slots": lora_slots, "adapters": ad,
             }
         p_offsets = offsets
+        p_lora = lora_slots
         if plan is not None:
             suffixes = plan.scatter_list(suffixes,
                                          [self.tokenizer.pad_id])
             p_offsets = plan.scatter_list(offsets, 0)
+            if p_lora is not None:
+                p_lora = plan.scatter_list(p_lora, 0)
         last_logits = self._prefill(slot_ids, suffixes, p_offsets,
                                     deadline=deadline, tables=tables_np,
-                                    budget=pre_budget)
+                                    budget=pre_budget, lora_ids=p_lora)
         # A scalar fetch, not block_until_ready: some PJRT transports
         # (the axon relay) return from block_until_ready before the
         # computation finishes, which would blame prefill time on decode
@@ -1745,11 +1987,13 @@ class InferenceEngine:
             "first_np": first_np, "prefill_tokens": prefill_tokens,
             "reused_tokens": reused_tokens,
             "prefix_reused_tokens": prefix_reused,
+            "lora_slots": lora_slots, "adapters": ad,
         }
 
     def _decode_dispatch_paged(self, tables, last, valid, key, budget,
                                temps, top_ks, top_ps, row_budgets, done0,
-                               *, greedy, max_new=DECODE_SEGMENT):
+                               *, greedy, max_new=DECODE_SEGMENT,
+                               lora=None):
         """One paged decode-segment dispatch through the kernel-
         degradation rung (mosaic chaos point; pool-direct → gather-view
         on kernel failure, re-dispatching this segment), committing the
@@ -1761,7 +2005,7 @@ class InferenceEngine:
             return self._decode_loop_paged(
                 self.params, self.kv.pools, tables, last, valid, key,
                 budget, temps, top_ks, top_ps, row_budgets, done0,
-                max_new=max_new, greedy=greedy)
+                max_new=max_new, greedy=greedy, lora=lora)
 
         from . import compile_watch
         with compile_watch.label(
@@ -1781,7 +2025,8 @@ class InferenceEngine:
 
     def _decode_dispatch_slots(self, slot_idx, last, valid, key, budget,
                                temps, top_ks, top_ps, row_budgets, done0,
-                               *, greedy, max_new=DECODE_SEGMENT):
+                               *, greedy, max_new=DECODE_SEGMENT,
+                               lora=None):
         """Contiguous-layout counterpart of _decode_dispatch_paged."""
         from . import compile_watch
         with compile_watch.label(f"decode[b={last.shape[0]}]",
@@ -1789,7 +2034,7 @@ class InferenceEngine:
             out, steps, l2, v2, d2, layers = self._decode_loop(
                 self.params, self.kv.layers, slot_idx, last, valid, key,
                 budget, temps, top_ks, top_ps, row_budgets, done0,
-                max_new=max_new, greedy=greedy)
+                max_new=max_new, greedy=greedy, lora=lora)
         with deadlines.commit_guard():
             self.kv.layers = layers
         return out, steps, l2, v2, d2
@@ -1808,11 +2053,13 @@ class InferenceEngine:
                        sampling_per_turn: Optional[
                            list[SamplingParams]] = None,
                        budget=None,
-                       session: Optional[str] = None) -> list[str]:
+                       session: Optional[str] = None,
+                       adapters_per_turn: Optional[
+                           list[Optional[str]]] = None) -> list[str]:
         return self.generate_batch_with_stats(
             turns, max_new_tokens=max_new_tokens, timeout_s=timeout_s,
             sampling_per_turn=sampling_per_turn, budget=budget,
-            session=session)[0]
+            session=session, adapters_per_turn=adapters_per_turn)[0]
 
     def generate_batch_with_stats(
             self, turns: list[tuple[str, str]],
@@ -1821,6 +2068,7 @@ class InferenceEngine:
             sampling_per_turn: Optional[list[SamplingParams]] = None,
             budget=None,
             session: Optional[str] = None,
+            adapters_per_turn: Optional[list[Optional[str]]] = None,
     ) -> tuple[list[str], GenStats]:
         """Serve N (slot_name, prompt) turns as one batched program pair.
 
@@ -1831,7 +2079,12 @@ class InferenceEngine:
         direct engine callers get the same rung structure. `session`
         namespaces the slot names (kvcache.scoped_slot) so two concurrent
         discussions' same-named knights never collide in the LRU — the
-        cross-session-contamination fix (ISSUE 4 satellite). Returns
+        cross-session-contamination fix (ISSUE 4 satellite).
+        `adapters_per_turn` (ISSUE 10): per-row LoRA persona adapter
+        ids (None = base); a mixed list serves every persona in ONE
+        batched program. Silently ignored on lora-off engines — the
+        ROUNDTABLE_LORA=0 kill-switch must restore base serving
+        byte-identically, not start raising. Returns
         (responses, this call's stats) — callers needing stats must take
         them from the return value, not from `last_stats`, which is a
         convenience field that concurrent callers may overwrite."""
@@ -1844,24 +2097,43 @@ class InferenceEngine:
         # past this check, possibly waiting on the serve lock) complete.
         deadlines.check_admission()
         with self._serve_lock:
-            # The "turn" rung of the span tree (ISSUE 5) — same node the
-            # turn Budget bounds; session/engine attrs make concurrent
-            # discussions separable in one trace file.
-            from ..utils import telemetry
-            if telemetry.ACTIVE:
-                with telemetry.span("turn", engine=self.cfg.name,
-                                    rows=len(turns),
-                                    session=session or "",
-                                    knights=[n for n, _ in turns]):
-                    return self._generate_batch_locked(
-                        turns, max_new_tokens, timeout_s,
-                        sampling_per_turn, budget)
-            return self._generate_batch_locked(turns, max_new_tokens,
-                                               timeout_s, sampling_per_turn,
-                                               budget)
+            # Adapter residency refs for the duration of the call —
+            # under the serve lock, so a swap can never race a
+            # concurrent dispatch's argument capture (ISSUE 10).
+            acquired = None
+            if self.lora is not None and adapters_per_turn:
+                self.lora.validate(adapters_per_turn, len(turns))
+                # acquire() is exception-atomic; `acquired` is set
+                # only AFTER it took the refs, so the finally below
+                # releases exactly what this call holds.
+                self.lora.acquire(adapters_per_turn)
+                acquired = list(adapters_per_turn)
+            elif self.lora is None:
+                adapters_per_turn = None
+            try:
+                # The "turn" rung of the span tree (ISSUE 5) — same
+                # node the turn Budget bounds; session/engine attrs
+                # make concurrent discussions separable in one trace.
+                from ..utils import telemetry
+                if telemetry.ACTIVE:
+                    with telemetry.span("turn", engine=self.cfg.name,
+                                        rows=len(turns),
+                                        session=session or "",
+                                        knights=[n for n, _ in turns]):
+                        return self._generate_batch_locked(
+                            turns, max_new_tokens, timeout_s,
+                            sampling_per_turn, budget,
+                            adapters_per_turn)
+                return self._generate_batch_locked(
+                    turns, max_new_tokens, timeout_s, sampling_per_turn,
+                    budget, adapters_per_turn)
+            finally:
+                if acquired:
+                    self.lora.release(acquired)
 
     def _generate_batch_locked(self, turns, max_new_tokens, timeout_s,
-                               sampling_per_turn=None, budget=None):
+                               sampling_per_turn=None, budget=None,
+                               adapters_per_turn=None):
         if faults.ARMED and len(turns) > 1:
             # Chaos point for the batched-round degradation ladder: a
             # "corrupted KV slot" fails the fan-out before any slot
@@ -1891,7 +2163,8 @@ class InferenceEngine:
         t0 = time.monotonic()
         with telemetry.span("prefill", engine=self.cfg.name) as _psp:
             prep = self._prepare_batch(turns, max_new_padded, deadline,
-                                       pre_budget, sampling_per_turn)
+                                       pre_budget, sampling_per_turn,
+                                       adapters=adapters_per_turn)
             _psp.set_attr("prefill_tokens", prep["prefill_tokens"])
             _psp.set_attr("reused_tokens", prep["reused_tokens"])
         stats.prefill_tokens = prep["prefill_tokens"]
@@ -1931,6 +2204,14 @@ class InferenceEngine:
         # (serving_loop.row_budget_fn — one definition for both engines).
         from .serving_loop import row_budget_fn
         row_remaining = row_budget_fn(per_row, sampling_per_turn, max_new)
+        lora_slots = prep.get("lora_slots")
+        dec_lora = None
+        if self.lora is not None:
+            dec_ids = list(lora_slots if lora_slots is not None
+                           else [0] * len(all_tokens))
+            if plan is not None:
+                dec_ids = plan.scatter_list(dec_ids, 0)
+            dec_lora = self._lora_args(dec_ids)
 
         def decode_dispatch(cur_last, cur_valid, budget, done0):
             row_budgets = row_remaining(budget)
@@ -1940,11 +2221,11 @@ class InferenceEngine:
                 return self._decode_dispatch_paged(
                     tables, cur_last, cur_valid, self._next_key(),
                     budget, temps, top_ks, top_ps, row_budgets, done0,
-                    greedy=greedy)
+                    greedy=greedy, lora=dec_lora)
             return self._decode_dispatch_slots(
                 slot_idx, cur_last, cur_valid, self._next_key(),
                 budget, temps, top_ks, top_ps, row_budgets, done0,
-                greedy=greedy)
+                greedy=greedy, lora=dec_lora)
 
         with telemetry.span("decode", engine=self.cfg.name,
                             max_new=max_new):
@@ -1956,10 +2237,34 @@ class InferenceEngine:
         if plan is not None:
             out_np = out_np[plan.pos]
 
+        commit = self.kv.commit
+        ad = prep.get("adapters")
+        if ad is not None and any(a is not None for a in ad):
+            # Persona rows must not FEED the cross-session prefix
+            # cache: their pages hold adapter-tinted K/V no other
+            # adapter (or the base) may alias (ISSUE 10).
+            idx_of = {name: (a is None)
+                      for (name, _p), a in zip(turns, ad)}
+
+            def commit(name, toks, _kv=self.kv, _idx=idx_of):
+                _kv.commit(name, toks, index=_idx.get(name, True))
+
         results = finalize_outputs(
             turns, first_np, out_np, all_tokens, max_new,
-            self.tokenizer.eos_id, self.kv.commit, self.tokenizer.decode,
+            self.tokenizer.eos_id, commit, self.tokenizer.decode,
             stats)
+        if self.lora is not None and lora_slots and any(lora_slots):
+            from .serving_loop import eos_trim
+            n = 0
+            for i, sl in enumerate(lora_slots):
+                if not sl:
+                    continue
+                ids_row = eos_trim(
+                    [int(first_np[i])] + [int(x) for x in out_np[i]],
+                    self.tokenizer.eos_id, max_new)
+                n += len(ids_row) + len(all_tokens[i]) \
+                    - prep["offsets"][i]
+            self.note_lora_tokens(n)
         stats.int4_paths = self.int4_path_report()
         # Publish this call into the unified registry (ISSUE 5): token/
         # throughput counters plus the int4 path-provenance view — the
@@ -2008,6 +2313,9 @@ class InferenceEngine:
             # ISSUE 9: speculative-decoding provenance (drafter,
             # per-dispatch drafted/accepted, throttle state).
             info["spec_decode"] = self.spec_describe()
+        # ISSUE 10: multi-LoRA persona provenance — the resolved
+        # state, adapter store residency, per-leaf routing paths.
+        info["lora"] = self.lora_describe()
         # Continuous-batching scheduler provenance (ISSUE 4): attached by
         # engine/scheduler.SessionScheduler — admit/queue/refuse counts,
         # queue depth, per-segment batch occupancy.

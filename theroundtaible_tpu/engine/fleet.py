@@ -74,10 +74,22 @@ def estimate_engine_hbm_bytes(engine_cfg: dict[str, Any],
         # for paged KV too — the pool is no longer replicated per
         # data replica (advisor r3 underestimate, closed).
         kv_bytes //= 2
+    lora_bytes = 0
+    lora_cfg = engine_cfg.get("lora")
+    if lora_cfg:
+        # Multi-LoRA adapter store (ISSUE 10): stacked A/B tensors are
+        # allocated for every slot up front (shapes are config-static)
+        # — charged by the same closed form the store itself derives
+        # from (engine/lora.stack_bytes_for: shared defaults, the
+        # `targets:` restriction, int8 at one byte per element), so
+        # the plan cannot drift from the real allocation.
+        from .lora import stack_bytes_for
+        lora_bytes = stack_bytes_for(model_cfg, lora_cfg,
+                                     dtype_bytes=dtype_b)
     # Activations + XLA workspace: prefill chunks are ≤2048 tokens, so
     # this is small next to 7B-class weights; floor it for tiny models.
     margin = max(256 << 20, w_bytes // 16)
-    return w_bytes + kv_bytes + margin
+    return w_bytes + kv_bytes + lora_bytes + margin
 
 
 # HBM per chip by device_kind, for backends that don't report

@@ -190,8 +190,14 @@ class SlotBook:
         state.tokens = state.tokens[:reuse]
         return state.slot_id, reuse
 
-    def commit(self, name: str, tokens: list[int]) -> None:
-        """Record that the slot's cache now covers exactly `tokens`."""
+    def commit(self, name: str, tokens: list[int],
+               index: bool = True) -> None:
+        """Record that the slot's cache now covers exactly `tokens`.
+        `index` exists for signature parity with PagedKVCache.commit
+        (ISSUE 10: persona rows must not feed the cross-session prefix
+        cache) — the contiguous layout has no index, so it is
+        ignored."""
+        del index
         self.acquire(name).tokens = list(tokens)
 
     def best_donor(self, name: str,
@@ -223,7 +229,8 @@ class SlotBook:
 def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
                    add_share, flush_shares, prefill_span,
                    extra_pinned: tuple[str, ...] = (),
-                   defer_span=None) -> tuple[list[int], int]:
+                   defer_span=None,
+                   donor_ok=None) -> tuple[list[int], int]:
     """Two-pass cross-knight shared-prefix reuse — THE algorithm, used by
     both serving engines so the donor cap, batch-common-prefix fold,
     l_shared clamp, laggard threshold and extra_prefill accounting cannot
@@ -259,6 +266,15 @@ def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
     leader's own write-exclusivity). A leader that already covers the
     span aliases immediately — the content exists.
 
+    `donor_ok(donor_state, row_i)` (ISSUE 10): extra donor gate —
+    multi-LoRA engines pass an adapter-identity check, since K/V baked
+    under one adapter is wrong under another. Conservative by design:
+    a rejected best donor is dropped, not re-searched (the prefill it
+    would have saved is small next to serving wrong bytes). The
+    LEADER pass needs no gate — lora engines only reach it for
+    uniform-adapter batches (engine._prepare_batch suppresses mixed
+    ones).
+
     Returns (updated offsets, leader-prefilled token count)."""
     b = len(names)
     pinned = tuple(names) + tuple(extra_pinned)
@@ -269,6 +285,9 @@ def share_prefixes(kv, names, all_tokens, offsets, *, min_shared: int,
         cap = len(all_tokens[i]) - 1
         donor, dlen = kv.best_donor(names[i], all_tokens[i])
         dlen = min(dlen, cap)
+        if donor is not None and donor_ok is not None \
+                and not donor_ok(donor, i):
+            donor = None
         if donor is not None and dlen - offsets[i] >= min_shared:
             add_share(donor, i, offsets[i], dlen)
             offsets[i] = dlen

@@ -1,0 +1,739 @@
+"""Multi-LoRA knight personas on one shared base model (ISSUE 10).
+
+The fleet used to get knight diversity by loading a distinct checkpoint
+per engine: K personas cost K× HBM and could never share a decode
+batch. This module serves personas as LoRA deltas over ONE resident
+base instead ("Serving Heterogeneous LoRA Adapters in Distributed LLM
+Inference Systems", AdaFuse — PAPERS.md): per target projection the
+serving matmul becomes `y = x·W + x·A_id^T·B_id`, where `id` is each
+row's adapter slot, so a mixed-persona batch runs in the SAME compiled
+program as a base batch and K personas cost K·(rank·(C+O)) extra bytes
+instead of K·params.
+
+Pieces:
+
+- **LoraStore** — the adapter store: per-target STACKED device tensors
+  `a_t [S, r, C]` / `b [S, r, O]` with S = max_adapters+1 slots (slot 0
+  is the all-zero "base" adapter, so rows without a persona index 0 and
+  get an exactly-zero delta — no masking anywhere on the serving path).
+  Stacked shapes are a function of config alone; loading/evicting an
+  adapter writes slot VALUES through one compiled setter per target, so
+  hot-swaps, mixed-adapter batches and occupancy drift compile nothing
+  (`ROUNDTABLE_RECOMPILE_STRICT=1` green). Residency is refcounted by
+  the serving paths (scheduler rows, generate calls); eviction is LRU
+  over unreferenced adapters; every load/evict moves the
+  roundtable_lora_* registry series and the per-adapter bytes gauge is
+  REMOVED at evict (the PR-6 gauge-leak lesson).
+- **lora_scope / apply** — the trace-time context (the spmd_mesh
+  pattern): engine programs enter `lora_scope((stacked, ids))` around
+  forward, and models/common._einsum's tagged call sites apply the
+  delta for their leaf. `ids` is per-ROW for batched programs and
+  per-TOKEN for the ragged flat buffer — apply flattens the activation
+  to [M, C] and broadcasts ids to match, so ONE implementation serves
+  prefill, decode, ragged mixed dispatches and speculative verify.
+  Routing per dispatch: the Pallas grouped BGMV kernel
+  (pallas/lora.py) where the plan admits it, else the XLA grouped
+  masked BMM — every decision recorded into the engine's `lora_paths`
+  sink at trace time with a machine-readable `lora_decline_reason`
+  (the int4_paths discipline).
+- **quantize-aware pairs** — `lora: {quant: "int8"}` stores the stacked
+  tensors as int8 with per-(slot, rank-row) scales
+  (engine/quant.quantize_lora_stack); apply dequantizes into the
+  matmul operand (LoRA tensors are tiny, so the dequant is noise) and
+  the kernel declines with "quant:int8-stack".
+
+Sharing interactions (correctness, not policy): K/V computed under
+adapter X is WRONG for adapter Y, so cross-knight prefix sharing is
+suppressed for mixed-adapter batches, the cross-session prefix cache
+only attaches to (and is only fed by) base-adapter rows, and own-slot
+reuse stays valid because a knight's adapter is stable within its
+session. See ARCHITECTURE.md "Multi-LoRA personas" for the decline
+table.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LORA_ENV = "ROUNDTABLE_LORA"
+
+DEFAULT_RANK = 8
+DEFAULT_MAX_ADAPTERS = 8
+# alpha/rank folded into B at load time: delta = x·A^T·(scale·B).
+DEFAULT_SCALE = 2.0
+# Random-persona init: BOTH A and B are drawn nonzero (classic LoRA
+# zero-init B would make an untrained persona a no-op, and a persona's
+# whole point here is distinct behavior without training).
+DEFAULT_INIT_STD = 0.02
+
+PATH_KERNEL = "pallas_grouped"
+PATH_XLA = "xla_grouped_bmm"
+
+
+def lora_enabled(cfg_value: Any) -> bool:
+    """The serving decision: LoRA needs an explicit `lora:` config
+    block (unlike ragged/spec it is not a default-on fast path — it
+    changes MODEL OUTPUTS), and ROUNDTABLE_LORA=0 kills it everywhere
+    (the byte-identity lever)."""
+    import os
+    if not cfg_value:
+        return False
+    return os.environ.get(LORA_ENV, "") != "0"
+
+
+def lora_dims(model_cfg) -> dict[str, tuple[int, int, str]]:
+    """Per-target (in_dim, out_flat, tp) for the decode-hot projections
+    — the leaf set models/common tags at its _einsum call sites. tp
+    mirrors sharding.param_specs' convention per leaf ("col" = output
+    axis model-sharded, "row" = contraction axis model-sharded), so the
+    stacked tensors partition the way the base weight already does.
+    MoE configs target attention only (expert matmuls have no tagged
+    seam — the decline table names it)."""
+    e, h, k, d, f = (model_cfg.embed_dim, model_cfg.num_heads,
+                     model_cfg.num_kv_heads, model_cfg.head_dim,
+                     model_cfg.mlp_dim)
+    dims = {
+        "q_proj": (e, h * d, "col"),
+        "k_proj": (e, k * d, "col"),
+        "v_proj": (e, k * d, "col"),
+        "o_proj": (h * d, e, "row"),
+    }
+    if not model_cfg.num_experts:
+        dims.update({
+            "gate_proj": (e, f, "col"),
+            "up_proj": (e, f, "col"),
+            "down_proj": (f, e, "row"),
+        })
+    return dims
+
+
+# ---------------------------------------------------------------------
+# trace-time context (the spmd_mesh pattern)
+# ---------------------------------------------------------------------
+
+_CTX = threading.local()
+
+
+class lora_scope:
+    """Announce the traced (stacked, ids) pair to the enclosing jit
+    trace. `payload` is None on lora-off engines — the scope is then
+    inert, and the tagged _einsum call sites cost one None check.
+    Thread-local for the same reason spmd_mesh is: distinct engines
+    trace concurrently from different threads."""
+
+    __slots__ = ("payload", "sink", "quant")
+
+    def __init__(self, payload, sink: Optional[dict] = None,
+                 quant: str = "none"):
+        self.payload = payload
+        self.sink = sink
+        self.quant = quant
+
+    def __enter__(self):
+        stack = getattr(_CTX, "stack", None)
+        if stack is None:
+            stack = _CTX.stack = []
+        stack.append(self if self.payload is not None else None)
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.stack.pop()
+        return False
+
+
+def _current_scope() -> Optional[lora_scope]:
+    stack = getattr(_CTX, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _dequant_stack(leaf, dtype):
+    """A stacked tensor back to `dtype`: raw array, or the int8
+    {"q","s"} pair quant.quantize_lora_stack emits (per-(slot, r) row
+    scales; tiny tensors, so the materialized dequant is noise)."""
+    if isinstance(leaf, dict):
+        return leaf["q"].astype(dtype) * leaf["s"][..., None].astype(dtype)
+    return leaf.astype(dtype)
+
+
+def _xla_grouped(x2, a_t, b_s, ids2):
+    """The XLA grouped-BMM baseline: dense over the adapter stack with
+    a row×slot mask folded into the [S, M, r] intermediate — shape-
+    static (the compute-dense-combine-sparse layout moe_mlp already
+    uses), no gathers, and GSPMD partitions it like any einsum. Slot 0
+    is all-zero so base rows contribute nothing twice over (mask AND
+    zero weights). Cost is S× the single-adapter FLOPs on the FIRST
+    matmul only — r/C of the base matmul, noise at prefill where this
+    path serves."""
+    s = a_t.shape[0]
+    xa = jnp.einsum("mc,src->smr", x2, a_t,
+                    preferred_element_type=jnp.float32)
+    mask = (ids2[None, :] == jnp.arange(s)[:, None])
+    xa = jnp.where(mask[:, :, None], xa, 0.0)
+    return jnp.einsum("smr,sro->mo", xa.astype(b_s.dtype), b_s,
+                      preferred_element_type=jnp.float32)
+
+
+def _record(sink: Optional[dict], key: str, m: int, path: str,
+            reason: Optional[str]) -> None:
+    if sink is None:
+        return
+    entry = {"leaf": key, "rows": m, "path": path}
+    if reason:
+        entry["fallback_reason"] = reason
+    sink[(key, m)] = entry
+
+
+def apply_current(key: str, x: jax.Array, y: jax.Array,
+                  tp: Optional[str] = None) -> jax.Array:
+    """Add the active scope's LoRA delta for target `key` to the base
+    einsum output `y` — the tail models/common._einsum calls for its
+    tagged leaves. No-op (one attribute check) without an active
+    scope or when the store doesn't target this leaf."""
+    scope = _current_scope()
+    if scope is None:
+        return y
+    stacked, ids = scope.payload
+    ent = stacked.get(key)
+    if ent is None:
+        return y
+    a_leaf, b_leaf = ent["a"], ent["b"]
+    c_dim = (a_leaf["q"] if isinstance(a_leaf, dict) else a_leaf).shape[-1]
+    x2 = x.reshape(-1, c_dim)
+    m = x2.shape[0]
+    # ids is per-row ([B]) for batched programs and per-token ([T])
+    # for the ragged flat buffer; both broadcast to one id per
+    # flattened row (row-major, matching the reshape).
+    ids2 = ids if ids.shape[0] == m else jnp.repeat(ids, m // ids.shape[0])
+
+    delta = None
+    reason: Optional[str] = None
+    from .pallas import lora as plora
+    if scope.quant != "none":
+        # Stack-level decline first: it names WHY the kernel can never
+        # serve this store, independent of backend/env.
+        reason = "quant:int8-stack"
+    elif not plora.enabled():
+        reason = "kernel-disabled"
+    else:
+        from .models.common import current_spmd_mesh
+        mesh = current_spmd_mesh()
+        if mesh is None:
+            reason = "mesh:unannounced"
+        elif mesh.size == 1:
+            delta, reason = plora.lora_bgmv_or_reason(
+                x2, a_leaf, b_s=b_leaf, ids=ids2)
+        else:
+            delta, reason = plora.lora_bgmv_spmd(
+                mesh, x2, a_leaf, b_leaf, ids2, tp=tp)
+    if delta is None:
+        dt = x.dtype
+        delta = _xla_grouped(x2, _dequant_stack(a_leaf, dt),
+                             _dequant_stack(b_leaf, dt), ids2)
+        _record(scope.sink, key, m, PATH_XLA, reason)
+    else:
+        _record(scope.sink, key, m, PATH_KERNEL, None)
+    return y + delta.reshape(y.shape).astype(y.dtype)
+
+
+def summarize_lora_paths(dispatches: dict) -> dict:
+    """Fold the trace-time lora dispatch log into the provenance report
+    describe() exposes — the summarize_int4_paths shape."""
+    kernel, fallback = [], []
+    for e in dispatches.values():
+        (kernel if e["path"] == PATH_KERNEL else fallback).append(e)
+
+    def order(e):
+        return (e["leaf"], e["rows"])
+
+    return {PATH_KERNEL: sorted(kernel, key=order),
+            PATH_XLA: sorted(fallback, key=order)}
+
+
+# ---------------------------------------------------------------------
+# the adapter store
+# ---------------------------------------------------------------------
+
+
+class LoraStore:
+    """Load/quantize-aware A·B pairs keyed by adapter id over stacked
+    device tensors, with hot-swap load/evict, refcounted residency and
+    HBM accounting. One per engine; every mutation happens on a thread
+    that holds the engine's serve lock (the scheduler thread, or a
+    generate call inside _generate_batch_locked), so swaps never race
+    an in-flight dispatch's argument capture."""
+
+    def __init__(self, model_cfg, mesh=None, *,
+                 max_adapters: int = DEFAULT_MAX_ADAPTERS,
+                 rank: int = DEFAULT_RANK, scale: float = DEFAULT_SCALE,
+                 dtype=jnp.bfloat16, quant: str = "none",
+                 adapters: Optional[dict] = None,
+                 targets: Optional[list] = None,
+                 engine_name: str = "", perf=None):
+        if max_adapters < 1:
+            raise ValueError(f"max_adapters must be >= 1, got "
+                             f"{max_adapters}")
+        if rank < 1:
+            raise ValueError(f"lora rank must be >= 1, got {rank}")
+        if quant not in ("none", "int8"):
+            raise ValueError(
+                f"lora quant must be none|int8, got {quant!r}")
+        self.rank = rank
+        self.scale = float(scale)
+        self.max_adapters = max_adapters
+        self.dtype = dtype
+        self.quant = quant
+        self.engine_name = engine_name
+        self.perf = perf
+        dims = lora_dims(model_cfg)
+        if targets:
+            unknown = [t for t in targets if t not in dims]
+            if unknown:
+                raise ValueError(
+                    f"unknown lora targets {unknown}; serveable: "
+                    f"{sorted(dims)}")
+            dims = {k: v for k, v in dims.items() if k in targets}
+        self.dims = dims
+        self.num_layers = int(getattr(model_cfg, "num_layers", 1))
+        # Registered persona configs, loadable on demand at acquire:
+        # {name: {"seed": int, "init_std": float, "path": npz}}.
+        self.personas: dict[str, dict] = dict(adapters or {})
+        s = max_adapters + 1
+        self._shardings = self._stack_shardings(mesh)
+        self.stacked: dict[str, dict[str, Any]] = {}
+        for key, (c, o, _tp) in dims.items():
+            a = jnp.zeros((s, rank, c), dtype)
+            b = jnp.zeros((s, rank, o), dtype)
+            sh = self._shardings.get(key)
+            if sh is not None:
+                a = jax.device_put(a, sh[0])
+                b = jax.device_put(b, sh[1])
+            if quant == "int8":
+                from .quant import quantize_lora_stack
+                a = quantize_lora_stack(a, dtype)
+                b = quantize_lora_stack(b, dtype)
+            self.stacked[key] = {"a": a, "b": b}
+        # adapter id -> slot (1..max_adapters); slot 0 is the base.
+        self._slots: dict[str, int] = {}
+        self._free: list[int] = list(range(1, s))
+        self._refs: dict[str, int] = {}
+        self._last_used: dict[str, float] = {}
+        self.loads = 0
+        self.evictions = 0
+        self.swaps = 0
+
+        @partial(jax.jit, donate_argnums=())
+        def set_slot(stack, slot, value):
+            # No donation ON PURPOSE: an in-flight dispatch may still
+            # hold the pre-swap arrays; donation would delete buffers
+            # under it. LoRA stacks are tiny — the copy is noise.
+            return stack.at[slot].set(value.astype(stack.dtype))
+
+        self._set_slot = set_slot
+
+    def _stack_shardings(self, mesh):
+        """NamedShardings for the stacked tensors on multi-device
+        meshes, mirroring how param_specs shards the base weight
+        (sharding.lora_stack_specs); dims the mesh does not divide
+        replicate, matching _fallback_replicated."""
+        out: dict[str, tuple] = {}
+        if mesh is None or mesh.devices.size <= 1:
+            return out
+        from jax.sharding import NamedSharding
+        from .sharding import (_fallback_replicated, lora_stack_specs,
+                               model_axis_size)
+        if model_axis_size(mesh) <= 1:
+            return out
+        s = self.max_adapters + 1
+        for key, (c, o, tp) in self.dims.items():
+            a_spec, b_spec = lora_stack_specs(tp)
+            a_spec = _fallback_replicated(a_spec, (s, self.rank, c), mesh)
+            b_spec = _fallback_replicated(b_spec, (s, self.rank, o), mesh)
+            out[key] = (NamedSharding(mesh, a_spec),
+                        NamedSharding(mesh, b_spec))
+        return out
+
+    # --- loading / eviction ---
+
+    def resolvable(self, adapter_id: Optional[str]) -> bool:
+        return (adapter_id is None or adapter_id in self._slots
+                or adapter_id in self.personas)
+
+    def resident(self) -> list[str]:
+        return sorted(self._slots)
+
+    def slot_of(self, adapter_id: str) -> Optional[int]:
+        return self._slots.get(adapter_id)
+
+    def adapter_bytes(self) -> int:
+        """HBM bytes ONE resident adapter COSTS TO STORE (its A+B rows
+        across the targets) — the per-slot price the memory ledger and
+        the per-adapter gauges report. NOT the streamed cost: the one
+        (tied) pair is applied at EVERY layer's tagged projections, so
+        decode re-reads it num_layers times per token —
+        streamed_bytes_per_token() below is the roofline number."""
+        per_elt = 1 if self.quant == "int8" else jnp.dtype(
+            self.dtype).itemsize
+        return sum(self.rank * (c + o) * per_elt
+                   for c, o, _tp in self.dims.values())
+
+    def streamed_bytes_per_token(self) -> int:
+        """HBM bytes a persona ROW streams per decode token on top of
+        the base weights: the tied A/B pair re-read at each of the
+        model's layers — the perfmodel decode-ceiling adjustment's
+        input (storage alone would understate it ~num_layers×)."""
+        return self.num_layers * self.adapter_bytes()
+
+    def resident_bytes(self) -> int:
+        return len(self._slots) * self.adapter_bytes()
+
+    def stack_bytes(self) -> int:
+        """Total resident bytes of the stacked tensors (allocated for
+        every slot up front — shapes are config-static)."""
+        total = 0
+        for ent in self.stacked.values():
+            for leaf in ent.values():
+                arrs = (leaf["q"], leaf["s"]) if isinstance(leaf, dict) \
+                    else (leaf,)
+                total += sum(int(x.size) * x.dtype.itemsize
+                             for x in arrs)
+        return total
+
+    def register(self, adapter_id: str, spec: Optional[dict] = None
+                 ) -> None:
+        """Register a persona config ({"seed": int, "init_std": float}
+        or {"path": npz}) loadable on demand at acquire."""
+        self.personas[adapter_id] = dict(spec or {})
+
+    def make_pair_tree(self, adapter_id: str) -> dict[str, tuple]:
+        """Materialize an adapter's {key: (a_t [r, C], b [r, O])} host
+        tree from its registered persona config: an npz saved by
+        save_pair_tree / bench_realweights --train-lora, or a
+        deterministic random persona from its seed."""
+        spec = self.personas.get(adapter_id)
+        if spec is None:
+            raise KeyError(
+                f"unknown lora adapter {adapter_id!r}; registered: "
+                f"{sorted(self.personas)}")
+        path = spec.get("path")
+        if path:
+            data = np.load(path)
+            out = {}
+            for key in self.dims:
+                if f"{key}.a" not in data:
+                    raise ValueError(
+                        f"lora npz {path} missing target {key!r}")
+                out[key] = (np.asarray(data[f"{key}.a"]),
+                            np.asarray(data[f"{key}.b"]))
+            return out
+        seed = int(spec.get("seed", 0))
+        std = float(spec.get("init_std", DEFAULT_INIT_STD))
+        root = jax.random.PRNGKey(seed ^ 0x10A4)
+        out = {}
+        for i, (key, (c, o, _tp)) in enumerate(sorted(self.dims.items())):
+            ka, kb = jax.random.split(jax.random.fold_in(root, i))
+            a = np.asarray(jax.random.normal(ka, (self.rank, c),
+                                             jnp.float32)) * (c ** -0.5)
+            b = np.asarray(jax.random.normal(kb, (self.rank, o),
+                                             jnp.float32)) * std
+            out[key] = (a, b)
+        return out
+
+    def load(self, adapter_id: str,
+             pair_tree: Optional[dict] = None) -> int:
+        """Load (or refresh) an adapter into a slot and return it.
+        `pair_tree` {key: (a_t [r, C], b [r, O])} overrides the
+        registered persona. Evicts the LRU UNREFERENCED adapter when
+        the store is full; raises when every slot is pinned by an
+        active serving call."""
+        if adapter_id in self._slots and pair_tree is None:
+            self._last_used[adapter_id] = time.monotonic()
+            return self._slots[adapter_id]
+        if pair_tree is None:
+            pair_tree = self.make_pair_tree(adapter_id)
+        slot = self._slots.get(adapter_id)
+        # A SWAP means a slot's previous contents were replaced: a
+        # refresh of a resident adapter, or a load that had to evict.
+        # A first load into a free slot is not one — the counter's
+        # name must mean what operators read into it.
+        is_swap = slot is not None
+        if slot is None:
+            if not self._free:
+                self._evict_lru()
+                is_swap = True
+            if not self._free:
+                raise RuntimeError(
+                    f"lora store exhausted: {self.max_adapters} slots "
+                    f"all referenced by active rows — raise "
+                    "lora.max_adapters or lower concurrency")
+            slot = self._free.pop(0)
+            self._slots[adapter_id] = slot
+            self._refs.setdefault(adapter_id, 0)
+        self._write_slot(slot, pair_tree)
+        self._last_used[adapter_id] = time.monotonic()
+        self.loads += 1
+        self._publish()
+        from ..utils import telemetry
+        if is_swap:
+            self.swaps += 1
+            telemetry.inc("roundtable_lora_swaps_total",
+                          engine=self.engine_name)
+        telemetry.set_gauge("roundtable_lora_adapter_bytes",
+                            self.adapter_bytes(),
+                            engine=self.engine_name, adapter=adapter_id)
+        return slot
+
+    def _write_slot(self, slot: int, pair_tree: dict) -> None:
+        sl = jnp.int32(slot)
+        for key, ent in self.stacked.items():
+            if key not in pair_tree:
+                raise ValueError(f"lora pair tree missing target "
+                                 f"{key!r}")
+            a, b = pair_tree[key]
+            c, o, _tp = self.dims[key]
+            a = jnp.asarray(a, jnp.float32)
+            b = jnp.asarray(b, jnp.float32) * self.scale
+            if a.shape != (self.rank, c) or b.shape != (self.rank, o):
+                raise ValueError(
+                    f"lora target {key!r} shape mismatch: got "
+                    f"A{tuple(a.shape)} B{tuple(b.shape)}, want "
+                    f"A{(self.rank, c)} B{(self.rank, o)}")
+            if self.quant == "int8":
+                from .quant import quantize_lora_slot
+                ent["a"] = quantize_lora_slot(ent["a"], sl, a,
+                                              self._set_slot)
+                ent["b"] = quantize_lora_slot(ent["b"], sl, b,
+                                              self._set_slot)
+            else:
+                ent["a"] = self._set_slot(ent["a"], sl, a)
+                ent["b"] = self._set_slot(ent["b"], sl, b)
+
+    def _evict_lru(self) -> None:
+        victims = [a for a, r in self._refs.items()
+                   if r <= 0 and a in self._slots]
+        if not victims:
+            return
+        victim = min(victims,
+                     key=lambda a: self._last_used.get(a, 0.0))
+        self.evict(victim)
+
+    def evict(self, adapter_id: str) -> bool:
+        """Drop a (non-referenced) adapter: its slot returns to the
+        free list and is zeroed lazily by the next load. Per-adapter
+        gauges are REMOVED — uuid-ish adapter churn must not grow the
+        registry one dead series per persona ever served."""
+        slot = self._slots.get(adapter_id)
+        if slot is None:
+            return False
+        if self._refs.get(adapter_id, 0) > 0:
+            raise RuntimeError(
+                f"cannot evict lora adapter {adapter_id!r}: "
+                f"{self._refs[adapter_id]} active row(s) reference it")
+        del self._slots[adapter_id]
+        self._refs.pop(adapter_id, None)
+        self._last_used.pop(adapter_id, None)
+        self._free.append(slot)
+        self.evictions += 1
+        self._publish()
+        from ..utils import telemetry
+        telemetry.REGISTRY.remove_gauge(
+            "roundtable_lora_adapter_bytes",
+            engine=self.engine_name, adapter=adapter_id)
+        return True
+
+    def _publish(self) -> None:
+        from ..utils import telemetry
+        telemetry.set_gauge("roundtable_lora_resident_adapters",
+                            len(self._slots), engine=self.engine_name)
+        if self.perf is not None:
+            # Decode-ceiling adjustment (ISSUE 10 perfmodel satellite):
+            # a persona row streams its adapter's bytes — once per
+            # LAYER — on top of the base weights every token.
+            self.perf.set_lora_row_bytes(
+                self.streamed_bytes_per_token() if self._slots else 0)
+
+    # --- residency / admission ---
+
+    def validate(self, adapter_ids: list, n_turns: int) -> None:
+        """Request-shape validation shared by the direct generate path
+        and the scheduler's queue mouth: per-turn length, unknown
+        personas, and more DISTINCT adapters than the store can ever
+        hold (which would otherwise fail deep inside acquire() with a
+        misleading 'all slots referenced' exhaustion error after
+        loading part of the list)."""
+        if len(adapter_ids) != n_turns:
+            raise ValueError(
+                f"adapters_per_turn has {len(adapter_ids)} entries "
+                f"for {n_turns} turns")
+        unknown = [a for a in adapter_ids
+                   if a is not None and not self.resolvable(a)]
+        if unknown:
+            raise ValueError(
+                f"unknown lora adapters {unknown}; registered: "
+                f"{sorted(self.personas)}")
+        distinct = {a for a in adapter_ids if a is not None}
+        if len(distinct) > self.max_adapters:
+            raise ValueError(
+                f"request names {len(distinct)} distinct lora "
+                f"adapters but the store holds at most "
+                f"{self.max_adapters} — raise lora.max_adapters")
+
+    def can_admit(self, adapter_ids: list) -> bool:
+        """Would acquiring these adapters succeed right now? Free slots
+        plus LRU-evictable (unreferenced) residents must cover the NEW
+        distinct adapters — the scheduler's admission backpressure."""
+        need = {a for a in adapter_ids
+                if a is not None and a not in self._slots}
+        if not need:
+            return True
+        evictable = sum(1 for a, r in self._refs.items()
+                        if r <= 0 and a in self._slots)
+        return len(need) <= len(self._free) + evictable
+
+    def acquire(self, adapter_ids: list) -> list[int]:
+        """Resolve per-row adapter ids (None = base) to slots, loading
+        registered personas on demand, and take one residency ref per
+        row. Callers release() with the SAME list.
+
+        Two passes: RESIDENT adapters are ref'd first, so a later
+        load's LRU eviction can never victimize an id this same
+        request names (a one-pass acquire could evict the list's own
+        not-yet-ref'd resident adapter, then crash — or silently
+        reload it from its registered spec, discarding explicitly
+        loaded weights). Exception-ATOMIC: a mid-list failure releases
+        the refs this call already took before re-raising, so no
+        caller path can leak refs (pinning slots forever) or
+        over-release them (un-pinning another request's live adapter
+        to eviction)."""
+        slots: list = [None] * len(adapter_ids)
+        taken: list = []
+        try:
+            for i, a in enumerate(adapter_ids):
+                if a is None:
+                    slots[i] = 0
+                elif a in self._slots:
+                    self._last_used[a] = time.monotonic()
+                    self._refs[a] = self._refs.get(a, 0) + 1
+                    taken.append(a)
+                    slots[i] = self._slots[a]
+            for i, a in enumerate(adapter_ids):
+                if slots[i] is None:
+                    slot = self.load(a)
+                    self._refs[a] = self._refs.get(a, 0) + 1
+                    taken.append(a)
+                    slots[i] = slot
+        except Exception:
+            self.release(taken)
+            raise
+        return slots
+
+    def release(self, adapter_ids: list) -> None:
+        for a in adapter_ids:
+            if a is None:
+                continue
+            if a in self._refs:
+                self._refs[a] = max(self._refs[a] - 1, 0)
+
+    def warm(self) -> None:
+        """Compile-and-stabilize the per-target slot setters: a first
+        hot-swap in steady state must compile nothing under
+        ROUNDTABLE_RECOMPILE_STRICT (the warmup contract). Two loads
+        reach the output-layout fixpoint; the throwaway persona is
+        evicted so slot accounting is untouched."""
+        name = "__lorawarm__"
+        self.personas.setdefault(name, {"seed": 0})
+        tree = self.make_pair_tree(name)
+        for _ in range(2):
+            # An explicit pair_tree forces the setter WRITE both times
+            # (a bare load() early-returns once resident — which would
+            # leave the setters one run short of their layout
+            # fixpoint, exactly the recompile warm() exists to kill).
+            self.load(name, tree)
+        self.evict(name)
+        self.personas.pop(name, None)
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "rank": self.rank,
+            "scale": self.scale,
+            "quant": self.quant,
+            "max_adapters": self.max_adapters,
+            "targets": sorted(self.dims),
+            "resident": self.resident(),
+            "registered": sorted(self.personas),
+            "refs": {a: r for a, r in self._refs.items() if r > 0},
+            "adapter_bytes": self.adapter_bytes(),
+            "resident_bytes": self.resident_bytes(),
+            "stack_bytes": self.stack_bytes(),
+            "loads": self.loads,
+            "evictions": self.evictions,
+            "swaps": self.swaps,
+        }
+
+
+def stack_bytes_for(model_cfg, lora_cfg, dtype_bytes: int = 2) -> int:
+    """Closed-form stacked-tensor bytes for a `lora:` config block —
+    the ONE place the plan-time estimate (fleet.estimate_engine_hbm_
+    bytes) and the store's real allocation derive from, honoring the
+    same defaults and `targets:` restriction (per-(slot, rank-row)
+    int8 scales are omitted: noise next to the q bytes)."""
+    lc = lora_cfg if isinstance(lora_cfg, dict) else {}
+    rank = int(lc.get("rank", DEFAULT_RANK))
+    slots = int(lc.get("max_adapters", DEFAULT_MAX_ADAPTERS)) + 1
+    per_elt = 1 if lc.get("quant") == "int8" else dtype_bytes
+    dims = lora_dims(model_cfg)
+    targets = lc.get("targets")
+    if targets:
+        dims = {k: v for k, v in dims.items() if k in targets}
+    return slots * rank * sum(c + o for c, o, _tp in dims.values()) \
+        * per_elt
+
+
+def save_pair_tree(path: str, pair_tree: dict) -> None:
+    """Save {key: (a_t, b)} as the npz layout make_pair_tree loads —
+    the bench_realweights --train-lora output format."""
+    arrays = {}
+    for key, (a, b) in pair_tree.items():
+        arrays[f"{key}.a"] = np.asarray(a)
+        arrays[f"{key}.b"] = np.asarray(b)
+    np.savez(path, **arrays)
+
+
+# --- test-visibility counters (tests/conftest.py `lora` guard) ---
+
+_lock = threading.Lock()
+_dispatches = 0
+_max_mixed = 0
+
+
+def reset_test_counters() -> None:
+    global _dispatches, _max_mixed
+    with _lock:
+        _dispatches = 0
+        _max_mixed = 0
+
+
+def note_dispatch_ids(ids) -> None:
+    """Record one dispatch's adapter composition: the conftest guard
+    fails a `lora`-marked test whose dispatches never mixed >= 2
+    distinct (non-base) adapters in ONE program."""
+    global _dispatches, _max_mixed
+    distinct = len({int(x) for x in np.asarray(ids).ravel()} - {0})
+    with _lock:
+        _dispatches += 1
+        if distinct > _max_mixed:
+            _max_mixed = distinct
+
+
+def dispatches_seen() -> int:
+    return _dispatches
+
+
+def max_mixed_seen() -> int:
+    return _max_mixed

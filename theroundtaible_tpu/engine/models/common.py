@@ -251,7 +251,7 @@ def dequant_int4(q4: jax.Array, s4: jax.Array, axis: int, group: int,
     return w.reshape(shape)
 
 
-def _einsum(spec: str, a: jax.Array, b, tp=None) -> jax.Array:
+def _einsum(spec: str, a: jax.Array, b, tp=None, lora=None) -> jax.Array:
     # bf16 inputs, f32 accumulation on the MXU. An int8-quantized weight
     # ({"q", "s"} dict, engine/quant.py) streams half the HBM bytes: the
     # int8→activation-dtype convert fuses into the matmul operand and the
@@ -265,6 +265,22 @@ def _einsum(spec: str, a: jax.Array, b, tp=None) -> jax.Array:
     # lm head) or "row" (row-parallel: o_proj, down_proj), mirroring
     # sharding.param_specs (see sharding.int4_shard_axis). Ignored for
     # every non-int4 leaf and on single-device meshes.
+    #
+    # `lora` names this call site's LoRA target leaf (ISSUE 10):
+    # when the enclosing trace announced a lora_scope (engine/lora.py,
+    # the spmd_mesh pattern), the per-row/per-token adapter delta
+    # `x·A_id^T·B_id` is added to the base output — grouped Pallas
+    # kernel or XLA grouped BMM, every routing decision recorded into
+    # the engine's lora_paths sink. Untagged call sites (lm head, MoE
+    # experts, router) and traces with no active scope are untouched.
+    y = _einsum_base(spec, a, b, tp)
+    if lora is not None:
+        from ..lora import apply_current
+        y = apply_current(lora, a, y, tp=tp)
+    return y
+
+
+def _einsum_base(spec: str, a: jax.Array, b, tp=None) -> jax.Array:
     if isinstance(b, Int4Leaf):
         # Fused VMEM-dequant kernels — the only layout that actually
         # streams packed int4 bytes on real TPU (pallas/int4mm.py; XLA
@@ -337,9 +353,12 @@ def project_qkv(
 
     Shared by dense attention below and the sequence-parallel cores in
     longcontext.py (which replace only the softmax(QK)V part)."""
-    q = _einsum("bte,ehd->bthd", x, layer["q_proj"], tp="col")  # [B,T,H,D]
-    k = _einsum("bte,ekd->btkd", x, layer["k_proj"], tp="col")  # [B,T,K,D]
-    v = _einsum("bte,ekd->btkd", x, layer["v_proj"], tp="col")
+    q = _einsum("bte,ehd->bthd", x, layer["q_proj"], tp="col",
+                lora="q_proj")                                  # [B,T,H,D]
+    k = _einsum("bte,ekd->btkd", x, layer["k_proj"], tp="col",
+                lora="k_proj")                                  # [B,T,K,D]
+    v = _einsum("bte,ekd->btkd", x, layer["v_proj"], tp="col",
+                lora="v_proj")
 
     if cfg.attn_bias:  # Qwen2: linear bias applied BEFORE rotary (HF order)
         q = q + layer["q_bias"].astype(jnp.float32)
@@ -411,7 +430,7 @@ def attention(
                     softcap=cfg.attn_logit_softcap)
         if out is not None:
             out = _einsum("bthd,hde->bte", out, layer["o_proj"],
-                          tp="row").astype(x.dtype)
+                          tp="row", lora="o_proj").astype(x.dtype)
             return out, (k_cache, v_cache)
 
     # GQA: expand K/V heads to match query heads.
@@ -427,20 +446,22 @@ def attention(
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     out = _einsum("bhts,bshd->bthd", probs, v_att).astype(x.dtype)
     out = _einsum("bthd,hde->bte", out, layer["o_proj"],
-                  tp="row").astype(x.dtype)
+                  tp="row", lora="o_proj").astype(x.dtype)
     return out, (k_cache, v_cache)
 
 
 def mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
     if cfg.num_experts:
         return moe_mlp(x, layer, cfg)
-    gate = _einsum("bte,ef->btf", x, layer["gate_proj"], tp="col")
-    up = _einsum("bte,ef->btf", x, layer["up_proj"], tp="col")
+    gate = _einsum("bte,ef->btf", x, layer["gate_proj"], tp="col",
+                   lora="gate_proj")
+    up = _einsum("bte,ef->btf", x, layer["up_proj"], tp="col",
+                 lora="up_proj")
     act = jax.nn.gelu(gate, approximate=True) if cfg.gelu_mlp \
         else jax.nn.silu(gate)
     hidden = (act * up).astype(x.dtype)
     return _einsum("btf,fe->bte", hidden, layer["down_proj"],
-                   tp="row").astype(x.dtype)
+                   tp="row", lora="down_proj").astype(x.dtype)
 
 
 def moe_mlp(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:

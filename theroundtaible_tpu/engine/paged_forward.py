@@ -124,7 +124,7 @@ def forward_paged(
                     f"model axis AND a block-legal chunk (T={t}, "
                     f"ps={page_size})")
             out = _einsum("bthd,hde->bte", out, layer["o_proj"],
-                          tp="row").astype(h.dtype)
+                          tp="row", lora="o_proj").astype(h.dtype)
             return out, (k_pool2, v_pool2)
 
         x, new_pool = transformer_block(
@@ -251,7 +251,7 @@ def forward_ragged(
                     q[0], k_pool2, v_pool2, tables, token_seq,
                     positions, kv_valid, cfg)
             out = _einsum("bthd,hde->bte", out[None], layer["o_proj"],
-                          tp="row").astype(h.dtype)
+                          tp="row", lora="o_proj").astype(h.dtype)
             return out, (k_pool2, v_pool2)
 
         x, new_pool = transformer_block(

@@ -498,11 +498,16 @@ class PagedKVCache:
         while len(state.pages) > keep:
             self._decref(state.pages.pop())
 
-    def commit(self, name: str, tokens: list[int]) -> None:
+    def commit(self, name: str, tokens: list[int],
+               index: bool = True) -> None:
+        # `index=False` (ISSUE 10): the slot's pages hold
+        # adapter-tinted K/V — commit the token record for own-slot
+        # reuse, but never publish the pages into the cross-session
+        # index (base rows of other sessions must not alias them).
         state = self.acquire(name)
         state.tokens = list(tokens)
         self._trim_pages(state, len(tokens))
-        if (self.prefix_cache is not None
+        if (index and self.prefix_cache is not None
                 and not name.startswith("__warmup_")):
             # Publish the slot's COMPLETE pages into the content-
             # addressed index (ISSUE 7): the next session whose prompt

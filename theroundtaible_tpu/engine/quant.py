@@ -267,6 +267,35 @@ def _spec_for_scale(spec, scale_axes: tuple[int, ...]):
                for a in scale_axes))
 
 
+def quantize_lora_stack(stack: jax.Array, act_dtype) -> dict[str, Any]:
+    """Symmetric int8 quantization of a STACKED LoRA tensor [S, r, X]
+    (ISSUE 10 quantize-aware adapter store): per-(slot, rank-row)
+    absmax scales over the last axis, the same w ≈ q·s contract as the
+    int8 weight dicts above — so a K-adapter store streams half the
+    delta bytes. The all-zero base slot quantizes to zeros exactly
+    (absmax floor only guards division). Apply-side dequant
+    (engine/lora._dequant_stack) materializes the tiny tensors; the
+    grouped Pallas kernel declines int8 stacks ("quant:int8-stack")."""
+    w32 = stack.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-1)
+    s = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / s[..., None]), -127, 127)
+    return {"q": q.astype(jnp.int8), "s": s.astype(act_dtype)}
+
+
+def quantize_lora_slot(leaf: dict[str, Any], slot, value32,
+                       set_slot) -> dict[str, Any]:
+    """Hot-swap ONE slot of an int8-quantized LoRA stack: quantize the
+    incoming f32 [r, X] rows with the same per-rank-row absmax rule and
+    write q/s through the store's compiled setter (values only — the
+    stacked shapes never change, so swaps compile nothing)."""
+    absmax = jnp.max(jnp.abs(value32), axis=-1)
+    s = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(value32 / s[..., None]), -127, 127)
+    return {"q": set_slot(leaf["q"], slot, q),
+            "s": set_slot(leaf["s"], slot, s)}
+
+
 def quantized_specs(specs: Params,
                     params: Optional[Params] = None) -> Params:
     """Transform a param PartitionSpec tree (sharding.param_specs) into

@@ -178,6 +178,10 @@ class _Row:
     # Speculative decoding (ISSUE 9): per-row drafter + adaptive
     # throttle (engine/spec_decode.RowSpec); None on spec-off engines.
     spec: Optional[Any] = None
+    # Multi-LoRA persona (ISSUE 10): this row's adapter SLOT in the
+    # engine's LoraStore (0 = base). A value, never a shape: mixed-
+    # adapter segments run the same compiled programs as base ones.
+    adapter_slot: int = 0
 
 
 class _Request:
@@ -189,13 +193,19 @@ class _Request:
                  "turn_budget", "dec_budget", "abandoned", "seg_count",
                  "occ_sum", "occ_max", "sess_max", "requeues",
                  "fits_below", "tele_ctx", "tele", "first_token_at",
-                 "share_plans", "spec_drafted", "spec_accepted")
+                 "share_plans", "spec_drafted", "spec_accepted",
+                 "adapters", "adapters_held")
 
     def __init__(self, session, turns, sampling_per_turn, max_new,
-                 timeout_s, budget, stats):
+                 timeout_s, budget, stats, adapters=None):
         self.session = session
         self.turns = turns
         self.sampling_per_turn = sampling_per_turn
+        # Per-turn LoRA persona adapter ids (ISSUE 10; None = base).
+        # adapters_held flips once acquire() took residency refs, so
+        # failure paths release exactly what admission took.
+        self.adapters = adapters
+        self.adapters_held = False
         self.max_new = max_new
         self.timeout_s = timeout_s
         self.budget = budget
@@ -337,20 +347,23 @@ class SessionScheduler:
                max_new_tokens: Optional[int] = None,
                timeout_s: float = 600.0,
                sampling_per_turn: Optional[list[SamplingParams]] = None,
-               budget=None):
+               budget=None, adapters_per_turn=None):
         """Serve one session round through the shared batch. Blocks the
         calling (session) thread until the round completes; returns
         (responses, GenStats) — the generate_batch_with_stats contract,
-        so the adapter ladder above is unchanged."""
+        so the adapter ladder above is unchanged. `adapters_per_turn`
+        (ISSUE 10): per-knight LoRA persona ids (None = base) —
+        co-batched rows with DIFFERENT adapters share one decode
+        segment on the shared base model."""
         req = self.submit_async(
             session, turns, max_new_tokens=max_new_tokens,
             timeout_s=timeout_s, sampling_per_turn=sampling_per_turn,
-            budget=budget)
+            budget=budget, adapters_per_turn=adapters_per_turn)
         return self.wait(req)
 
     def submit_async(self, session, turns, *, max_new_tokens=None,
                      timeout_s: float = 600.0, sampling_per_turn=None,
-                     budget=None) -> _Request:
+                     budget=None, adapters_per_turn=None) -> _Request:
         if self.closed:
             raise SchedulerClosed("scheduler is closed")
         if not turns:
@@ -375,6 +388,31 @@ class SessionScheduler:
                 f"scheduler batches at most {self.max_rows} (num_slots "
                 f"{engine.kv.num_slots}) — raise num_slots / max_rows")
         max_new = max_new_tokens or engine.sampling.max_new_tokens
+        store = getattr(engine, "lora", None)
+        if store is None:
+            adapters_per_turn = None
+        elif adapters_per_turn is not None:
+            # Validated at the QUEUE mouth (ISSUE 10): a request naming
+            # more distinct personas than the store can ever hold
+            # deadlocks the FIFO head if queued; unknown personas fail
+            # the submitter now instead of at admission. The distinct-
+            # count case is a REFUSAL (counted, like the rows/pages
+            # never-fits); the rest share LoraStore.validate with the
+            # direct generate path so the two cannot drift.
+            distinct = {a for a in adapters_per_turn if a is not None}
+            if (len(adapters_per_turn) == len(turns)
+                    and len(distinct) > store.max_adapters):
+                with self._cv:
+                    self._bump("refused")
+                self._event("refuse", session=session,
+                            reason=f"{len(distinct)} adapters > store "
+                                   f"{store.max_adapters}")
+                raise SchedulerRefused(
+                    f"session {session!r} names {len(distinct)} "
+                    f"distinct lora adapters but the store holds at "
+                    f"most {store.max_adapters} — raise "
+                    "lora.max_adapters")
+            store.validate(adapters_per_turn, len(turns))
         if engine.kv_layout == "paged":
             # Never-fits = LOWER bound (1-token prompts): a request
             # generate_batch could serve must never be refused here.
@@ -390,7 +428,8 @@ class SessionScheduler:
                     f"but the pool holds {engine.kv.usable_pages()} — "
                     "raise num_pages or lower max_new_tokens")
         req = _Request(session, list(turns), sampling_per_turn, max_new,
-                       timeout_s, budget, self._fresh_stats())
+                       timeout_s, budget, self._fresh_stats(),
+                       adapters=adapters_per_turn)
         with self._cv:
             # Re-checked under the lock: close() flips `closed` and
             # drains the queue under this same lock, so a request can
@@ -743,6 +782,7 @@ class SessionScheduler:
                 # release loop would free nothing — undo explicitly or
                 # the orphans distort _fits_now until LRU pressure.
                 self._release_request_slots(req)
+                self._release_adapters(req)
                 self._fail_request(req, e)
                 self._after_engine_failure(e)
 
@@ -769,6 +809,7 @@ class SessionScheduler:
                 or "pool exhausted" not in str(err).lower()):
             return False
         self._release_request_slots(req)
+        self._release_adapters(req)
         req.requeues += 1
         telemetry.inc("roundtable_sched_requeues_total",
                       engine=self._tname)
@@ -790,6 +831,13 @@ class SessionScheduler:
             # A previous admission of this request hit REAL pool
             # exhaustion at this batch size — wait for retirement to
             # actually shrink the batch before re-attempting.
+            return False
+        store = getattr(engine, "lora", None)
+        if (store is not None and req.adapters
+                and not store.can_admit(req.adapters)):
+            # Adapter-residency backpressure (ISSUE 10): every store
+            # slot is referenced by live rows — retirement frees refs,
+            # then the LRU evicts and this request's personas load.
             return False
         if engine.kv_layout == "paged" and self._active:
             # Pages the live rows have pinned are untouchable; the rest
@@ -957,6 +1005,18 @@ class SessionScheduler:
                                                 engine.max_seq_len)
 
         self._spill_for_pressure(req)
+        # Adapter residency (ISSUE 10): taken on the scheduler thread
+        # while it holds the engine serve lock, so a load's stacked-
+        # tensor swap can never race a dispatch's argument capture.
+        # Refs are held for the REQUEST's lifetime (rows keep decoding
+        # across segments) and released at retire/fail.
+        store = getattr(engine, "lora", None)
+        row_slots = None
+        if store is not None:
+            ads = req.adapters or [None] * len(req.turns)
+            row_slots = store.acquire(ads)
+            req.adapters = ads
+            req.adapters_held = True
         active_names = tuple(r.name for r in self._active)
         scoped_turns = [(scoped_slot(req.session, n), p)
                         for n, p in req.turns]
@@ -977,7 +1037,7 @@ class SessionScheduler:
         prep = engine._prepare_batch(
             scoped_turns, max_new_padded, deadline, pre_budget,
             req.sampling_per_turn, extra_pinned=active_names,
-            defer_prefill=deferred)
+            defer_prefill=deferred, adapters=req.adapters)
         # The engine may resolve a WARM join back to the prologue
         # (suffix below ragged_defer_min — blocking one tiny bucket
         # dispatch beats segment-gated chunk ticks); first_np says
@@ -987,6 +1047,11 @@ class SessionScheduler:
         stats.reused_tokens = prep["reused_tokens"]
         stats.prefix_reused_tokens = prep["prefix_reused_tokens"]
         stats.prefill_seconds = time.monotonic() - t0
+        if row_slots and any(row_slots) and prep["first_np"] is not None:
+            engine.note_lora_tokens(sum(
+                len(t) - o for t, o, sl in zip(prep["all_tokens"],
+                                               prep["offsets"],
+                                               row_slots) if sl))
 
         eos = engine.tokenizer.eos_id
         per_row = prep["per_row"]
@@ -1012,7 +1077,8 @@ class SessionScheduler:
                 rows.append(_Row(
                     name=scoped, tokens=toks, sampling=per_row[i],
                     max_new=row_cap, slot_id=prep["slot_ids"][i],
-                    pending=list(toks[off:]), pos=off, valid=off))
+                    pending=list(toks[off:]), pos=off, valid=off,
+                    adapter_slot=(row_slots[i] if row_slots else 0)))
             else:
                 tok = int(prep["first_np"][i])
                 rows.append(_Row(
@@ -1020,7 +1086,8 @@ class SessionScheduler:
                     sampling=per_row[i], max_new=row_cap,
                     slot_id=prep["slot_ids"][i], produced=[tok],
                     last=tok, valid=len(toks),
-                    done=(tok == eos)))
+                    done=(tok == eos),
+                    adapter_slot=(row_slots[i] if row_slots else 0)))
         req.rows = rows
         if engine.spec_decode:
             # Per-row self-drafters (ISSUE 9): the corpus is the row's
@@ -1127,8 +1194,10 @@ class SessionScheduler:
             # weight-streaming ceiling, as a bw_utilization gauge.
             perf = getattr(self.engine, "perf", None)
             if perf is not None:
-                perf.publish_decode_sample(steps * len(alive),
-                                           now - t_prev)
+                perf.publish_decode_sample(
+                    steps * len(alive), now - t_prev,
+                    lora_bytes_per_token=self._lora_bytes_per_token(
+                        alive))
             t_prev = now
             if spec_err is not None:
                 still = [r for r in alive
@@ -1262,7 +1331,8 @@ class SessionScheduler:
             seqs.append(RaggedSeq(
                 [r.last], r.valid, engine.kv.table_for([r.name])[0],
                 temperature=r.sampling.temperature,
-                top_k=r.sampling.top_k, top_p=r.sampling.top_p))
+                top_k=r.sampling.top_k, top_p=r.sampling.top_p,
+                adapter=r.adapter_slot))
             rows_in.append(("decode", r, 1))
         slots_left = shape - RAGGED_BLOCK_Q * len(live)
         for r in filling:
@@ -1273,7 +1343,8 @@ class SessionScheduler:
                 list(r.pending[:take]), r.pos,
                 engine.kv.table_for([r.name])[0],
                 temperature=r.sampling.temperature,
-                top_k=r.sampling.top_k, top_p=r.sampling.top_p))
+                top_k=r.sampling.top_k, top_p=r.sampling.top_p,
+                adapter=r.adapter_slot))
             rows_in.append(("prefill", r, take))
             slots_left -= -(-take // RAGGED_BLOCK_Q) * RAGGED_BLOCK_Q
         batch = build_ragged_batch(
@@ -1301,6 +1372,7 @@ class SessionScheduler:
         eos = engine.tokenizer.eos_id
         now = time.monotonic()
         n_prefill = n_decode = 0
+        lora_toks = 0
         for i, (kind, r, take) in enumerate(rows_in):
             tok = int(nxt[i])
             req = self._row_req.get(id(r))
@@ -1310,10 +1382,14 @@ class SessionScheduler:
                 r.valid += 1
                 r.done = (tok == eos) or len(r.produced) >= r.max_new
                 n_decode += 1
+                if r.adapter_slot:
+                    lora_toks += 1
             else:
                 del r.pending[:take]
                 r.pos += take
                 n_prefill += take
+                if r.adapter_slot:
+                    lora_toks += take
                 if not r.pending:
                     # Join complete: the chunk that finished the prompt
                     # also sampled the row's first token (the prologue's
@@ -1334,6 +1410,7 @@ class SessionScheduler:
         # requests' decode_seconds, chunk tokens in prefill_seconds —
         # and the perfmodel gauges get the same split (a mixed batch
         # must not mislabel its roofline fraction).
+        engine.note_lora_tokens(lora_toks)
         self.ragged_segments += 1
         telemetry.inc("roundtable_sched_ragged_segments_total",
                       engine=self._tname)
@@ -1363,7 +1440,10 @@ class SessionScheduler:
             req.sess_max = max(req.sess_max, sessions)
         perf = getattr(engine, "perf", None)
         if perf is not None:
-            perf.publish_mixed_sample(n_prefill, n_decode, wall)
+            perf.publish_mixed_sample(
+                n_prefill, n_decode, wall,
+                lora_bytes_per_token=self._lora_bytes_per_token(
+                    [r for _k, r, _t in rows_in]))
             for req in reqs:
                 perf.publish_session_kv(
                     req.session, sum(r.valid for r in req.rows))
@@ -1482,7 +1562,7 @@ class SessionScheduler:
                 [r.last] + d, r.valid, engine.kv.table_for([r.name])[0],
                 temperature=r.sampling.temperature,
                 top_k=r.sampling.top_k, top_p=r.sampling.top_p,
-                n_scores=len(d) + 1))
+                n_scores=len(d) + 1, adapter=r.adapter_slot))
         batch = build_ragged_batch(
             seqs, t_budget=shape, s_max=engine.kv.num_slots + 1,
             pages_per_seq=engine.kv.pages_per_seq,
@@ -1513,6 +1593,7 @@ class SessionScheduler:
         eos = engine.tokenizer.eos_id
         from .spec_decode import accept_prefix
         n_emit = 0
+        lora_toks = 0
         drafted_tot = 0
         accepted_tot = 0
         emits: dict[int, tuple[_Request, int]] = {}
@@ -1532,6 +1613,8 @@ class SessionScheduler:
             r.last = emit[-1]
             r.valid += len(emit)
             r.done = (r.last == eos) or len(r.produced) >= r.max_new
+            if r.adapter_slot:
+                lora_toks += len(emit)
             # Accepted-for-accounting = drafts actually COMMITTED:
             # eos/budget truncation can drop matched drafts, and every
             # acceptance metric must equal served work (a fully-matched
@@ -1572,6 +1655,7 @@ class SessionScheduler:
                         row=r.name, rate=round(r.spec.rate(), 3))
                     self._event("spec_throttle", row=r.name,
                                 rate=round(r.spec.rate(), 3))
+        engine.note_lora_tokens(lora_toks)
         engine.note_spec_dispatch(drafted_tot, accepted_tot,
                                   rows=len(live))
 
@@ -1601,8 +1685,9 @@ class SessionScheduler:
             # len(live) rows — that is the roofline-relevant count; the
             # accepted total is the user-visible rate and must not
             # report >100% bandwidth utilization.
-            perf.publish_mixed_sample(0, n_emit, wall,
-                                      decode_dispatch_tokens=len(live))
+            perf.publish_mixed_sample(
+                0, n_emit, wall, decode_dispatch_tokens=len(live),
+                lora_bytes_per_token=self._lora_bytes_per_token(live))
             for req in reqs:
                 perf.publish_session_kv(
                     req.session, sum(r.valid for r in req.rows))
@@ -1646,6 +1731,18 @@ class SessionScheduler:
             if req.turn_budget.token.cancelled or req.turn_budget.expired:
                 return False
         return True
+
+    def _lora_bytes_per_token(self, rows: list[_Row]):
+        """This sample's mean adapter bytes streamed per decoded token
+        (ISSUE 10 perfmodel satellite): the exact mix, so the roofline
+        gauges neither overreport base-only segments against a lora-
+        discounted ceiling nor persona segments against the base one.
+        None on lora-off engines (the perf default applies)."""
+        store = getattr(self.engine, "lora", None)
+        if store is None or not rows:
+            return None
+        n_ad = sum(1 for r in rows if r.adapter_slot)
+        return store.streamed_bytes_per_token() * n_ad / len(rows)
 
     def _reqs_of(self, rows: list[_Row]) -> list[_Request]:
         seen: dict[int, _Request] = {}
@@ -1794,6 +1891,16 @@ class SessionScheduler:
             [SamplingParams(temperature=t, top_k=k, top_p=p)
              for t, k, p in zip(temps_l, top_ks_l, top_ps_l)])
 
+        lora = None
+        if getattr(engine, "lora", None) is not None:
+            # Per-row adapter slots (ISSUE 10): pad rows ride the base
+            # (zero) adapter — their delta is exactly zero and their
+            # outputs are masked anyway. A value, so mixed-adapter
+            # recomposition compiles nothing.
+            slots = [r.adapter_slot for r in rows]
+            ids = (plan.scatter_list(slots, 0) if plan is not None
+                   else slots + [0] * pad)
+            lora = engine._lora_args(ids)
         if plan is not None:
             last_d = plan.scatter_rows(last, np.int32(eos))
             valid_d = plan.scatter_rows(valid, 1)
@@ -1814,6 +1921,7 @@ class SessionScheduler:
             "top_ks": top_ks, "top_ps": top_ps, "greedy": greedy,
             "seg_budget": seg_budget, "deadline": deadline,
             "budgets_max": int(budgets.max()) if len(budgets) else 0,
+            "lora": lora,
         }
 
     def _dispatch(self, ctx: dict):
@@ -1832,12 +1940,13 @@ class SessionScheduler:
                     engine._next_key(), jnp.int32(DECODE_SEGMENT),
                     ctx["temps"], ctx["top_ks"], ctx["top_ps"],
                     ctx["budgets_d"], ctx["done_d"],
-                    greedy=ctx["greedy"])
+                    greedy=ctx["greedy"], lora=ctx["lora"])
             return engine._decode_dispatch_slots(
                 ctx["slot_idx"], ctx["last_d"], ctx["valid_d"],
                 engine._next_key(), jnp.int32(DECODE_SEGMENT),
                 ctx["temps"], ctx["top_ks"], ctx["top_ps"],
-                ctx["budgets_d"], ctx["done_d"], greedy=ctx["greedy"])
+                ctx["budgets_d"], ctx["done_d"], greedy=ctx["greedy"],
+                lora=ctx["lora"])
 
         return run_dispatch(dispatch, engine.retry, ctx["deadline"],
                             budget=ctx["seg_budget"])
@@ -1877,13 +1986,24 @@ class SessionScheduler:
             last_np = last_np[plan.pos]
             valid_np = valid_np[plan.pos]
             done_np = done_np[plan.pos]
+        lora_toks = 0
+        eos = self.engine.tokenizer.eos_id
         for i, r in enumerate(ctx["rows"]):
             if r.done:
                 continue  # masked rows emit eos filler — not output
-            r.produced.extend(int(x) for x in out_np[i])
+            row = [int(x) for x in out_np[i]]
+            r.produced.extend(row)
             r.last = int(last_np[i])
             r.valid = int(valid_np[i])
             r.done = bool(done_np[i]) or len(r.produced) >= r.max_new
+            if r.adapter_slot:
+                # Count tokens up to (and including) the row's eos —
+                # post-eos filler is not served work, and the direct
+                # generate path counts eos-trimmed exactly; the two
+                # definitions of apply_tokens must agree.
+                lora_toks += (row.index(eos) + 1 if eos in row
+                              else len(row))
+        self.engine.note_lora_tokens(lora_toks)
         return n
 
     # --- failure containment ---
@@ -1929,8 +2049,15 @@ class SessionScheduler:
             self._fail_request(req, err, release=False)
         return True
 
+    def _release_adapters(self, req: _Request) -> None:
+        store = getattr(self.engine, "lora", None)
+        if store is not None and req.adapters_held:
+            req.adapters_held = False
+            store.release(req.adapters or [])
+
     def _fail_request(self, req: _Request, err: BaseException,
                       release: bool = True) -> None:
+        self._release_adapters(req)
         if release:
             for r in req.rows:
                 try:
@@ -1982,10 +2109,17 @@ class SessionScheduler:
                 req.stats.decode_tokens += len(ids)
                 # Commit prompt + every FED token (= all but the last
                 # sampled one) for next-round prefix reuse — the
-                # finalize_outputs contract.
+                # finalize_outputs contract. Persona rows never feed
+                # the cross-session prefix cache (index=False): their
+                # pages hold adapter-tinted K/V (ISSUE 10).
                 fed = ids[:-1] if ids else []
-                engine.kv.commit(r.name, r.tokens + fed)
+                engine.kv.commit(r.name, r.tokens + fed,
+                                 index=not r.adapter_slot)
                 texts.append(engine.tokenizer.decode(ids))
+            # (roundtable_lora_apply_tokens_total was bumped per
+            # DISPATCH as the tokens were served — retire must not
+            # count them again.)
+            self._release_adapters(req)
             req.stats.int4_paths = engine.int4_path_report()
             req.stats.sched = {
                 "queue_wait_s": round(
@@ -2011,6 +2145,11 @@ class SessionScheduler:
                     "acceptance_rate": round(
                         req.spec_accepted / req.spec_drafted, 3),
                 }
+            if req.adapters and any(a is not None
+                                    for a in req.adapters):
+                # Persona provenance (ISSUE 10): which LoRA adapter
+                # served each knight of this round.
+                req.stats.sched["lora_adapters"] = list(req.adapters)
             self._drop_request(req)
             self._last_active[req.session] = time.monotonic()
             req.result = (texts, req.stats)

@@ -480,11 +480,12 @@ class RaggedSeq:
     build_ragged_batch turns a list of these into device inputs."""
 
     __slots__ = ("tokens", "pos", "table", "temperature", "top_k",
-                 "top_p", "n_scores")
+                 "top_p", "n_scores", "adapter")
 
     def __init__(self, tokens: list[int], pos: int, table: np.ndarray,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, n_scores: int = 1):
+                 top_p: float = 1.0, n_scores: int = 1,
+                 adapter: int = 0):
         self.tokens = tokens
         self.pos = pos
         self.table = table
@@ -492,6 +493,11 @@ class RaggedSeq:
         self.top_k = top_k
         self.top_p = top_p
         self.n_scores = n_scores
+        # LoRA adapter SLOT of this sequence (ISSUE 10, 0 = base): the
+        # flat buffer mixes sequences with different adapters in one
+        # dispatch, so identity rides per TOKEN (token_adapter below) -
+        # a value, never a shape.
+        self.adapter = adapter
 
 
 def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
@@ -545,6 +551,7 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
     query_offsets = np.zeros(s_max, np.int32)
     kv_valid = np.ones(s_max, np.int32)
     last_rows = np.zeros(s_max, np.int32)
+    token_adapter = np.zeros(t_budget, np.int32)
     temps = np.ones(s_max, np.float32)
     top_ks = np.zeros(s_max, np.int32)
     top_ps = np.ones(s_max, np.float32)
@@ -581,6 +588,10 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
             seq_of_block[b0 + k] = i
             block_qstart[b0 + k] = k * bq
         tables[i] = s.table
+        # Pad rows inside the span keep adapter 0: their K/V lands on
+        # the scratch page and their outputs are dropped, so the base
+        # (zero) delta is both correct and the cheapest.
+        token_adapter[row:row + n] = s.adapter
         query_offsets[i] = s.pos
         kv_valid[i] = s.pos + n
         last_rows[i] = row + n - 1
@@ -600,7 +611,7 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
         "block_qstart": block_qstart, "tables": tables,
         "query_offsets": query_offsets, "kv_valid": kv_valid,
         "last_rows": last_rows, "temps": temps, "top_ks": top_ks,
-        "top_ps": top_ps,
+        "top_ps": top_ps, "token_adapter": token_adapter,
         "greedy": all(s.temperature <= 0.0 for s in seqs),
         "n_seqs": len(seqs), "n_tokens": n_tokens,
         "score_width": score_width,

@@ -185,6 +185,35 @@ def int4_shard_axis(tp: Optional[str], w_ndim: int, n_cont: int,
     return None, False
 
 
+def lora_shard_axis(tp: Optional[str]) -> Optional[str]:
+    """Which STACKED-LoRA axis carries the model shards for a target
+    projection — kept HERE next to param_specs/int4_shard_axis so the
+    base weight's placement and the LoRA stack's partitioning can
+    never drift (ISSUE 10). tp="col" (q/k/v, gate/up): the delta's
+    OUTPUT axis is the model-sharded one, so B's last axis shards and
+    each device computes its own delta slice with no collective.
+    tp="row" (o_proj, down_proj): the CONTRACTION axis is sharded, so
+    A's last axis shards and per-shard partial deltas combine with one
+    psum over "model" — the same all-reduce the base matmul inserts.
+    Returns "out" | "in" | None (replicate)."""
+    if tp == "col":
+        return "out"
+    if tp == "row":
+        return "in"
+    return None
+
+
+def lora_stack_specs(tp: Optional[str]) -> tuple[P, P]:
+    """(a_spec, b_spec) for the stacked LoRA tensors a_t [S, r, C] /
+    b [S, r, O] of a target with TP convention `tp` — the resident
+    placement lora_bgmv_spmd's in_specs must match (a mismatch would
+    regather the stack per dispatch)."""
+    which = lora_shard_axis(tp)
+    a_spec = P(None, None, MODEL_AXIS if which == "in" else None)
+    b_spec = P(None, None, MODEL_AXIS if which == "out" else None)
+    return a_spec, b_spec
+
+
 def kv_cache_spec() -> P:
     """KV cache [B, S, K, D]: slots on data axis, kv heads on model axis."""
     return P(DATA_AXIS, None, MODEL_AXIS, None)

@@ -123,6 +123,18 @@ def publish_memory_ledger(engine) -> dict[str, Any]:
         if ledger.get("hbm_bytes") is not None:
             reg.set_gauge("roundtable_kv_hbm_bytes",
                           ledger["hbm_bytes"], engine=name)
+    # ISSUE 10: the multi-LoRA adapter store's HBM footprint rides
+    # the same ledger publish — resident personas and what each costs,
+    # next to the KV split they multiply scenario coverage against.
+    store = getattr(engine, "lora", None)
+    if store is not None:
+        ledger["lora_resident_adapters"] = len(store.resident())
+        ledger["lora_adapter_bytes"] = store.adapter_bytes()
+        ledger["lora_stack_bytes"] = store.stack_bytes()
+        reg.set_gauge("roundtable_lora_resident_adapters",
+                      ledger["lora_resident_adapters"], engine=name)
+        reg.set_gauge("roundtable_lora_stack_bytes",
+                      ledger["lora_stack_bytes"], engine=name)
     # ISSUE 7: the host-RAM offload tier's footprint rides the same
     # ledger publish (sessions parked out of HBM + what they cost in
     # host bytes).

@@ -239,6 +239,14 @@ class EnginePerf:
         self.chip = chip
         self.chip_source = chip_source
         self.kv_token_bytes = kv_token_bytes
+        # Multi-LoRA streamed-bytes overhead (ISSUE 10): a persona row
+        # streams its adapter's A/B bytes on top of the base weights
+        # every decode token, so the weight-streaming ceiling drops.
+        # The engine's LoraStore keeps this at the per-adapter cost
+        # while any adapter is resident (a conservative default for
+        # call-level gauges); the scheduler passes the exact per-
+        # sample mix to publish_decode_sample/publish_mixed_sample.
+        self.lora_row_bytes = 0.0
         self.decode_ceiling = (decode_ceiling_tps(param_bytes, chip,
                                                   n_devices)
                                if chip else None)
@@ -289,6 +297,21 @@ class EnginePerf:
             chip=chip, chip_source=source,
             kv_token_bytes=kv_bytes_per_token(engine.cfg, kv_itemsize))
 
+    def set_lora_row_bytes(self, n: float) -> None:
+        self.lora_row_bytes = float(max(n, 0.0))
+
+    def _decode_ceiling(self, lora_bytes_per_token=None) -> float:
+        """The weight-streaming ceiling with LoRA bytes folded in
+        (ISSUE 10): a K-adapter batch streams base + adapter bytes per
+        token, so judging it against the base-only ceiling would
+        overreport bw_utilization exactly when personas are active."""
+        extra = (self.lora_row_bytes if lora_bytes_per_token is None
+                 else lora_bytes_per_token)
+        if not extra:
+            return self.decode_ceiling
+        return decode_ceiling_tps(self.param_bytes + int(extra),
+                                  self.chip, self.n_devices)
+
     # --- live publication seams ---
 
     def publish_call(self, stats) -> None:
@@ -302,7 +325,7 @@ class EnginePerf:
             # publish_gen_stats' series (one writer per series).
             telemetry.set_gauge(
                 "roundtable_bw_utilization",
-                stats.decode_tps / self.decode_ceiling,
+                stats.decode_tps / self._decode_ceiling(),
                 engine=self.engine_name, phase="decode")
             n += 1
         if stats.prefill_seconds and stats.prefill_tokens:
@@ -314,15 +337,20 @@ class EnginePerf:
         if n:
             note_published(n)
 
-    def publish_decode_sample(self, tokens: int, seconds: float) -> None:
+    def publish_decode_sample(self, tokens: int, seconds: float,
+                              lora_bytes_per_token=None) -> None:
         """Per-decode-segment utilization sample (the scheduler's
         segment boundary): tokens is the segment's attributed count
         (steps × live rows — rows finishing mid-segment emit filler,
-        so this is a slight over-attribution, stated here once)."""
+        so this is a slight over-attribution, stated here once).
+        `lora_bytes_per_token` (ISSUE 10): the sample's actual mean
+        adapter bytes streamed per token (None = the store-level
+        default)."""
         if self.decode_ceiling is None or seconds <= 0 or tokens <= 0:
             return
+        ceiling = self._decode_ceiling(lora_bytes_per_token)
         telemetry.set_gauge("roundtable_bw_utilization",
-                            (tokens / seconds) / self.decode_ceiling,
+                            (tokens / seconds) / ceiling,
                             engine=self.engine_name, phase="decode")
         note_published(1)
 
@@ -330,6 +358,7 @@ class EnginePerf:
                              decode_tokens: int,
                              seconds: float,
                              decode_dispatch_tokens: Optional[int] = None,
+                             lora_bytes_per_token=None,
                              ) -> None:
         """Per-RAGGED-segment attribution (ISSUE 8): a mixed dispatch
         carries both prefill chunks and decode tokens, so the roofline
@@ -350,7 +379,11 @@ class EnginePerf:
         produced) or a 3x-accepting run reports 300% bandwidth
         utilization; the ACCEPTED rate publishes separately as the
         user-visible `roundtable_spec_accepted_tps`. None (the plain
-        ragged path) means the two counts coincide."""
+        ragged path) means the two counts coincide.
+
+        `lora_bytes_per_token` (ISSUE 10): the sample's mean adapter
+        bytes streamed per token — folds into the decode ceiling so a
+        K-adapter batch doesn't overreport bw_utilization."""
         if self.decode_ceiling is None or seconds <= 0:
             return
         n = 0
@@ -360,7 +393,8 @@ class EnginePerf:
                                else decode_dispatch_tokens)
             telemetry.set_gauge(
                 "roundtable_bw_utilization",
-                (roofline_tokens / seconds) / self.decode_ceiling,
+                (roofline_tokens / seconds)
+                / self._decode_ceiling(lora_bytes_per_token),
                 engine=self.engine_name, phase="decode")
             n += 1
             if decode_dispatch_tokens is not None:
@@ -409,6 +443,7 @@ class EnginePerf:
             "prefill_peak_tps": (round(self.prefill_peak, 1)
                                  if self.prefill_peak else None),
             "kv_bytes_per_token": self.kv_token_bytes,
+            "lora_row_bytes": int(self.lora_row_bytes),
         }
 
 
@@ -488,6 +523,7 @@ PERF_SERIES_PREFIXES = (
     "roundtable_kv_", "roundtable_hbm_", "roundtable_session_kv_",
     "roundtable_prefix_",   # ISSUE 7: prefix-cache hit/miss/size series
     "roundtable_spec_",     # ISSUE 9: speculation accept/rate series
+    "roundtable_lora_",     # ISSUE 10: multi-LoRA residency/apply series
 )
 
 

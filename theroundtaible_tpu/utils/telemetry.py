@@ -742,6 +742,20 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "verify_dispatches": "roundtable_sched_spec_segments_total "
                              "(+ warmup dispatches)",
     },
+    # engine.describe()["lora"] (ISSUE 10): the multi-LoRA persona
+    # provenance sink's registry bindings — residency/swap counters
+    # move in lockstep with the store's describe() totals (LoraStore
+    # load/evict and engine.note_lora_tokens are the single writers).
+    "engine_lora": {
+        "apply_tokens": "roundtable_lora_apply_tokens_total",
+        "swaps": "roundtable_lora_swaps_total",
+        "resident": "roundtable_lora_resident_adapters gauge",
+        "adapter_bytes": "roundtable_lora_adapter_bytes{adapter=...} "
+                         "gauge (REMOVED at evict)",
+        "stack_bytes": "roundtable_lora_stack_bytes gauge "
+                       "(memory-ledger publish)",
+        "share_suppressed": "derived (engine counter; lora_describe)",
+    },
 }
 
 
