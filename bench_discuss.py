@@ -56,6 +56,19 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            (personas must be DIFFERENT models, measurably), the
            mixed-vs-alone token-parity bit, and the lora store/path
            provenance embedded. ROUNDTABLE_BENCH_LORA_K overrides K.)
+       ROUNDTABLE_BENCH_KV_QUANT=1 ..    (quantized-KV-page A/B,
+           ISSUE 11: the same pool BYTE budget served int8-KV-ON then
+           bf16-OFF, in ONE record — max resident sessions before the
+           allocator evicts (the acceptance bar: >= 1.8x at int8),
+           scheduled decode tok/s, the ledger's resident-vs-logical
+           byte split, the greedy token-parity bit across modes, the
+           per-page-path dequant provenance (kernel vs XLA, with
+           machine-readable fallback_reason), the quant-aware roofline
+           block, and ROUNDTABLE_RECOMPILE_STRICT=1 green across the
+           serve. On CPU the model is a head_dim=64 tiny-gemma variant
+           (D=16's per-cell f32 scale overhead caps the page ratio at
+           1.6x; serving head_dims amortize it — gemma-2b's D=256
+           gives 1.97x). ROUNDTABLE_BENCH_KVQ_DTYPE=int4 A/Bs int4.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -1382,6 +1395,227 @@ def lora_child() -> int:
     return 0
 
 
+def kv_quant_child() -> int:
+    """Quantized-KV-page A/B (ISSUE 11 acceptance): the same pool byte
+    budget served quant-ON (int8 pages + per-cell scales, in-kernel
+    dequant) then quant-OFF (bf16 pages), in ONE record.
+
+    Three measurements per mode, all through the REAL serving path:
+    - MAX RESIDENT SESSIONS: admit fixed-shape sessions one at a time
+      (offload tier off — no spill valve) until the allocator EVICTS an
+      earlier session's pages; the count still fully resident is the
+      honest capacity number (the pool refuses by LRU-evicting, not by
+      raising). Quantized pools hold page_ratio x the pages in the same
+      bytes, so the bar is >= 1.8x at int8.
+    - SCHEDULED DECODE tok/s: K concurrent sessions through the
+      session scheduler with ROUNDTABLE_RECOMPILE_STRICT=1 armed after
+      a warm pass — the record carries the strict-green bit.
+    - GREEDY TOKEN PARITY: the probe session's tokens must match
+      across modes (the rms-bound acceptance rule's observable).
+    """
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.engine import compile_watch
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.models.registry import get_model_config
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+    from theroundtaible_tpu.utils import perfmodel
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    kvq_dtype = os.environ.get("ROUNDTABLE_BENCH_KVQ_DTYPE", "int8")
+    if on_cpu:
+        # head_dim=64: tiny-gemma's D=16 pays its per-cell f32 scale on
+        # every 16 payload bytes (page ratio 1.6x); D=64 amortizes to
+        # 1.88x so the CPU record exercises the same >= 1.8x bar the
+        # chip hits at D=256.
+        cfg = get_model_config("tiny-gemma", max_seq_len=512,
+                               head_dim=64)
+        kw = {"mesh_shape": {"data": 1, "model": 1}}
+        page_size, num_slots, max_new, k_sched = 32, 32, 24, 3
+    else:
+        cfg = get_model_config("gemma-2b-it", max_seq_len=2048)
+        kw = {}
+        page_size, num_slots, max_new, k_sched = 128, 32, 48, 3
+    session_prompt = (TOPIC + " The knight surveys the state of the "
+                      "store, weighs the proposal on its merits, and "
+                      "answers at length about the event log design. ")
+    # The SAME pool byte budget on both sides, stated in pages: bf16
+    # gets POOL_PAGES, the quantized pool gets page_ratio x as many —
+    # byte-for-byte what the engine's default sizing does, pinned
+    # explicitly so the A/B denominator can't drift with num_slots
+    # (slots are sized to never bind; PAGES are the contended
+    # resource, exactly the production refusal mode).
+    from theroundtaible_tpu.engine import kv_quant as kvq_mod
+    pool_pages = 6 * (cfg.max_seq_len // page_size)
+    spec = kvq_mod.resolve_spec(kvq_dtype)[0]
+    quant_pages = int(pool_pages * kvq_mod.page_ratio(
+        spec, cfg.head_dim)) if spec is not None else pool_pages
+
+    def build(quant):
+        # prefix_cache off: the capacity climb must charge every
+        # session its own pages — cache aliasing of the shared topic
+        # preamble would make "resident sessions" unbounded and the
+        # A/B vacuous. kv_offload off: no spill valve under pressure.
+        return InferenceEngine(
+            cfg, num_slots=num_slots, kv_layout="paged",
+            page_size=page_size, kv_offload=False, prefix_cache=False,
+            num_pages=(quant_pages if quant else pool_pages),
+            kv_quant=(kvq_dtype if quant else None), **kw)
+
+    def max_resident_sessions(eng) -> int:
+        """Admit sessions until the allocator evicts one — the count
+        still fully resident right before the first eviction."""
+        admitted: list[str] = []
+        for i in range(4 * num_slots):
+            name = f"cap{i}"
+            try:
+                eng.generate(f"Distinct transcript {i}: "
+                             + session_prompt, slot_name=name,
+                             max_new_tokens=8)
+            except RuntimeError:
+                break           # hard exhaustion also ends the climb
+            admitted.append(name)
+            resident = set(eng.kv.slot_names())
+            if any(a not in resident for a in admitted):
+                return len(admitted) - 1
+        return len(admitted)
+
+    def run_mode(quant: bool) -> dict:
+        eng = build(quant)
+        warm_s = eng.warmup(max_prompt_tokens=256, batch_sizes=(1,))
+        # Capacity climb on the bare engine (no scheduler spill valve).
+        resident = max_resident_sessions(eng)
+        eng.kv.revive_if_dead()
+        for n in list(eng.kv.slot_names()):
+            eng.kv.release(n)
+        # Scheduled throughput with STRICT armed after a warm pass.
+        sched = SessionScheduler(eng)
+        errors: list = []
+        dec = {"tokens": 0}
+        lock = threading.Lock()
+
+        def knight(i, tag):
+            try:
+                _, stats = sched.submit(
+                    f"{tag}{i}", [(f"knight{i}",
+                                   session_prompt + f"Knight {i}: ")],
+                    max_new_tokens=max_new)
+                with lock:
+                    dec["tokens"] += stats.decode_tokens
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append((i, repr(e)))
+
+        def round_of(tag):
+            threads = [threading.Thread(target=knight, args=(i, tag))
+                       for i in range(k_sched)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+
+        try:
+            round_of("warm")
+            compile_watch.install()
+            compile_watch.warmup_complete("bench_kvq")
+            strict0 = compile_watch.steady_state_compiles()
+            os.environ["ROUNDTABLE_RECOMPILE_STRICT"] = "1"
+            dec["tokens"] = 0
+            t0 = time.monotonic()
+            round_of("load")
+            wall = time.monotonic() - t0
+        finally:
+            os.environ.pop("ROUNDTABLE_RECOMPILE_STRICT", None)
+            sched.close()
+        strict_green = (not errors and
+                        compile_watch.steady_state_compiles() == strict0)
+        compile_watch.reset_steady_state()
+        if errors:
+            raise RuntimeError(f"kv_quant bench mode quant={quant}: "
+                               f"{errors}")
+        # Parity probe: one fresh greedy session, compared across modes.
+        probe = eng.generate(session_prompt, slot_name="probe",
+                             max_new_tokens=16)
+        led = eng.kv.memory_ledger()
+        spec = eng.kv_quant_spec
+        kv_ctx = cfg.max_seq_len // 2
+        roof = perfmodel.roofline_block(
+            param_bytes=eng.perf.param_bytes,
+            num_params=eng.num_params,
+            n_devices=int(eng.mesh.devices.size),
+            kv_stream_bytes=kv_ctx * eng.perf.kv_token_bytes,
+            kv_dtype=led["kv_dtype"])
+        return {
+            "kv_dtype": led["kv_dtype"],
+            "max_resident_sessions": resident,
+            "num_pages": eng.kv.num_pages,
+            "decode_tokens": dec["tokens"],
+            "wall_s": round(wall, 2),
+            "decode_tok_s": round(dec["tokens"] / max(wall, 1e-9), 1),
+            "strict_green": strict_green,
+            "warmup_s": round(warm_s, 1),
+            "ledger": {k: led[k] for k in (
+                "kv_dtype", "kv_quant_bits", "kv_bytes_resident",
+                "kv_bytes_logical", "kv_quant_bytes_saved",
+                "usable_pages", "hbm_bytes")},
+            "kv_quant": eng.kv_quant_describe(),
+            "kv_bytes_per_token": eng.perf.kv_token_bytes,
+            "roofline": roof,
+            "group": (spec.effective_group(cfg.head_dim)
+                      if spec is not None else None),
+            "_probe": probe,
+        }
+
+    on = run_mode(True)
+    off = run_mode(False)
+    parity = on.pop("_probe") == off.pop("_probe")
+    ratio = round(on["max_resident_sessions"]
+                  / max(off["max_resident_sessions"], 1), 3)
+    result_line = {
+        "metric": f"kv_quant_pages[{cfg.name}][{kvq_dtype}]",
+        "value": ratio,
+        "unit": "max_resident_sessions_ratio_quant_vs_bf16",
+        "detail": {
+            "quant_on": on,
+            "quant_off": off,
+            "max_resident_sessions_ratio": ratio,
+            "greedy_token_parity": parity,
+            "strict_green_both_modes": (on["strict_green"]
+                                        and off["strict_green"]),
+            "decode_ceiling_lift": round(
+                on["roofline"]["decode_ceiling_tps"]
+                / max(off["roofline"]["decode_ceiling_tps"], 1e-9), 3),
+            "acceptance": {
+                "criterion": ">= 1.8x max resident sessions at int8 "
+                             "vs bf16 on the same pool byte budget, "
+                             "greedy parity True, STRICT green",
+                "meets": (ratio >= 1.8 and parity
+                          and on["strict_green"]
+                          and off["strict_green"]),
+            },
+            "head_dim": cfg.head_dim,
+            "page_size": page_size,
+            "cpu_wall_caveat": on_cpu,
+            "platform": jax.devices()[0].platform,
+            "telemetry": _registry_snapshot(),
+            "perf": _perf_block(),
+        },
+    }
+    print(json.dumps(result_line), flush=True)
+    return 0
+
+
 def main() -> int:
     from bench_common import run_watchdogged
     # The offered-load / prefix-reuse sweeps run many scripted
@@ -1391,12 +1625,15 @@ def main() -> int:
                  or os.environ.get("ROUNDTABLE_BENCH_PREFIX_REUSE")
                  or os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE")
                  or os.environ.get("ROUNDTABLE_BENCH_LORA")
+                 or os.environ.get("ROUNDTABLE_BENCH_KV_QUANT")
                  else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
                            attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_KV_QUANT"):
+        return kv_quant_child()
     if os.environ.get("ROUNDTABLE_BENCH_LORA"):
         return lora_child()
     if os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE"):

@@ -61,6 +61,14 @@ run_step "bench_discuss.py (spec-decode A/B)" \
 # persona distribution divergence, mixed-vs-alone parity bit.
 run_step "bench_discuss.py (multi-LoRA A/B)" \
   env ROUNDTABLE_BENCH_LORA=1 python bench_discuss.py
+# Quantized-KV-page A/B (ISSUE 11): the same pool byte budget served
+# int8-KV-on vs bf16-off on chip (gemma-2b D=256 → page ratio 1.97x) —
+# max resident sessions before eviction (the >= 1.8x bar), scheduled
+# decode tok/s, ledger resident/logical split, greedy parity bit,
+# per-page-path dequant provenance, STRICT green. The CPU twin of
+# this record is KVQ_r11.json.
+run_step "bench_discuss.py (KV-quant A/B)" \
+  env ROUNDTABLE_BENCH_KV_QUANT=1 python bench_discuss.py
 # 1500 s: the 900 s budget SIGTERMed twice — host-side training alone
 # is ~330 s and first-time tunnel compiles are 20-40 s per prefill
 # shape bucket. Still LAST so even a hang costs no core measurement.

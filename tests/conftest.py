@@ -310,6 +310,33 @@ def _lora_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _kv_quant_guard(request):
+    """Tier-1 guard for @pytest.mark.kv_quant (ISSUE 11 satellite): a
+    test that CLAIMS quantized-KV-page coverage must not silently serve
+    bf16 pools — if no serving dispatch during the test ever READ a
+    quantized page (kernel-dequant or XLA-dequant), the `kv_quant:`
+    config silently resolved off (kill-switch left armed, contiguous
+    layout, spec declined at construction) and the test's compression
+    claims are vacuous; fail LOUD. Decline/fallback/kill-switch unit
+    tests (which legitimately serve bf16) mark allow_bf16=True."""
+    marker = request.node.get_closest_marker("kv_quant")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import kv_quant as kvq_mod
+
+    kvq_mod.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_bf16"):
+        return
+    assert kvq_mod.quant_dispatches() > 0, (
+        "kv_quant-marked test recorded ZERO quantized-page dispatches: "
+        "serving silently ran bf16 pools (kill-switch armed? layout "
+        "contiguous? spec declined?) — mark allow_bf16=True only for "
+        "decline/fallback/kill-switch units")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

@@ -192,6 +192,29 @@ def kv_status(session) -> int:
         "roundtable_kv_page_", "roundtable_kv_fragmentation",
         "roundtable_kv_shared_pages", "roundtable_kv_exclusive_pages",
         "roundtable_kv_hbm_bytes", "roundtable_hbm_"))
+    # ISSUE 11: the quantized-page dtype split — kv_dtype rendered
+    # from the bits gauge (0 = bf16 pool), logical vs resident bytes
+    # and the saved delta next to each other so the compression claim
+    # is auditable from the same screen as the residency it frees.
+    quant_keys = [k for k in series
+                  if k.split("{")[0] == "roundtable_kv_quant_bits"]
+    if quant_keys:
+        print(style.bold("\n  Quantized KV pages (ISSUE 11):"))
+        for k in sorted(quant_keys):
+            lb = _labels(k)
+            bits = int(series[k])
+            dtype = {8: "int8", 4: "int4"}.get(bits, "bf16")
+            eng = lb.get("engine", "?")
+            logical = series.get(
+                f"roundtable_kv_bytes_logical{{engine={eng}}}", 0)
+            saved = series.get(
+                f"roundtable_kv_quant_bytes_saved{{engine={eng}}}", 0)
+            print(style.dim(
+                f"    {eng:<16} kv_dtype={dtype:<5} "
+                f"kv_bytes_logical={logical:g} "
+                f"kv_bytes_resident={logical - saved:g} "
+                f"saved={saved:g}"))
+        any_out = True
     any_out |= section("Prefix cache (cross-session index)",
                        ("roundtable_prefix_",))
     any_out |= section("Host-RAM offload tier", (

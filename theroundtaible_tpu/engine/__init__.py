@@ -98,7 +98,7 @@ def _cache_key(config: dict[str, Any]) -> str:
                  "kv_layout", "page_size", "num_pages", "n_micro",
                  "quant", "dcn_axis", "prefix_cache",
                  "prefix_cache_pages", "kv_offload", "ragged_attn",
-                 "spec_decode", "spec_max_draft", "lora")}
+                 "spec_decode", "spec_max_draft", "lora", "kv_quant")}
     return json.dumps(relevant, sort_keys=True)
 
 

@@ -115,7 +115,8 @@ class InferenceEngine:
                  ragged_attn: Optional[bool] = None,
                  spec_decode: Optional[bool] = None,
                  spec_max_draft: Optional[int] = None,
-                 lora: Optional[dict] = None):
+                 lora: Optional[dict] = None,
+                 kv_quant: Any = None):
         # Multi-host: join the process group BEFORE any backend/device
         # call when ROUNDTABLE_COORDINATOR is set (engine/distributed.py);
         # jax.devices() below then spans every host's chips.
@@ -205,6 +206,27 @@ class InferenceEngine:
                 f"kv_layout must be contiguous|paged, got {kv_layout!r}")
         self.kv_layout = kv_layout
 
+        # Quantized KV pages (ISSUE 11): resolve the `kv_quant:` config
+        # against the ROUNDTABLE_KV_QUANT kill-switch BEFORE the pool is
+        # built — the pool's dtype, its scale arrays, and its
+        # byte-budget-equal default page count all follow the spec.
+        # Contiguous layouts decline (no page unit to quantize); the
+        # reason is machine-readable like every other path decision.
+        from .kv_quant import resolve_spec as _kvq_resolve
+        self.kv_quant_spec = None
+        self.kv_quant_reason: Optional[str] = None
+        self.kv_quant_fallback_reason: Optional[str] = None
+        self._kv_quant_dispatches: dict[str, int] = {}
+        from collections import deque as _dq
+        self._kv_quant_recent = _dq(maxlen=32)
+        if kv_layout != "paged":
+            self.kv_quant_reason = ("kv_layout:contiguous"
+                                    if kv_quant and kv_quant != "none"
+                                    else "disabled:config")
+        else:
+            self.kv_quant_spec, self.kv_quant_reason = \
+                _kvq_resolve(kv_quant)
+
         if kv_layout == "paged":
             from jax.sharding import NamedSharding, PartitionSpec as P
             from .paging import PagedKVCache
@@ -255,7 +277,8 @@ class InferenceEngine:
             self.kv = PagedKVCache(
                 model_cfg, num_slots, self.max_seq_len, dtype,
                 pool_sharding, page_size=page_size, num_pages=num_pages,
-                copy_pages_fn=copy_pages_padded, data_size=data_size)
+                copy_pages_fn=copy_pages_padded, data_size=data_size,
+                kv_quant=self.kv_quant_spec)
         else:
             cache_sharding = None
             if self.mesh.devices.size > 1:
@@ -523,29 +546,90 @@ class InferenceEngine:
                      or spmd_partitionable(model_cfg.num_heads,
                                            model_cfg.num_kv_heads,
                                            n_model)))
+            # Quantized pages (ISSUE 11): can the Pallas kernels
+            # dequantize this pool shape IN-KERNEL? A decline (int4
+            # packing/grouping on this head_dim) routes serving to the
+            # XLA dequant paths — gather view for the batched
+            # programs — with the machine-readable reason recorded,
+            # the int4mm plan/decline discipline: no dispatch can
+            # reach a Mosaic failure on chip.
+            if self.kv_quant_spec is not None:
+                from .pallas.attention import kv_quant_decline_reason
+                self.kv_quant_fallback_reason = kv_quant_decline_reason(
+                    page_size, model_cfg.head_dim, kh_l, group,
+                    self.kv_quant_spec.bits, self.kv_quant_spec.group)
+                if (self.kv_quant_fallback_reason is not None
+                        and self.paged_direct):
+                    self.paged_direct = False
+                    self.paged_degraded_reason = (
+                        f"kv_quant:{self.kv_quant_fallback_reason}")
             self._paged_replicas = data_size if self.paged_direct else 1
             n_pages_seq = self.max_seq_len // page_size
+            _kvq_spec = self.kv_quant_spec
+            _n_layers = model_cfg.num_layers
+            from .kv_quant import (dequantize_cells as _kvq_deq,
+                                   quantize_cells as _kvq_q,
+                                   split_combined as _kvq_split)
 
-            def gather_view(pools, tables, b):
+            def gather_view(combined, tables, b):
+                # Combined pools (+ scales when quantized) -> the
+                # position-aligned bf16 [B, S, K, D] view forward()
+                # consumes — quantized pools dequantize AT THE GATHER
+                # (kv_quant.dequantize_cells, the XLA read seam).
+                pools, scales = _kvq_split(combined, _n_layers)
                 caches_b = []
-                for k_pool, v_pool in pools:
-                    tail = k_pool.shape[2:]
-                    kb = k_pool[tables].reshape(
-                        b, n_pages_seq * page_size, *tail)
-                    vb = v_pool[tables].reshape(
-                        b, n_pages_seq * page_size, *tail)
-                    caches_b.append((kb, vb))
+                for li, (k_pool, v_pool) in enumerate(pools):
+                    if scales is not None:
+                        ks, vs = scales[li]
+                        kb = _kvq_deq(k_pool[tables], ks[tables],
+                                      _kvq_spec, dtype)
+                        vb = _kvq_deq(v_pool[tables], vs[tables],
+                                      _kvq_spec, dtype)
+                        tail = (k_pool.shape[2], model_cfg.head_dim)
+                    else:
+                        kb, vb = k_pool[tables], v_pool[tables]
+                        tail = k_pool.shape[2:]
+                    caches_b.append(
+                        (kb.reshape(b, n_pages_seq * page_size, *tail),
+                         vb.reshape(b, n_pages_seq * page_size, *tail)))
                 return caches_b
 
-            def scatter_view(pools, tables, new_b, b):
-                out = []
-                for (k_pool, v_pool), (nk, nv) in zip(pools, new_b):
-                    tail = k_pool.shape[2:]
-                    nk5 = nk.reshape(b, n_pages_seq, page_size, *tail)
-                    nv5 = nv.reshape(b, n_pages_seq, page_size, *tail)
-                    out.append((k_pool.at[tables].set(nk5),
-                                v_pool.at[tables].set(nv5)))
-                return out
+            def scatter_view(combined, tables, new_b, b):
+                # The inverse write seam: the updated bf16 view
+                # RE-QUANTIZES cell-by-cell before scattering back.
+                # Unwritten cells round-trip exactly (requantizing a
+                # dequantized cell reproduces its payload and scale —
+                # the pinned stability property), so repeated
+                # gather/scatter segments cannot drift.
+                pools, scales = _kvq_split(combined, _n_layers)
+                out_p, out_s = [], []
+                for li, ((k_pool, v_pool), (nk, nv)) in enumerate(
+                        zip(pools, new_b)):
+                    if scales is not None:
+                        ks, vs = scales[li]
+                        nk_q, nk_s = _kvq_q(nk, _kvq_spec)
+                        nv_q, nv_s = _kvq_q(nv, _kvq_spec)
+                        qtail = k_pool.shape[2:]
+                        stail = ks.shape[2:]
+                        out_p.append((
+                            k_pool.at[tables].set(nk_q.reshape(
+                                b, n_pages_seq, page_size, *qtail)),
+                            v_pool.at[tables].set(nv_q.reshape(
+                                b, n_pages_seq, page_size, *qtail))))
+                        out_s.append((
+                            ks.at[tables].set(nk_s.reshape(
+                                b, n_pages_seq, page_size, *stail)),
+                            vs.at[tables].set(nv_s.reshape(
+                                b, n_pages_seq, page_size, *stail))))
+                    else:
+                        tail = k_pool.shape[2:]
+                        nk5 = nk.reshape(b, n_pages_seq, page_size,
+                                         *tail)
+                        nv5 = nv.reshape(b, n_pages_seq, page_size,
+                                         *tail)
+                        out_p.append((k_pool.at[tables].set(nk5),
+                                      v_pool.at[tables].set(nv5)))
+                return out_p + out_s
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step_paged(params, pools, tables, tokens, offsets,
@@ -571,10 +655,12 @@ class InferenceEngine:
                     t = tokens.shape[1]
                     positions = offsets[:, None] + jnp.arange(t)[None, :]
                     valid = offsets + lengths
+                    pools_l, scales_l = _kvq_split(pools, _n_layers)
                     logits, new_pools = forward_paged(
-                        params, cfg, tokens, positions, pools, tables,
+                        params, cfg, tokens, positions, pools_l, tables,
                         valid, pool_replicas=data_size,
-                        last_pos=lengths - 1)
+                        last_pos=lengths - 1,
+                        scales=scales_l, quant_spec=_kvq_spec)
                     return host_read(logits[:, 0]), new_pools
 
             # Keep BOTH compiled-closure pairs: the gather-view programs
@@ -622,9 +708,12 @@ class InferenceEngine:
                 from .paged_forward import forward_paged
 
                 def step_fn(last, valid, pools):
+                    pools_l, scales_l = _kvq_split(pools, _n_layers)
                     return forward_paged(
-                        params, cfg, last[:, None], valid[:, None], pools,
-                        tables, valid + 1, pool_replicas=data_size)
+                        params, cfg, last[:, None], valid[:, None],
+                        pools_l, tables, valid + 1,
+                        pool_replicas=data_size,
+                        scales=scales_l, quant_spec=_kvq_spec)
 
                 return decode_while(
                     step_fn, pools, first_token, start_valid, key, budget,
@@ -645,18 +734,38 @@ class InferenceEngine:
                 # write range); table entries past a row's allocation are
                 # the scratch page, which absorbs the pad-tail garbage and
                 # is never read — same contract as scatter_view.
-                out = []
-                for (k_pool, v_pool), (nk, nv) in zip(pools, new_layers):
+                # Quantized pools quantize-on-write here too (ISSUE 11).
+                pools_l, scales_l = _kvq_split(pools, _n_layers)
+                out_p, out_s = [], []
+                for li, ((k_pool, v_pool), (nk, nv)) in enumerate(
+                        zip(pools_l, new_layers)):
                     b, t = nk.shape[0], nk.shape[1]
                     n = t // page_size
-                    tail = k_pool.shape[2:]
-                    nk5 = nk.reshape(b, n, page_size, *tail) \
-                        .astype(k_pool.dtype)
-                    nv5 = nv.reshape(b, n, page_size, *tail) \
-                        .astype(v_pool.dtype)
-                    out.append((k_pool.at[tables[:, :n]].set(nk5),
-                                v_pool.at[tables[:, :n]].set(nv5)))
-                return out
+                    if scales_l is not None:
+                        ks, vs = scales_l[li]
+                        nk_q, nk_s = _kvq_q(nk.astype(dtype), _kvq_spec)
+                        nv_q, nv_s = _kvq_q(nv.astype(dtype), _kvq_spec)
+                        qtail = k_pool.shape[2:]
+                        stail = ks.shape[2:]
+                        out_p.append((
+                            k_pool.at[tables[:, :n]].set(
+                                nk_q.reshape(b, n, page_size, *qtail)),
+                            v_pool.at[tables[:, :n]].set(
+                                nv_q.reshape(b, n, page_size, *qtail))))
+                        out_s.append((
+                            ks.at[tables[:, :n]].set(
+                                nk_s.reshape(b, n, page_size, *stail)),
+                            vs.at[tables[:, :n]].set(
+                                nv_s.reshape(b, n, page_size, *stail))))
+                    else:
+                        tail = k_pool.shape[2:]
+                        nk5 = nk.reshape(b, n, page_size, *tail) \
+                            .astype(k_pool.dtype)
+                        nv5 = nv.reshape(b, n, page_size, *tail) \
+                            .astype(v_pool.dtype)
+                        out_p.append((k_pool.at[tables[:, :n]].set(nk5),
+                                      v_pool.at[tables[:, :n]].set(nv5)))
+                return out_p + out_s
 
             self._scatter_kv_paged = scatter_kv_paged
 
@@ -730,6 +839,12 @@ class InferenceEngine:
                 else:
                     decline = _pattn.ragged_decline_reason(
                         page_size, model_cfg.head_dim, kh_l, group)
+                if (decline is None
+                        and self.kv_quant_fallback_reason is not None):
+                    # Quantized pool the kernel cannot dequantize
+                    # in-kernel (ISSUE 11): ragged dispatches serve the
+                    # XLA dense path with the quant decline recorded.
+                    decline = f"kv_quant:{self.kv_quant_fallback_reason}"
                 self.ragged_path = ("pallas_ragged" if decline is None
                                     else "xla_ragged")
                 self.ragged_fallback_reason = decline
@@ -747,14 +862,16 @@ class InferenceEngine:
                 from .paged_forward import forward_ragged
                 with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
                         self._lora_scope(lora):
+                    pools_l, scales_l = _kvq_split(pools, _n_layers)
                     logits, new_pools = forward_ragged(
                         params, cfg,
-                        tokens, positions, pools, tables, seq_of_block,
+                        tokens, positions, pools_l, tables, seq_of_block,
                         block_qstart, query_offsets, kv_valid,
                         token_pages, token_offs, token_seq, last_rows,
                         attn_path=attn_path,
                         sample_rows=(sample_rows if score_width
-                                     else None))
+                                     else None),
+                        scales=scales_l, quant_spec=_kvq_spec)
                     lf = logits.astype(jnp.float32)
                     if score_width:
                         # Speculative verify (ISSUE 9): per-position
@@ -945,6 +1062,7 @@ class InferenceEngine:
                             if config.get("spec_max_draft") is not None
                             else None),
             lora=config.get("lora"),
+            kv_quant=config.get("kv_quant"),
         )
         # Set by fleet.check_fleet_fits when it flips an unpinned config
         # to int8: surfaced via describe() so the degrade is visible
@@ -1319,7 +1437,7 @@ class InferenceEngine:
             if path == "pallas_ragged" and faults.ARMED:
                 faults.maybe_inject("mosaic_compile")
             return self._ragged_step(
-                self.params, self.kv.pools,
+                self.params, self.kv.combined_pools(),
                 jnp.asarray(batch["tables"]),
                 jnp.asarray(batch["tokens"]),
                 jnp.asarray(batch["positions"]),
@@ -1357,8 +1475,9 @@ class InferenceEngine:
         # A watchdog-abandoned dispatch completing late must NOT commit
         # onto pools the recovery path may have revived.
         with deadlines.commit_guard():
-            self.kv.pools = pools
+            self.kv.set_combined(pools)
         path = self.ragged_path
+        self._note_kv_quant("ragged", kernel=path == "pallas_ragged")
         self._ragged_dispatches[path] = \
             self._ragged_dispatches.get(path, 0) + 1
         entry = {"path": path, "tokens": int(batch["n_tokens"]),
@@ -1388,6 +1507,54 @@ class InferenceEngine:
             "dispatches": dict(self._ragged_dispatches),
             "recent": list(self._ragged_recent)[-8:],
         }
+
+    def _note_kv_quant(self, seam: str, kernel: bool) -> None:
+        """Record one serving dispatch that CONSUMED quantized pages
+        (ISSUE 11): engine-owned provenance sink + the module test
+        counter the conftest `kv_quant` guard reads — the
+        int4_paths/ragged pattern. `kernel` = the dequant ran inside a
+        Pallas kernel (pool-direct / pallas_ragged); False = the XLA
+        dequant fallback (gather view / ragged dense path) served, with
+        the machine-readable reason recorded per entry."""
+        if self.kv_quant_spec is None:
+            return
+        from . import kv_quant as kvq_mod
+        kvq_mod.note_quant_dispatch(kernel)
+        path = "kernel_dequant" if kernel else "xla_dequant"
+        key = f"{seam}:{path}"
+        self._kv_quant_dispatches[key] = \
+            self._kv_quant_dispatches.get(key, 0) + 1
+        entry: dict[str, Any] = {"seam": seam, "path": path}
+        if not kernel:
+            entry["fallback_reason"] = (
+                self.kv_quant_fallback_reason
+                or self.paged_degraded_reason
+                or (self.ragged_fallback_reason if seam == "ragged"
+                    else None)
+                or "gather_view:pool-direct-off")
+        self._kv_quant_recent.append(entry)
+
+    def kv_quant_describe(self) -> dict[str, Any]:
+        """Quantized-KV provenance (ISSUE 11): the resolved spec, why
+        the feature is off (reason) or why the kernels declined
+        in-kernel dequant (fallback_reason), the per-seam dispatch
+        counts and the recent-dispatch ring — embedded in describe()
+        and bench records the way int4_paths/ragged/spec are."""
+        spec = self.kv_quant_spec
+        info: dict[str, Any] = {
+            "enabled": spec is not None,
+            "dtype": spec.dtype_name if spec is not None else None,
+            "bits": spec.bits if spec is not None else None,
+            "reason": self.kv_quant_reason,
+            "fallback_reason": self.kv_quant_fallback_reason,
+            "dispatches": dict(self._kv_quant_dispatches),
+            "recent": list(self._kv_quant_recent)[-8:],
+        }
+        if spec is not None and self.kv_layout == "paged":
+            info["group"] = spec.effective_group(self.cfg.head_dim)
+            info["bytes_saved"] = max(
+                self.kv.hbm_bytes_logical() - self.kv.hbm_bytes(), 0)
+        return info
 
     def note_spec_dispatch(self, drafted: int, accepted: int,
                            rows: int) -> None:
@@ -1510,8 +1677,8 @@ class InferenceEngine:
                 self.params, jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(lengths))
         if self.kv_layout == "paged":
-            self.kv.pools = self._scatter_kv_paged(
-                self.kv.pools, jnp.asarray(tables), caches)
+            self.kv.set_combined(self._scatter_kv_paged(
+                self.kv.combined_pools(), jnp.asarray(tables), caches))
         else:
             slot_idx = jnp.asarray(slot_ids, jnp.int32)
             self.kv.layers = self._scatter_kv(self.kv.layers, slot_idx,
@@ -1547,7 +1714,7 @@ class InferenceEngine:
             if self.paged_direct and faults.ARMED:
                 faults.maybe_inject("mosaic_compile")
             return self._prefill_step_paged(
-                self.params, self.kv.pools, tables,
+                self.params, self.kv.combined_pools(), tables,
                 jnp.asarray(chunk), jnp.asarray(offs, jnp.int32),
                 jnp.asarray(lengths), lora=lora_arg)
 
@@ -1578,7 +1745,9 @@ class InferenceEngine:
                     # revived (the guard holds the ticket lock across
                     # the commit).
                     with deadlines.commit_guard():
-                        self.kv.pools = pools
+                        self.kv.set_combined(pools)
+                    self._note_kv_quant("prefill",
+                                        kernel=self.paged_direct)
                 else:
                     last, layers = self._prefill_step(
                         self.params, self.kv.layers, slot_idx,
@@ -2003,9 +2172,9 @@ class InferenceEngine:
             if self.paged_direct and faults.ARMED:
                 faults.maybe_inject("mosaic_compile")
             return self._decode_loop_paged(
-                self.params, self.kv.pools, tables, last, valid, key,
-                budget, temps, top_ks, top_ps, row_budgets, done0,
-                max_new=max_new, greedy=greedy, lora=lora)
+                self.params, self.kv.combined_pools(), tables, last,
+                valid, key, budget, temps, top_ks, top_ps, row_budgets,
+                done0, max_new=max_new, greedy=greedy, lora=lora)
 
         from . import compile_watch
         with compile_watch.label(
@@ -2020,7 +2189,8 @@ class InferenceEngine:
         # A watchdog-abandoned dispatch completing late must NOT commit
         # onto pools the recovery path may have revived.
         with deadlines.commit_guard():
-            self.kv.pools = pools
+            self.kv.set_combined(pools)
+        self._note_kv_quant("decode", kernel=self.paged_direct)
         return out, steps, l2, v2, d2
 
     def _decode_dispatch_slots(self, slot_idx, last, valid, key, budget,
@@ -2313,6 +2483,9 @@ class InferenceEngine:
             # ISSUE 9: speculative-decoding provenance (drafter,
             # per-dispatch drafted/accepted, throttle state).
             info["spec_decode"] = self.spec_describe()
+            # ISSUE 11: quantized-KV-page provenance (spec, per-seam
+            # dispatch paths, kernel-decline reason, bytes saved).
+            info["kv_quant"] = self.kv_quant_describe()
         # ISSUE 10: multi-LoRA persona provenance — the resolved
         # state, adapter store residency, per-leaf routing paths.
         info["lora"] = self.lora_describe()

@@ -74,6 +74,24 @@ def estimate_engine_hbm_bytes(engine_cfg: dict[str, Any],
         # for paged KV too — the pool is no longer replicated per
         # data replica (advisor r3 underestimate, closed).
         kv_bytes //= 2
+        # Quantized KV pages (ISSUE 11): charge cells at the CONFIGURED
+        # page dtype width, not bf16. resolve_spec applies the same
+        # ROUNDTABLE_KV_QUANT kill-switch the engine applies, so the
+        # plan matches what construction will actually allocate. With
+        # an explicit num_pages the pool bytes follow the quantized
+        # cell directly; the DEFAULT pool keeps the bf16 byte budget by
+        # design (page_ratio x more pages in the same bytes — the
+        # 2-4x-sessions payoff), so kv_bytes stays the halved budget.
+        num_pages = engine_cfg.get("num_pages")
+        if num_pages is not None:
+            from .kv_quant import cell_bytes_per_token, resolve_spec
+            kvq = engine_cfg.get("kv_quant")
+            spec = (resolve_spec(kvq)[0] if kvq and kvq != "none"
+                    else None)
+            page_size = int(engine_cfg.get("page_size", 128))
+            kv_bytes = int(int(num_pages) * page_size
+                           * cell_bytes_per_token(model_cfg, spec,
+                                                  dtype_b))
     lora_bytes = 0
     lora_cfg = engine_cfg.get("lora")
     if lora_cfg:

@@ -172,8 +172,12 @@ class HostOffloadTier:
                replica: int) -> list[tuple[np.ndarray, np.ndarray]]:
         kv = self.engine.kv
         scratch = kv.scratch_page(replica)
+        # Combined pools (ISSUE 11): quantized pools spill their scale
+        # arrays as extra "layers" in the same host record — int8
+        # payload + scales is the whole state, so restore is exactly
+        # lossless and spill bandwidth drops with the payload width.
         per_layer: list[list[tuple[np.ndarray, np.ndarray]]] = [
-            [] for _ in kv.pools]
+            [] for _ in kv.combined_pools()]
         from . import compile_watch
         for start in range(0, len(page_ids), WIDTH):
             ids = page_ids[start:start + WIDTH]
@@ -181,7 +185,7 @@ class HostOffloadTier:
             ids = ids + [scratch] * (WIDTH - n)
             with compile_watch.label("kv_spill[fetch]",
                                      engine=self._name):
-                out = self._fetch_pages(kv.pools,
+                out = self._fetch_pages(kv.combined_pools(),
                                         jnp.asarray(ids, jnp.int32))
             for li, (k, v) in enumerate(out):
                 per_layer[li].append((np.asarray(k)[:n],
@@ -216,9 +220,10 @@ class HostOffloadTier:
             with compile_watch.label("kv_restore[write]",
                                      engine=self._name):
                 pools = self._write_pages(
-                    kv.pools, jnp.asarray(ids, jnp.int32), data)
+                    kv.combined_pools(), jnp.asarray(ids, jnp.int32),
+                    data)
             with deadlines.commit_guard():
-                kv.pools = pools
+                kv.set_combined(pools)
 
     def warm(self) -> None:
         """Compile-and-stabilize the fetch/write programs (ONE shape

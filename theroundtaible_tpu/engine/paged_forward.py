@@ -39,6 +39,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from . import kv_quant as kvq
 from .models.common import (MASK_VALUE, ModelConfig, Params, _einsum,
                             _softcap, current_spmd_mesh, embed_tokens,
                             gather_rows, project_qkv, rms_norm,
@@ -50,14 +51,17 @@ def forward_paged(
     params: Params, cfg: ModelConfig,
     tokens: jax.Array,            # [B, T] token ids (T==1: decode step)
     positions: jax.Array,         # [B, T] absolute positions
-    pools: list,                  # per-layer (k_pool, v_pool) [P,ps,K,D]
+    pools: list,                  # per-layer (k_pool, v_pool) [P,ps,K,Dp]
     table: jax.Array,             # [B, pages_per_seq] int32
     kv_valid_len: jax.Array,      # [B] valid entries AFTER this call
     pool_replicas: int = 1,       # data-axis shards of the page axis
     last_pos: Optional[jax.Array] = None,   # [B] row index into T
+    scales: Optional[list] = None,  # per-layer (k_s, v_s) [P,ps,K,G]
+    quant_spec=None,                # kv_quant.KVQuantSpec when scales
+    kernel_quant: bool = True,      # False: shapes the kernel declined
 ) -> tuple[jax.Array, list]:
     """One serving step off the page pools — decode (T==1) or a prefill
-    chunk (T==bucket); returns (logits [B,T,V], new_pools) — [B,1,V]
+    chunk (T==bucket); returns (logits [B,T,V], new_combined) — [B,1,V]
     when `last_pos` is given (hidden gathered before the lm head, same
     OOM guard as models/common.forward). Mirrors
     models/common.forward, with attention + cache update replaced by the
@@ -65,7 +69,18 @@ def forward_paged(
     ([B,T] position-indexed — pad-tail cells land on real decode-reserve
     pages or the scratch page, both overwritten/ignored before any
     read, same contract as the gather view) and attends through the
-    page-table-aware kernel."""
+    page-table-aware kernel.
+
+    Quantized pools (ISSUE 11): `scales` carries the per-layer per-cell
+    scale pools — the scatter seam QUANTIZES each written token's K/V
+    locally (its own absmax scale, neighbours untouched), and the
+    kernels dequantize in-kernel via the scale operands. The returned
+    list is then pools + scales in the engine's combined-pytree order.
+    `kernel_quant=False` (a shape kv_quant_decline_reason declined on
+    chip) dequantizes the WHOLE pool per layer before a bf16 kernel
+    call — correct but memory-heavy; the engine records the reason and
+    serves the gather view instead on the hot path, so this branch only
+    backs direct callers."""
     page_size = pools[0][0].shape[1]
     b, t = tokens.shape
     pages = table[jnp.arange(b)[:, None],
@@ -76,43 +91,77 @@ def forward_paged(
     if cfg.scale_embeddings:
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
 
+    quant = scales is not None
+    kv_bits = quant_spec.bits if quant else 8
     new_pools = []
-    for layer, (k_pool, v_pool) in zip(params["layers"], pools):
-        def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool):
+    new_scales = []
+    for li, (layer, (k_pool, v_pool)) in enumerate(
+            zip(params["layers"], pools)):
+        k_sc, v_sc = scales[li] if quant else (None, None)
+
+        def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool,
+                    k_sc=k_sc, v_sc=v_sc):
             q, k, v = project_qkv(h, layer, cfg, positions)
             # Scatter this call's K/V into the rows' pages (write ranges
             # are exclusive after COW, see module docstring) BEFORE the
-            # kernel reads the pool.
-            k_pool2 = k_pool.at[pages, offs].set(k)
-            v_pool2 = v_pool.at[pages, offs].set(v)
+            # kernel reads the pool — quantize-on-write when the pool
+            # is quantized (per-cell scales: a token's write never
+            # touches its neighbours' quantization).
+            if quant:
+                k_q, k_s = kvq.quantize_cells(k, quant_spec)
+                v_q, v_s = kvq.quantize_cells(v, quant_spec)
+                k_pool2 = k_pool.at[pages, offs].set(k_q)
+                v_pool2 = v_pool.at[pages, offs].set(v_q)
+                k_sc2 = k_sc.at[pages, offs].set(k_s)
+                v_sc2 = v_sc.at[pages, offs].set(v_s)
+            else:
+                k_pool2 = k_pool.at[pages, offs].set(k)
+                v_pool2 = v_pool.at[pages, offs].set(v)
+                k_sc2 = v_sc2 = None
+            if quant and not kernel_quant:
+                # Declined shape: dequantize the pool for a bf16 kernel
+                # call (direct-caller fallback — the engine's serving
+                # path uses the gather view for these shapes).
+                kp, vp = (kvq.dequantize_cells(k_pool2, k_sc2,
+                                               quant_spec, q.dtype),
+                          kvq.dequantize_cells(v_pool2, v_sc2,
+                                               quant_spec, q.dtype))
+                ks = vs = None
+            else:
+                kp, vp = k_pool2, v_pool2
+                ks, vs = k_sc2, v_sc2
             mesh = current_spmd_mesh()
             multi = mesh is not None and mesh.size > 1
             if t == 1:
                 if multi:
                     out = pattn.paged_decode_spmd(
-                        mesh, q, k_pool2, v_pool2, table, kv_valid_len,
+                        mesh, q, kp, vp, table, kv_valid_len,
                         sliding_window=cfg.sliding_window,
                         softcap=cfg.attn_logit_softcap,
-                        pool_replicas=pool_replicas)
+                        pool_replicas=pool_replicas,
+                        k_scale=ks, v_scale=vs, kv_bits=kv_bits)
                 else:
                     out = pattn.paged_decode_attention(
-                        q, k_pool2, v_pool2, table, kv_valid_len,
+                        q, kp, vp, table, kv_valid_len,
                         sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        k_scale=ks, v_scale=vs, kv_bits=kv_bits)
             else:
                 if multi:
                     out = pattn.paged_prefill_spmd(
-                        mesh, q, k_pool2, v_pool2, table,
+                        mesh, q, kp, vp, table,
                         positions[:, 0], kv_valid_len,
                         sliding_window=cfg.sliding_window,
                         softcap=cfg.attn_logit_softcap,
-                        pool_replicas=pool_replicas)
+                        pool_replicas=pool_replicas,
+                        k_scale=ks, v_scale=vs, kv_bits=kv_bits)
                 else:
                     out = pattn.paged_prefill_attention(
-                        q, k_pool2, v_pool2, table, positions[:, 0],
+                        q, kp, vp, table, positions[:, 0],
                         kv_valid_len,
                         sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        k_scale=ks, v_scale=vs, kv_bits=kv_bits)
             if out is None:
                 # engine.paged_direct gates on spmd_partitionable and
                 # serving buckets always satisfy the block check, so
@@ -125,11 +174,13 @@ def forward_paged(
                     f"ps={page_size})")
             out = _einsum("bthd,hde->bte", out, layer["o_proj"],
                           tp="row", lora="o_proj").astype(h.dtype)
-            return out, (k_pool2, v_pool2)
+            return out, (k_pool2, v_pool2, k_sc2, v_sc2)
 
-        x, new_pool = transformer_block(
+        x, new_cache = transformer_block(
             x, layer, cfg, positions, None, None, None, attn_fn=attn_fn)
-        new_pools.append(new_pool)
+        new_pools.append(new_cache[:2])
+        if quant:
+            new_scales.append(new_cache[2:])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,
                  cfg.rmsnorm_unit_offset)
@@ -138,27 +189,40 @@ def forward_paged(
     head = params["embedding"] if cfg.tie_embeddings else params["lm_head"]
     logits = _einsum("bte,ve->btv", x, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
-    return logits, new_pools
+    return logits, new_pools + new_scales
 
 
 # --- ragged mixed prefill/decode forward (ISSUE 8) ---
 
 
 def _ragged_xla_attention(q, k_pool, v_pool, tables, token_seq,
-                          positions, kv_valid, cfg: ModelConfig):
+                          positions, kv_valid, cfg: ModelConfig,
+                          k_sc=None, v_sc=None, quant_spec=None):
     """XLA fallback for the ragged kernel: per-token dense attention
     against each token's sequence slice of the gather view. Memory-
     heavy ([T, L, K, D] — the gather view's budget times the buffer's
     sequence fan-in) and FLOP-dense where the kernel would skip beyond
     the frontier: this is the recorded degrade path for pools the
     kernel declines (head_dim, page_size, VMEM), never the serving
-    default. q [T, H, D] → [T, H, D]."""
+    default. q [T, H, D] → [T, H, D]. Quantized pools dequantize at
+    the gather (kv_quant.dequantize_cells — identical math to the
+    in-kernel dequant, so kernel and fallback agree)."""
     t, h, d = q.shape
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     s, pp = tables.shape
     length = pp * page_size
-    kg = k_pool[tables].reshape(s, length, kh, d)
-    vg = v_pool[tables].reshape(s, length, kh, d)
+    if k_sc is not None:
+        # Gather FIRST, then dequantize the gathered slices — the
+        # dequant cost scales with the view, not the whole pool.
+        kg = kvq.dequantize_cells(k_pool[tables], k_sc[tables],
+                                  quant_spec, q.dtype) \
+            .reshape(s, length, kh, d)
+        vg = kvq.dequantize_cells(v_pool[tables], v_sc[tables],
+                                  quant_spec, q.dtype) \
+            .reshape(s, length, kh, d)
+    else:
+        kg = k_pool[tables].reshape(s, length, kh, d)
+        vg = v_pool[tables].reshape(s, length, kh, d)
     kt = kg[token_seq]                                # [T, L, K, D]
     vt = vg[token_seq]
     if cfg.kv_repeat > 1:
@@ -193,6 +257,8 @@ def forward_ragged(
     last_rows: jax.Array,         # [S] flat row of each seq's last token
     attn_path: str = "kernel",    # "kernel" | "xla" (static)
     sample_rows: Optional[jax.Array] = None,  # [S, R] rows to score
+    scales: Optional[list] = None,  # per-layer (k_s, v_s) (ISSUE 11)
+    quant_spec=None,
 ) -> tuple[jax.Array, list]:
     """One MIXED prefill/decode step over the flat token buffer
     (serving_loop.build_ragged_batch layout): every sequence's chunk or
@@ -218,12 +284,31 @@ def forward_ragged(
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
     pos2 = positions[None]
 
+    quant = scales is not None
+    kv_bits = quant_spec.bits if quant else 8
     new_pools = []
-    for layer, (k_pool, v_pool) in zip(params["layers"], pools):
-        def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool):
+    new_scales = []
+    for li, (layer, (k_pool, v_pool)) in enumerate(
+            zip(params["layers"], pools)):
+        k_sc, v_sc = scales[li] if quant else (None, None)
+
+        def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool,
+                    k_sc=k_sc, v_sc=v_sc):
             q, k, v = project_qkv(h, layer, cfg, pos2)      # [1,T,H,D]
-            k_pool2 = k_pool.at[token_pages, token_offs].set(k[0])
-            v_pool2 = v_pool.at[token_pages, token_offs].set(v[0])
+            if quant:
+                # Quantize-on-write (ISSUE 11): each flat-buffer token
+                # writes its own payload + scale; pads land on the
+                # scratch page, never read.
+                k_q, k_s = kvq.quantize_cells(k[0], quant_spec)
+                v_q, v_s = kvq.quantize_cells(v[0], quant_spec)
+                k_pool2 = k_pool.at[token_pages, token_offs].set(k_q)
+                v_pool2 = v_pool.at[token_pages, token_offs].set(v_q)
+                k_sc2 = k_sc.at[token_pages, token_offs].set(k_s)
+                v_sc2 = v_sc.at[token_pages, token_offs].set(v_s)
+            else:
+                k_pool2 = k_pool.at[token_pages, token_offs].set(k[0])
+                v_pool2 = v_pool.at[token_pages, token_offs].set(v[0])
+                k_sc2 = v_sc2 = None
             if attn_path == "kernel":
                 mesh = current_spmd_mesh()
                 if mesh is not None and mesh.size > 1:
@@ -231,7 +316,8 @@ def forward_ragged(
                         mesh, q[0], k_pool2, v_pool2, tables,
                         seq_of_block, block_qstart, query_offsets,
                         kv_valid, sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        k_scale=k_sc2, v_scale=v_sc2, kv_bits=kv_bits)
                     if out is None:
                         # The engine gates ragged_path on
                         # partitionability at build time — reaching
@@ -245,18 +331,22 @@ def forward_ragged(
                         q[0], k_pool2, v_pool2, tables, seq_of_block,
                         block_qstart, query_offsets, kv_valid,
                         sliding_window=cfg.sliding_window,
-                        softcap=cfg.attn_logit_softcap)
+                        softcap=cfg.attn_logit_softcap,
+                        k_scale=k_sc2, v_scale=v_sc2, kv_bits=kv_bits)
             else:
                 out = _ragged_xla_attention(
                     q[0], k_pool2, v_pool2, tables, token_seq,
-                    positions, kv_valid, cfg)
+                    positions, kv_valid, cfg, k_sc=k_sc2, v_sc=v_sc2,
+                    quant_spec=quant_spec)
             out = _einsum("bthd,hde->bte", out[None], layer["o_proj"],
                           tp="row", lora="o_proj").astype(h.dtype)
-            return out, (k_pool2, v_pool2)
+            return out, (k_pool2, v_pool2, k_sc2, v_sc2)
 
-        x, new_pool = transformer_block(
+        x, new_cache = transformer_block(
             x, layer, cfg, pos2, None, None, None, attn_fn=attn_fn)
-        new_pools.append(new_pool)
+        new_pools.append(new_cache[:2])
+        if quant:
+            new_scales.append(new_cache[2:])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps,
                  cfg.rmsnorm_unit_offset)
@@ -269,5 +359,5 @@ def forward_ragged(
     logits = _einsum("bte,ve->btv", sel, head, tp="col")
     logits = _softcap(logits, cfg.final_logit_softcap)
     if sample_rows is not None:
-        return logits[0].reshape(s, r, -1), new_pools
-    return logits[0], new_pools
+        return logits[0].reshape(s, r, -1), new_pools + new_scales
+    return logits[0], new_pools + new_scales

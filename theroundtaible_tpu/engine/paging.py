@@ -112,7 +112,7 @@ class PagedKVCache:
                  num_pages: Optional[int] = None,
                  copy_pages_fn: Optional[Callable] = None,
                  pool_factory: Optional[Callable] = None,
-                 data_size: int = 1):
+                 data_size: int = 1, kv_quant=None):
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
@@ -123,13 +123,33 @@ class PagedKVCache:
         self.page_size = page_size
         self.pages_per_seq = self.max_seq_len // page_size
         self.data_size = max(int(data_size), 1)
+        # Quantized pages (ISSUE 11): `kv_quant` is a
+        # kv_quant.KVQuantSpec — pools store int8 payload (int4: packed
+        # nibbles) with a parallel per-layer per-cell scale pool.
+        # Scales are indexed by the SAME page axis, so every sharing
+        # mechanism (alias/adopt/COW/commit/prefix-cache/offload)
+        # carries them with the page for free.
+        self.kv_quant = kv_quant
+        self._kv_dtype_bytes = jnp.dtype(dtype).itemsize
+        if kv_quant is not None and pool_factory is not None:
+            raise ValueError(
+                "kv_quant is not supported with a custom pool_factory "
+                "(the PP engine's stage-stacked pools decline upstream)")
         # Default pool: HALF the contiguous budget — the honest claim of
         # paging is serving the same slots in less HBM — plus one scratch
         # page per data replica (data_size == 1: page 0, as before).
+        # Quantized pools keep the SAME BYTE budget (the bf16 default's
+        # bytes), so the freed bytes become MORE PAGES — the
+        # 2-4x-resident-sessions payoff. Page demand math everywhere is
+        # in pages; the dtype dependence lives here, once.
         if num_pages is None:
             num_pages = max(num_slots * self.pages_per_seq // 2,
-                            self.data_size * self.pages_per_seq
-                            ) + self.data_size
+                            self.data_size * self.pages_per_seq)
+            if kv_quant is not None:
+                from .kv_quant import page_ratio
+                num_pages = int(num_pages * page_ratio(
+                    kv_quant, cfg.head_dim, self._kv_dtype_bytes))
+            num_pages += self.data_size
         # The page axis shards over "data": round up so it divides.
         self.num_pages = -(-num_pages // self.data_size) * self.data_size
         per_replica = self.num_pages // self.data_size
@@ -144,7 +164,7 @@ class PagedKVCache:
             # axis this allocator still manages; copy_pages_fn must
             # address pages in that layout).
             self._make_pools = pool_factory
-        else:
+        elif kv_quant is None:
             shape = (self.num_pages, page_size, cfg.num_kv_heads,
                      cfg.head_dim)
             make = (lambda: jnp.zeros(shape, dtype)) if sharding is None \
@@ -152,7 +172,28 @@ class PagedKVCache:
                                              sharding))
             self._make_pools = lambda n_pages: [
                 (make(), make()) for _ in range(cfg.num_layers)]
+        else:
+            qshape = (self.num_pages, page_size, cfg.num_kv_heads,
+                      kv_quant.packed_dim(cfg.head_dim))
+            sshape = (self.num_pages, page_size, cfg.num_kv_heads,
+                      kv_quant.num_groups(cfg.head_dim))
+
+            def _mk(shape, dt):
+                x = jnp.zeros(shape, dt)
+                # Scale pools share the payload's sharding spec — same
+                # page and kv-head axes, unsharded minor axis.
+                return x if sharding is None else jax.device_put(
+                    x, sharding)
+
+            self._make_pools = lambda n_pages: [
+                (_mk(qshape, jnp.int8), _mk(qshape, jnp.int8))
+                for _ in range(cfg.num_layers)]
+            self._make_scales = lambda n_pages: [
+                (_mk(sshape, jnp.float32), _mk(sshape, jnp.float32))
+                for _ in range(cfg.num_layers)]
         self.pools = self._make_pools(self.num_pages)
+        self.scales = (self._make_scales(self.num_pages)
+                       if kv_quant is not None else None)
         self._copy_pages_fn = copy_pages_fn
         self._slots: dict[str, PagedSlot] = {}
         # Replica r owns pages [r*per, (r+1)*per); the range's FIRST page
@@ -200,10 +241,53 @@ class PagedKVCache:
                    for n in names if n in self._slots)
 
     def hbm_bytes(self) -> int:
-        """Resident pool bytes across all layers (the accounting the
-        contiguous layout can't improve on)."""
+        """Resident pool bytes across all layers — payload plus, on
+        quantized pools, the per-cell scale arrays (ISSUE 11)."""
         k, _ = self.pools[0]
-        return 2 * k.size * k.dtype.itemsize * len(self.pools)
+        total = 2 * k.size * k.dtype.itemsize * len(self.pools)
+        if self.scales is not None:
+            s, _ = self.scales[0]
+            total += 2 * s.size * s.dtype.itemsize * len(self.scales)
+        return total
+
+    def hbm_bytes_logical(self) -> int:
+        """What the SAME pools would cost at the bf16 cell layout — the
+        ledger's kv_bytes_logical counterpart to hbm_bytes (resident).
+        Identical to hbm_bytes on unquantized pools."""
+        if self.kv_quant is None:
+            return self.hbm_bytes()
+        return (2 * self.num_pages * self.page_size
+                * self.cfg.num_kv_heads * self.cfg.head_dim
+                * self._kv_dtype_bytes * len(self.pools))
+
+    # --- combined pool pytree (ISSUE 11) ---
+    #
+    # The engine's donated jit programs carry pools and scales as ONE
+    # pytree (per-layer (k, v) pairs, scale pairs appended), so every
+    # dispatch seam moves them together and bf16 engines see exactly
+    # the old list — the kill-switch byte-identity hinges on that.
+
+    def combined_pools(self) -> list:
+        if self.scales is None:
+            return self.pools
+        return list(self.pools) + list(self.scales)
+
+    def set_combined(self, combined: list) -> None:
+        n = len(self.pools)
+        if self.scales is None:
+            self.pools = combined
+        else:
+            self.pools = list(combined[:n])
+            self.scales = list(combined[n:])
+
+    def _run_page_copy(self, src_ids, dst_ids) -> None:
+        """Whole-page device copy through the engine's jit'd copier —
+        scale rows ride the same dispatch on quantized pools (a COW'd
+        or adopted page without its scales would dequantize garbage)."""
+        out = self._copy_pages_fn(self.combined_pools(),
+                                  jnp.asarray(src_ids, jnp.int32),
+                                  jnp.asarray(dst_ids, jnp.int32))
+        self.set_combined(out)
 
     def slot_names(self) -> list[str]:
         return list(self._slots)
@@ -256,8 +340,21 @@ class PagedKVCache:
         cache_pages = (self.prefix_cache.page_count()
                        if self.prefix_cache is not None else 0)
         n_slots = len(self._slots)
+        # Quantized-page split (ISSUE 11 satellite): resident = what
+        # the pools actually cost (payload + scales), logical = what
+        # the same pools would cost at bf16 cells. The saved delta
+        # feeds roundtable_kv_quant_bytes_saved.
+        resident = self.hbm_bytes()
+        logical = self.hbm_bytes_logical()
         return {
             "layout": "paged",
+            "kv_dtype": (self.kv_quant.dtype_name
+                         if self.kv_quant is not None else "bf16"),
+            "kv_quant_bits": (self.kv_quant.bits
+                              if self.kv_quant is not None else 0),
+            "kv_bytes_resident": resident,
+            "kv_bytes_logical": logical,
+            "kv_quant_bytes_saved": max(logical - resident, 0),
             "slots_in_use": n_slots,
             "num_slots": self.num_slots,
             "slot_occupancy": round(n_slots / max(self.num_slots, 1), 3),
@@ -275,7 +372,7 @@ class PagedKVCache:
             "shared_pages": shared,
             "exclusive_pages": len(covered) - shared,
             "prefix_cache_pages": cache_pages,
-            "hbm_bytes": self.hbm_bytes(),
+            "hbm_bytes": resident,
         }
 
     def revive_if_dead(self) -> bool:
@@ -287,6 +384,8 @@ class PagedKVCache:
         if not k.is_deleted():
             return False
         self.pools = self._make_pools(self.num_pages)
+        if self.scales is not None:
+            self.scales = self._make_scales(self.num_pages)
         self._slots.clear()
         self._refs.clear()
         per = self._per_replica
@@ -428,9 +527,7 @@ class PagedKVCache:
         fresh = self._alloc_page(pinned, state.replica)
         self._decref(p)
         state.pages[j] = fresh
-        self.pools = self._copy_pages_fn(
-            self.pools, jnp.asarray([p], jnp.int32),
-            jnp.asarray([fresh], jnp.int32))
+        self._run_page_copy([p], [fresh])
         return fresh
 
     def _alloc_page(self, pinned_names: tuple[str, ...],
@@ -631,9 +728,7 @@ class PagedKVCache:
         if hi % ps and hi_page < len(src.pages):
             copy_into_dst(hi_page)
         if cow_src:
-            self.pools = self._copy_pages_fn(
-                self.pools, jnp.asarray(cow_src, jnp.int32),
-                jnp.asarray(cow_dst, jnp.int32))
+            self._run_page_copy(cow_src, cow_dst)
 
     def adopt_span(self, dst_name: str, src_pages: list[int], lo: int,
                    hi: int, pinned: tuple[str, ...] = ()) -> None:
@@ -701,9 +796,7 @@ class PagedKVCache:
                 else:
                     copy_into_dst(j)
             if cow_src:
-                self.pools = self._copy_pages_fn(
-                    self.pools, jnp.asarray(cow_src, jnp.int32),
-                    jnp.asarray(cow_dst, jnp.int32))
+                self._run_page_copy(cow_src, cow_dst)
         finally:
             for j, p in guards.items():
                 if j not in transferred:

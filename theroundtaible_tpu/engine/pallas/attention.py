@@ -45,6 +45,25 @@ from ..models.common import MASK_VALUE as NEG_INF
 _LANES = 128  # TPU lane width; m/l scratch is replicated across lanes
 
 
+def _dequant_kv(x, s, kv_bits: int, dtype):
+    """In-kernel dequant of one KV block (ISSUE 11): payload [bkv, Dp]
+    int8 + per-cell scales [bkv, G] f32 -> values [bkv, D] in `dtype`.
+    int4 payloads unpack through kv_quant.unpack_int4 (the ONE copy of
+    the nibble-order contract — shift arithmetic only, which Mosaic
+    lowers; probed chipless); the grouped scale multiply is a
+    minor-axis reshape, also Mosaic-legal. This is the kernel-side
+    twin of kv_quant.dequantize_cells — same unpack, same scale
+    math, so the kernel and XLA fallback cannot drift."""
+    if kv_bits == 4:
+        from ..kv_quant import unpack_int4
+        x = unpack_int4(x)
+    bkv, d = x.shape
+    n_groups = s.shape[-1]
+    xg = x.astype(jnp.float32).reshape(bkv, n_groups, d // n_groups)
+    return (xg * s[..., None].astype(jnp.float32)) \
+        .reshape(bkv, d).astype(dtype)
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -86,7 +105,8 @@ def supported(t: int, s: int, d: int) -> bool:
 def _prefill_accumulate(q, k, v, q_start, kv_start, valid, state, *,
                         group: int, block_q: int, block_kv: int,
                         sliding_window: Optional[int],
-                        softcap: Optional[float]):
+                        softcap: Optional[float],
+                        k_scale=None, v_scale=None, kv_bits: int = 8):
     """One online-softmax accumulation of a q block [G*bq, D] against one
     kv block [bkv, D] whose first entry holds absolute position kv_start.
     Shared by the contiguous (_prefill_kernel) and paged
@@ -95,7 +115,16 @@ def _prefill_accumulate(q, k, v, q_start, kv_start, valid, state, *,
     value-in/value-out over `state` = (m, l, acc) so callers can keep
     per-kv-head running state in scratch slices (the paged kernels loop
     heads in-kernel; a ref-mutating helper would pin the scratch
-    layout)."""
+    layout).
+
+    `k_scale`/`v_scale` [bkv, G] (ISSUE 11): the kv block arrived as a
+    quantized page — dequantize in-kernel before the dots, so the bytes
+    streamed from HBM are the int8/int4 payload + scales and the math
+    past this line is IDENTICAL to the bf16 path (the numeric core of
+    the quantized-parity discipline)."""
+    if k_scale is not None:
+        k = _dequant_kv(k, k_scale, kv_bits, q.dtype)
+        v = _dequant_kv(v, v_scale, kv_bits, q.dtype)
     m_prev, l_prev, acc_prev = state
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -257,16 +286,24 @@ def flash_prefill_attention(
 
 
 def _paged_prefill_kernel(table_ref, offs_ref, valid_ref, q_ref, k_ref,
-                          v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                          v_ref, *rest,
                           block_q: int, page_size: int,
                           num_page_blocks: int, kh: int, group: int,
                           sliding_window: Optional[int],
-                          softcap: Optional[float]):
+                          softcap: Optional[float],
+                          kv_bits: int = 8, quantized: bool = False):
     # Identical math to _prefill_kernel (shared _prefill_accumulate); the
     # paged differences: the kv block for grid step sb is pool page
     # table[b, sb], and ALL kv heads ride one (1, ps, K, D) block with a
     # static in-kernel head loop — per-head pool blocks are
-    # Mosaic-illegal for K > 1 (see _paged_decode_kernel).
+    # Mosaic-illegal for K > 1 (see _paged_decode_kernel). Quantized
+    # pools (ISSUE 11) ride two extra per-page scale blocks whose index
+    # map is the kv block's, dequantized inside _prefill_accumulate.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     tb = pl.program_id(1)
     sb = pl.program_id(2)
@@ -292,7 +329,10 @@ def _paged_prefill_kernel(table_ref, offs_ref, valid_ref, q_ref, k_ref,
                 sb * page_size, valid,
                 (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
                 block_q=block_q, block_kv=page_size,
-                sliding_window=sliding_window, softcap=softcap)
+                sliding_window=sliding_window, softcap=softcap,
+                k_scale=(ks_ref[0, :, khi, :] if quantized else None),
+                v_scale=(vs_ref[0, :, khi, :] if quantized else None),
+                kv_bits=kv_bits)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():
@@ -342,6 +382,9 @@ def paged_prefill_attention(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, ps, K, G] (ISSUE 11)
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> jax.Array:
     """Blockwise causal prefill attention straight off the page pool.
 
@@ -350,11 +393,17 @@ def paged_prefill_attention(
     may be ALIASED donor pages — the kernel only reads. The kv block
     index map reads the page table, so only pages inside each q block's
     causal/window frontier are DMA'd and the [B, S, K, D] gather view is
-    never built. Returns [B, T, H, D] in q's dtype."""
+    never built. Returns [B, T, H, D] in q's dtype.
+
+    `k_scale`/`v_scale` (ISSUE 11): the pool holds quantized pages —
+    int8 payload (int4: D/2 packed nibbles when kv_bits=4) with
+    per-cell scales; the scale blocks ride the SAME page index map as
+    the kv blocks and dequant happens in-kernel."""
     b, t, h, d = q.shape
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     group = h // kh
     pages_per_seq = table.shape[1]
+    quantized = k_scale is not None
     block_q = _paged_prefill_block_q(t, page_size, d, kh, group)
     if block_q is None or not paged_decode_supported(page_size, d, kh,
                                                      group):
@@ -370,16 +419,24 @@ def paged_prefill_attention(
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
         return (table_ref[bi, sb], 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, kh, group, block_q, d),
+                     lambda bi, tb, sb, t_, o_, v_:
+                     (bi, 0, 0, tb, 0)),
+        pl.BlockSpec((1, page_size, kh, k_pool.shape[-1]), kv_index),
+        pl.BlockSpec((1, page_size, kh, v_pool.shape[-1]), kv_index),
+    ]
+    operands = [qt, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page_size, kh, k_scale.shape[-1]), kv_index),
+            pl.BlockSpec((1, page_size, kh, v_scale.shape[-1]), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, t // block_q, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, kh, group, block_q, d),
-                         lambda bi, tb, sb, t_, o_, v_:
-                         (bi, 0, 0, tb, 0)),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, kh, group, block_q, d),
             lambda bi, tb, sb, t_, o_, v_: (bi, 0, 0, tb, 0)),
@@ -392,14 +449,15 @@ def paged_prefill_attention(
     kernel = functools.partial(
         _paged_prefill_kernel, block_q=block_q, page_size=page_size,
         num_page_blocks=pages_per_seq, kh=kh, group=group,
-        sliding_window=sliding_window, softcap=softcap)
+        sliding_window=sliding_window, softcap=softcap,
+        kv_bits=kv_bits, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
     )(table.astype(jnp.int32), offsets.astype(jnp.int32),
-      kv_valid.astype(jnp.int32), qt, k_pool, v_pool)
+      kv_valid.astype(jnp.int32), *operands)
     return out.reshape(b, kh * group, t, d).transpose(0, 2, 1, 3)
 
 
@@ -412,12 +470,17 @@ def paged_prefill_spmd(
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
     pool_replicas: int = 1,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> Optional[jax.Array]:
     """paged_prefill_attention under a (data, model) mesh — the same
     partitioning as paged_decode_spmd (kv heads on "model" matching the
     pool's sharding; table/offsets/valid row-aligned with the batch;
     pool_replicas > 1 shards the page axis over "data" and rebases each
-    shard's table to its local range — see paged_decode_spmd)."""
+    shard's table to its local range — see paged_decode_spmd). Scale
+    pools (ISSUE 11) partition exactly like the kv pools — same page
+    and kv-head axes."""
     from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -441,21 +504,29 @@ def paged_prefill_spmd(
 
     q_spec = P(batch_ax, None, head_ax, None)
     pool_spec = P(page_ax, None, kv_head_ax, None)
+    quantized = k_scale is not None
 
-    def body(ql, kp, vp, tl, ol, vl):
+    def body(ql, kp, vp, tl, ol, vl, *sc):
         if page_ax is not None:
             tl = tl - jax.lax.axis_index("data") * per_replica
+        ks, vs = sc if sc else (None, None)
         return paged_prefill_attention(
             ql, kp, vp, tl, ol, vl, sliding_window=sliding_window,
-            softcap=softcap, interpret=interpret)
+            softcap=softcap, interpret=interpret,
+            k_scale=ks, v_scale=vs, kv_bits=kv_bits)
 
+    in_specs = (q_spec, pool_spec, pool_spec,
+                P(batch_ax, None), P(batch_ax), P(batch_ax))
+    args = [q, k_pool, v_pool, table.astype(jnp.int32),
+            offsets.astype(jnp.int32), kv_valid.astype(jnp.int32)]
+    if quantized:
+        in_specs += (pool_spec, pool_spec)
+        args += [k_scale, v_scale]
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(q_spec, pool_spec, pool_spec,
-                             P(batch_ax, None), P(batch_ax), P(batch_ax)),
+                   in_specs=in_specs,
                    out_specs=q_spec, axis_names=_manual_axes(mesh),
                    check_vma=False)
-    return fn(q, k_pool, v_pool, table.astype(jnp.int32),
-              offsets.astype(jnp.int32), kv_valid.astype(jnp.int32))
+    return fn(*args)
 
 
 # --- decode kernel ---
@@ -560,14 +631,19 @@ def flash_attention_spmd(
 def _decode_accumulate(q, k, v, kv_start, valid, state, *,
                        group: int, block_kv: int,
                        sliding_window: Optional[int],
-                       softcap: Optional[float]):
+                       softcap: Optional[float],
+                       k_scale=None, v_scale=None, kv_bits: int = 8):
     """One online-softmax accumulation of a single-position query group
     [G, D] against one kv block [bkv, D] whose first entry holds absolute
     position kv_start. Shared by the contiguous (_decode_kernel) and
     paged (_paged_decode_kernel) decode kernels — the two differ ONLY in
     how the kv block is addressed, so the math lives here once. Pure
     value-in/value-out over `state` = (m, l, acc) — see
-    _prefill_accumulate for why."""
+    _prefill_accumulate for why. `k_scale`/`v_scale`: quantized-page
+    blocks dequantize in-kernel first (ISSUE 11 — ditto)."""
+    if k_scale is not None:
+        k = _dequant_kv(k, k_scale, kv_bits, q.dtype)
+        v = _dequant_kv(v, v_scale, kv_bits, q.dtype)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # [G, bkv]
@@ -666,11 +742,12 @@ def paged_decode_supported(page_size: int, d: int, kh: int = 1,
     return _interpret() or d % 128 == 0
 
 
-def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, page_size: int,
+def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref,
+                         *rest, page_size: int,
                          num_page_blocks: int, kh: int, group: int,
                          sliding_window: Optional[int],
-                         softcap: Optional[float]):
+                         softcap: Optional[float],
+                         kv_bits: int = 8, quantized: bool = False):
     # Identical online-softmax math to _decode_kernel; the paged
     # differences: the kv block for grid step sb is pool page
     # table[b, sb] (not cache row sb), and ALL kv heads ride one block —
@@ -683,6 +760,13 @@ def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
     # unrolled loop walks the heads against per-head scratch slices.
     # valid INCLUDES the current step's entry, which the caller has
     # already written into the pool (q position = valid - 1).
+    # Quantized pools (ISSUE 11): two extra per-page scale blocks ride
+    # the kv index map, dequantized inside _decode_accumulate.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     sb = pl.program_id(1)
 
@@ -707,7 +791,10 @@ def _paged_decode_kernel(table_ref, valid_ref, q_ref, k_ref, v_ref, o_ref,
                 sb * page_size, valid,
                 (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
                 block_kv=page_size, sliding_window=sliding_window,
-                softcap=softcap)
+                softcap=softcap,
+                k_scale=(ks_ref[0, :, khi, :] if quantized else None),
+                v_scale=(vs_ref[0, :, khi, :] if quantized else None),
+                kv_bits=kv_bits)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():
@@ -728,6 +815,9 @@ def paged_decode_spmd(
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
     pool_replicas: int = 1,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> Optional[jax.Array]:
     """paged_decode_attention under a multi-device (data, model) mesh.
 
@@ -773,21 +863,29 @@ def paged_decode_spmd(
 
     q_spec = P(batch_ax, None, head_ax, None)
     pool_spec = P(page_ax, None, kv_head_ax, None)
+    quantized = k_scale is not None
 
-    def body(ql, kp, vp, tl, vl):
+    def body(ql, kp, vp, tl, vl, *sc):
         if page_ax is not None:
             tl = tl - jax.lax.axis_index("data") * per_replica
+        ks, vs = sc if sc else (None, None)
         return paged_decode_attention(
             ql, kp, vp, tl, vl, sliding_window=sliding_window,
-            softcap=softcap, interpret=interpret)
+            softcap=softcap, interpret=interpret,
+            k_scale=ks, v_scale=vs, kv_bits=kv_bits)
 
+    in_specs = (q_spec, pool_spec, pool_spec,
+                P(batch_ax, None), P(batch_ax))
+    args = [q, k_pool, v_pool, table.astype(jnp.int32),
+            kv_valid.astype(jnp.int32)]
+    if quantized:
+        in_specs += (pool_spec, pool_spec)
+        args += [k_scale, v_scale]
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(q_spec, pool_spec, pool_spec,
-                             P(batch_ax, None), P(batch_ax)),
+                   in_specs=in_specs,
                    out_specs=q_spec, axis_names=_manual_axes(mesh),
                    check_vma=False)
-    return fn(q, k_pool, v_pool, table.astype(jnp.int32),
-              kv_valid.astype(jnp.int32))
+    return fn(*args)
 
 
 def paged_decode_attention(
@@ -800,6 +898,9 @@ def paged_decode_attention(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, ps, K, G] (ISSUE 11)
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> jax.Array:
     """Single-position decode attention straight off the page pool.
 
@@ -812,13 +913,16 @@ def paged_decode_attention(
     carries ALL kv heads (1, ps, K, D) and a static in-kernel loop walks
     them — per-head (1, ps, 1, D) blocks are Mosaic-illegal for K > 1,
     and total DMA bytes are identical either way (each page read once
-    per row). Returns [B, 1, H, D].
+    per row). Returns [B, 1, H, D]. `k_scale`/`v_scale` (ISSUE 11):
+    quantized pools dequantize in-kernel — the scale blocks ride the
+    same page index map.
     """
     b, t, h, d = q.shape
     assert t == 1, "decode kernel serves exactly one position"
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     group = h // kh
     pages_per_seq = table.shape[1]
+    quantized = k_scale is not None
     if not paged_decode_supported(page_size, d, kh, group):
         raise ValueError(f"unsupported pool shape ps={page_size} D={d}")
     interpret = _interpret() if interpret is None else interpret
@@ -835,15 +939,23 @@ def paged_decode_attention(
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
         return (table_ref[bi, sb], 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, kh, group, d),
+                     lambda bi, sb, t_, v_: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, kh, k_pool.shape[-1]), kv_index),
+        pl.BlockSpec((1, page_size, kh, v_pool.shape[-1]), kv_index),
+    ]
+    operands = [qt, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page_size, kh, k_scale.shape[-1]), kv_index),
+            pl.BlockSpec((1, page_size, kh, v_scale.shape[-1]), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, kh, group, d),
-                         lambda bi, sb, t_, v_: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, kh, group, d),
             lambda bi, sb, t_, v_: (bi, 0, 0, 0)),
@@ -856,14 +968,14 @@ def paged_decode_attention(
     kernel = functools.partial(
         _paged_decode_kernel, page_size=page_size,
         num_page_blocks=pages_per_seq, kh=kh, group=group,
-        sliding_window=sliding_window, softcap=softcap)
+        sliding_window=sliding_window, softcap=softcap,
+        kv_bits=kv_bits, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(table.astype(jnp.int32), kv_valid.astype(jnp.int32),
-      qt, k_pool, v_pool)
+    )(table.astype(jnp.int32), kv_valid.astype(jnp.int32), *operands)
     return out.reshape(b, 1, h, d)
 
 
@@ -946,11 +1058,49 @@ def ragged_supported(page_size: int, d: int, kh: int = 1,
     return ragged_decline_reason(page_size, d, kh, group) is None
 
 
+def kv_quant_decline_reason(page_size: int, d: int, kh: int, group: int,
+                            bits: int = 8,
+                            quant_group: int = 32) -> Optional[str]:
+    """Why the Pallas kernels cannot serve a QUANTIZED pool of this
+    shape, or None when they can — the machine-readable
+    `fallback_reason` the engine records (the int4mm plan_reason
+    pattern, ISSUE 11). The bf16 kernel gates (page_size block
+    legality, VMEM, lane-aligned D) apply unchanged — quantized blocks
+    are strictly smaller, so the bf16 VMEM estimate stays a safe upper
+    bound; int4 additionally needs an even head_dim whose packed width
+    and scale grouping are well-formed. A declined shape serves through
+    the XLA dequant fallback (gather view / ragged dense path) — the
+    pages stay quantized either way, only the dequant site moves."""
+    if bits not in (8, 4):
+        return f"kv_bits:{bits}"
+    base = ragged_decline_reason(page_size, d, kh, group)
+    if base is not None:
+        return base
+    if bits == 4:
+        if d % 2:
+            return f"int4_head_dim:{d}"
+        from ..kv_quant import KVQuantSpec
+        g = KVQuantSpec(bits=4, group=quant_group).effective_group(d)
+        if d % g or g % 2:
+            # effective_group clamps to >= 2; a grouping that doesn't
+            # tile D evenly means no well-formed scale layout exists.
+            return f"int4_group:d={d},g={quant_group}"
+    return None
+
+
+def kv_quant_kernel_supported(page_size: int, d: int, kh: int,
+                              group: int, bits: int = 8,
+                              quant_group: int = 32) -> bool:
+    return kv_quant_decline_reason(page_size, d, kh, group, bits,
+                                   quant_group) is None
+
+
 def _ragged_kernel(table_ref, blkseq_ref, blkq_ref, qoffs_ref, valid_ref,
-                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   q_ref, k_ref, v_ref, *rest,
                    page_size: int, num_page_blocks: int, kh: int,
                    group: int, sliding_window: Optional[int],
-                   softcap: Optional[float]):
+                   softcap: Optional[float],
+                   kv_bits: int = 8, quantized: bool = False):
     # Grid (q_blocks, pages_per_seq). Identical online-softmax math to
     # _paged_prefill_kernel (shared _prefill_accumulate, all kv heads on
     # one pool block with a static head loop — see _paged_decode_kernel
@@ -962,6 +1112,13 @@ def _ragged_kernel(table_ref, blkseq_ref, blkq_ref, qoffs_ref, valid_ref,
     # rows: they attend the sequence's valid prefix (finite garbage —
     # MASK_VALUE is a large finite negative, so even an all-masked row
     # exponentiates to finite junk) and the host drops their outputs.
+    # Quantized pools (ISSUE 11): per-page scale blocks ride the kv
+    # index map, dequantized inside _prefill_accumulate.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     qb = pl.program_id(0)
     sb = pl.program_id(1)
 
@@ -986,7 +1143,10 @@ def _ragged_kernel(table_ref, blkseq_ref, blkq_ref, qoffs_ref, valid_ref,
                 sb * page_size, valid,
                 (m_scr[khi], l_scr[khi], acc_scr[khi]), group=group,
                 block_q=RAGGED_BLOCK_Q, block_kv=page_size,
-                sliding_window=sliding_window, softcap=softcap)
+                sliding_window=sliding_window, softcap=softcap,
+                k_scale=(ks_ref[0, :, khi, :] if quantized else None),
+                v_scale=(vs_ref[0, :, khi, :] if quantized else None),
+                kv_bits=kv_bits)
 
     @pl.when(sb == num_page_blocks - 1)
     def _finish():
@@ -1010,6 +1170,9 @@ def ragged_paged_attention(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, ps, K, G] (ISSUE 11)
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> jax.Array:
     """Mixed prefill/decode attention over a flat token buffer, straight
     off the page pool.
@@ -1029,6 +1192,7 @@ def ragged_paged_attention(
     page_size, kh = k_pool.shape[1], k_pool.shape[2]
     group = h // kh
     pages_per_seq = tables.shape[1]
+    quantized = k_scale is not None
     if t % RAGGED_BLOCK_Q:
         raise ValueError(
             f"flat buffer T={t} must be a multiple of {RAGGED_BLOCK_Q}")
@@ -1056,16 +1220,24 @@ def ragged_paged_attention(
         sb = jnp.clip(sb, lo_blk, jnp.maximum(hi_blk, 0))
         return (table_ref[seq, sb], 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((kh, group, RAGGED_BLOCK_Q, d),
+                     lambda qb, sb, t_, b_, s_, o_, v_:
+                     (0, 0, qb, 0)),
+        pl.BlockSpec((1, page_size, kh, k_pool.shape[-1]), kv_index),
+        pl.BlockSpec((1, page_size, kh, v_pool.shape[-1]), kv_index),
+    ]
+    operands = [qt, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page_size, kh, k_scale.shape[-1]), kv_index),
+            pl.BlockSpec((1, page_size, kh, v_scale.shape[-1]), kv_index),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(num_blocks, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((kh, group, RAGGED_BLOCK_Q, d),
-                         lambda qb, sb, t_, b_, s_, o_, v_:
-                         (0, 0, qb, 0)),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-            pl.BlockSpec((1, page_size, kh, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (kh, group, RAGGED_BLOCK_Q, d),
             lambda qb, sb, t_, b_, s_, o_, v_: (0, 0, qb, 0)),
@@ -1078,7 +1250,8 @@ def ragged_paged_attention(
     kernel = functools.partial(
         _ragged_kernel, page_size=page_size,
         num_page_blocks=pages_per_seq, kh=kh, group=group,
-        sliding_window=sliding_window, softcap=softcap)
+        sliding_window=sliding_window, softcap=softcap,
+        kv_bits=kv_bits, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1086,7 +1259,7 @@ def ragged_paged_attention(
         interpret=interpret,
     )(tables.astype(jnp.int32), seq_of_block.astype(jnp.int32),
       block_qstart.astype(jnp.int32), query_offsets.astype(jnp.int32),
-      kv_valid.astype(jnp.int32), qt, k_pool, v_pool)
+      kv_valid.astype(jnp.int32), *operands)
     return out.transpose(2, 0, 1, 3).reshape(t, h, d)
 
 
@@ -1101,6 +1274,9 @@ def ragged_paged_spmd(
     sliding_window: Optional[int] = None,
     softcap: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    kv_bits: int = 8,
 ) -> Optional[jax.Array]:
     """ragged_paged_attention under a model-axis mesh via shard_map —
     the flash_attention_spmd head-sharding pattern: kv heads ride
@@ -1133,23 +1309,31 @@ def ragged_paged_spmd(
     pool_spec = P(None, None, kv_head_ax, None)
     meta2 = P(None, None)
     meta1 = P(None)
+    quantized = k_scale is not None
 
-    def body(ql, kp, vp, tl, bl, bq, qo, vl):
+    def body(ql, kp, vp, tl, bl, bq, qo, vl, *sc):
+        ks, vs = sc if sc else (None, None)
         return ragged_paged_attention(
             ql, kp, vp, tl, bl, bq, qo, vl,
             sliding_window=sliding_window, softcap=softcap,
-            interpret=interpret)
+            interpret=interpret, k_scale=ks, v_scale=vs,
+            kv_bits=kv_bits)
 
+    in_specs = (q_spec, pool_spec, pool_spec, meta2,
+                meta1, meta1, meta1, meta1)
+    args = [q, k_pool, v_pool, tables.astype(jnp.int32),
+            seq_of_block.astype(jnp.int32),
+            block_qstart.astype(jnp.int32),
+            query_offsets.astype(jnp.int32),
+            kv_valid.astype(jnp.int32)]
+    if quantized:
+        in_specs += (pool_spec, pool_spec)
+        args += [k_scale, v_scale]
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(q_spec, pool_spec, pool_spec, meta2,
-                             meta1, meta1, meta1, meta1),
+                   in_specs=in_specs,
                    out_specs=q_spec, axis_names=_manual_axes(mesh),
                    check_vma=False)
-    return fn(q, k_pool, v_pool, tables.astype(jnp.int32),
-              seq_of_block.astype(jnp.int32),
-              block_qstart.astype(jnp.int32),
-              query_offsets.astype(jnp.int32),
-              kv_valid.astype(jnp.int32))
+    return fn(*args)
 
 
 def ragged_decode_attention(

@@ -120,6 +120,19 @@ def publish_memory_ledger(engine) -> dict[str, Any]:
             reg.set_gauge("roundtable_prefix_cache_pages",
                           ledger.get("prefix_cache_pages", 0),
                           engine=name)
+            # ISSUE 11: the quantized-page split — resident (payload +
+            # scales, what the pools actually cost) vs logical (the
+            # same pools at bf16 cells); bits=0 marks a bf16 pool so a
+            # dashboard can tell "quantization off" from "no data".
+            reg.set_gauge("roundtable_kv_quant_bits",
+                          ledger.get("kv_quant_bits", 0), engine=name)
+            reg.set_gauge("roundtable_kv_bytes_logical",
+                          ledger.get("kv_bytes_logical",
+                                     ledger.get("hbm_bytes", 0)),
+                          engine=name)
+            reg.set_gauge("roundtable_kv_quant_bytes_saved",
+                          ledger.get("kv_quant_bytes_saved", 0),
+                          engine=name)
         if ledger.get("hbm_bytes") is not None:
             reg.set_gauge("roundtable_kv_hbm_bytes",
                           ledger["hbm_bytes"], engine=name)

@@ -139,11 +139,17 @@ def flops_per_token(num_params: int) -> float:
 
 
 def decode_ceiling_tps(param_bytes: int, chip: ChipSpec,
-                       n_devices: int = 1) -> float:
+                       n_devices: int = 1,
+                       kv_stream_bytes: int = 0) -> float:
     """Weight-streaming decode ceiling: with TP over n chips each chip
-    streams param_bytes/n per token (KV traffic excluded — MQA at
-    serving context reads <1% of the weight bytes)."""
-    return n_devices * chip.hbm_gbps * 1e9 / max(param_bytes, 1)
+    streams param_bytes/n per token. `kv_stream_bytes` (ISSUE 11) is
+    the per-token KV read — context_tokens x resident cell bytes (data
+    + scales on a quantized pool) — folded into the streamed term;
+    0 keeps the historical weights-only ceiling (MQA at short serving
+    context reads <1% of the weight bytes, but long contexts and batch
+    don't, and quantized pages shrink exactly this term)."""
+    return (n_devices * chip.hbm_gbps * 1e9
+            / max(param_bytes + kv_stream_bytes, 1))
 
 
 def prefill_peak_tps(num_params: int, chip: ChipSpec,
@@ -165,19 +171,29 @@ def roofline_block(*, param_bytes: int, num_params: int,
                    decode_tps: Optional[float] = None,
                    prefill_tps: Optional[float] = None,
                    chip: Optional[ChipSpec] = None,
-                   int4_fallbacks: Optional[int] = None) -> dict:
+                   int4_fallbacks: Optional[int] = None,
+                   kv_stream_bytes: int = 0,
+                   kv_dtype: Optional[str] = None) -> dict:
     """The bench-record `roofline` dict — produced HERE and only here
     (bench.py embeds it verbatim; the drift test pins these keys).
 
     When no chip is given or detectable, the block assumes v5e and
     says so in `chip_source` — a hardware-window record must never
-    silently drop its ceiling because a plugin renamed device_kind."""
+    silently drop its ceiling because a plugin renamed device_kind.
+
+    `kv_stream_bytes`/`kv_dtype` (ISSUE 11): per-token KV bytes the
+    decode step streams on top of the weights (context x resident cell
+    bytes — data + scales on a quantized pool). Nonzero folds into the
+    ceiling and rides the block as explicit keys, so an int8-KV record
+    carries its own higher ceiling next to the dtype that earned it;
+    0 keeps the historical weights-only block byte-identical."""
     source = "given"
     if chip is None:
         chip, source = detect_chip()
         if chip is None:
             chip, source = V5E, "assumed-v5e"
-    ceiling = decode_ceiling_tps(param_bytes, chip, n_devices)
+    ceiling = decode_ceiling_tps(param_bytes, chip, n_devices,
+                                 kv_stream_bytes)
     peak = prefill_peak_tps(num_params, chip, n_devices)
     block = {
         "chip": chip.name,
@@ -194,12 +210,23 @@ def roofline_block(*, param_bytes: int, num_params: int,
         # the packed-bytes ceiling above is optimistic for that share
         # of dispatches — the count rides along so the reader knows.
         block["int4_fallback_dispatches"] = int(int4_fallbacks)
+    if kv_stream_bytes:
+        block["kv_stream_bytes_per_token"] = int(kv_stream_bytes)
+        block["kv_dtype"] = kv_dtype or "bf16"
     return block
 
 
-def kv_bytes_per_token(cfg: Any, dtype_bytes: int = 2) -> int:
+def kv_bytes_per_token(cfg: Any, dtype_bytes: int = 2,
+                       quant_spec: Any = None) -> int:
     """Resident KV bytes one cached token costs this model:
-    layers × (K + V) × kv_heads × head_dim × dtype."""
+    layers × (K + V) × kv_heads × head_dim × dtype. `quant_spec`
+    (ISSUE 11, a kv_quant.KVQuantSpec) switches the cell to the
+    quantized layout — int8/int4 payload PLUS the per-cell scale
+    arrays, the closed form engine/kv_quant.cell_bytes_per_token owns
+    (lazy import keeps this module host-only at load)."""
+    if quant_spec is not None:
+        from ..engine.kv_quant import cell_bytes_per_token
+        return int(cell_bytes_per_token(cfg, quant_spec, dtype_bytes))
     return int(cfg.num_layers * 2 * cfg.num_kv_heads * cfg.head_dim
                * dtype_bytes)
 
@@ -247,6 +274,14 @@ class EnginePerf:
         # call-level gauges); the scheduler passes the exact per-
         # sample mix to publish_decode_sample/publish_mixed_sample.
         self.lora_row_bytes = 0.0
+        # Quantized-KV streamed term (ISSUE 11): decode streams each
+        # row's whole context from the page pool every token on top of
+        # the weights. kv_token_bytes is already the RESIDENT cell cost
+        # (data + scales on a quantized pool — from_engine resolves the
+        # spec), so set_kv_decode_context(mean context tokens) is all
+        # the ceiling needs to price the pool dtype; 0 (the default)
+        # keeps the historical weights-only ceiling.
+        self.kv_decode_context = 0
         self.decode_ceiling = (decode_ceiling_tps(param_bytes, chip,
                                                   n_devices)
                                if chip else None)
@@ -279,13 +314,21 @@ class EnginePerf:
         chip = chip_spec(kind)
         source = ("env" if os.environ.get(CHIP_ENV)
                   else "detected" if chip else "none")
+        quant_spec = getattr(engine, "kv_quant_spec", None)
         if kv_itemsize is None:
             kv_itemsize = 2
             kv = getattr(engine, "kv", None)
             pools = getattr(kv, "pools", None)
             layers = getattr(kv, "layers", None)
-            if pools:
+            if pools and quant_spec is None:
                 kv_itemsize = pools[0][0].dtype.itemsize
+            elif pools:
+                # Quantized pools store int8 payload — itemsize 1 would
+                # miss the scales; the spec's closed cell form below
+                # charges both, against the engine's LOGICAL kv dtype
+                # (the allocator records it — quantize-off round-trips
+                # to exactly that width).
+                kv_itemsize = getattr(kv, "_kv_dtype_bytes", 2)
             elif layers:
                 kv_itemsize = layers[0][0].dtype.itemsize
         return cls(
@@ -295,22 +338,35 @@ class EnginePerf:
             num_params=engine.num_params,
             n_devices=int(engine.mesh.devices.size),
             chip=chip, chip_source=source,
-            kv_token_bytes=kv_bytes_per_token(engine.cfg, kv_itemsize))
+            kv_token_bytes=kv_bytes_per_token(engine.cfg, kv_itemsize,
+                                              quant_spec=quant_spec))
 
     def set_lora_row_bytes(self, n: float) -> None:
         self.lora_row_bytes = float(max(n, 0.0))
+
+    def set_kv_decode_context(self, tokens: int) -> None:
+        """Mean per-row context length the decode ceiling should charge
+        KV streaming for (ISSUE 11) — tokens x kv_token_bytes joins the
+        streamed term. 0 restores the weights-only ceiling."""
+        self.kv_decode_context = int(max(tokens, 0))
 
     def _decode_ceiling(self, lora_bytes_per_token=None) -> float:
         """The weight-streaming ceiling with LoRA bytes folded in
         (ISSUE 10): a K-adapter batch streams base + adapter bytes per
         token, so judging it against the base-only ceiling would
-        overreport bw_utilization exactly when personas are active."""
+        overreport bw_utilization exactly when personas are active.
+        The quantized-KV streamed term (ISSUE 11) folds in the same
+        way: context x resident cell bytes per decoded token — int8
+        pages halve it, which RAISES the ceiling this gauge divides by
+        (the explicit decode-ceiling correction the bench A/B prices)."""
         extra = (self.lora_row_bytes if lora_bytes_per_token is None
                  else lora_bytes_per_token)
-        if not extra:
+        kv_extra = self.kv_decode_context * self.kv_token_bytes
+        if not extra and not kv_extra:
             return self.decode_ceiling
         return decode_ceiling_tps(self.param_bytes + int(extra),
-                                  self.chip, self.n_devices)
+                                  self.chip, self.n_devices,
+                                  kv_stream_bytes=int(kv_extra))
 
     # --- live publication seams ---
 
@@ -443,6 +499,7 @@ class EnginePerf:
             "prefill_peak_tps": (round(self.prefill_peak, 1)
                                  if self.prefill_peak else None),
             "kv_bytes_per_token": self.kv_token_bytes,
+            "kv_decode_context": self.kv_decode_context,
             "lora_row_bytes": int(self.lora_row_bytes),
         }
 
