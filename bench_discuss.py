@@ -69,6 +69,17 @@ Usage: python bench_discuss.py            (real chip; gemma-2b × 3 knights)
            (D=16's per-cell f32 scale overhead caps the page ratio at
            1.6x; serving head_dims amortize it — gemma-2b's D=256
            gives 1.97x). ROUNDTABLE_BENCH_KVQ_DTYPE=int4 A/Bs int4.)
+       ROUNDTABLE_BENCH_RESTART=1 ..     (restart-under-load, ISSUE 12:
+           K concurrent multi-round scripted sessions on one paged +
+           host-offload engine, served fault-free then with ROLLING
+           supervisor.restart() cycles fired mid-run (after rounds 1
+           and 2) — ONE record with sessions recovered vs lost, the
+           recovery wall per restart (quiesce → evacuate → rebuild →
+           restore) and its p95, and the greedy token-parity bit vs
+           the uninterrupted run: the across-restart KV restore is
+           byte-identical exactly when later rounds' own-slot reuse
+           produces the same tokens. ROUNDTABLE_BENCH_RESTART_N
+           overrides the restart count.)
 Same watchdog+retry child-process pattern as bench.py (the single-claim
 TPU tunnel hangs rather than erroring while another process holds it).
 """
@@ -1616,6 +1627,162 @@ def kv_quant_child() -> int:
     return 0
 
 
+def restart_child() -> int:
+    """Restart-under-load (ISSUE 12 acceptance): the same K-session
+    multi-round scripted load served twice on a paged + host-offload
+    engine — fault-free, then with rolling `supervisor.restart()`
+    cycles fired mid-run — in ONE record.
+
+    Three claims, all through the REAL serving path (scheduler submit,
+    own-slot reuse across rounds, supervisor quiesce → evacuate →
+    rebuild → restore):
+    - ZERO LOSS: every session completes every round in the restart
+      run (sessions_lost == 0, completions match the baseline).
+    - RECOVERY WALL: per-restart wall (and p95 across the rolling
+      cycles) as reported by the supervisor's restart report.
+    - GREEDY TOKEN PARITY: later rounds extend earlier rounds'
+      committed KV via own-slot reuse, so the restart run's tokens
+      match the fault-free run's exactly IFF the evacuate → restore
+      hop was byte-identical.
+    """
+    from bench_common import install_sigterm_exit
+
+    install_sigterm_exit()
+    import statistics
+    import threading
+
+    import jax
+
+    if os.environ.get("ROUNDTABLE_BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from theroundtaible_tpu.engine import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+    from theroundtaible_tpu.engine.supervisor import (EngineSupervisor,
+                                                      set_supervisor,
+                                                      supervisor)
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        config = {"model": "tiny-gemma", "max_seq_len": 512,
+                  "num_slots": 12, "kv_layout": "paged", "page_size": 32,
+                  "kv_offload": True,
+                  "mesh": {"data": 1, "model": 1},
+                  "sampling": {"temperature": 0.0}}
+        max_new, rounds, k = 16, 3, 3
+    else:
+        config = {"model": "gemma-2b-it", "max_seq_len": 2048,
+                  "num_slots": 12, "kv_layout": "paged",
+                  "kv_offload": True,
+                  "sampling": {"temperature": 0.0}}
+        max_new, rounds, k = 48, 3, 3
+    n_restarts = int(os.environ.get("ROUNDTABLE_BENCH_RESTART_N", "2"))
+
+    def run_mode(restart: bool) -> dict:
+        set_supervisor(EngineSupervisor(max_restarts=n_restarts + 2))
+        eng = InferenceEngine.from_config(dict(config))
+        sched = SessionScheduler(eng)
+        produced: dict = {f"s{i}": [] for i in range(k)}
+        errors: dict = {}
+        lock = threading.Lock()
+
+        def run_session(i: int) -> None:
+            sid = f"s{i}"
+            transcript = (TOPIC + f" Knight {i} weighs shard {i} of "
+                          "the store against the event log proposal.")
+            for _r in range(rounds):
+                try:
+                    texts, _stats = sched.submit(
+                        sid, [(f"knight{i}", transcript)],
+                        max_new_tokens=max_new, timeout_s=300.0)
+                except Exception as e:  # noqa: BLE001 — counted as loss
+                    with lock:
+                        errors[sid] = repr(e)
+                    return
+                with lock:
+                    produced[sid].append(texts[0])
+                transcript += " " + texts[0]
+
+        threads = [threading.Thread(target=run_session, args=(i,),
+                                    daemon=True) for i in range(k)]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        restart_walls: list[float] = []
+        if restart:
+            for cycle in range(1, n_restarts + 1):
+                # Rolling restart AFTER round `cycle` has committed
+                # everywhere: the next rounds must reuse KV that
+                # crossed the evacuate → restore hop.
+                while True:
+                    with lock:
+                        if errors or all(len(v) >= cycle
+                                         for v in produced.values()):
+                            break
+                    time.sleep(0.02)
+                if errors:
+                    break
+                rep = supervisor().restart(
+                    sched.engine, reason=f"bench_rolling_{cycle}",
+                    scheduler=sched)
+                restart_walls.append(rep["wall_s"])
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        snap = supervisor().snapshot()
+        sched.close()
+        set_supervisor(None)
+        return {
+            "wall_s": round(wall, 2),
+            "rounds_completed": {s: len(v) for s, v in produced.items()},
+            "sessions_failed": errors,
+            "restart_walls_s": restart_walls,
+            "supervisor": {kk: snap[kk] for kk in (
+                "restarts", "sessions_recovered", "sessions_lost")},
+            "_tokens": {s: list(v) for s, v in produced.items()},
+        }
+
+    base = run_mode(False)
+    rec = run_mode(True)
+    parity = base.pop("_tokens") == rec.pop("_tokens")
+    walls = rec["restart_walls_s"]
+    p95 = (statistics.quantiles(walls, n=20)[-1] if len(walls) > 1
+           else (walls[0] if walls else None))
+    zero_loss = (not rec["sessions_failed"]
+                 and rec["rounds_completed"] == base["rounds_completed"]
+                 and rec["supervisor"]["sessions_lost"] == 0)
+    result_line = {
+        "metric": "engine_restart_under_load",
+        "value": p95,
+        "unit": "recovery_p95_wall_s",
+        "detail": {
+            "fault_free": base,
+            "restart_run": rec,
+            "restarts_fired": len(walls),
+            "recovery_p95_wall_s": p95,
+            "sessions_recovered": rec["supervisor"]["sessions_recovered"],
+            "sessions_lost": rec["supervisor"]["sessions_lost"],
+            "greedy_token_parity": parity,
+            "acceptance": {
+                "criterion": "zero sessions lost across rolling "
+                             "restarts under load, greedy token parity "
+                             "vs the uninterrupted run",
+                "meets": bool(zero_loss and parity),
+            },
+            "cpu_wall_caveat": on_cpu,
+            "platform": jax.devices()[0].platform,
+            "telemetry": _registry_snapshot(),
+            "perf": _perf_block(),
+        },
+    }
+    print(json.dumps(result_line), flush=True)
+    return 0
+
+
 def main() -> int:
     from bench_common import run_watchdogged
     # The offered-load / prefix-reuse sweeps run many scripted
@@ -1626,12 +1793,15 @@ def main() -> int:
                  or os.environ.get("ROUNDTABLE_BENCH_SPEC_DECODE")
                  or os.environ.get("ROUNDTABLE_BENCH_LORA")
                  or os.environ.get("ROUNDTABLE_BENCH_KV_QUANT")
+                 or os.environ.get("ROUNDTABLE_BENCH_RESTART")
                  else ATTEMPT_TIMEOUT_S)
     return run_watchdogged(os.path.abspath(__file__), [],
                            attempt_s, MAX_ATTEMPTS, RETRY_DELAY_S)
 
 
 def _run_child() -> int:
+    if os.environ.get("ROUNDTABLE_BENCH_RESTART"):
+        return restart_child()
     if os.environ.get("ROUNDTABLE_BENCH_KV_QUANT"):
         return kv_quant_child()
     if os.environ.get("ROUNDTABLE_BENCH_LORA"):

@@ -337,6 +337,38 @@ def _kv_quant_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _supervision_guard(request):
+    """Tier-1 guard for @pytest.mark.supervision (ISSUE 12 satellite):
+    a test that CLAIMS engine-supervision coverage must actually cross
+    an engine restart — if the supervisor never ran a restart cycle
+    (successful OR budgeted-failed) during the test, the quiesce →
+    evacuate → rebuild → restore machinery silently never engaged
+    (kill-switch left on, detection never triggered) and the test's
+    recovery claims are vacuous; fail LOUD. Detection/journal/gate unit
+    tests (which legitimately never rebuild) mark allow_norestart=True.
+    The guard also restores the process supervisor singleton, so one
+    test's dead-engine verdict can never poison another's submits."""
+    marker = request.node.get_closest_marker("supervision")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.engine import supervisor as sup_mod
+
+    sup_mod.set_supervisor(None)
+    sup_mod.reset_test_counters()
+    yield
+    restarts = sup_mod.restarts_seen()
+    sup_mod.set_supervisor(None)
+    if marker.kwargs.get("allow_norestart"):
+        return
+    assert restarts > 0, (
+        "supervision-marked test never crossed an engine restart: the "
+        "supervisor's quiesce/evacuate/rebuild/restore cycle silently "
+        "never ran (mark allow_norestart=True only for detection/"
+        "journal/gate units)")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

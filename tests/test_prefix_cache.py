@@ -608,15 +608,64 @@ class TestHostOffload:
         assert desc["spilled_sessions"] == 1
         # flush (what fleet.drain does per engine) + evacuate
         assert kv.flush() >= 1
-        moved = eng.kv_offload.evacuate()
+        manifest = eng.kv_offload.evacuate()
         assert kv.pages_in_use() == 0, "drain left pages resident"
-        assert moved >= 1
+        assert manifest["pages_moved"] >= 1
+        assert s_idle in manifest["sessions"]
         # the evacuated session still restores byte-identical
         eng.kv_offload.restore_session(s_idle)
         out2 = eng.generate(shared, slot_name=n_idle)
         ref = make_engine(prefix_cache=False, kv_offload=False)
         assert out2 == ref.generate(shared, slot_name=n_idle)
         assert out1 == out2
+
+    @pytest.mark.prefix_cache(allow_cold=True)
+    def test_evacuate_subset_selector_byte_identity(self):
+        """ISSUE 12 satellite: evacuate() with a per-session selector
+        moves ONLY the targeted sessions fully to host RAM (the
+        supervisor's per-engine evacuation, not fleet.drain's
+        all-or-nothing shape) and returns a restorable manifest; the
+        evacuated subset restores byte-identical while the untargeted
+        session's pool state is untouched."""
+        eng = make_engine(prefix_cache=False)
+        ref = make_engine(prefix_cache=False, kv_offload=False)
+        prompts = {
+            "sub0": PREAMBLE + "Bedivere recounts the northern ford.",
+            "sub1": PREAMBLE + "Tristan recounts the harbor watch.",
+            "sub2": PREAMBLE + "Gawain recounts the long portage.",
+        }
+        names = {s: scoped_slot(s, "kay") for s in prompts}
+        outs = {s: eng.generate(p, slot_name=names[s])
+                for s, p in prompts.items()}
+        kv = eng.kv
+        pages_before = {s: list(kv._slots[names[s]].pages)
+                        for s in prompts}
+        manifest = eng.kv_offload.evacuate(["sub0", "sub1"])
+        # Only the targeted subset moved: manifest names exactly them,
+        # with their full host footprint accounted.
+        assert sorted(manifest["sessions"]) == ["sub0", "sub1"]
+        assert manifest["slots_spilled"] == 2
+        assert manifest["host_bytes"] > 0
+        for s in ("sub0", "sub1"):
+            assert eng.kv_offload.has(s)
+            assert manifest["sessions"][s]["host_rows"] > 0
+        # The untargeted session never left the pool.
+        assert not eng.kv_offload.has("sub2")
+        assert kv._slots[names["sub2"]].pages == pages_before["sub2"]
+        # The evacuated records are fully host-resident (adoptable by a
+        # rebuilt engine's tier): no "kept" pool-page holds remain.
+        for s in ("sub0", "sub1"):
+            rec = eng.kv_offload._spilled[s]
+            assert not any(kind == "kept"
+                           for srec in rec.slots.values()
+                           for kind, _p in srec.entries)
+        # Restore the subset: byte-identical serving vs the cache-off
+        # twin AND vs the pre-evacuation outputs.
+        for s in ("sub0", "sub1"):
+            assert eng.kv_offload.restore_session(s) >= 1
+            out2 = eng.generate(prompts[s], slot_name=names[s])
+            assert out2 == outs[s]
+            assert out2 == ref.generate(prompts[s], slot_name=names[s])
 
     @pytest.mark.scheduler(allow_serial=True)
     @pytest.mark.prefix_cache(allow_cold=True)

@@ -62,10 +62,21 @@ def build_parser():
     v = sub.add_parser(
         "serve",
         help="Serve K concurrent discussions on one shared engine fleet")
-    v.add_argument("topics", nargs="+",
+    v.add_argument("topics", nargs="*",
                    help="Topics (one concurrent discussion each)")
     v.add_argument("--sessions", type=int, default=None,
                    help="Fan ONE topic into K concurrent discussions")
+    v.add_argument("--journal", default=None, metavar="DIR",
+                   help="Journal every committed turn to DIR (fsynced "
+                        "JSONL per session) so a crashed process can "
+                        "resume with --resume DIR")
+    v.add_argument("--resume", dest="resume_dir", default=None,
+                   metavar="DIR",
+                   help="Replay the session journal at DIR through the "
+                        "normal submit path (re-prefill; the prefix "
+                        "cache makes it cheap), restoring every "
+                        "session's KV at its last committed turn — "
+                        "then serve the given topics (if any)")
     v.add_argument("--read-code", action="store_true", default=None,
                    help="Read source code into context without asking")
     v.add_argument("--no-read-code", dest="read_code",
@@ -90,6 +101,10 @@ def build_parser():
                     help="Render the KV-tier view: memory ledger with "
                          "the cross-session sharing split, prefix-cache "
                          "hit/miss series, host-RAM offload state")
+    st.add_argument("--health", action="store_true",
+                    help="Render fleet health: breakers, admission "
+                         "gates, scheduler queues, and the supervisor's "
+                         "engine-restart history")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -153,7 +168,9 @@ def dispatch(args) -> int:
     if args.command == "serve":
         from .commands.serve import serve_command
         return serve_command(args.topics, sessions=args.sessions,
-                             read_code=args.read_code)
+                             read_code=args.read_code,
+                             journal_dir=args.journal,
+                             resume_dir=args.resume_dir)
     if args.command == "summon":
         from .commands.summon import summon_command
         return summon_command(read_code=args.read_code)
@@ -162,7 +179,8 @@ def dispatch(args) -> int:
         return status_command(
             telemetry_view=getattr(args, "telemetry", False),
             perf_view=getattr(args, "perf", False),
-            kv_view=getattr(args, "kv", False))
+            kv_view=getattr(args, "kv", False),
+            health_view=getattr(args, "health", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

@@ -48,7 +48,8 @@ def _dedupe_topics(topics: list[str]) -> list[str]:
 
 
 def _attach_schedulers(adapters: dict, session_id: str,
-                       admit_hold_s: float) -> tuple[list, list]:
+                       admit_hold_s: float,
+                       journal=None) -> tuple[list, list]:
     """Bind every tpu-llm adapter in this session's seat map to its
     session id and to the SHARED per-engine scheduler (scheduler_for:
     one scheduler per resident engine, however many sessions share it).
@@ -79,6 +80,15 @@ def _attach_schedulers(adapters: dict, session_id: str,
         except TypeError:
             adapter.session = session_id
             continue
+        if journal is not None and sched.journal is not journal:
+            # Durable turn journal (ISSUE 12): one journal per serve
+            # root, shared by every scheduler — committed turns fsync
+            # at retire so `serve --resume` survives a kill -9. A
+            # different already-attached journal is REPLACED: `--resume
+            # DIR1 --journal DIR2` must journal new turns into DIR2,
+            # not keep the replay-attached DIR1 (the full-disk
+            # migration case).
+            sched.attach_journal(journal)
         attach(sched, session=session_id)
         if sched not in scheds:
             scheds.append(sched)
@@ -96,6 +106,7 @@ def serve_discussions(
     admit_hold_s: float = 0.25,
     reporter_factory: Optional[Callable[[str], Any]] = None,
     close_schedulers: bool = True,
+    journal_dir: Optional[str] = None,
 ) -> dict[str, Any]:
     """Run one discussion per topic, all concurrently, on shared engines.
 
@@ -110,6 +121,10 @@ def serve_discussions(
     "wall_s": total}.
     """
     topics = _dedupe_topics(list(topics))
+    journal = None
+    if journal_dir is not None:
+        from ..engine.session_journal import SessionJournal
+        journal = SessionJournal(journal_dir)
     all_scheds: list = []
     owned_scheds: list = []
     # Session ids carry a per-CALL unique component: two concurrent
@@ -134,7 +149,8 @@ def serve_discussions(
             # Plain appends from session threads; deduped by identity
             # when the report is built.
             scheds, owned = _attach_schedulers(
-                adapters, entry["session_id"], admit_hold_s)
+                adapters, entry["session_id"], admit_hold_s,
+                journal=journal)
             all_scheds.extend(scheds)
             owned_scheds.extend(owned)
             reporter = (reporter_factory(entry["session_id"])
@@ -172,14 +188,102 @@ def serve_discussions(
     return report
 
 
+def resume_from_journal(resume_dir: str, *,
+                        config=None,
+                        project_root: Optional[str] = None,
+                        scheduler=None) -> dict[str, Any]:
+    """Replay a session journal through the normal submit path
+    (ISSUE 12 crash recovery): every committed turn of every journaled
+    session is re-submitted with a 1-token budget, so the fresh
+    engine re-prefills the exact committed token stream through the
+    same reuse/prefix-cache/commit machinery as live serving and each
+    session's KV ends at its last committed turn. Re-prefill is
+    acceptable on the crash path — the prefix cache makes repeated
+    spans cheap.
+
+    `scheduler` (tests / embedding callers) replays onto that
+    scheduler directly; otherwise adapters are seated from `config`
+    (or the project's config) and the first tpu-llm engine's shared
+    scheduler is used. The journal is attached to the scheduler
+    afterwards, so the resumed process keeps journaling new turns into
+    the same directory with continued turn numbering.
+
+    Returns {"sessions", "turns", "scheduler"}."""
+    from ..engine.session_journal import SessionJournal, replay_turns
+
+    journal = SessionJournal(resume_dir)
+    sched = scheduler
+    if sched is None:
+        config = config or load_config(project_root or os.getcwd())
+        adapters = initialize_adapters(config)
+        from ..engine.scheduler import acquire_scheduler
+        for adapter in adapters.values():
+            if not hasattr(adapter, "attach_scheduler"):
+                continue
+            try:
+                engine = adapter._get_engine()
+                sched, _created = acquire_scheduler(engine)
+                break
+            except Exception:  # noqa: BLE001 — try the next seat
+                continue
+        if sched is None:
+            raise ConfigError(
+                "serve --resume needs at least one tpu-llm knight "
+                "whose engine can be built — no scheduler available "
+                "to replay onto")
+    report: dict[str, Any] = {"sessions": 0, "turns": 0,
+                              "scheduler": sched}
+    for session in journal.sessions():
+        report["turns"] += replay_turns(journal, session, sched.submit)
+        report["sessions"] += 1
+    if sched.journal is None:
+        sched.attach_journal(journal)
+    return report
+
+
 def serve_command(topics: list[str], sessions: Optional[int] = None,
                   read_code: Optional[bool] = None,
-                  project_root: Optional[str] = None) -> int:
+                  project_root: Optional[str] = None,
+                  journal_dir: Optional[str] = None,
+                  resume_dir: Optional[str] = None) -> int:
     """CLI: `roundtable serve "topic" --sessions 4` (one topic fanned
-    into K concurrent discussions) or `roundtable serve "t1" "t2" "t3"`
-    (one discussion each)."""
+    into K concurrent discussions), `roundtable serve "t1" "t2" "t3"`
+    (one discussion each), `--journal DIR` for crash-durable turn
+    records, `--resume DIR` to replay a crashed process's journal."""
     project_root = project_root or os.getcwd()
     config = load_config(project_root)
+    if not topics and not resume_dir:
+        raise ConfigError(
+            "serve needs topics to discuss (or --resume DIR)")
+    if resume_dir:
+        print(style.bold(f"\n  Resuming sessions from journal "
+                         f"{resume_dir}..."))
+        r = resume_from_journal(resume_dir, config=config,
+                                project_root=project_root)
+        print(style.dim(
+            f"  replayed {r['turns']} committed turn(s) across "
+            f"{r['sessions']} session(s) — KV restored at the last "
+            "committed turn"))
+        # A resumed serve keeps journaling into the same directory
+        # unless the operator pointed --journal elsewhere.
+        journal_dir = journal_dir or resume_dir
+        if not topics:
+            # Nothing to serve: the replay above VALIDATED the journal
+            # (every committed turn re-prefilled cleanly), but the
+            # restored KV lives only in this process — continuing the
+            # work needs topics in the same invocation.
+            from ..engine.session_journal import SessionJournal
+            j = SessionJournal(resume_dir)
+            for session in j.sessions():
+                last = j.last_turn(session)
+                print(style.dim(
+                    f"    {session}: resumed at committed turn {last}"))
+            print(style.dim(
+                "\n  journal validated — no topics given, so this "
+                "process exits. To continue serving after a crash, "
+                "pass the next topics in the same invocation:\n"
+                "    roundtable serve --resume DIR \"next topic\"\n"))
+            return 0
     if sessions and len(topics) == 1:
         topics = topics * sessions
     elif sessions and len(topics) != sessions:
@@ -190,7 +294,8 @@ def serve_command(topics: list[str], sessions: Optional[int] = None,
     print(style.bold(f"\n  Serving {len(topics)} concurrent "
                      "discussion(s) on the shared fleet...\n"))
     report = serve_discussions(topics, config, project_root,
-                               read_source_code=bool(read_code))
+                               read_source_code=bool(read_code),
+                               journal_dir=journal_dir)
 
     failed = 0
     for entry in report["sessions"]:

@@ -39,8 +39,13 @@ def phase_display(status) -> tuple[str, str, object]:
 def status_command(project_root: Optional[str] = None,
                    telemetry_view: bool = False,
                    perf_view: bool = False,
-                   kv_view: bool = False) -> int:
+                   kv_view: bool = False,
+                   health_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
+    if health_view:
+        # Fleet health needs no session dir — it reads the live
+        # process's breaker/scheduler/supervisor state.
+        return health_status()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
@@ -159,6 +164,59 @@ def telemetry_status(session) -> int:
         print(style.bold(f"\n  Flight-recorder dumps ({len(dumps)}):"))
         for p in dumps[-5:]:
             print(style.dim(f"    {p}"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --health` (ISSUE 12) ---
+
+
+def health_status() -> int:
+    """`roundtable status --health` — the fleet-health view: breaker
+    state, the admission gate, scheduler queues, and the ISSUE 12
+    supervision roll-up (restart totals, dead engines and why, and each
+    engine's bounded restart history). Live-process state: meaningful
+    from the serving process (serve foreground, tests, a REPL driving
+    the fleet) — a fresh CLI process reports an idle fleet."""
+    from ..engine.fleet import fleet_health
+
+    h = fleet_health()
+    print(style.bold("\n  Fleet health"))
+    print(style.dim(
+        f"    engines={h['total']}  breakers_open={h['open']}  "
+        f"degraded={h['degraded']}  draining={h['draining']}  "
+        f"hangs={h['hangs']}  queued_sessions={h['queued_sessions']}"))
+    for s in h["schedulers"]:
+        gate = ("closed" if s.get("closed")
+                else f"paused:{s['paused']}" if s.get("paused")
+                else "open")
+        print(style.dim(
+            f"    scheduler[{s['engine']}] queued={s['queued']} "
+            f"active_rows={s['active_rows']} "
+            f"sessions={len(s['sessions'])} (admission {gate})"))
+
+    sup = h["supervisor"]
+    print(style.bold("\n  Supervision (engine restarts):"))
+    print(style.dim(
+        f"    restarts={sup['restarts']}  "
+        f"sessions_recovered={sup['sessions_recovered']}  "
+        f"sessions_lost={sup['sessions_lost']}  "
+        f"dead_engines={sup['dead_engines']}"))
+    if not sup["engines"]:
+        print(style.dim("    (no engine has ever needed a restart)"))
+    for e in sup["engines"]:
+        state = (style.red(f"DEAD: {e['dead_reason']}") if e["dead"]
+                 else style.green("alive"))
+        print(f"    {e['engine']}: {e['restarts']} restart(s), "
+              f"{e['failed_restarts']} failed — {state}")
+        for ev in e["history"][-5:]:
+            ok = "ok" if ev.get("ok") else "FAILED"
+            extra = ""
+            if ev.get("restored_sessions") is not None:
+                extra = f", restored {ev['restored_sessions']} session(s)"
+            print(style.dim(
+                f"      #{ev.get('restart', '?')} {ev.get('reason')}: "
+                f"{ok} in {ev.get('wall_s', 0):.3f}s{extra}"))
     print("")
     return 0
 

@@ -48,7 +48,9 @@ class AdapterError(RoundtableError):
     def __init__(self, message: str, kind: str = "unknown",
                  hint: Optional[str] = None, cause: Optional[BaseException] = None):
         super().__init__(message, hint=hint, cause=cause)
-        self.kind = kind  # not_installed | timeout | auth | api | oom | hang | unknown
+        # not_installed | timeout | auth | api | oom | hang |
+        # device_lost | unknown
+        self.kind = kind
 
 
 class SessionError(RoundtableError):
@@ -76,6 +78,11 @@ _KIND_HINTS = {
     "hang": "A device wait exceeded its watchdog budget — the program is "
             "presumed wedged. Check device health, or raise the rung budget "
             "(ROUNDTABLE_RUNG_BUDGETS) if the wait was legitimate.",
+    "device_lost": "The accelerator itself failed or disappeared — no "
+                   "retry on this engine can succeed. The engine "
+                   "supervisor rebuilds it (engine/supervisor.py); if "
+                   "this persists past the restart budget, check "
+                   "device health / the platform runtime.",
     "unknown": None,
 }
 
@@ -100,6 +107,15 @@ _OOM_MARKERS = ("resource_exhausted", "out of memory", "hbm", "oom",
 # crash (no blind retry, revive + re-seat). Markers are whole words the
 # watchdog/fault messages carry ("hang" alone would match "change").
 _HANG_MARKERS = ("watchdog", "wedged", "hang detected", "(hang)")
+# Device loss (ISSUE 12): the accelerator itself died or vanished — the
+# strongest failure kind, classified FIRST: neither a retry nor a
+# revive on the same engine can succeed, only the supervisor's
+# tear-down/rebuild (engine/supervisor.py) helps. Markers match the
+# real runtime messages ("DATA_LOSS: ...", "device is lost", libtpu
+# halt strings) and the deterministic fault injection.
+_DEVICE_LOST_MARKERS = ("device lost", "device is lost", "data_loss",
+                        "device halted", "chip reboot",
+                        "(device_lost)")
 
 
 def classify_error(err: BaseException) -> str:
@@ -107,6 +123,8 @@ def classify_error(err: BaseException) -> str:
     if isinstance(err, AdapterError):
         return err.kind
     msg = str(err).lower()
+    if any(m in msg for m in _DEVICE_LOST_MARKERS):
+        return "device_lost"
     if any(m in msg for m in _NOT_INSTALLED_MARKERS):
         return "not_installed"
     if any(m in msg for m in _OOM_MARKERS):

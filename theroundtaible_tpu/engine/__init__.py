@@ -119,11 +119,31 @@ def get_engine(config: dict[str, Any]):
         if key not in _engines:
             if (config.get("mesh") or {}).get("pipe"):
                 from .pp_serving import PPEngine
-                _engines[key] = PPEngine.from_config(config)
+                eng = PPEngine.from_config(config)
             else:
                 from .engine import InferenceEngine
-                _engines[key] = InferenceEngine.from_config(config)
+                eng = InferenceEngine.from_config(config)
+            # Supervision identity + rebuild recipe (ISSUE 12): the
+            # EngineSupervisor rebuilds a dead engine from exactly this
+            # config and keys its restart budget by this cache key.
+            eng._engine_cache_key = key
+            eng._engine_config = dict(config)
+            _engines[key] = eng
         return _engines[key]
+
+
+def replace_engine(old, new) -> bool:
+    """Swap a rebuilt engine into the cache in place of the instance it
+    supersedes (engine/supervisor.py restart cycle): every later
+    get_engine with the same config serves the fresh engine. Returns
+    whether a cache entry was replaced (False for engines constructed
+    outside the cache — tests, ad-hoc instances)."""
+    with _lock:
+        for k, v in list(_engines.items()):
+            if v is old:
+                _engines[k] = new
+                return True
+    return False
 
 
 def get_breaker(config: dict[str, Any]):
@@ -175,10 +195,27 @@ def reset_engines() -> None:
 _LORA_EXPORTS = ("LoraStore", "lora_enabled", "lora_dims",
                  "save_pair_tree")
 
+# Public supervision surface (ISSUE 12): the supervisor singleton
+# accessors, the classified dead-engine error, and the durable session
+# journal — same lazy-export discipline (supervisor pulls core.errors
+# only; the journal is pure host code). The singleton itself is reached
+# as engine.supervisor.supervisor() — the bare name would shadow the
+# submodule.
+_SUPERVISION_EXPORTS = ("EngineSupervisor", "EngineDead",
+                        "set_supervisor", "supervisor_snapshot")
+_JOURNAL_EXPORTS = ("SessionJournal", "replay_turns",
+                    "replay_turn_prompt")
+
 
 def __getattr__(name: str):
     if name in _LORA_EXPORTS:
         from . import lora as _lora
         return getattr(_lora, name)
+    if name in _SUPERVISION_EXPORTS:
+        from . import supervisor as _sup
+        return getattr(_sup, name)
+    if name in _JOURNAL_EXPORTS:
+        from . import session_journal as _sj
+        return getattr(_sj, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

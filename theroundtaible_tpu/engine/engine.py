@@ -1069,6 +1069,11 @@ class InferenceEngine:
         # after the fact, not only in the warning stream (advisor r3).
         engine.quant_auto_degraded = bool(
             config.get("_quant_auto_degraded"))
+        # Rebuild recipe (ISSUE 12): the supervisor reconstructs a dead
+        # engine from exactly this config — captured here so engines
+        # built outside the get_engine cache (tests, benches) are
+        # supervisable too.
+        engine._engine_config = dict(config)
         if "dispatch_retries" in config:
             from .faults import RetryPolicy
             engine.retry = RetryPolicy(

@@ -52,7 +52,8 @@ from ..utils import telemetry
 ARMED = False
 
 POINTS = ("mosaic_compile", "dispatch", "slow_dispatch", "hbm_oom",
-          "kv_corrupt", "hang", "slow_wait")
+          "kv_corrupt", "hang", "slow_wait", "device_lost",
+          "engine_wedged")
 
 # Messages are crafted so core.errors.classify_error maps each fault to
 # the kind its real counterpart would carry ("hbm" → oom, "wedged" →
@@ -67,6 +68,17 @@ _DEFAULT_MESSAGES = {
     "kv_corrupt": "injected fault: corrupted KV slot detected",
     "hang": "injected fault: device dispatch wedged (hang)",
     "slow_wait": "injected fault: slow device wait",
+    # ISSUE 12 supervisor-tier points. Messages classify via
+    # core.errors: "(device_lost)" / "device is lost" hit the
+    # device-lost markers (classified FIRST, non-retryable in place —
+    # routed to the EngineSupervisor, never the dispatch retry);
+    # engine_wedged carries the hang markers (the watchdog family) so
+    # REPEATED firings model "hangs past the ladder" without an armed
+    # watchdog — exactly what the supervisor's hang escalation counts.
+    "device_lost": "injected fault: DATA_LOSS: device is lost "
+                   "(device_lost)",
+    "engine_wedged": "injected fault: device program wedged beyond the "
+                     "dispatch ladder (hang)",
 }
 
 # Default sleep for an injected `hang` before it raises: long enough
@@ -191,12 +203,15 @@ def maybe_inject(point: str) -> None:
 
 def inject_dispatch_faults() -> None:
     """The dispatch-stage points, in severity order. One call site in the
-    serving loop covers transient failure, slowness, wedging and OOM."""
+    serving loop covers transient failure, slowness, wedging, OOM and
+    device loss."""
     maybe_inject("slow_dispatch")
     maybe_inject("slow_wait")
     maybe_inject("dispatch")
     maybe_inject("hang")
+    maybe_inject("engine_wedged")
     maybe_inject("hbm_oom")
+    maybe_inject("device_lost")
 
 
 def _arm_from_env() -> None:
@@ -251,8 +266,11 @@ def is_kernel_failure(err: BaseException) -> bool:
 # already passed, the allocation will fail again, the config is wrong —
 # or the device program is wedged (hang: the wait already consumed its
 # rung budget and likely its donated buffers; only the adapter rung's
-# revive + re-prefill helps).
-_NO_RETRY_KINDS = ("timeout", "oom", "auth", "not_installed", "hang")
+# revive + re-prefill helps). device_lost is the strongest: the chip
+# itself is gone — nothing short of the supervisor's engine rebuild
+# (engine/supervisor.py) can serve this config again.
+_NO_RETRY_KINDS = ("timeout", "oom", "auth", "not_installed", "hang",
+                   "device_lost")
 
 # Message markers with the same property: a donated-then-failed dispatch
 # leaves its inputs deleted, so re-running the identical program dies on

@@ -321,6 +321,10 @@ def fleet_health() -> dict[str, Any]:
         # ISSUE 6: compile-observatory roll-up — is the fleet in steady
         # state, and has anything recompiled mid-serve since?
         "perf": _perf_rollup(),
+        # ISSUE 12: the supervisor's restart history — totals, dead
+        # engines and WHY, per-engine restart budgets. Cheap: reads the
+        # process singleton's host-side state, never constructs it.
+        "supervisor": _supervisor_rollup(),
     }
 
 
@@ -331,6 +335,11 @@ def _perf_rollup() -> dict[str, Any]:
             "steady_state": s["steady_state"],
             "steady_state_compiles": s["steady_state_compiles"],
             "strict": s["strict"]}
+
+
+def _supervisor_rollup() -> dict[str, Any]:
+    from .supervisor import supervisor_snapshot
+    return supervisor_snapshot()
 
 
 def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
@@ -377,6 +386,11 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
     # Queued scheduler sessions fail fast NOW — their submitters were
     # never admitted, so there is nothing to wait for; active sessions
     # drain through the serve-lock wait below like any in-flight turn.
+    # The admission gate closes too (ISSUE 12): a drained scheduler
+    # must not race new admissions against the flush below — resume()
+    # reopens it (the module DRAINING flag alone left the gate shut).
+    for s in schedulers():
+        s.pause_admission("fleet.drain")
     rejected = sum(s.reject_queued() for s in schedulers())
     with _lock:
         engines = list(_engines.items())
@@ -408,7 +422,12 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
                         # after fleet.resume().
                         tier = getattr(eng, "kv_offload", None)
                         if tier is not None:
-                            entry["evacuated_pages"] = tier.evacuate()
+                            # evacuate() returns a restorable manifest
+                            # (ISSUE 12); the drain report keeps its
+                            # historical pages-count key.
+                            manifest = tier.evacuate()
+                            entry["evacuated_pages"] = \
+                                manifest["pages_moved"]
                     except Exception as e:  # noqa: BLE001
                         entry["flush_error"] = str(e)
                         report["clean"] = False
@@ -423,9 +442,18 @@ def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:
 
 def resume() -> None:
     """Re-open admission after a drain (fleet_health()['draining'] goes
-    False; engines accept new turns again)."""
+    False; engines accept new turns again).
+
+    Also re-opens every attached scheduler's admission gate (ISSUE 12
+    satellite): drain() closes the per-scheduler gates, and flipping
+    only the module-level DRAINING flag left a drained scheduler's
+    queue paused forever — submits after resume() queued but never
+    admitted. Reopening is idempotent and wakes the loops."""
     from . import deadlines
+    from .scheduler import schedulers
     deadlines.end_drain()
+    for s in schedulers():
+        s.reopen_admission()
 
 
 def plan_fleet(engine_configs: list[dict[str, Any]],

@@ -86,6 +86,16 @@ class SpilledSession:
     def n_rows(self) -> int:
         return len(self.replicas)
 
+    def fully_host_resident(self) -> bool:
+        """No "kept" pool pages — the ONE definition of "this record
+        can cross engines": restorable_sessions() reports by it and
+        adopt() filters by it (a record still referencing pool pages
+        would alias unrelated content on a pool that never held
+        them)."""
+        return not any(kind == "kept"
+                       for srec in self.slots.values()
+                       for kind, _p in srec.entries)
+
     def host_bytes(self) -> int:
         return sum(k.nbytes + v.nbytes for k, v in self.host)
 
@@ -143,6 +153,15 @@ class HostOffloadTier:
 
     def spilled_sessions(self) -> list[str]:
         return list(self._spilled)
+
+    def restorable_sessions(self) -> list[str]:
+        """Sessions whose spill records are FULLY host-resident (no
+        "kept" pool pages) — exactly the set adopt() will accept onto
+        a fresh engine's tier. The supervisor uses this when an
+        evacuation dies mid-cycle: these sessions survive the pool
+        even though the evacuation itself failed."""
+        return [s for s, rec in self._spilled.items()
+                if rec.fully_host_resident()]
 
     def has(self, session: str) -> bool:
         return session in self._spilled
@@ -440,18 +459,45 @@ class HostOffloadTier:
                 restored += self.restore_session(session, pinned)
         return restored
 
-    # --- drain / teardown ---
+    # --- drain / evacuation / teardown ---
 
-    def evacuate(self) -> int:
-        """Convert every kept-resident page to host bytes and drop the
-        tier's holds (fleet.drain: after the flush released every slot
-        and the index, the tier's kept pages are the only thing between
-        a drained pool and zero pages in use — move them down so the
-        drain's claim is true AND the sessions still restore without
-        re-prefill after resume). Returns pages moved."""
+    def evacuate(self, sessions: Optional[list[str]] = None) -> dict:
+        """Move sessions FULLY to host RAM and return a restorable
+        manifest (ISSUE 12): first spill every still-resident targeted
+        session (slots in the pool spill through spill_session — pages
+        with external holders stay resident under tier refs), then
+        convert those kept-resident holds to host bytes and drop them,
+        so every targeted session's state lives entirely in host RAM —
+        pool-independent, which is exactly what lets the supervisor
+        graft the records onto a REBUILT engine's tier (adopt()) and
+        restore byte-identical KV across an engine restart.
+
+        `sessions=None` targets everything (the fleet.drain shape:
+        after the flush released every slot and the index, the tier's
+        kept pages are the only thing between a drained pool and zero
+        pages in use). A subset selector evacuates only those sessions;
+        the rest keep their pool/tier state untouched. The caller owns
+        engine serialization (serve lock / scheduler thread).
+
+        Manifest: {"pages_moved", "slots_spilled", "host_bytes",
+        "sessions": {session: {"slots", "host_rows", "host_bytes"}}} —
+        every listed session restores via restore_session/restore_for
+        (or transparently at its next submit)."""
         kv = self.engine.kv
+        targets = None if sessions is None else set(sessions)
+        # Pass 1: spill targeted sessions whose slots still sit in the
+        # pool (the supervisor path — fleet.drain's flush has usually
+        # emptied the pool already, making this a no-op there).
+        resident = sorted({session_of(n) for n in kv.slot_names()}
+                          - {""})
+        slots_spilled = 0
+        for s in resident:
+            if targets is None or s in targets:
+                slots_spilled += self.spill_session(s)
         moved = 0
-        for rec in self._spilled.values():
+        for session, rec in self._spilled.items():
+            if targets is not None and session not in targets:
+                continue
             kept: dict[int, int] = {}   # page -> #mappings in this rec
             for srec in rec.slots.values():
                 for kind, p in srec.entries:
@@ -475,9 +521,49 @@ class HostOffloadTier:
             for p, n_maps in kept.items():
                 for _ in range(n_maps):
                     kv.unref(p)
-        if moved:
+        if moved or slots_spilled:
             self._publish()
-        return moved
+        manifest: dict = {
+            "pages_moved": moved,
+            "slots_spilled": slots_spilled,
+            "host_bytes": 0,
+            "sessions": {},
+        }
+        for session, rec in self._spilled.items():
+            if targets is not None and session not in targets:
+                continue
+            b = rec.host_bytes()
+            manifest["sessions"][session] = {
+                "slots": len(rec.slots),
+                "host_rows": rec.n_rows(),
+                "host_bytes": b,
+            }
+            manifest["host_bytes"] += b
+        return manifest
+
+    def adopt(self, other: "HostOffloadTier") -> list[str]:
+        """Graft another tier's spill records onto THIS tier (the
+        supervisor's engine rebuild: the dead engine's evacuated
+        sessions become the fresh engine's restorable sessions).
+        Records must be fully host-resident — evacuate() first: a
+        record still holding "kept" pool pages references a pool this
+        tier has never seen, and restoring it would alias unrelated
+        content. Such records are refused (left on `other`, named in
+        no list) rather than corrupting the new pool. Returns the
+        adopted session names."""
+        adopted: list[str] = []
+        for session, rec in list(other._spilled.items()):
+            if not rec.fully_host_resident():
+                continue
+            if session in self._spilled:
+                continue  # this tier's own record wins
+            self._spilled[session] = rec
+            del other._spilled[session]
+            adopted.append(session)
+        if adopted:
+            self._publish()
+            other._publish()
+        return adopted
 
     def drop_all(self) -> None:
         """Forget every spilled record WITHOUT touching the pool — for

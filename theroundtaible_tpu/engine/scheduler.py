@@ -261,7 +261,8 @@ class SessionScheduler:
 
     def __init__(self, engine, *, admit_hold_s: float = 0.0,
                  max_rows: Optional[int] = None,
-                 idle_spill_s: Optional[float] = None):
+                 idle_spill_s: Optional[float] = None,
+                 journal=None):
         # The continuous-batching loop recomposes rows at the decode
         # SEGMENT seam — it needs the single-program engine's compiled
         # closures. PPEngine has no such seam (stage-pipelined decode).
@@ -295,6 +296,31 @@ class SessionScheduler:
         self._stop = False
         self.closed = False
         self._lock_held = False
+        # The lock OBJECT actually held (ISSUE 12): a supervised engine
+        # rebuild swaps self.engine mid-lifetime, and releasing
+        # "self.engine._serve_lock" after a swap would release the NEW
+        # engine's (unheld) lock while leaking the old one.
+        self._held_lock: Optional[threading.Lock] = None
+        # Admission gate (ISSUE 12): while set, queued requests stay
+        # QUEUED (the supervisor's quiesce / fleet.drain) — nothing is
+        # admitted and nothing is rejected; reopen_admission (or
+        # fleet.resume) lifts it. A reason string, None = open.
+        self._paused: Optional[str] = None
+        # Thread-safe preempt mailbox (ISSUE 12): force_fail_active
+        # posts an error here; the loop thread consumes it at its next
+        # health check — request state stays single-writer.
+        self._force_fail: Optional[BaseException] = None
+        # Durable session journal (ISSUE 12): when attached, every
+        # retired round appends one fsynced committed-turn record, so a
+        # hard process crash resumes at the last committed turn
+        # (engine/session_journal.py; serve --resume replays it).
+        self._journal = journal
+        # THIS scheduler's journal provenance (the journal object is
+        # shared across every scheduler of a serve root — its own
+        # .records/.errors are fleet-wide and would double-count when
+        # describe() outputs are summed per scheduler).
+        self.journal_turns = 0
+        self.journal_errors = 0
         # Decision provenance (ISSUE 4: recorded like the int4 paths).
         self.admitted = 0
         self.refused = 0
@@ -373,6 +399,19 @@ class SessionScheduler:
         # (fleet.drain satellite).
         deadlines.check_admission()
         engine = self.engine
+        # Dead-engine gate (ISSUE 12): the supervisor exhausted this
+        # engine's restart budget — every submit fails fast with the
+        # same classified reason instead of queueing into a corpse.
+        from ..core.errors import classify_error
+        from .supervisor import EngineDead, engine_dead_reason
+        dead = engine_dead_reason(engine)
+        if dead is not None:
+            # The reason string carries the terminal cause, so the
+            # classified kind survives into the adapter ladder's error
+            # accounting (device_lost stays device_lost).
+            raise EngineDead(
+                f"engine {self._tname!r} is dead: {dead}",
+                kind=classify_error(RuntimeError(dead)))
         # Against max_rows, not num_slots: a request wider than the
         # scheduler's batch would pass a slots-only check, then sit at
         # the FIFO head forever (admission only examines the head) and
@@ -565,6 +604,9 @@ class SessionScheduler:
                 self.engine, "kv_offload", None).spilled_sessions())
             if getattr(self.engine, "kv_offload", None) is not None
             else 0,
+            "paused": self._paused,
+            "journal_turns": self.journal_turns,
+            "journal_errors": self.journal_errors,
             "events": events,
         }
 
@@ -592,6 +634,7 @@ class SessionScheduler:
             "queued": len(self._queue),
             "active_rows": len(self._active),
             "sessions": sessions,
+            "paused": self._paused,
             "closed": self.closed,
         }
 
@@ -643,6 +686,100 @@ class SessionScheduler:
                             reason=type(error).__name__)
         return len(rejected)
 
+    def pause_admission(self, reason: str = "paused") -> None:
+        """Close the admission gate (ISSUE 12): queued and newly
+        submitted requests WAIT (nothing is rejected); active requests
+        keep serving. The supervisor's quiesce and fleet.drain use
+        this; reopen_admission (or fleet.resume) lifts it."""
+        with self._cv:
+            if self._paused is None:
+                self._paused = reason
+        self._event("pause_admission", reason=reason)
+
+    def reopen_admission(self) -> None:
+        """Re-open the admission gate and wake the loop — the
+        fleet.resume satellite: a drained/supervised scheduler's queue
+        must actually resume admitting, not just stop rejecting."""
+        with self._cv:
+            was = self._paused
+            self._paused = None
+            self._cv.notify_all()
+        if was is not None:
+            self._event("reopen_admission", was=was)
+
+    @property
+    def paused(self) -> Optional[str]:
+        return self._paused
+
+    def quiesce(self, timeout_s: float = 30.0) -> bool:
+        """Pause admission and wait (from a non-loop thread) for every
+        ACTIVE request to retire or fail — the supervisor's step 2.
+        Returns True when the batch drained clean within `timeout_s`
+        (queued requests stay queued; they serve after the restart)."""
+        self.pause_admission("quiesce")
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._active_reqs and time.monotonic() < deadline:
+                # Retirement doesn't notify the cv — the timeout doubles
+                # as the poll cadence.
+                self._cv.wait(timeout=0.05)
+        return not self._active_reqs
+
+    def fail_active_requests(self, err: BaseException) -> int:
+        """Fail every active request with `err` — LOOP-THREAD ONLY (the
+        supervisor's crash path runs on this thread inside the failed
+        dispatch's tick). Returns the count."""
+        reqs = list(self._active_reqs)
+        for req in reqs:
+            self._fail_request(req, err)
+        return len(reqs)
+
+    def force_fail_active(self, err: BaseException,
+                          timeout_s: float = 5.0) -> int:
+        """Thread-safe preempt: ask the loop to fail every active
+        request with `err` at its next health check, then wait for it.
+        The supervisor's quiesce-timeout fallback — request state is
+        single-writer (the loop thread), so an external thread must
+        never mutate it directly. Returns requests failed (best
+        effort: the loop may be wedged in a device wait, in which case
+        the watchdog — not this call — unwedges it)."""
+        with self._cv:
+            n = len(self._active_reqs)
+            if n == 0:
+                return 0
+            self._force_fail = err
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self._active_reqs:
+                return n
+            time.sleep(0.02)
+        return n - len(self._active_reqs)
+
+    def reattach_engine(self, new_engine) -> None:
+        """Point this scheduler at a REBUILT engine (the supervisor's
+        step 5). Caller contract: admission is paused, no requests are
+        active, and the old engine's serve lock is not held by this
+        scheduler. The rebuilt engine re-enters warmup (reopen_warmup)
+        so its fresh compiles are sanctioned under
+        ROUNDTABLE_RECOMPILE_STRICT — the caller re-declares via
+        declare_warmup_complete() once post-restart traffic is warm."""
+        from . import compile_watch
+        self.engine = new_engine
+        new_engine._scheduler = self
+        self.max_rows = min(self.max_rows, new_engine.kv.num_slots)
+        compile_watch.reopen_warmup(self._tname)
+        self._event("reattach_engine")
+
+    def attach_journal(self, journal) -> None:
+        """Attach a durable session journal (engine/session_journal):
+        every retired round appends one fsynced committed-turn record."""
+        self._journal = journal
+
+    @property
+    def journal(self):
+        return self._journal
+
     def close(self, timeout_s: float = 30.0) -> None:
         """Stop the loop: queued requests are rejected, active requests
         are allowed `timeout_s` to finish, then the thread exits."""
@@ -661,10 +798,19 @@ class SessionScheduler:
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while (not self._queue and not self._active
-                       and not self._stop
-                       and not self._idle_spill_due()):
+                # A paused scheduler with only queued work sleeps: the
+                # queue cannot be admitted until reopen_admission
+                # notifies, and a busy-tick would spin the loop.
+                while (not self._active and not self._stop
+                       and not self._idle_spill_due()
+                       and (not self._queue or self._paused)):
                     self._cv.wait(timeout=0.25)
+                    if self._queue and self._paused:
+                        # Paused with queued work: tick at the wait
+                        # cadence anyway so queue-deadline sweeps still
+                        # run (a request must die at ITS timeout even
+                        # while admission is gated).
+                        break
                 if self._stop and not self._active and not self._queue:
                     break
             try:
@@ -715,13 +861,16 @@ class SessionScheduler:
 
     def _acquire_engine(self) -> None:
         if not self._lock_held:
-            self.engine._serve_lock.acquire()
+            lock = self.engine._serve_lock
+            lock.acquire()
+            self._held_lock = lock
             self._lock_held = True
 
     def _release_engine(self) -> None:
         if self._lock_held:
             self._lock_held = False
-            self.engine._serve_lock.release()
+            lock, self._held_lock = self._held_lock, None
+            lock.release()
 
     # --- admission ---
 
@@ -751,7 +900,10 @@ class SessionScheduler:
     def _admit_queued(self) -> None:
         while True:
             with self._cv:
-                if not self._queue:
+                if not self._queue or self._paused:
+                    # Paused (supervisor quiesce / fleet drain): queued
+                    # requests WAIT — they are served after the gate
+                    # reopens, never rejected here.
                     return
                 req = self._queue[0]
                 # Batch-formation hold: with an EMPTY batch, wait up to
@@ -784,6 +936,12 @@ class SessionScheduler:
                 self._release_request_slots(req)
                 self._release_adapters(req)
                 self._fail_request(req, e)
+                # Engine-fatal triage runs on the admission path too: a
+                # device_lost during the admission prefill must reach
+                # the supervisor (rebuild + restore), not leave a sick
+                # engine serving the remaining sessions.
+                if self._supervisor_intervened(e):
+                    return
                 self._after_engine_failure(e)
 
     def _release_request_slots(self, req: _Request) -> None:
@@ -919,8 +1077,12 @@ class SessionScheduler:
         """True when the proactive idle policy has work — the loop's
         idle wait must wake for it, or an otherwise-quiet scheduler
         would never run the spill tick."""
-        if (self.idle_spill_s is None
+        if (self.idle_spill_s is None or self._paused
                 or getattr(self.engine, "kv_offload", None) is None):
+            # Paused must mirror _spill_idle_by_age's gate: if "due"
+            # stayed True while the spill tick refused to run, the idle
+            # wait would never sleep and the loop would busy-spin for
+            # the whole pause window.
             return False
         now = time.monotonic()
         return any(now - self._last_active.get(s, now)
@@ -933,8 +1095,10 @@ class SessionScheduler:
         RAM — a consensus round can sit for minutes while humans type,
         and resident-but-idle KV is exactly the capacity ceiling this
         tier lifts."""
-        if (self.idle_spill_s is None
+        if (self.idle_spill_s is None or self._paused
                 or getattr(self.engine, "kv_offload", None) is None):
+            # Paused: the supervisor may hold (or be about to take) the
+            # serve lock for an engine swap — don't contend for it.
             return
         now = time.monotonic()
         idle = [s for s in self._spillable_sessions(set())
@@ -1457,6 +1621,8 @@ class SessionScheduler:
         hold a half-written chunk; the adapter ladder re-prefills from
         the prompt), while decode-only sessions re-dispatch through the
         compiled segment path from intact host+KV state."""
+        if self._supervisor_intervened(err):
+            return
         if self._after_engine_failure(err):
             return
         self._bump("preemptions")
@@ -2016,6 +2182,8 @@ class SessionScheduler:
         PREEMPT the batch into per-session dispatches: the session the
         fault follows fails alone; everyone else's rows re-run their
         segment from intact host+KV state, byte-identical."""
+        if self._supervisor_intervened(err):
+            return
         if self._after_engine_failure(err):
             return
         self._bump("preemptions")
@@ -2032,6 +2200,22 @@ class SessionScheduler:
                 self._fail_request(req, e)
                 continue
             req.stats.decode_seconds += time.monotonic() - t0
+
+    def _supervisor_intervened(self, err: BaseException) -> bool:
+        """Engine-fatal triage BEFORE the dispatch ladder (ISSUE 12):
+        device_lost failures, repeated hangs past the ladder, and
+        already-dead engines route to the EngineSupervisor, which tears
+        the engine down, rebuilds it, and restores the evacuated
+        sessions — all inline on this (the loop) thread. Returns True
+        when the supervisor took over (the batch is gone: actives were
+        failed into their adapter ladders as part of the quiesce);
+        False lets preempt-isolate / revive handle it as before."""
+        try:
+            from .supervisor import supervisor
+            return supervisor().handle_dispatch_failure(self, err)
+        except Exception as e:  # noqa: BLE001 — triage must not mask err
+            self._event("supervisor_error", error=str(e)[:200])
+            return False
 
     def _after_engine_failure(self, err: BaseException) -> bool:
         """Donation-death check after ANY engine dispatch failure: a
@@ -2120,6 +2304,12 @@ class SessionScheduler:
             # DISPATCH as the tokens were served — retire must not
             # count them again.)
             self._release_adapters(req)
+            if self._journal is not None:
+                # Durable commit point (ISSUE 12): the round's results
+                # are about to be handed back — journal them fsynced
+                # FIRST, so the record on disk never claims less than
+                # the submitter saw.
+                self._journal_retired(req, eos, max_new)
             req.stats.int4_paths = engine.int4_path_report()
             req.stats.sched = {
                 "queue_wait_s": round(
@@ -2173,9 +2363,51 @@ class SessionScheduler:
                         occupancy_max=req.occ_max)
             req.event.set()
 
+    def _journal_retired(self, req: _Request, eos: int,
+                         max_new: int) -> None:
+        """Append this retired round's committed-turn record to the
+        session journal (engine/session_journal.py). Guarded end to
+        end: a journal failure costs durability, never availability —
+        the round still retires and the submitter still gets its
+        result (record_turn itself degrades OSErrors to a counter)."""
+        try:
+            ads = req.adapters or [None] * len(req.rows)
+            rows = []
+            for (knight, prompt), r, adapter in zip(req.turns, req.rows,
+                                                    ads):
+                rows.append({
+                    "knight": knight,
+                    "prompt": prompt,
+                    "prompt_tokens": list(r.tokens),
+                    "produced": eos_trim(list(r.produced), eos, max_new),
+                    "adapter": adapter,
+                })
+            rec = self._journal.record_turn(req.session, rows,
+                                            engine=self._tname)
+            if rec is not None:
+                self.journal_turns += 1
+            elif not self._journal._suspended:
+                # record_turn degraded an OSError to None (suspension
+                # during replay also returns None, but that is not an
+                # error).
+                self.journal_errors += 1
+        except Exception as e:  # noqa: BLE001 — durability < availability
+            self.journal_errors += 1
+            self._event("journal_error", session=req.session,
+                        error=str(e)[:200])
+
     # --- per-request health (budgets / cancellation / abandonment) ---
 
     def _check_request_health(self) -> None:
+        forced = self._force_fail
+        if forced is not None:
+            # force_fail_active's mailbox (ISSUE 12): the supervisor's
+            # quiesce-timeout fallback posted an error; every active
+            # request fails with it HERE, on the loop thread — request
+            # state is single-writer.
+            self._force_fail = None
+            for req in list(self._active_reqs):
+                self._fail_request(req, forced)
         now = time.monotonic()
         for req in list(self._active_reqs):
             if req.abandoned:

@@ -1,0 +1,287 @@
+"""Durable session journal — crash-consistent record of committed turns.
+
+The scheduler's host state (and the KV pool behind it) dies with the
+process: a SIGKILL mid-discussion loses every session even though each
+retired turn was already final. This module (ISSUE 12 tentpole, second
+half) makes the COMMIT point durable: at retire time the scheduler
+appends one JSONL record per session round — knight names, a prompt
+hash, the committed token ids, the persona adapter ids — and fsyncs at
+the turn boundary, so the record on disk is exactly the set of turns
+whose results were handed back to submitters. RTP-LLM (PAPERS.md)
+treats restart-surviving session state as table stakes for production
+serving; this is the minimal durable form of it.
+
+Crash consistency rules:
+
+- **Append-only, one file per session** (`<root>/<session>.jsonl`).
+  A record is written as one line + flush + fsync before the turn is
+  considered journaled; a crash between retire and fsync loses at most
+  the in-flight turn — which the submitter never saw complete, so the
+  journal can never claim MORE than was served.
+- **Torn tails are expected, not fatal.** A kill -9 mid-write leaves a
+  partial last line; the reader stops at the first undecodable line and
+  serves every complete record before it (the classic WAL rule).
+- **Replay goes through the normal submit path.** `replay_turn_prompt`
+  rebuilds the exact committed token stream of a recorded turn;
+  `commands/serve.resume_from_journal` submits it with a 1-token
+  budget, so the fresh engine re-prefills the transcript through the
+  same reuse/prefix-cache/commit machinery as live serving (re-prefill
+  is acceptable on the crash path — the prefix cache makes repeated
+  spans cheap) and the session's KV ends at the exact committed turn.
+
+Journal failures must never fail serving: the scheduler guards every
+write and degrades to an event + counter (`journal_errors`) — a full
+disk costs durability, not availability.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from pathlib import Path
+from typing import Any, Iterable, Optional
+
+from ..utils import telemetry
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _safe_name(session: str) -> str:
+    """Session ids are caller-chosen (uuid-tagged serve ids, bench
+    names, test strings) — map them onto one safe filename, with a
+    short hash suffix so two ids that sanitize identically ("a/b" and
+    "a_b") can never share a journal file."""
+    digest = hashlib.sha256(session.encode("utf-8")).hexdigest()[:8]
+    return f"{_SAFE.sub('_', session)[:80]}-{digest}"
+
+
+def prompt_sha(prompt: Any) -> str:
+    """Stable hash of a turn's prompt (str or token-id list) — replay
+    and audits verify identity without storing the raw text twice."""
+    if isinstance(prompt, (list, tuple)):
+        raw = ",".join(str(int(t)) for t in prompt)
+    else:
+        raw = str(prompt)
+    return hashlib.sha256(raw.encode("utf-8")).hexdigest()
+
+
+class SessionJournal:
+    """Append-only per-session JSONL journal of committed turns."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        # One lock PER SESSION for the write path: the journal object
+        # is shared by every scheduler of a serve root, and fsync can
+        # be many milliseconds — a single journal-wide lock would
+        # serialize every engine's retire path behind every other
+        # engine's fsync. A session is owned by one scheduler, so the
+        # per-session lock gives the same turn-numbering consistency
+        # with no cross-engine stall; `_lock` only guards the shared
+        # dicts.
+        self._session_locks: dict[str, threading.Lock] = {}
+        # session -> next turn index, seeded lazily from disk so a
+        # resumed process continues the numbering it crashed at.
+        self._next_turn: dict[str, int] = {}
+        self._names: dict[str, str] = {}   # session -> filename stem
+        self.records = 0
+        self.errors = 0
+        # True while a replay drives the normal submit path: the
+        # replayed turns would otherwise re-journal themselves as fresh
+        # commits, doubling the file on every resume.
+        self._suspended = False
+
+    # --- paths / discovery ---
+
+    def path_for(self, session: str) -> Path:
+        stem = self._names.get(session)
+        if stem is None:
+            stem = self._names.setdefault(session, _safe_name(session))
+        return self.root / f"{stem}.jsonl"
+
+    def sessions(self) -> list[str]:
+        """Every session with at least one committed record on disk
+        (read from the records themselves — filenames are sanitized)."""
+        out: dict[str, None] = {}
+        for p in sorted(self.root.glob("*.jsonl")):
+            for rec in self._read(p, limit=1):
+                out.setdefault(rec["session"])
+        return list(out)
+
+    # --- writing ---
+
+    def suspend_replay(self) -> "_Suspended":
+        """Context manager: journal writes no-op while a replay drives
+        the normal submit path (see module docstring)."""
+        return _Suspended(self)
+
+    def record_turn(self, session: str, rows: list[dict],
+                    **meta) -> Optional[dict]:
+        """Append ONE committed-turn record, fsynced before returning.
+
+        `rows` is one dict per knight row of the round:
+        {"knight": name, "prompt": str|ids, "prompt_tokens": [ids...],
+         "produced": [ids...], "adapter": persona-or-None}. The record
+        stores the prompt HASH plus the token ids — everything replay
+        needs, nothing it doesn't. Extra `meta` (consensus scores,
+        round ids) rides along verbatim. Returns the record (None when
+        suspended for replay or the write failed — serving continues
+        either way; failures count in `errors`)."""
+        if self._suspended:
+            return None
+        with self._lock:
+            slock = self._session_locks.setdefault(
+                session, threading.Lock())
+        with slock:
+            with self._lock:
+                turn = self._next_turn.get(session)
+            if turn is None:
+                turn = self._scan_next_turn(session)
+            rec = {
+                "v": 1,
+                "session": session,
+                "turn": turn,
+                "ts": round(time.time(), 3),
+                "rows": [
+                    {
+                        "knight": r["knight"],
+                        "prompt_sha256": prompt_sha(
+                            r.get("prompt",
+                                  r.get("prompt_tokens", []))),
+                        "prompt_tokens": [int(t) for t in
+                                          r.get("prompt_tokens", [])],
+                        "produced": [int(t) for t in
+                                     r.get("produced", [])],
+                        "adapter": r.get("adapter"),
+                    }
+                    for r in rows
+                ],
+            }
+            for k, v in meta.items():
+                if v is not None:
+                    rec[k] = v
+            try:
+                path = self.path_for(session)
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, separators=(",", ":"))
+                            + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError as e:
+                with self._lock:
+                    self.errors += 1
+                telemetry.inc("roundtable_journal_errors_total")
+                telemetry.recorder().record(
+                    "journal_error", session=session,
+                    error=str(e)[:200])
+                return None
+            with self._lock:
+                self._next_turn[session] = turn + 1
+                self.records += 1
+        telemetry.inc("roundtable_journal_turns_total")
+        return rec
+
+    def _scan_next_turn(self, session: str) -> int:
+        last = self.last_turn(session)
+        return 0 if last is None else last + 1
+
+    # --- reading / replay ---
+
+    def _read(self, path: Path, limit: Optional[int] = None) -> list[dict]:
+        """Complete records of one journal file, stopping at the first
+        torn/undecodable line (crash-consistency: everything before a
+        torn tail was fsynced by construction)."""
+        out: list[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail: the crash's half-written line
+                    if not isinstance(rec, dict) or "rows" not in rec:
+                        break
+                    out.append(rec)
+                    if limit is not None and len(out) >= limit:
+                        break
+        except OSError:
+            return out
+        return out
+
+    def turns(self, session: str) -> list[dict]:
+        """Every committed record for `session`, in commit order."""
+        return self._read(self.path_for(session))
+
+    def last_turn(self, session: str) -> Optional[int]:
+        recs = self.turns(session)
+        return recs[-1]["turn"] if recs else None
+
+    def describe(self) -> dict:
+        return {
+            "root": str(self.root),
+            "sessions": len(list(self.root.glob("*.jsonl"))),
+            "records_written": self.records,
+            "errors": self.errors,
+        }
+
+
+class _Suspended:
+    def __init__(self, journal: SessionJournal):
+        self._j = journal
+
+    def __enter__(self):
+        self._j._suspended = True
+        return self._j
+
+    def __exit__(self, *exc):
+        self._j._suspended = False
+        return False
+
+
+def replay_turn_prompt(row: dict) -> list[int]:
+    """The exact committed token stream of one journaled row: the
+    turn's prompt ids followed by every produced id. Submitting this as
+    a (pre-tokenized) prompt with a 1-token budget re-prefills and
+    commits the full turn through the normal serving path, leaving the
+    slot's KV exactly where the retired turn left it."""
+    return (list(row.get("prompt_tokens", []))
+            + list(row.get("produced", [])))
+
+
+def replay_turns(journal: SessionJournal, session: str,
+                 submit) -> int:
+    """Replay every committed turn of `session` through `submit` —
+    a callable with the scheduler/engine submit signature
+    `submit(session, [(knight, token_ids), ...], max_new_tokens=1)`.
+    Turns replay in commit order so later turns reuse the earlier ones'
+    KV (own-slot reuse + prefix cache make this cheap). Journal writes
+    are suspended for the duration. Returns the number of turns
+    replayed."""
+    recs = journal.turns(session)
+    with journal.suspend_replay():
+        for rec in recs:
+            turns = [(row["knight"], replay_turn_prompt(row))
+                     for row in rec["rows"]]
+            kwargs: dict = {"max_new_tokens": 1}
+            ads = [row.get("adapter") for row in rec["rows"]]
+            if any(a is not None for a in ads):
+                # Persona rows must replay under their adapter: the
+                # committed K/V was adapter-tinted, and a base-model
+                # re-prefill would bake DIFFERENT bytes into the slot.
+                kwargs["adapters_per_turn"] = ads
+            submit(session, turns, **kwargs)
+    return len(recs)
+
+
+def iter_all_turns(journal: SessionJournal) -> Iterable[tuple[str, dict]]:
+    for session in journal.sessions():
+        for rec in journal.turns(session):
+            yield session, rec

@@ -1,0 +1,652 @@
+"""Engine supervision — tear a sick engine down, rebuild it, put the
+sessions back.
+
+PRs 1/2 recover DISPATCHES (retry → revive → serial fallback →
+breaker) and PR 7 made idle KV restorable, but nothing owned the engine
+LIFECYCLE: a lost device, a program wedged past the ladder, or an
+operator's rolling restart still killed every live session, because no
+component could say "this engine is done — quiesce it, rebuild it from
+its config, and restore the sessions onto the fresh instance". RTP-LLM
+(PAPERS.md) treats exactly this supervised-lifecycle-with-state-handoff
+as table stakes for production serving. This module is that layer
+(ISSUE 12 tentpole), the robustness spine the serving gateway (ROADMAP
+item 1) and the multi-replica tier (item 2) stand on.
+
+The restart cycle (ARCHITECTURE.md "Supervision & recovery"):
+
+1. **Detect.** Three triggers route here instead of the dispatch
+   ladder: a `device_lost`-classified failure (the chip itself is gone
+   — `core/errors` classifies it FIRST and `faults.RetryPolicy` never
+   retries it in place), repeated `hang`-kind failures past the ladder
+   (`hang_threshold` consecutive — one hang is the watchdog's business,
+   a stream of them means the ENGINE is wedged), or an explicit
+   `supervisor().restart(engine)` (rolling restarts, operator action).
+   `ROUNDTABLE_SUPERVISOR=0` disarms auto-detection; explicit restarts
+   always work.
+2. **Quiesce.** The scheduler's admission gate closes
+   (`pause_admission` — submits still QUEUE, they are served after the
+   restart; nothing is rejected on the rolling path) and in-flight
+   requests either finish (manual restart: `quiesce()` waits for
+   retirement) or preempt-fail into their adapters' PR-1 ladders
+   (crash path: their turn state is gone with the dispatch anyway).
+3. **Evacuate.** `HostOffloadTier.evacuate()` moves every remaining
+   session fully to host RAM and returns a restorable manifest —
+   pool-independent records the fresh engine's tier `adopt()`s, so a
+   session idles ACROSS the restart with its KV byte-identical.
+4. **Rebuild.** A fresh engine from the SAME config
+   (`engine._engine_config`, captured at construction) under bounded
+   exponential backoff; `build_attempts` construction failures burn one
+   restart, and `max_restarts` exhausted marks the engine DEAD — later
+   submits fail fast with a clean classified error and
+   `fleet_health()["supervisor"]` says why.
+5. **Restore.** The scheduler re-attaches to the fresh engine
+   (`compile_watch.reopen_warmup` — post-restart compiles are a
+   SANCTIONED warmup phase, so ROUNDTABLE_RECOMPILE_STRICT serving
+   crosses a restart without a violation), evacuated sessions restore
+   eagerly (failures stay adopted and restore lazily at next submit —
+   `_prepare_batch`'s restore seam), and the paused queue resumes.
+
+Everything is observable: `roundtable_engine_restarts_total{reason}`,
+`roundtable_engine_restart_seconds`,
+`roundtable_sessions_{recovered,lost}_total`, a `supervisor` flight
+dump per restart, and `fleet_health()["supervisor"]` / `roundtable
+status --health` render the restart history.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.errors import AdapterError, classify_error
+from ..utils import telemetry
+
+_HISTORY_CAP = 32
+
+# Test-visibility counters (tests/conftest.py `supervision` marker
+# guard): a marked test that never crossed an engine restart fails
+# LOUD — the supervision it claims to cover silently never ran.
+_test_restarts = 0
+_test_lock = threading.Lock()
+
+
+def reset_test_counters() -> None:
+    global _test_restarts
+    with _test_lock:
+        _test_restarts = 0
+
+
+def restarts_seen() -> int:
+    return _test_restarts
+
+
+def _note_restart() -> None:
+    global _test_restarts
+    with _test_lock:
+        _test_restarts += 1
+
+
+def supervision_enabled() -> bool:
+    """Auto-detection kill switch: ROUNDTABLE_SUPERVISOR=0 keeps the
+    PR-1/2 ladder behavior byte-identical (failures surface to the
+    adapters; nothing rebuilds). Explicit restart() calls ignore it."""
+    return os.environ.get("ROUNDTABLE_SUPERVISOR", "1") not in (
+        "0", "false", "off")
+
+
+def engine_key(engine) -> str:
+    """Stable identity for supervision state: the engine-cache key when
+    the engine came through get_engine (the rebuilt instance inherits
+    it), else a per-INSTANCE direct key (tests, ad-hoc engines) made
+    sticky by writing it back onto the engine — so two unrelated
+    engines that happen to share a model name never pool hang counts or
+    restart budgets, while a rebuilt engine (which copies the key)
+    keeps its predecessor's budget."""
+    key = getattr(engine, "_engine_cache_key", None)
+    if key:
+        return key
+    name = getattr(getattr(engine, "cfg", None), "name", "?")
+    key = f"direct:{name}@{id(engine):x}"
+    try:
+        engine._engine_cache_key = key
+    except (AttributeError, TypeError):  # frozen/slotted test doubles
+        pass
+    return key
+
+
+class EngineDead(AdapterError):
+    """The supervisor exhausted this engine's restart budget — serving
+    on it can never succeed again in this process."""
+
+    def __init__(self, message: str, kind: str = "unknown"):
+        super().__init__(message, kind=kind)
+
+
+@dataclass
+class _EngineState:
+    key: str
+    name: str = "engine"
+    restarts: int = 0
+    failed_restarts: int = 0
+    consecutive_hangs: int = 0
+    last_hang_at: Optional[float] = None
+    dead: bool = False
+    dead_reason: str = ""
+    dead_kind: str = "unknown"
+    last_restart_s: Optional[float] = None
+    history: list = field(default_factory=list)
+
+    def note_history(self, entry: dict) -> None:
+        self.history.append(entry)
+        del self.history[:-_HISTORY_CAP]
+
+    def snapshot(self) -> dict:
+        return {
+            "engine": self.name,
+            "restarts": self.restarts,
+            "failed_restarts": self.failed_restarts,
+            "consecutive_hangs": self.consecutive_hangs,
+            "dead": self.dead,
+            "dead_reason": self.dead_reason,
+            "last_restart_s": self.last_restart_s,
+            "history": list(self.history),
+        }
+
+
+class EngineSupervisor:
+    """Supervised engine lifecycle: detection thresholds, the restart
+    budget, and the quiesce → evacuate → rebuild → restore cycle."""
+
+    def __init__(self, *, max_restarts: int = 3,
+                 build_attempts: int = 3,
+                 backoff_s: float = 0.2, backoff_mult: float = 2.0,
+                 hang_threshold: int = 2,
+                 hang_window_s: float = 60.0,
+                 quiesce_timeout_s: float = 30.0):
+        self.max_restarts = max_restarts
+        self.build_attempts = build_attempts
+        self.backoff_s = backoff_s
+        self.backoff_mult = backoff_mult
+        self.hang_threshold = hang_threshold
+        self.hang_window_s = hang_window_s
+        self.quiesce_timeout_s = quiesce_timeout_s
+        self._states: dict[str, _EngineState] = {}
+        self._lock = threading.Lock()
+        # Serializes whole restart cycles: two threads must never
+        # rebuild one engine concurrently (double-built engines, torn
+        # spill adoption).
+        self._restart_lock = threading.Lock()
+        self.restarts = 0
+        self.sessions_recovered = 0
+        self.sessions_lost = 0
+
+    # --- state ---
+
+    def _state_for(self, engine) -> _EngineState:
+        key = engine_key(engine)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _EngineState(
+                    key=key,
+                    name=getattr(getattr(engine, "cfg", None), "name",
+                                 "engine"))
+            return st
+
+    def dead_reason(self, engine) -> Optional[str]:
+        """Why this engine is beyond restarting (None while it isn't) —
+        the scheduler's submit gate fails fast on it."""
+        with self._lock:
+            st = self._states.get(engine_key(engine))
+        if st is not None and st.dead:
+            return st.dead_reason
+        return None
+
+    def reset(self, engine=None) -> None:
+        """Forget supervision state (operator override / tests): one
+        engine's, or everything."""
+        with self._lock:
+            if engine is None:
+                self._states.clear()
+            else:
+                self._states.pop(engine_key(engine), None)
+
+    def snapshot(self) -> dict[str, Any]:
+        """fleet_health()["supervisor"]: restart totals + per-engine
+        state with the bounded restart history."""
+        with self._lock:
+            states = [st.snapshot() for st in self._states.values()]
+        return {
+            "restarts": self.restarts,
+            "sessions_recovered": self.sessions_recovered,
+            "sessions_lost": self.sessions_lost,
+            "dead_engines": sum(1 for s in states if s["dead"]),
+            "engines": states,
+        }
+
+    # --- detection ---
+
+    def handle_dispatch_failure(self, sched, err: BaseException) -> bool:
+        """The scheduler-thread detection seam, called after a shared
+        dispatch failure that a revive did not explain. Decides whether
+        this failure is ENGINE-fatal (device_lost; repeated hangs past
+        the ladder; an already-dead engine) and, when it is, performs
+        the supervised restart inline — the caller's active requests
+        are failed into their adapter ladders as part of the cycle.
+        Returns True when the engine was torn down (callers stop
+        touching it); False routes the failure to the normal
+        preempt-isolate ladder."""
+        if not supervision_enabled():
+            return False
+        engine = sched.engine
+        st = self._state_for(engine)
+        if st.dead:
+            dead = EngineDead(
+                f"engine {st.name!r} is dead: {st.dead_reason}",
+                kind=st.dead_kind)
+            sched.fail_active_requests(dead)
+            return True
+        kind = classify_error(err)
+        if kind == "device_lost":
+            trigger = "device_lost"
+        elif kind == "hang":
+            # "Consecutive" is bounded in TIME, not just in failure
+            # order: healthy dispatches never report here, so without a
+            # window two unrelated hangs hours apart would read as an
+            # escalation.
+            now = time.monotonic()
+            if (st.last_hang_at is not None
+                    and now - st.last_hang_at > self.hang_window_s):
+                st.consecutive_hangs = 0
+            st.last_hang_at = now
+            st.consecutive_hangs += 1
+            if st.consecutive_hangs < self.hang_threshold:
+                return False
+            trigger = "hang_escalation"
+        else:
+            st.consecutive_hangs = 0
+            return False
+        if getattr(engine, "_engine_config", None) is None:
+            # No rebuild recipe — record the verdict, let the ladder
+            # degrade as before (better a sick engine serving retries
+            # than a supervisor that can only destroy).
+            telemetry.recorder().record(
+                "supervisor_unrebuildable", engine=st.name,
+                trigger=trigger)
+            return False
+        try:
+            self.restart(engine, reason=trigger, cause=err,
+                         scheduler=sched)
+        except EngineDead:
+            pass    # sessions already failed with the classified error
+        except Exception:  # noqa: BLE001 — budgeted failure
+            # The cycle failed inside its budget: actives were already
+            # failed into their ladders, the queue reopened — the next
+            # fatal failure triggers the next (budgeted) attempt.
+            pass
+        return True
+
+    # --- the restart cycle ---
+
+    def restart(self, engine, *, reason: str = "manual",
+                cause: Optional[BaseException] = None,
+                scheduler=None,
+                rebuild: Optional[Callable[[], Any]] = None,
+                warm_batches: Optional[tuple[int, ...]] = None) -> dict:
+        """One full supervised restart of `engine`. Returns a report
+        dict; raises EngineDead when the restart budget is exhausted
+        (the engine is marked dead first, so every later submit fails
+        fast with the same classified reason)."""
+        with self._restart_lock:
+            return self._restart_locked(
+                engine, reason=reason, cause=cause, scheduler=scheduler,
+                rebuild=rebuild, warm_batches=warm_batches)
+
+    def _restart_locked(self, engine, *, reason, cause, scheduler,
+                        rebuild, warm_batches) -> dict:
+        st = self._state_for(engine)
+        name = st.name
+        if st.dead:
+            raise EngineDead(
+                f"engine {name!r} is dead: {st.dead_reason}",
+                kind=st.dead_kind)
+        t0 = time.monotonic()
+        sched = scheduler
+        if sched is None:
+            cand = getattr(engine, "_scheduler", None)
+            if (cand is not None and not cand.closed
+                    and cand.engine is engine):
+                sched = cand
+        if st.restarts >= self.max_restarts:
+            # Budget bounds restart CYCLES, successful or not: an
+            # engine that keeps needing rebuilds is flapping — stop
+            # serving it before the flapping eats the fleet's wall.
+            self._mark_dead(st, engine, sched, cause=cause)
+            report = {"engine": name, "reason": reason, "ok": False,
+                      "dead": True,
+                      "cause": str(cause)[:200] if cause else None}
+            # counted=False: no cycle ran — this refusal must not
+            # inflate restart totals or put a ~0s sample into the
+            # recovery-wall histogram.
+            self._finish(st, report, t0, reason, counted=False)
+            raise EngineDead(
+                f"engine {name!r} is dead: {st.dead_reason}",
+                kind=st.dead_kind)
+        on_sched_thread = (
+            sched is not None
+            and threading.current_thread() is sched._thread)
+        report: dict[str, Any] = {
+            "engine": name, "reason": reason, "restart": st.restarts + 1,
+            "cause": str(cause)[:200] if cause else None,
+        }
+        telemetry.recorder().record(
+            "supervisor_restart_begin", engine=name, reason=reason,
+            error=str(cause or "")[:200])
+
+        fail_err = cause or RuntimeError(
+            f"engine {name!r} restarting ({reason})")
+        evac_sessions: list[str] = []
+        own_lock = False
+        try:
+            # The whole cycle is a SANCTIONED warmup phase for this
+            # label: the evacuation's spill gathers and the fresh
+            # engine's construction/warmup compiles must not read as
+            # steady-state violations under ROUNDTABLE_RECOMPILE_STRICT
+            # (reattach_engine reopens again after the swap; the owner
+            # re-declares once post-restart traffic is warm).
+            from . import compile_watch
+            compile_watch.reopen_warmup(name)
+            # --- quiesce ---
+            if sched is not None:
+                sched.pause_admission(f"supervisor:{reason}")
+                if on_sched_thread:
+                    # Crash path, on the serving thread itself: the
+                    # failed dispatch's requests cannot finish — fail
+                    # them into their adapter ladders now.
+                    report["requests_failed"] = \
+                        sched.fail_active_requests(fail_err)
+                else:
+                    drained = sched.quiesce(self.quiesce_timeout_s)
+                    report["quiesced_clean"] = drained
+                    if not drained:
+                        report["requests_failed"] = \
+                            sched.force_fail_active(
+                                fail_err, timeout_s=5.0)
+            if not (on_sched_thread and sched is not None
+                    and sched._lock_held):
+                # Serialize against direct generate_batch callers; the
+                # scheduler thread already holds the serve lock on the
+                # crash path.
+                lock = getattr(engine, "_serve_lock", None)
+                if lock is not None:
+                    if not lock.acquire(timeout=self.quiesce_timeout_s):
+                        raise TimeoutError(
+                            f"engine {name!r} serve lock never freed — "
+                            "an in-flight turn outlived the quiesce "
+                            "window; restart aborted")
+                    own_lock = True
+
+            # --- evacuate ---
+            tier = getattr(engine, "kv_offload", None)
+            if tier is not None:
+                try:
+                    manifest = tier.evacuate()
+                    evac_sessions = list(manifest["sessions"])
+                    report["evacuated"] = {
+                        "sessions": len(evac_sessions),
+                        "pages_moved": manifest["pages_moved"],
+                        "host_bytes": manifest["host_bytes"],
+                    }
+                except Exception as e:  # noqa: BLE001 — dead pool
+                    # A lost device can make the pool unreadable: KV
+                    # still resident in it is gone (those sessions'
+                    # next turn re-prefills from the transcript /
+                    # journal). Sessions ALREADY fully host-resident
+                    # survive the pool — adopt() grafts them onto the
+                    # fresh tier below and they restore normally, so
+                    # they count recovered, not lost.
+                    recoverable = set(tier.restorable_sessions())
+                    kv = getattr(engine, "kv", None)
+                    lost = set()
+                    if kv is not None:
+                        from .kvcache import session_of
+                        try:
+                            lost = {session_of(n)
+                                    for n in kv.slot_names()} - {""}
+                        except Exception:  # noqa: BLE001
+                            pass
+                    lost |= set(tier.spilled_sessions())
+                    lost -= recoverable
+                    evac_sessions = sorted(recoverable)
+                    report["evacuation_error"] = str(e)[:200]
+                    report["sessions_lost"] = len(lost)
+                    self._note_lost(len(lost))
+
+            # --- rebuild (bounded exponential backoff) ---
+            build = rebuild
+            if build is None:
+                cfg = getattr(engine, "_engine_config", None)
+                if cfg is None:
+                    raise RuntimeError(
+                        f"engine {name!r} has no rebuild recipe "
+                        "(_engine_config) — construct it via "
+                        "from_config/get_engine or pass rebuild=")
+                build = lambda: type(engine).from_config(dict(cfg))  # noqa: E731
+            new_engine = None
+            last_err: Optional[BaseException] = None
+            for attempt in range(self.build_attempts):
+                try:
+                    new_engine = build()
+                    break
+                except Exception as e:  # noqa: BLE001 — budgeted
+                    last_err = e
+                    telemetry.recorder().record(
+                        "supervisor_rebuild_failed", engine=name,
+                        attempt=attempt, error=str(e)[:200])
+                    if attempt + 1 < self.build_attempts:
+                        time.sleep(self.backoff_s
+                                   * (self.backoff_mult ** attempt))
+            if new_engine is None:
+                st.failed_restarts += 1
+                raise RuntimeError(
+                    f"engine {name!r} rebuild failed after "
+                    f"{self.build_attempts} attempt(s): {last_err}"
+                ) from last_err
+
+            # --- adopt + warm + restore ---
+            new_engine._engine_config = getattr(
+                engine, "_engine_config", None)
+            if getattr(engine, "_engine_cache_key", None):
+                new_engine._engine_cache_key = engine._engine_cache_key
+            new_tier = getattr(new_engine, "kv_offload", None)
+            if tier is not None and new_tier is not None:
+                adopted = new_tier.adopt(tier)
+                report["adopted_sessions"] = len(adopted)
+            if warm_batches is not None:
+                new_engine.warmup(batch_sizes=tuple(warm_batches))
+            restored = 0
+            if new_tier is not None and evac_sessions:
+                for s in evac_sessions:
+                    try:
+                        restored += 1 if new_tier.restore_session(s) \
+                            else 0
+                    except Exception:  # noqa: BLE001 — lazy restore
+                        # The record was re-filed intact (restore is
+                        # all-or-nothing): it restores at the session's
+                        # next submit through _prepare_batch instead.
+                        pass
+                report["restored_sessions"] = restored
+                self._note_recovered(len(evac_sessions))
+
+            # --- swap + re-attach ---
+            if own_lock:
+                engine._serve_lock.release()
+                own_lock = False
+            elif (on_sched_thread and sched is not None
+                  and sched._lock_held):
+                sched._release_engine()
+            from . import replace_engine
+            replace_engine(engine, new_engine)
+            cfg = getattr(engine, "_engine_config", None)
+            if cfg is not None:
+                try:
+                    from . import get_breaker
+                    get_breaker(cfg).reset()
+                except Exception:  # noqa: BLE001 — breaker is advisory
+                    pass
+            if sched is not None:
+                sched.reattach_engine(new_engine)
+            report["ok"] = True
+        except BaseException as e:
+            report["ok"] = False
+            report["error"] = str(e)[:200]
+            if own_lock:
+                engine._serve_lock.release()
+            st.restarts += 1
+            if st.restarts >= self.max_restarts:
+                # This failed cycle consumed the last budget: fail the
+                # sessions with the classified reason NOW instead of
+                # letting the next trigger discover the corpse.
+                self._mark_dead(st, engine, sched, cause=e)
+                report["dead"] = True
+            elif sched is not None:
+                # Budget remains: leave the (old) engine serving its
+                # ladder — admission reopens so queued sessions fail
+                # through their adapters rather than starving.
+                sched.reopen_admission()
+            self._finish(st, report, t0, reason)
+            if st.dead:
+                raise EngineDead(
+                    f"engine {name!r} is dead: {st.dead_reason}",
+                    kind=st.dead_kind) from e
+            raise
+        # --- resume ---
+        st.restarts += 1
+        st.consecutive_hangs = 0
+        if sched is not None:
+            sched.reopen_admission()
+        self._finish(st, report, t0, reason)
+        return report
+
+    # --- bookkeeping ---
+
+    def _mark_dead(self, st: _EngineState, engine, sched,
+                   cause: Optional[BaseException]) -> None:
+        st.dead = True
+        st.dead_kind = (classify_error(cause) if cause is not None
+                        else "unknown")
+        st.dead_reason = (
+            f"restart budget exhausted ({st.restarts} restart(s), "
+            f"budget {self.max_restarts})"
+            + (f": {str(cause)[:200]}" if cause else ""))
+        dead = EngineDead(
+            f"engine {st.name!r} is dead: {st.dead_reason}",
+            kind=st.dead_kind)
+        lost = 0
+        if sched is not None:
+            # Sessions fail with a CLEAN classified error, not a
+            # timeout: queued requests reject now; actives fail
+            # directly when we ARE the loop thread (the crash path —
+            # posting a mailbox to ourselves and waiting on it would
+            # stall serving for the full timeout and count nothing),
+            # else on the loop's next health check.
+            lost += sched.reject_queued(dead)
+            if threading.current_thread() is sched._thread:
+                lost += sched.fail_active_requests(dead)
+            else:
+                lost += sched.force_fail_active(dead, timeout_s=5.0)
+            sched.reopen_admission()  # submit gate fails fast instead
+        self._note_lost(lost)
+        cfg = getattr(engine, "_engine_config", None)
+        if cfg is not None:
+            try:
+                from . import get_breaker
+                get_breaker(cfg).trip(dead)
+            except Exception:  # noqa: BLE001 — breaker is advisory
+                pass
+        telemetry.set_gauge("roundtable_engine_dead", 1.0,
+                            engine=st.name)
+        telemetry.recorder().record(
+            "supervisor_engine_dead", engine=st.name,
+            reason=st.dead_reason)
+
+    def _note_recovered(self, n: int) -> None:
+        if n:
+            self.sessions_recovered += n
+            telemetry.inc("roundtable_sessions_recovered_total", n)
+
+    def _note_lost(self, n: int) -> None:
+        if n:
+            self.sessions_lost += n
+            telemetry.inc("roundtable_sessions_lost_total", n)
+
+    def _finish(self, st: _EngineState, report: dict, t0: float,
+                reason: str, counted: bool = True) -> None:
+        """counted=False records history + the flight dump but keeps
+        the restart totals and the recovery-wall histogram honest: a
+        request REFUSED at entry (budget already exhausted) is not a
+        restart cycle."""
+        wall = time.monotonic() - t0
+        st.last_restart_s = round(wall, 3)
+        report["wall_s"] = round(wall, 3)
+        st.note_history({k: report.get(k) for k in
+                         ("reason", "restart", "ok", "dead", "wall_s",
+                          "cause", "error", "restored_sessions")})
+        if counted:
+            self.restarts += 1
+            _note_restart()
+            telemetry.inc("roundtable_engine_restarts_total",
+                          reason=reason)
+            telemetry.REGISTRY.observe(
+                "roundtable_engine_restart_seconds", wall)
+        # Every restart is an incident with a postmortem (the PR-5
+        # flight-recorder discipline): the dump carries the ring —
+        # scheduler decisions, the triggering fault — plus this report.
+        telemetry.flight_dump("supervisor", extra=dict(report))
+
+
+# --- process-global supervisor (the breaker-registry pattern) ---
+
+_supervisor: Optional[EngineSupervisor] = None
+_supervisor_lock = threading.Lock()
+
+
+def supervisor() -> EngineSupervisor:
+    """The process supervisor singleton — schedulers and fleet surfaces
+    share one budget/history store, exactly like the breaker cache."""
+    global _supervisor
+    with _supervisor_lock:
+        if _supervisor is None:
+            _supervisor = EngineSupervisor()
+        return _supervisor
+
+
+def set_supervisor(sup: Optional[EngineSupervisor]) -> None:
+    """Install a configured supervisor (tests, operators tuning
+    budgets). None restores a fresh default on next use."""
+    global _supervisor
+    with _supervisor_lock:
+        _supervisor = sup
+
+
+def engine_dead_reason(engine) -> Optional[str]:
+    """Why `engine` is beyond restarting, without constructing a
+    supervisor (the scheduler's submit-gate fast path: one lock + dict
+    probe when supervision has never run)."""
+    with _supervisor_lock:
+        sup = _supervisor
+    return sup.dead_reason(engine) if sup is not None else None
+
+
+def supervisor_snapshot() -> dict[str, Any]:
+    """fleet_health's view: never constructs state, cheap when nothing
+    has ever restarted."""
+    with _supervisor_lock:
+        sup = _supervisor
+    if sup is None:
+        return {"restarts": 0, "sessions_recovered": 0,
+                "sessions_lost": 0, "dead_engines": 0, "engines": []}
+    return sup.snapshot()

@@ -700,6 +700,15 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "perf": "roundtable_compiles_total / "
                 "roundtable_steady_state_compiles_total series "
                 "(engine/compile_watch summary roll-up)",
+        # ISSUE 12: the supervisor's restart history roll-up —
+        # counters move in lockstep with EngineSupervisor._finish /
+        # _mark_dead (the single writers for both stores).
+        "supervisor": "roundtable_engine_restarts_total{reason=...} / "
+                      "roundtable_engine_restart_seconds / "
+                      "roundtable_sessions_recovered_total / "
+                      "roundtable_sessions_lost_total / "
+                      "roundtable_engine_dead gauge "
+                      "(engine/supervisor snapshot)",
     },
     "scheduler_describe": {
         "admitted": "roundtable_sched_admitted_total",
@@ -727,6 +736,14 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "spills": "roundtable_sched_spills_total",
         "spilled_sessions": "roundtable_kv_spilled_sessions gauge "
                             "(kv_offload tier)",
+        # ISSUE 12: admission-gate + durable-journal provenance.
+        "paused": "pause_admission/reopen_admission flight events "
+                  "(gate reason string; None = open)",
+        "journal_turns": "roundtable_journal_turns_total "
+                         "(counter is fleet-wide; the describe key is "
+                         "THIS scheduler's share)",
+        "journal_errors": "roundtable_journal_errors_total "
+                          "(same per-scheduler split)",
         "events": "flight recorder ring (sched_* kinds)",
     },
     # engine.describe()["spec_decode"] (ISSUE 9): the speculation
