@@ -389,6 +389,211 @@ def measure_served(min_turns: int = 20, budget=None,
 
 
 
+# --- sampled-traffic speculative-decoding A/B (ISSUE 13 satellite) ---
+
+TREE_ARTIFACT = ROOT / "TREE_r13.json"
+
+SPEC_TREE = {"branch": 2, "depth": 3}
+
+
+def measure_spec_ab(budget=None, flush=None, sessions=3,
+                    turns_per_session=2, max_new=48) -> dict:
+    """The honest-acceptance A/B (ISSUE 13): SAMPLED (temperature 0.7 /
+    top_p 0.95) traffic from the trained realweights checkpoint through
+    the REAL SessionScheduler spec phase, one arm per drafter config —
+    the PR-9 n-gram chain, the draft-model chain, draft-model + tree
+    verify, and the LoRA draft head (zero-init distillation
+    placeholder: its proposals ARE base greedy, the well-distilled
+    limit, served through the PR-10 store at rank*(in+out) bytes).
+
+    The headline is accepted tokens PER VERIFY DISPATCH on sampled
+    traffic (scripted acceptance 1.0 is explicitly NOT evidence — see
+    BENCH_NOTES.md): prompts are fresh build_system_prompt transcripts
+    the n-gram drafter has never seen repeat, so its lookup collapses
+    exactly the way real serving makes it collapse, while the model
+    drafter's acceptance is the sampler's peakedness. Greedy parity
+    (spec-on == spec-off byte-identical) and the kill-switch's
+    zero-dispatch restoration ride the same record."""
+    import numpy as np  # noqa: F401 — engine deps resolved before arms
+
+    from theroundtaible_tpu.engine import deadlines
+    from theroundtaible_tpu.engine.engine import InferenceEngine
+    from theroundtaible_tpu.engine.sampling import SamplingParams
+    from theroundtaible_tpu.engine.scheduler import SessionScheduler
+
+    if budget is None:
+        budget = deadlines.Budget.root(None, rung="discussion")
+
+    base_cfg = {
+        "model": "tiny-llama", "checkpoint": str(CKPT_DIR),
+        "max_seq_len": 512, "num_slots": 4, "dtype": "float32",
+        "kv_layout": "paged",
+        # Headroom past the slots' own demand so tree verify's loaned
+        # private pages come from a real free list (a loan-starved pool
+        # silently degrades every row to chain).
+        "num_pages": 40,
+        "sampling": {"temperature": 0.7, "top_p": 0.95,
+                     "max_new_tokens": max_new},
+    }
+    lora_cfg = {"max_adapters": 2, "rank": 8, "scale": 1.0,
+                "adapters": {"drafthead": {"seed": 7, "init_std": 0.0}}}
+    arms = [
+        ("ngram_chain", True, None),
+        ("model_chain", {"drafter": "model"}, None),
+        ("model_tree", {"drafter": "model", "tree": dict(SPEC_TREE)},
+         None),
+        ("lora_tree", {"drafter": "lora", "adapter": "drafthead",
+                       "tree": dict(SPEC_TREE)}, lora_cfg),
+    ]
+
+    # SAME sampled-traffic prompt set for every arm: fresh production
+    # prompts (build_system_prompt + sampled transcript rounds) the
+    # drafters have never seen — seeded so the A/B compares drafters,
+    # not prompt luck.
+    rng = random.Random(1313)
+    prompt_sets = []
+    for _ in range(sessions):
+        prompt_sets.append([
+            (f"knight-{k}", make_prompt_and_reply(rng)[0])
+            for k in range(turns_per_session)])
+
+    def run_arm(name, spec_cfg, lora, greedy=False):
+        cfg = dict(base_cfg, spec_decode=spec_cfg)
+        if lora:
+            cfg["lora"] = dict(lora)
+        if greedy:
+            cfg = dict(cfg, sampling=dict(cfg["sampling"],
+                                          temperature=0.0, top_p=1.0))
+        engine = InferenceEngine.from_config(cfg)
+        sched = SessionScheduler(engine)
+        sp = SamplingParams(
+            temperature=cfg["sampling"]["temperature"],
+            top_p=cfg["sampling"]["top_p"], max_new_tokens=max_new)
+        by_round = []
+        tokens = 0
+        texts_all = []
+        t0 = time.time()
+        try:
+            for si, turns in enumerate(prompt_sets):
+                if budget.expired:
+                    break
+                before = engine.spec_describe()
+                texts, stats = sched.submit(
+                    f"{name}-s{si}", turns, max_new_tokens=max_new,
+                    sampling_per_turn=[sp] * len(turns))
+                texts_all.append(texts)
+                tokens += stats.decode_tokens
+                after = engine.spec_describe()
+                dd = (after["verify_dispatches"]
+                      - before["verify_dispatches"])
+                da = after["accepted_tokens"] - before["accepted_tokens"]
+                dr = after["drafted_tokens"] - before["drafted_tokens"]
+                by_round.append({
+                    "session": si, "verify_dispatches": dd,
+                    "accepted": da, "drafted": dr,
+                    "acceptance_rate": round(da / dr, 3) if dr else None,
+                    "accepted_per_dispatch": (round(da / dd, 3)
+                                              if dd else None)})
+        finally:
+            sched.close()
+        wall = time.time() - t0
+        info = engine.spec_describe()
+        disp = info["verify_dispatches"]
+        return {
+            "drafter": info["drafter"],
+            "tree": info["tree"],
+            "drafter_reason": info["drafter_reason"],
+            "verify_dispatches": disp,
+            "draft_dispatches": info["draft_dispatches"],
+            "drafted_tokens": info["drafted_tokens"],
+            "accepted_tokens": info["accepted_tokens"],
+            "acceptance_rate": info["acceptance_rate"],
+            "accepted_per_dispatch": (
+                round(info["accepted_tokens"] / disp, 3) if disp
+                else 0.0),
+            "tree_rows": info["tree_rows"],
+            "tree_nodes": info["tree_nodes"],
+            "throttled_rows": info["throttled_rows"],
+            "decode_tokens": tokens,
+            "accepted_tok_s": round(
+                info["accepted_tokens"] / max(wall, 1e-9), 2),
+            "tok_s": round(tokens / max(wall, 1e-9), 2),
+            "wall_s": round(wall, 2),
+            "acceptance_by_round": by_round,
+        }, texts_all
+
+    record = {
+        "config": "sampled-traffic spec A/B on trained realweights "
+                  "(ISSUE 13)",
+        "traffic": {"sessions": sessions,
+                    "turns_per_session": turns_per_session,
+                    "max_new": max_new,
+                    "sampling": base_cfg["sampling"],
+                    "note": "fresh production prompts per session; "
+                            "identical prompt set across arms"},
+        "tree": dict(SPEC_TREE),
+        "arms": {},
+        "partial": True,
+    }
+
+    def _flush():
+        if flush is not None:
+            flush(record)
+
+    for name, spec_cfg, lora in arms:
+        if budget.expired:
+            record["budget_exhausted"] = True
+            break
+        print(f"  arm {name}...", flush=True)
+        record["arms"][name], _texts = run_arm(name, spec_cfg, lora)
+        _flush()
+
+    # Greedy parity: spec-off vs model+tree spec-on must be
+    # byte-identical (the output-invariance contract) on this REAL
+    # checkpoint.
+    parity = None
+    if not budget.expired:
+        print("  greedy parity check...", flush=True)
+        off_arm, off_texts = run_arm("parity_off", False, None,
+                                     greedy=True)
+        on_arm, on_texts = run_arm(
+            "parity_on", {"drafter": "model",
+                          "tree": dict(SPEC_TREE)}, None, greedy=True)
+        parity = {
+            "identical": off_texts == on_texts,
+            "spec_off_dispatches": off_arm["verify_dispatches"],
+            "spec_on_accepted": on_arm["accepted_tokens"],
+        }
+        record["greedy_parity"] = parity
+        _flush()
+
+    # Kill-switch restoration: spec_decode off serves ZERO verify
+    # dispatches (the record's honesty witness for the baseline arm).
+    if parity is not None:
+        record["kill_switch"] = {
+            "verify_dispatches": parity["spec_off_dispatches"],
+            "zero": parity["spec_off_dispatches"] == 0,
+        }
+
+    a = record["arms"]
+    if "ngram_chain" in a and ("model_tree" in a or "lora_tree" in a):
+        best_tree = max(
+            (a[k]["accepted_per_dispatch"]
+             for k in ("model_tree", "lora_tree") if k in a))
+        record["meets"] = bool(
+            best_tree > a["ngram_chain"]["accepted_per_dispatch"]
+            and (parity is None or parity["identical"])
+            and record.get("kill_switch", {}).get("zero", True))
+        record["headline"] = {
+            "ngram_chain_accepted_per_dispatch":
+                a["ngram_chain"]["accepted_per_dispatch"],
+            "best_tree_accepted_per_dispatch": best_tree,
+        }
+    record["partial"] = False
+    _flush()
+    return record
+
+
 # --- tiny per-persona LoRA training (ISSUE 10 satellite) ---
 
 # Persona flavors for --train-lora: each gets a reply corpus skewed to
@@ -561,7 +766,47 @@ def main() -> int:
                          "for the ROUNDTABLE_BENCH_LORA bench "
                          "(serve with lora scale 1.0)")
     ap.add_argument("--lora-steps", type=int, default=60)
+    ap.add_argument("--spec", action="store_true",
+                    help="sampled-traffic speculative-decoding A/B "
+                         "(ISSUE 13): ngram chain vs draft-model chain "
+                         "vs model/LoRA tree verify on the cached "
+                         "checkpoint, through the real scheduler — "
+                         "writes TREE_r13.json (acceptance by round, "
+                         "accepted tok/s, greedy parity, kill-switch)")
     args = ap.parse_args()
+
+    if args.spec:
+        if not (CKPT_DIR / "model.safetensors").exists():
+            print(json.dumps({
+                "metric": "spec_tree_ab", "value": 0.0,
+                "unit": "status", "status": "no_cached_checkpoint",
+                "detail": {"fix": "run bench_realweights.py "
+                                  "--train-only first"}}), flush=True)
+            return 0
+        from theroundtaible_tpu.engine import deadlines
+        budget = deadlines.Budget.root(
+            args.budget_s if args.budget_s > 0 else None,
+            rung="discussion")
+        rec = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())}
+
+        def flush_tree(r):
+            rec.update(r)
+            TREE_ARTIFACT.write_text(json.dumps(rec, indent=2))
+
+        out = measure_spec_ab(budget=budget, flush=flush_tree)
+        print(json.dumps({
+            "metric": "spec_tree_accepted_per_dispatch",
+            "value": out.get("headline", {}).get(
+                "best_tree_accepted_per_dispatch", 0.0),
+            "unit": "tokens/verify-dispatch",
+            "baseline_ngram": out.get("headline", {}).get(
+                "ngram_chain_accepted_per_dispatch"),
+            "meets": out.get("meets"),
+            "partial": bool(out.get("budget_exhausted")),
+            "artifact": TREE_ARTIFACT.name,
+        }), flush=True)
+        return 0
 
     if args.train_lora:
         if not (CKPT_DIR / "config.json").exists():

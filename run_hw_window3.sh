@@ -69,6 +69,22 @@ run_step "bench_discuss.py (multi-LoRA A/B)" \
 # this record is KVQ_r11.json.
 run_step "bench_discuss.py (KV-quant A/B)" \
   env ROUNDTABLE_BENCH_KV_QUANT=1 python bench_discuss.py
+# Draft-model + tree speculation A/B (ISSUE 13): SAMPLED realweights
+# traffic through the scheduler — ngram chain vs draft-model chain vs
+# model/LoRA tree verify. On-chip the headline is accepted tokens per
+# verify dispatch on sampled traffic (the CPU twin is TREE_r13.json;
+# scripted acceptance 1.0 is disallowed as evidence, BENCH_NOTES.md)
+# plus greedy parity and the kill-switch zero-dispatch bit. Needs the
+# cached checkpoint, so it runs after the probe loop and before the
+# long realweights serve.
+run_step "bench_realweights.py --spec (tree-spec A/B)" \
+  timeout 900 python bench_realweights.py --spec --budget-s 840
+git add TREE_r13.json 2>/dev/null && \
+  git commit -q -o TREE_r13.json \
+    -m "Hardware window 3: on-chip tree-speculation A/B artifact
+
+No-Verification-Needed: measurement artifact only, no source change" \
+  || true
 # 1500 s: the 900 s budget SIGTERMed twice — host-side training alone
 # is ~330 s and first-time tunnel compiles are 20-40 s per prefill
 # shape bucket. Still LAST so even a hang costs no core measurement.

@@ -279,6 +279,17 @@ def _spec_decode_guard(request):
         f"{spec_mod.drafted_seen()} drafted): speculation silently "
         "served 1-token decode — mark allow_cold=True only for "
         "rejection/throttle units")
+    if marker.kwargs.get("tree") and not marker.kwargs.get("allow_chain"):
+        # ISSUE 13: a test CLAIMING tree-verify coverage must have
+        # walked a MULTI-NODE accepted path (>= 2 edges) at least once
+        # — single-edge acceptance is indistinguishable from a lucky
+        # chain, so a silent degrade-to-chain (no free pages, no
+        # root-distinct proposals) would make the tree claims vacuous.
+        assert spec_mod.tree_accepted_paths_seen() > 0, (
+            "spec_decode(tree=True)-marked test never accepted a "
+            f"multi-node tree path ({spec_mod.tree_nodes_seen()} tree "
+            "nodes packed): tree verify silently degraded to chain — "
+            "mark allow_chain=True only for chain-only units")
 
 
 @pytest.fixture(autouse=True)

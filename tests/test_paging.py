@@ -131,6 +131,75 @@ class TestAllocator:
             kv.ensure_capacity("b", 32, write_from=0, pinned=("a", "b"))
 
 
+class TestPageLoans:
+    """Raw page loans for tree-verify private path tables (ISSUE 13):
+    free-list-only borrowing (graceful degradation, never eviction),
+    plain-decref returns, and the accepted-path swap_in_page adoption."""
+
+    def test_take_free_pages_never_evicts(self):
+        kv = make_cache(num_slots=4, num_pages=9)   # 8 usable pages
+        kv.acquire("a")
+        kv.ensure_capacity("a", 96, write_from=0)   # 6 of 8 pages
+        free = kv.free_pages()
+        loan = kv.take_free_pages(2)
+        assert loan is not None and len(loan) == 2
+        assert kv.free_pages() == free - 2
+        # A loan larger than the free list returns None and takes
+        # NOTHING — resident slots and the free list are untouched.
+        assert kv.take_free_pages(free) is None
+        assert kv.free_pages() == free - 2
+        assert "a" in kv._slots
+        kv.give_back_pages(loan)
+        assert kv.free_pages() == free
+
+    def test_swap_in_page_adopts_loan_and_frees_old(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 40, write_from=0)   # 3 pages
+        old = kv._slots["a"].pages[1]
+        free = kv.free_pages()
+        [loan] = kv.take_free_pages(1)
+        kv.swap_in_page("a", 1, loan)
+        assert kv._slots["a"].pages[1] == loan
+        # The exclusive old page freed; the loan's reference became the
+        # slot's mapping reference — net free count is unchanged (one
+        # out on loan-now-resident, one back from the old mapping).
+        assert kv.free_pages() == free
+        assert old in kv._free_by_replica[0]
+        kv.release("a")
+        assert kv.pages_in_use() == 0
+
+    def test_swap_in_page_keeps_shared_old_page_alive(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 64, write_from=0)
+        kv.commit("a", list(range(64)))
+        kv.acquire("b")
+        kv.alias_span("a", "b", 0, 48)              # pages shared a<->b
+        shared = kv._slots["b"].pages[1]
+        [loan] = kv.take_free_pages(1)
+        kv.swap_in_page("b", 1, loan)
+        # b's mapping moved to the loan; a (the other holder) keeps the
+        # original page — decref, never force-free.
+        assert kv._slots["a"].pages[1] == shared
+        assert shared not in kv._free_by_replica[0]
+        assert kv.refcount(shared) == 1
+
+    def test_give_back_after_swap_does_not_double_free(self):
+        kv = make_cache()
+        kv.acquire("a")
+        kv.ensure_capacity("a", 40, write_from=0)
+        loan = kv.take_free_pages(2)
+        kv.swap_in_page("a", 0, loan[0])
+        # The settlement path gives back only the UNUSED loan — the
+        # swapped page's reference now belongs to the slot mapping.
+        kv.give_back_pages(loan[1:])
+        assert loan[0] not in kv._free_by_replica[0]
+        assert loan[1] in kv._free_by_replica[0]
+        kv.release("a")
+        assert loan[0] in kv._free_by_replica[0]
+
+
 class TestPagedEngineParity:
     """The paged engine must produce byte-identical greedy output to the
     contiguous engine — same model, same seed, every serving feature."""

@@ -1,4 +1,4 @@
-"""Speculative decoding on the shared batch (ISSUE 9).
+"""Speculative decoding on the shared batch (ISSUE 9 + ISSUE 13).
 
 Covers the tentpole end to end: the n-gram self-drafter, the acceptance
 rule, the static-width verify program on the PR-8 ragged seam, the
@@ -9,6 +9,14 @@ sessions' accepted history intact, and a prefix-cache attach of a
 transcript partially produced by accepted drafts), STRICT no-compile
 across acceptance drift, and the kill-switch's zero-spec-dispatch
 restoration.
+
+ISSUE 13 adds: the `spec_decode:` dict resolution (drafter + tree
+shape), the Drafter protocol (draft_paths root-branching), the tree
+acceptance walk, the device-batched model/LoRA drafters on the shared
+engine, tree verify through the scheduler with loaned-page private
+tables (multi-node acceptance + parity + loan settlement), the
+throttle's re-probe hysteresis, EOS/budget accepted-token accounting
+on tree walks, and STRICT across drafter hot-swap.
 """
 
 import threading
@@ -571,3 +579,477 @@ def test_publish_mixed_sample_splits_accepted_vs_dispatch(monkeypatch):
     snap = telemetry.REGISTRY.snapshot_compact()
     assert not any(k.startswith("roundtable_spec_accepted_tps")
                    and "spec-test" in k for k in snap)
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: spec_decode dict resolution / drafter protocol / tree walk
+# ---------------------------------------------------------------------------
+
+
+class TestSpecOptions:
+    def test_bool_config_resolves_to_ngram_chain(self):
+        opts = sd.SpecOptions.resolve(True)
+        assert opts.drafter == "ngram" and opts.tree is None
+
+    def test_dict_config_resolves_drafter_and_tree(self):
+        opts = sd.SpecOptions.resolve(
+            {"drafter": "model", "tree": {"branch": 3, "depth": 2},
+             "max_draft": 5, "draft_checkpoint": "/x"})
+        assert opts.drafter == "model"
+        assert opts.tree == {"branch": 3, "depth": 2}
+        assert opts.max_draft == 5 and opts.draft_checkpoint == "/x"
+
+    def test_unknown_drafter_raises(self):
+        with pytest.raises(ValueError, match="drafter"):
+            sd.SpecOptions.resolve({"drafter": "oracle"})
+
+    def test_tree_validation(self):
+        with pytest.raises(ValueError, match="branch"):
+            sd.SpecOptions.resolve({"tree": {"branch": 1}})
+        with pytest.raises(ValueError, match="depth"):
+            sd.SpecOptions.resolve({"tree": {"branch": 2, "depth": 0}})
+        with pytest.raises(ValueError, match="tree"):
+            sd.SpecOptions.resolve({"tree": [2, 2]})
+
+    def test_lora_drafter_needs_adapter_name(self):
+        with pytest.raises(ValueError, match="adapter"):
+            sd.SpecOptions.resolve({"drafter": "lora"})
+
+    def test_enabled_key_keeps_kill_switch_live(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_DECODE", "0")
+        assert not sd.spec_enabled({"drafter": "model"})
+        assert sd.spec_enabled({"drafter": "model", "enabled": True})
+        monkeypatch.delenv("ROUNDTABLE_SPEC_DECODE")
+        assert not sd.spec_enabled({"enabled": False})
+
+    def test_engine_rejects_tree_deeper_than_score_width(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        with pytest.raises(ValueError, match="depth"):
+            InferenceEngine(cfg, num_slots=2, kv_layout="paged",
+                            mesh_shape={"data": 1, "model": 1},
+                            spec_max_draft=2,
+                            spec_decode={"tree": {"branch": 2,
+                                                  "depth": 3}})
+
+    def test_dict_max_draft_feeds_engine_static(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        eng = InferenceEngine(cfg, num_slots=2, kv_layout="paged",
+                              mesh_shape={"data": 1, "model": 1},
+                              spec_decode={"max_draft": 2})
+        assert eng.spec_max_draft == 2
+
+    def test_tree_statics_are_config_functions(self):
+        cfg = get_model_config("tiny-gemma", **MODEL_KW)
+        eng = InferenceEngine(cfg, num_slots=4, kv_layout="paged",
+                              mesh_shape={"data": 1, "model": 1},
+                              spec_decode={"tree": {"branch": 2,
+                                                    "depth": 3}})
+        assert eng.spec_branch == 2
+        assert eng.spec_s_max == 4 * 2 + 1
+        assert eng.spec_copy_slots == 4 * (2 - 1)
+        # Chain engines keep the PR-9 shapes exactly.
+        chain = InferenceEngine(cfg, num_slots=4, kv_layout="paged",
+                                mesh_shape={"data": 1, "model": 1})
+        assert chain.spec_s_max == 5 and chain.spec_copy_slots == 0
+
+
+class TestDraftPaths:
+    def test_path0_is_byte_identical_to_chain_draft(self):
+        d = NGramDrafter([1, 2, 3, 4, 5, 1, 2, 3])
+        assert d.draft_paths(4, 1) == [d.draft(4)]
+
+    def test_branches_have_distinct_roots(self):
+        # The tail trigram (7,1,2) proposes -> 4 (its prior
+        # occurrence); bigram backoff (1,2) proposes -> 9 — two
+        # root-distinct candidate paths for the tree.
+        d = NGramDrafter([7, 1, 2, 4, 1, 2, 9, 7, 1, 2])
+        paths = d.draft_paths(3, 2)
+        assert len(paths) == 2
+        roots = [p[0] for p in paths]
+        assert set(roots) == {4, 9}
+        # Path 0 stays the chain draft exactly.
+        assert paths[0] == d.draft(3)
+
+    def test_single_continuation_yields_single_path(self):
+        d = NGramDrafter([1, 2, 3, 4, 1, 2])
+        paths = d.draft_paths(3, 3)
+        assert len(paths) == 1 and paths[0][0] == 3
+
+    def test_protocol_conformance(self):
+        assert isinstance(NGramDrafter([]), sd.Drafter)
+
+
+class TestAcceptTree:
+    def test_greedy_walk_descends_matching_path(self):
+        # Two root branches; device tokens follow path 1 for two edges
+        # then diverge -> 3 committed tokens (2 accepted + correction).
+        paths = [[5, 6], [9, 7]]
+        props = [[9, 1, 2], [9, 7, 4]]
+        emit, a, cur = sd.accept_tree(paths, props)
+        assert emit == [9, 7, 4]
+        assert a == 2 and cur == 1
+
+    def test_no_matching_root_emits_correction_only(self):
+        paths = [[5], [9]]
+        props = [[3, 1], [3, 2]]
+        emit, a, cur = sd.accept_tree(paths, props)
+        assert emit == [3] and a == 0 and cur == 0
+
+    def test_trunk_win_matches_chain_rule(self):
+        paths = [[4, 5, 6]]
+        props = [[4, 5, 1, 7]]
+        emit, a, cur = sd.accept_tree(paths, props)
+        assert (emit, a) == accept_prefix(paths[0], props[0])[0:2] \
+            or (emit, a) == (list(accept_prefix(paths[0], props[0])[0]),
+                             accept_prefix(paths[0], props[0])[1])
+        assert emit == [4, 5, 1] and a == 2 and cur == 0
+
+    def test_deeper_alternate_beats_short_trunk(self):
+        # The trunk dies at the root; the depth-1 alternate matches and
+        # its own next position provides the bonus token.
+        paths = [[5, 6, 7], [8]]
+        props = [[8, 0, 0, 0], [8, 2]]
+        emit, a, cur = sd.accept_tree(paths, props)
+        assert emit == [8, 2] and a == 1 and cur == 1
+
+
+class TestReprobeHysteresis:
+    def _tripped(self):
+        rs = RowSpec([1, 2, 3])
+        # Exactly the tripping dispatch count: a disabled row's later
+        # note()s run the probe branch and would skew the module
+        # reprobe counters the tests below measure relatively.
+        for _ in range(sd.SPEC_MIN_DISPATCHES):
+            rs.note(4, 0)
+        assert rs.disabled
+        return rs
+
+    def test_throttled_row_reprobes_after_interval(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_REPROBE", "4")
+        rs = self._tripped()
+        rs.mark_idle(10)
+        assert not rs.should_draft(11)
+        assert not rs.should_draft(13)
+        assert rs.should_draft(14), "interval elapsed: probe must fire"
+        # Armed until note(): the scheduler's probe + real call agree.
+        assert rs.should_draft(14)
+
+    def test_successful_probe_recovers_with_fresh_window(self,
+                                                         monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_REPROBE", "4")
+        before = sd.reprobe_recoveries_seen()
+        rs = self._tripped()
+        rs.mark_idle(0)
+        assert rs.should_draft(4)
+        rs.note(4, 3)  # probe's own acceptance clears the floor
+        assert not rs.disabled, "probe must re-enable the row"
+        # Fresh window: the stale all-zero history must not re-trip.
+        assert rs.rate() == pytest.approx(0.75)
+        assert not rs.note(4, 3)
+        assert sd.reprobe_recoveries_seen() == before + 1
+
+    def test_failed_probe_waits_a_whole_interval(self, monkeypatch):
+        monkeypatch.setenv("ROUNDTABLE_SPEC_REPROBE", "4")
+        before = sd.reprobes_seen()
+        rs = self._tripped()
+        rs.mark_idle(0)
+        assert rs.should_draft(4)
+        rs.note(4, 0)  # probe fails
+        assert rs.disabled
+        assert sd.reprobes_seen() == before + 1
+        rs.mark_idle(4)
+        assert not rs.should_draft(6), "failed probe must not re-arm"
+        assert rs.should_draft(8)
+
+
+class TestTreeBatchBuilder:
+    def _batch(self, seqs, copy_pairs=None, copy_slots=0):
+        table = np.zeros(4, np.int32)
+        for s in seqs:
+            if s.table is None:
+                s.table = table
+        return build_ragged_batch(
+            seqs, t_budget=64, s_max=5, pages_per_seq=4,
+            scratch_page=0, pad_id=0, page_size=16,
+            score_width=5, copy_pairs=copy_pairs, copy_slots=copy_slots)
+
+    def test_copy_pairs_pad_with_scratch_self_copies(self):
+        b = self._batch([RaggedSeq([9, 4], 0, None, n_scores=2)],
+                        copy_pairs=[(3, 7)], copy_slots=3)
+        assert list(b["copy_src"]) == [3, 0, 0]
+        assert list(b["copy_dst"]) == [7, 0, 0]
+
+    def test_copy_shape_is_composition_independent(self):
+        one = self._batch([RaggedSeq([9, 4], 0, None, n_scores=2)],
+                          copy_pairs=[], copy_slots=3)
+        many = self._batch([RaggedSeq([9, 4], 0, None, n_scores=2),
+                            RaggedSeq([3], 2, None)],
+                           copy_pairs=[(1, 2), (3, 4)], copy_slots=3)
+        assert one["copy_src"].shape == many["copy_src"].shape
+        # Zero live pairs is still the SAME program: arrays present,
+        # all scratch self-copies.
+        assert list(one["copy_src"]) == [0, 0, 0]
+
+    def test_copy_validation(self):
+        with pytest.raises(ValueError, match="copy_slots"):
+            self._batch([RaggedSeq([9], 0, None)],
+                        copy_pairs=[(1, 2), (3, 4)], copy_slots=1)
+        with pytest.raises(ValueError, match="copy_pairs"):
+            self._batch([RaggedSeq([9], 0, None)],
+                        copy_pairs=[(1, 2)], copy_slots=0)
+
+
+# ---------------------------------------------------------------------------
+# the scheduled tree-verify phase (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+class TestScheduledTree:
+    TREE = {"branch": 2, "depth": 3}
+
+    def _run(self, spec, sessions=("s0", "s2"), max_new=70,
+             num_slots=4, **kw):
+        engine = make_engine(num_slots=num_slots, spec_decode=spec, **kw)
+        sched = SessionScheduler(engine)
+        try:
+            out, err = _join_mid_decode(sched, list(sessions),
+                                        max_new=max_new)
+            assert not err, err
+        finally:
+            sched.close()
+        return out, engine
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode(tree=True)
+    def test_model_tree_multi_node_acceptance_and_parity(self):
+        """The ISSUE 13 acceptance core: the draft-model proposer with
+        tree verify serves byte-identical greedy outputs while
+        accepting MULTI-NODE tree paths (the conftest tree guard), with
+        draft dispatches and tree provenance on record."""
+        off, _ = self._run(False)
+        on, eng = self._run({"drafter": "model", "tree": self.TREE})
+        for sid in ("s0", "s2"):
+            assert on[sid][0] == off[sid][0], f"{sid} diverged"
+        info = eng.spec_describe()
+        assert info["drafter"] == "model"
+        assert info["tree"] == self.TREE
+        assert info["tree_rows"] > 0
+        assert info["tree_nodes"] > info["tree_rows"]
+        assert info["draft_dispatches"] > 0
+        assert info["accepted_tokens"] > 0
+        assert sd.tree_accepted_paths_seen() > 0
+        # The drafter-labeled tree series is live in the registry.
+        snap = telemetry.REGISTRY.snapshot_compact()
+        assert any(k.startswith("roundtable_spec_tree_nodes_total")
+                   and "drafter=model" in k for k in snap), snap.keys()
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode(tree=True)
+    def test_lora_drafter_as_hot_swappable_adapter(self):
+        """Drafting as an adapter (ISSUE 13): the draft head is a LoRA
+        pair in the PR-10 store (init_std 0 -> delta exactly zero, the
+        distilled-head placeholder whose proposals equal base greedy),
+        resolved at construction with a residency ref, serving
+        byte-identical outputs with multi-node tree acceptance."""
+        off, _ = self._run(False)
+        spec = {"drafter": "lora", "adapter": "drafthead",
+                "tree": self.TREE}
+        on, eng = self._run(
+            spec, lora={"adapters": {"drafthead": {"seed": 3,
+                                                   "init_std": 0.0}}})
+        assert eng.spec_drafter == "lora", eng.spec_drafter_reason
+        for sid in ("s0", "s2"):
+            assert on[sid][0] == off[sid][0], f"{sid} diverged"
+        info = eng.spec_describe()
+        assert info["drafter"] == "lora"
+        assert info["accepted_tokens"] > 0
+        assert sd.tree_accepted_paths_seen() > 0
+        # Hot-swap away releases the draft head's residency ref.
+        assert eng.lora.slot_of("drafthead") is not None
+        eng.set_spec_drafter("ngram")
+        assert eng.spec_drafter == "ngram"
+        assert eng.lora._refs.get("drafthead", 0) == 0
+
+    @pytest.mark.spec_decode(allow_cold=True)
+    def test_lora_drafter_without_store_falls_back_to_ngram(self):
+        eng = make_engine(num_slots=2,
+                          spec_decode={"drafter": "lora",
+                                       "adapter": "ghost"})
+        assert eng.spec_decode
+        assert eng.spec_drafter == "ngram"
+        assert "lora" in (eng.spec_drafter_reason or "")
+        info = eng.spec_describe()
+        assert info["drafter"] == "ngram"
+        assert info["drafter_reason"] == eng.spec_drafter_reason
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode(tree=True)
+    def test_strict_across_drafter_hot_swap_and_tree_drift(self):
+        """STRICT acceptance line (ISSUE 13): warmup compiles the tree
+        verify + propose programs; steady-state serving across a
+        drafter hot-swap (model -> ngram -> model) and acceptance drift
+        compiles NOTHING — drafter identity, tree composition and
+        acceptance patterns are pure values."""
+        from theroundtaible_tpu.engine import compile_watch
+
+        assert compile_watch.install() != "off"
+        engine = make_engine(num_slots=4,
+                             spec_decode={"drafter": "model",
+                                          "tree": self.TREE})
+        engine.warmup(max_prompt_tokens=256, batch_sizes=(1, 2, 4))
+        sched = SessionScheduler(engine, max_rows=4)
+        try:
+            warm, errs = _join_mid_decode(sched, ["s0", "s1"])
+            assert not errs, f"warm pass failed: {errs}"
+            sched.declare_warmup_complete()
+            assert compile_watch.steady_state_compiles() == 0
+            engine.set_spec_drafter("ngram")
+            r1, errs = _join_mid_decode(sched, ["s2"])
+            assert not errs, errs
+            engine.set_spec_drafter("model")
+            r2, errs = _join_mid_decode(sched, ["s0", "s1", "s2"])
+            assert not errs, errs
+            assert compile_watch.steady_state_compiles() == 0, \
+                "drafter hot-swap or tree drift recompiled mid-serve"
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler
+    @pytest.mark.spec_decode
+    def test_budget_truncation_counts_only_committed(self):
+        """Regression mirror of the PR-9 min(a, len(emit)) fix for the
+        tree walk: a row whose turn budget truncates an accepted path
+        must count only COMMITTED tokens — accepted_tokens can never
+        exceed the decode tokens actually served."""
+        off, _ = self._run(False, max_new=5)
+        on, eng = self._run({"drafter": "model", "tree": self.TREE},
+                            max_new=5)
+        for sid in ("s0", "s2"):
+            assert on[sid][0] == off[sid][0], f"{sid} diverged"
+        info = eng.spec_describe()
+        # Each row commits 5 tokens total, 1 of them at admission: at
+        # most 4 decode-committed tokens per row can be accepted
+        # drafts.
+        assert 0 < info["accepted_tokens"] <= 4 * 2
+
+    @pytest.mark.scheduler(allow_serial=True)
+    @pytest.mark.spec_decode(tree=True)
+    def test_eos_inside_tree_counts_only_committed(self, monkeypatch):
+        """EOS-inside-tree accounting (ISSUE 13 satellite): an accepted
+        path truncated by EOS commits only the tokens up to it, and
+        roundtable_spec_accepted_tokens_total moves by exactly that
+        count (crafted drafter + device tokens through the REAL
+        _run_spec_segment, including the loaned-page settlement of the
+        winning non-trunk path)."""
+        from theroundtaible_tpu.engine.sampling import SamplingParams
+        from theroundtaible_tpu.engine.scheduler import _Row
+        from theroundtaible_tpu.engine.spec_decode import RowSpec
+
+        engine = make_engine(num_slots=2,
+                             spec_decode={"tree": self.TREE})
+        eos = engine.tokenizer.eos_id
+        sched = SessionScheduler(engine)
+        try:
+            name = "eosrow"
+            prompt = [engine.tokenizer.bos_id, 5, 6, 7]
+            engine.kv.ensure_capacity(name, len(prompt) + 64,
+                                      write_from=0)
+            r = _Row(name=name, tokens=prompt,
+                     sampling=SamplingParams(temperature=0.0),
+                     max_new=20, produced=[9], last=9,
+                     valid=len(prompt))
+            r.spec = RowSpec(list(prompt))
+            monkeypatch.setattr(
+                NGramDrafter, "draft_paths",
+                lambda self, n, branch=1: [[11, 12, 13],
+                                           [14, eos, 15]])
+            free_before = sum(len(f)
+                              for f in engine.kv._free_by_replica)
+
+            def fake_dispatch(batch):
+                sw = batch["score_width"]
+                out = np.zeros((engine.spec_s_max, sw), np.int32)
+                # seq 0 = trunk run [9, 11, 12, 13]: the device's root
+                # token is 14 -> the trunk dies immediately.
+                out[0, 0] = 14
+                # seq 1 = alt run [9, 14, eos, 15]: the device follows
+                # the path through eos and past it.
+                out[1, :4] = [14, eos, 15, 99]
+                return out
+
+            monkeypatch.setattr(engine, "_ragged_dispatch",
+                                fake_dispatch)
+            assert sched._run_spec_segment([r])
+            # Walk accepted 3 edges on path 1, EOS truncates to 2
+            # committed tokens: [14, eos].
+            assert r.produced == [9, 14, eos]
+            assert r.done and r.valid == len(prompt) + 2
+            info = engine.spec_describe()
+            assert info["accepted_tokens"] == 2, (
+                "accepted must equal COMMITTED tokens, not walked "
+                "edges")
+            assert info["tree_nodes"] == 6 and info["tree_rows"] == 1
+            # Loan settlement: the winning path's page swapped in, the
+            # rest returned — no page leaked.
+            free_after = sum(len(f)
+                             for f in engine.kv._free_by_replica)
+            assert free_after == free_before
+        finally:
+            sched.close()
+
+    @pytest.mark.scheduler(allow_serial=True)
+    @pytest.mark.spec_decode(allow_cold=True)
+    def test_throttled_row_reprobes_through_scheduler(self, monkeypatch):
+        """Throttle hysteresis satellite: an always-wrong drafter trips
+        the throttle, and the row RE-PROBES every
+        ROUNDTABLE_SPEC_REPROBE committed tokens instead of decoding
+        1-token for the rest of its turn — outputs stay byte-identical
+        (every probe's correction IS the plain-decode token)."""
+        monkeypatch.setenv("ROUNDTABLE_SPEC_REPROBE", "4")
+        engine = make_engine(num_slots=4)
+        # Long enough that a segment BOUNDARY lands past the re-probe
+        # interval with > DECODE_SEGMENT budget remaining — the probe
+        # check only runs at boundaries the pipelined mini-loop
+        # exposes (throttle trips at ~7 tokens; the next boundary sits
+        # one 64-token segment later).
+        baseline = engine.generate_batch(PROMPTS["s0"],
+                                         max_new_tokens=160,
+                                         session="base")
+        bad = engine.cfg.vocab_size - 1
+        monkeypatch.setattr(
+            NGramDrafter, "draft",
+            lambda self, n: [bad] * n if len(self) else [])
+        before = sd.reprobes_seen()
+        sched = SessionScheduler(engine)
+        try:
+            out, err = _join_mid_decode(sched, ["s0"], max_new=160)
+            assert not err, err
+            assert out["s0"][0] == baseline, "probe corrections diverged"
+        finally:
+            sched.close()
+        assert engine.spec_describe()["throttled_rows"] >= 1, \
+            "throttle never tripped"
+        assert sd.reprobes_seen() > before, \
+            "throttled row never re-probed"
+        assert sd.reprobe_recoveries_seen() == 0
+
+    def test_empty_probe_resolves_and_waits_interval(self, monkeypatch):
+        """A probe whose drafter proposes NOTHING must resolve FAILED
+        (review finding): `probing` cannot stay armed forever, or the
+        row pays per-tick draft host work for the rest of its turn."""
+        monkeypatch.setenv("ROUNDTABLE_SPEC_REPROBE", "4")
+        rs = RowSpec([1, 2, 3])
+        for _ in range(sd.SPEC_MIN_DISPATCHES):
+            rs.note(4, 0)
+        assert rs.disabled
+        rs.mark_idle(0)
+        before = sd.reprobes_seen()
+        assert rs.should_draft(4) and rs.probing
+        rs.probe_failed(4)  # drafter returned [] — no dispatch ran
+        assert not rs.probing
+        assert sd.reprobes_seen() == before + 1
+        assert not rs.should_draft(6), "failed empty probe must wait"
+        assert rs.should_draft(8)
+        # No-op on unthrottled rows.
+        fresh = RowSpec([1, 2, 3])
+        fresh.probe_failed(10)
+        assert not fresh.disabled and sd.reprobes_seen() == before + 1

@@ -148,6 +148,7 @@ class InferenceEngine:
             raise ValueError(
                 f"quant must be none|int8|int4, got {quant!r}")
         self.quant = quant
+        self.dtype = dtype
         # int4 path-provenance sink: the trace-time dispatch log every
         # spmd_mesh context below carries (models/common._record_int4) —
         # populated as each (batch, bucket) program traces, summarized
@@ -851,14 +852,15 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,),
                      static_argnames=("greedy", "attn_path",
-                                      "score_width"))
+                                      "score_width", "propose_width"))
             def ragged_step(params, pools, tables, tokens, positions,
                             token_pages, token_offs, token_seq,
                             seq_of_block, block_qstart, query_offsets,
                             kv_valid, last_rows, key, temps, top_ks,
                             top_ps, sample_rows=None, greedy=True,
                             attn_path="kernel", score_width=0,
-                            lora=None):
+                            lora=None, copy_src=None, copy_dst=None,
+                            propose_width=0):
                 from .paged_forward import forward_ragged
                 with spmd_mesh(mesh, int4_sink=self._int4_dispatches), \
                         self._lora_scope(lora):
@@ -871,7 +873,8 @@ class InferenceEngine:
                         attn_path=attn_path,
                         sample_rows=(sample_rows if score_width
                                      else None),
-                        scales=scales_l, quant_spec=_kvq_spec)
+                        scales=scales_l, quant_spec=_kvq_spec,
+                        copy_src=copy_src, copy_dst=copy_dst)
                     lf = logits.astype(jnp.float32)
                     if score_width:
                         # Speculative verify (ISSUE 9): per-position
@@ -895,6 +898,16 @@ class InferenceEngine:
                         nxt = sample_token_batch(
                             lf, key, temps, top_ks,
                             top_ps).astype(jnp.int32)
+                if propose_width:
+                    # Draft-model propose dispatch (ISSUE 13): alongside
+                    # the greedy next token, the top-`propose_width` ids
+                    # of each row's tip distribution seed the root
+                    # branches of the token tree. score_width==0 here
+                    # (propose batches are plain ragged dispatches), so
+                    # lf is [S, V].
+                    tops = jax.lax.top_k(
+                        lf, propose_width)[1].astype(jnp.int32)
+                    return host_read(nxt, tops), new_pools
                 return host_read(nxt), new_pools
 
             self._ragged_step = ragged_step
@@ -907,9 +920,18 @@ class InferenceEngine:
         # host and the static score_width program scores every draft
         # position in one forward. ROUNDTABLE_SPEC_DECODE=0 /
         # spec_decode: False restores 1-token decode byte-identically.
-        from .spec_decode import (DEFAULT_MAX_DRAFT, spec_enabled)
+        from .spec_decode import (DEFAULT_MAX_DRAFT, SpecOptions,
+                                  spec_enabled)
         self.spec_decode = False
         self.spec_reason: Optional[str] = None
+        # The resolved `spec_decode:` block (ISSUE 13): dict configs
+        # choose the drafter + tree shape; the PR-9 bool path resolves
+        # to the ngram chain defaults. Validation raises HERE so
+        # from_config and the constructor fail identically.
+        self.spec_options = SpecOptions.resolve(spec_decode)
+        if spec_max_draft is None and self.spec_options.max_draft \
+                is not None:
+            spec_max_draft = self.spec_options.max_draft
         self.spec_max_draft = (DEFAULT_MAX_DRAFT if spec_max_draft is None
                                else int(spec_max_draft))
         from .serving_loop import RAGGED_BLOCK_Q
@@ -921,10 +943,24 @@ class InferenceEngine:
                 f"spec_max_draft must be 1..{RAGGED_BLOCK_Q - 1} "
                 f"(verify run = drafts+1 tokens in one "
                 f"{RAGGED_BLOCK_Q}-row block), got {self.spec_max_draft}")
+        if (self.spec_options.tree is not None
+                and self.spec_options.tree["depth"] > self.spec_max_draft):
+            # Every root-to-leaf run is 1 + depth tokens and the static
+            # score gather is spec_max_draft + 1 wide — a deeper tree
+            # would need a new compiled width.
+            raise ValueError(
+                f"spec_decode tree depth {self.spec_options.tree['depth']}"
+                f" exceeds spec_max_draft {self.spec_max_draft} (the "
+                f"static score_width must cover every root-to-leaf run)")
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_throttled = 0
         self._spec_dispatches = 0
+        self._spec_tree_nodes = 0
+        self._spec_tree_rows = 0
+        # drafter kind -> [drafted, accepted] (per-proposer attribution
+        # for the labeled acceptance-rate gauge).
+        self._spec_by_drafter: dict[str, list[int]] = {}
         self._spec_recent = _deque(maxlen=32) if kv_layout == "paged" \
             else None
         if kv_layout != "paged":
@@ -935,6 +971,19 @@ class InferenceEngine:
             self.spec_reason = f"ragged:{self.ragged_reason}"
         else:
             self.spec_decode = True
+        # Tree-verify statics (ISSUE 13): on a tree-configured engine
+        # EVERY verify dispatch carries branch-times row capacity and a
+        # fixed block of page-copy slots — how many tree rows (0
+        # included) actually use them is a VALUE, so chain/tree/no-spec
+        # mixes and acceptance drift never compile a new program. Chain
+        # engines keep the PR-9 shapes exactly (branch 1, zero copy
+        # slots — build_ragged_batch then adds no arrays at all).
+        self.spec_tree = (self.spec_options.tree
+                          if self.spec_decode else None)
+        self.spec_branch = (self.spec_tree["branch"]
+                            if self.spec_tree else 1)
+        self.spec_s_max = num_slots * self.spec_branch + 1
+        self.spec_copy_slots = num_slots * (self.spec_branch - 1)
 
         # Per-engine roofline model (ISSUE 6): streamed bytes from the
         # ACTUAL (quantized) tree + chip ceilings, published at event
@@ -977,6 +1026,123 @@ class InferenceEngine:
                 targets=lora_cfg.get("targets"),
                 engine_name=model_cfg.name, perf=self.perf)
             self._lora_quant = self.lora.quant
+
+        # Drafter resolution (ISSUE 13): which proposer actually serves
+        # the speculative phase. Config VALIDATION raised above; drafter
+        # AVAILABILITY falls back to the ngram chain with the reason
+        # recorded (the decline-table discipline) — a missing LoRA store
+        # or unreadable draft checkpoint must degrade serving, never
+        # kill the engine. Resolution runs AFTER the LoRA store exists
+        # so the `lora` drafter can pin its adapter slot.
+        self.spec_drafter = "ngram" if self.spec_decode else None
+        self.spec_drafter_reason: Optional[str] = None
+        self.spec_device_drafter = None
+        if self.spec_decode and self.spec_options.drafter != "ngram":
+            try:
+                self._install_drafter(self.spec_options.drafter,
+                                      adapter=self.spec_options.adapter,
+                                      checkpoint=self.spec_options
+                                      .draft_checkpoint)
+            except Exception as e:  # noqa: BLE001 — degrade, record
+                self.spec_drafter_reason = (
+                    f"{self.spec_options.drafter}:{str(e)[:120]}")
+
+    def _install_drafter(self, kind: str, adapter: Optional[str] = None,
+                         checkpoint: Optional[str] = None) -> None:
+        """Build (or hot-swap to) the `kind` drafter. Drafting is pure
+        VALUES through already-compiled programs — a draft-model params
+        override shares the engine pytree shapes, a LoRA draft head is
+        one more slot in the stacked store — so steady-state swaps
+        compile nothing (the STRICT acceptance line). Raises when the
+        drafter's dependency is missing; callers record the reason and
+        keep the ngram chain."""
+        from .spec_decode import DRAFTER_KINDS, DeviceDrafter
+        if kind not in DRAFTER_KINDS:
+            raise ValueError(
+                f"drafter must be one of {DRAFTER_KINDS}, got {kind!r}")
+        if kind == "ngram":
+            self.spec_device_drafter = None
+            self.spec_drafter = "ngram"
+            self.spec_drafter_reason = None
+            return
+        if kind == "model":
+            draft_params = None
+            if checkpoint:
+                draft_params = self._load_draft_params(checkpoint)
+            self.spec_device_drafter = DeviceDrafter(
+                "model", params=draft_params)
+        else:  # lora
+            if self.lora is None:
+                raise RuntimeError(
+                    f"lora drafter needs a `lora:` store "
+                    f"({self.lora_reason or 'disabled:config'})")
+            if not adapter:
+                raise ValueError("lora drafter needs an adapter name")
+            if not self.lora.resolvable(adapter):
+                self.lora.register(adapter)
+            # Residency ref held for the drafter's lifetime (swap to a
+            # different drafter releases it) — the draft head must not
+            # be LRU-evicted under an in-flight propose dispatch.
+            slot = self.lora.acquire([adapter])[0]
+            self.spec_device_drafter = DeviceDrafter(
+                "lora", adapter_slot=slot)
+            self.spec_device_drafter.adapter_id = adapter
+        self.spec_drafter = kind
+        self.spec_drafter_reason = None
+
+    def set_spec_drafter(self, kind: str,
+                         adapter: Optional[str] = None,
+                         checkpoint: Optional[str] = None) -> None:
+        """Hot-swap the active drafter per workload (ISSUE 13: drafting
+        as an adapter). Values-only — no program recompiles; the old
+        LoRA draft head's residency ref releases so the store can evict
+        it. Raises (state unchanged) when the new drafter's dependency
+        is missing or speculation is off on this engine."""
+        if not self.spec_decode:
+            raise RuntimeError(
+                f"spec_decode is off on this engine ({self.spec_reason})")
+        old = self.spec_device_drafter
+        self._install_drafter(kind, adapter=adapter, checkpoint=checkpoint)
+        if old is not None and old is not self.spec_device_drafter:
+            if (old.kind == "lora" and self.lora is not None
+                    and getattr(old, "adapter_id", None)):
+                self.lora.release([old.adapter_id])
+            # The outgoing device drafter's shadow slots die with it:
+            # _drop_request only releases draft slots while a device
+            # drafter is INSTALLED, so swapping away would otherwise
+            # orphan every live row's draft pages until slot-pressure
+            # eviction (free-list depletion degrades tree verify and
+            # shrinks prefix-cache capacity meanwhile).
+            self._release_draft_slots()
+
+    def _release_draft_slots(self) -> None:
+        """Release every shadow draft slot in the paged pool (hot-swap
+        away from a device drafter; the per-row path at retire is the
+        scheduler's _drop_request)."""
+        from .spec_decode import DRAFT_SCOPE
+        if self.kv_layout != "paged":
+            return
+        for name in list(self.kv._slots):
+            if name.startswith(DRAFT_SCOPE):
+                self.kv.release(name)
+
+    def _load_draft_params(self, checkpoint: str):
+        """Load + shard (+ quantize, matching the engine) a draft
+        checkpoint onto the SAME ModelConfig shapes — the `params`
+        override must be pytree-identical to self.params or the shared
+        ragged program would retrace."""
+        from .checkpoint import load_hf_checkpoint
+        params = load_hf_checkpoint(checkpoint, self.cfg, self.dtype)
+        from .sharding import shard_params
+        params = shard_params(params, self.cfg, self.mesh)
+        if self.quant in ("int8", "int4"):
+            from .quant import quantize_params
+            from .sharding import model_axis_size
+            params = quantize_params(
+                params, self.cfg, act_dtype=self.dtype,
+                free_source=True, bits=8 if self.quant == "int8" else 4,
+                model_shards=model_axis_size(self.mesh))
+        return params
 
     @staticmethod
     def _resolve_attn(model_cfg: ModelConfig, attn: str,
@@ -1246,32 +1412,51 @@ class InferenceEngine:
             temp = 0.0 if greedy else max(self.sampling.temperature, 0.1)
             seqs = [RaggedSeq([bos] + [5] * 23, 0, t0, temperature=temp),
                     RaggedSeq([7], 8, t1, temperature=temp)]
-            batches = [(seqs, 0)]
+            batches = [(seqs, 0, self.kv.num_slots + 1, 0, 0)]
             if self.spec_decode:
-                # Speculative verify programs (ISSUE 9): ONE extra
+                # Speculative verify programs (ISSUE 9 + 13): ONE extra
                 # compiled variant per (shape, mode) — score_width is
-                # the static spec_max_draft+1, so acceptance drift and
-                # per-row throttle flips (mixed 1-draft/k-draft rows)
-                # change only values in steady state.
+                # the static spec_max_draft+1 and, on a tree-configured
+                # engine, s_max/copy_slots are the static branch-scaled
+                # values, so acceptance drift, throttle flips AND
+                # chain/tree composition changes are values in steady
+                # state (chain engines: spec_s_max == num_slots+1 and
+                # zero copy slots — the PR-9 program exactly).
                 r = self.spec_max_draft + 1
                 batches.append((
                     [RaggedSeq([7] * r, 8, t1, temperature=temp,
                                n_scores=r),
                      RaggedSeq([9], 4, t0, temperature=temp,
-                               n_scores=1)], r))
-            for warm_seqs, score_width in batches:
+                               n_scores=1)], r,
+                    self.spec_s_max, self.spec_copy_slots, 0))
+                if self.spec_branch > 1:
+                    # The propose variant (top-k root seeding) the
+                    # DeviceDrafter issues under tree config — warmed
+                    # whenever the tree SHAPE exists, independent of
+                    # which drafter is currently installed, so a
+                    # post-warmup set_spec_drafter('model'|'lora')
+                    # hot-swap stays values-only (no mid-serve
+                    # compile).
+                    batches.append((
+                        [RaggedSeq([7], 8, t1, temperature=temp),
+                         RaggedSeq([9], 4, t0, temperature=temp)],
+                        0, self.kv.num_slots + 1, 0, self.spec_branch))
+            for warm_seqs, score_width, s_max, copy_slots, pw in batches:
                 for shape in self.ragged_shapes:
                     batch = build_ragged_batch(
                         warm_seqs, t_budget=shape,
-                        s_max=self.kv.num_slots + 1,
+                        s_max=s_max,
                         pages_per_seq=self.kv.pages_per_seq,
                         scratch_page=self.kv.scratch_page(0),
                         pad_id=self.tokenizer.pad_id,
                         page_size=self.kv.page_size,
-                        score_width=score_width)
+                        score_width=score_width,
+                        copy_slots=copy_slots)
+                    if pw:
+                        batch["propose_width"] = pw
                     for _ in range(2):
                         nxt = self._ragged_dispatch(batch)
-                        np.asarray(nxt)  # force completion
+                        jax.tree_util.tree_map(np.asarray, nxt)
         self._release_warm_slots()
 
     def _release_warm_slots(self) -> None:
@@ -1437,12 +1622,20 @@ class InferenceEngine:
         from .pallas import attention as pattn
 
         score_width = int(batch.get("score_width", 0) or 0)
+        propose_width = int(batch.get("propose_width", 0) or 0)
+        # Draft-model dispatches (ISSUE 13) ride the SAME compiled
+        # programs with a params VALUE override — the draft checkpoint
+        # shares the engine's pytree shapes by construction.
+        params = (batch["draft_params"]
+                  if batch.get("draft_params") is not None
+                  else self.params)
+        copy_src = batch.get("copy_src")
 
         def run(path):
             if path == "pallas_ragged" and faults.ARMED:
                 faults.maybe_inject("mosaic_compile")
             return self._ragged_step(
-                self.params, self.kv.combined_pools(),
+                params, self.kv.combined_pools(),
                 jnp.asarray(batch["tables"]),
                 jnp.asarray(batch["tokens"]),
                 jnp.asarray(batch["positions"]),
@@ -1464,7 +1657,12 @@ class InferenceEngine:
                            else "xla"),
                 score_width=score_width,
                 lora=self._lora_args(batch["token_adapter"])
-                if self.lora is not None else None)
+                if self.lora is not None else None,
+                copy_src=(jnp.asarray(copy_src)
+                          if copy_src is not None else None),
+                copy_dst=(jnp.asarray(batch["copy_dst"])
+                          if copy_src is not None else None),
+                propose_width=propose_width)
 
         from . import compile_watch
         with compile_watch.label(
@@ -1489,6 +1687,10 @@ class InferenceEngine:
                  "seqs": int(batch["n_seqs"])}
         if score_width:
             entry["spec"] = True
+        if batch.get("draft"):
+            # Draft-model/LoRA proposal dispatch (ISSUE 13): provenance
+            # distinguishes drafting cost from verify cost.
+            entry["draft"] = True
         if path != "pallas_ragged":
             entry["fallback_reason"] = (self.ragged_fallback_reason
                                         or "unknown")
@@ -1562,49 +1764,76 @@ class InferenceEngine:
         return info
 
     def note_spec_dispatch(self, drafted: int, accepted: int,
-                           rows: int) -> None:
+                           rows: int, tree_nodes: int = 0,
+                           tree_rows: int = 0) -> None:
         """Record one verify dispatch's acceptance outcome (the
         scheduler computes it host-side after the read): engine-owned
         provenance sink + the registry counter/gauge series — the
-        int4_paths/ragged pattern, ISSUE 9 telemetry satellite."""
+        int4_paths/ragged pattern, ISSUE 9 telemetry satellite. The
+        counters carry a `drafter` label (ISSUE 13) so an acceptance
+        collapse attributes to the PROPOSER, not the throttle, and tree
+        dispatches additionally count their packed nodes."""
         from . import spec_decode as _sd
         self._spec_drafted += drafted
         self._spec_accepted += accepted
         self._spec_dispatches += 1
+        self._spec_tree_nodes += tree_nodes
+        self._spec_tree_rows += tree_rows
+        drafter = self.spec_drafter or "ngram"
+        # Per-DRAFTER accumulators: the labeled acceptance-rate gauge
+        # must report THIS drafter's rate, not the lifetime blend — a
+        # collapsing post-hot-swap drafter hiding behind a healthy
+        # predecessor's rate is exactly the misattribution the label
+        # exists to prevent.
+        d_tot = self._spec_by_drafter.setdefault(drafter, [0, 0])
+        d_tot[0] += drafted
+        d_tot[1] += accepted
         if self._spec_recent is not None:
-            self._spec_recent.append(
-                {"drafted": drafted, "accepted": accepted, "rows": rows,
-                 "path": self.ragged_path})
+            entry = {"drafted": drafted, "accepted": accepted,
+                     "rows": rows, "path": self.ragged_path,
+                     "drafter": drafter}
+            if tree_rows:
+                entry["tree_rows"] = tree_rows
+                entry["tree_nodes"] = tree_nodes
+            self._spec_recent.append(entry)
         _sd.note_spec_dispatch(drafted, accepted)
         from ..utils import telemetry
         name = self.cfg.name
         if drafted:
             telemetry.inc("roundtable_spec_drafted_tokens_total",
-                          drafted, engine=name)
+                          drafted, engine=name, drafter=drafter)
             telemetry.inc("roundtable_spec_rejected_tokens_total",
-                          drafted - accepted, engine=name)
+                          drafted - accepted, engine=name,
+                          drafter=drafter)
         if accepted:
             telemetry.inc("roundtable_spec_accepted_tokens_total",
-                          accepted, engine=name)
-        if self._spec_drafted:
+                          accepted, engine=name, drafter=drafter)
+        if tree_nodes:
+            telemetry.inc("roundtable_spec_tree_nodes_total",
+                          tree_nodes, engine=name, drafter=drafter)
+        if d_tot[0]:
             telemetry.set_gauge(
                 "roundtable_spec_acceptance_rate",
-                self._spec_accepted / self._spec_drafted, engine=name)
+                d_tot[1] / d_tot[0], engine=name, drafter=drafter)
 
     def note_spec_throttle(self) -> None:
         self._spec_throttled += 1
 
     def spec_describe(self) -> dict[str, Any]:
-        """Speculative-decoding provenance (ISSUE 9): the resolved
-        state, the drafter, cumulative drafted/accepted counts and the
+        """Speculative-decoding provenance (ISSUE 9 + 13): the resolved
+        state, the ACTIVE drafter (+ why a configured one fell back),
+        the tree shape, cumulative drafted/accepted counts and the
         recent per-dispatch ring — embedded in describe() and bench
         records the way int4_paths/ragged are."""
         rate = (self._spec_accepted / self._spec_drafted
                 if self._spec_drafted else None)
+        dd = self.spec_device_drafter
         return {
             "enabled": self.spec_decode,
             "reason": self.spec_reason,
-            "drafter": "ngram" if self.spec_decode else None,
+            "drafter": self.spec_drafter,
+            "drafter_reason": self.spec_drafter_reason,
+            "tree": (dict(self.spec_tree) if self.spec_tree else None),
             "max_draft": self.spec_max_draft,
             "verify_dispatches": self._spec_dispatches,
             "drafted_tokens": self._spec_drafted,
@@ -1613,6 +1842,12 @@ class InferenceEngine:
             "acceptance_rate": (round(rate, 3)
                                 if rate is not None else None),
             "throttled_rows": self._spec_throttled,
+            "by_drafter": {k: {"drafted": v[0], "accepted": v[1]}
+                           for k, v in self._spec_by_drafter.items()},
+            "tree_nodes": self._spec_tree_nodes,
+            "tree_rows": self._spec_tree_rows,
+            "draft_dispatches": (dd.draft_dispatches
+                                 if dd is not None else 0),
             "recent": (list(self._spec_recent)[-8:]
                        if self._spec_recent is not None else []),
         }

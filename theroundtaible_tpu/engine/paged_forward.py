@@ -259,6 +259,8 @@ def forward_ragged(
     sample_rows: Optional[jax.Array] = None,  # [S, R] rows to score
     scales: Optional[list] = None,  # per-layer (k_s, v_s) (ISSUE 11)
     quant_spec=None,
+    copy_src: Optional[jax.Array] = None,  # [C] page pre-COW (ISSUE 13)
+    copy_dst: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, list]:
     """One MIXED prefill/decode step over the flat token buffer
     (serving_loop.build_ragged_batch layout): every sequence's chunk or
@@ -278,7 +280,17 @@ def forward_ragged(
     forward, and the causal mask makes each position's logits EXACTLY
     what 1-token decode would compute given the accepted prefix (the
     output-invariance core). Returns ([S, R, V], new_pools); the lm
-    head still runs on S*R gathered rows, never the full buffer."""
+    head still runs on S*R gathered rows, never the full buffer.
+
+    `copy_src`/`copy_dst` [C] (ISSUE 13, tree verify): whole pages
+    device-copied pool->pool per layer BEFORE the K/V scatter — the
+    pre-COW that gives each tree path's private frontier page the
+    committed cells its causal reads need (pads are scratch->scratch
+    self-copies; scales ride with their pages, the _run_page_copy
+    contract). With this, a token TREE is just more sequences of the
+    same flat buffer: per-path tables keep sibling writes apart, the
+    ordinary causal mask is exact along every root-to-leaf path, and
+    no kernel changes at all."""
     x = embed_tokens(params["embedding"], tokens[None])     # [1, T, E]
     if cfg.scale_embeddings:
         x = x * jnp.sqrt(jnp.float32(cfg.embed_dim)).astype(x.dtype)
@@ -295,6 +307,15 @@ def forward_ragged(
         def attn_fn(h, layer, k_pool=k_pool, v_pool=v_pool,
                     k_sc=k_sc, v_sc=v_sc):
             q, k, v = project_qkv(h, layer, cfg, pos2)      # [1,T,H,D]
+            if copy_src is not None:
+                # Tree-path pre-COW (ISSUE 13): private frontier pages
+                # receive the committed cells before this layer's
+                # scatter can write draft cells into them.
+                k_pool = k_pool.at[copy_dst].set(k_pool[copy_src])
+                v_pool = v_pool.at[copy_dst].set(v_pool[copy_src])
+                if quant:
+                    k_sc = k_sc.at[copy_dst].set(k_sc[copy_src])
+                    v_sc = v_sc.at[copy_dst].set(v_sc[copy_src])
             if quant:
                 # Quantize-on-write (ISSUE 11): each flat-buffer token
                 # writes its own payload + scale; pads land on the

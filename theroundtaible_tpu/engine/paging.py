@@ -565,6 +565,40 @@ class PagedKVCache:
                 "(tpu-llm adapter config) or lower max_new_tokens")
         return free.pop(0)
 
+    # --- raw page loans (ISSUE 13: tree-verify private path pages) ---
+
+    def take_free_pages(self, n: int,
+                        replica: int = 0) -> Optional[list[int]]:
+        """Borrow `n` pages from the FREE list only — never evicts a
+        slot and never reclaims the prefix cache, so a borrower that
+        can gracefully do without (the tree verify degrades a row to
+        chain speculation) cannot destroy resident state to get its
+        scratch. None when the replica's free list is short."""
+        free = self._free_by_replica[replica]
+        if len(free) < n:
+            return None
+        return [free.pop(0) for _ in range(n)]
+
+    def give_back_pages(self, pages: list[int]) -> None:
+        """Return pages taken by take_free_pages (or adopted-and-
+        replaced pages) — plain decref, so a page that was swapped
+        into a slot's table meanwhile is NOT freed under it."""
+        for p in pages:
+            self._decref(p)
+
+    def swap_in_page(self, name: str, j: int, page: int) -> None:
+        """Replace slot `name`'s logical page j with `page`, whose
+        cells already hold the position range's K/V (the tree verify's
+        accepted path: the private page was pre-COW'd from the old
+        frontier page in-dispatch, then received the accepted tokens'
+        writes — a copy-on-write whose copy already happened). The old
+        page decrefs (an index/donor holder keeps its copy; exclusive
+        pages free), and the loaned page's reference becomes the
+        slot's mapping reference."""
+        state = self._slots[name]
+        self._decref(state.pages[j])
+        state.pages[j] = page
+
     # --- prefix bookkeeping ---
 
     @staticmethod

@@ -1260,8 +1260,16 @@ class SessionScheduler:
             # output tokens commit (RowSpec.drafter.sync before every
             # draft). Host dict work only, O(prompt) once per admission.
             from .spec_decode import RowSpec
+            # Device drafters (model/lora) keep their state in the
+            # shadow draft slots — skip the per-row O(prompt) n-gram
+            # index entirely (prompts carry whole transcripts); a
+            # later hot-swap to ngram rebuilds it lazily in
+            # _spec_drafts.
+            kind = getattr(engine, "spec_drafter", None) or "ngram"
             for r in rows:
-                r.spec = RowSpec(list(r.tokens))
+                r.spec = RowSpec(
+                    list(r.tokens) if kind == "ngram" else None,
+                    kind=kind)
         if deferred:
             # Deferred leader-span plans (the last prologue dispatch,
             # gone): laggard rows BLOCK until the leader's chunks write
@@ -1651,12 +1659,18 @@ class SessionScheduler:
     # --- the speculative verify segment (ISSUE 9) ---
 
     def _spec_drafts(self, live: list[_Row],
-                     probe: bool = False) -> Optional[dict]:
-        """Per-row draft proposals for one verify dispatch: each
-        spec-enabled, unthrottled row's n-gram continuation, capped by
-        its remaining token budget (a verify commits up to drafts+1
-        tokens, so a row with <= 1 remaining never drafts). Returns
-        None when NO row drafts — the tick then serves the plain
+                     probe: bool = False, dispatch=None,
+                     read=None) -> Optional[dict]:
+        """Per-row draft proposals for one verify dispatch (ISSUE 13:
+        drafter-aware): each spec-enabled row that `should_draft` —
+        unthrottled, or throttled-but-re-probing — proposes up to
+        `branch` candidate PATHS (chain drafters: one), capped by its
+        remaining token budget (a verify commits up to depth+1 tokens,
+        so a row with <= 1 remaining never drafts). The ngram drafter
+        proposes host-side per row; model/LoRA drafters batch all rows
+        through the engine's DeviceDrafter (ordinary ragged dispatches
+        against the shadow draft slots). Returns {id(row): [path,...]}
+        or None when NO row drafts — the tick then serves the plain
         pipelined segments, which is exactly the 1-token-decode
         fallback the adaptive throttle promises (a non-accepting batch
         must never pay more dispatches than plain decode)."""
@@ -1666,18 +1680,92 @@ class SessionScheduler:
             return None
         if RAGGED_BLOCK_Q * len(live) > engine.ragged_tokens:
             return None  # flat buffer cannot carry every live row
-        drafts: dict[int, list[int]] = {}
+        tree = getattr(engine, "spec_tree", None)
+        branch = engine.spec_branch if tree else 1
+        depth = min(tree["depth"], engine.spec_max_draft) if tree \
+            else engine.spec_max_draft
+        dd = getattr(engine, "spec_device_drafter", None)
+
+        def cap_of(r: _Row) -> int:
+            if r.spec is None or not r.spec.should_draft(len(r.produced)):
+                return 0
+            return min(depth, r.max_new - len(r.produced) - 1)
+
+        if dd is not None:
+            from .spec_decode import DraftUnavailable
+            if probe:
+                # Eligibility alone answers _may_speculate — a device
+                # drafter always proposes >= 1 token for an eligible
+                # row, and probing must cost neither draft dispatches
+                # nor the O(transcript) context copies below.
+                return ({"__probe__": True}
+                        if any(cap_of(r) >= 1 for r in live) else None)
+            rows = []
+            for r in live:
+                c = cap_of(r)
+                if c >= 1:
+                    # Incremental context cache: extend with the newly
+                    # committed tokens only — never re-concatenate the
+                    # whole transcript per tick.
+                    cc = r.spec.ctx
+                    if cc is None:
+                        cc = r.spec.ctx = list(r.tokens)
+                    need = len(r.tokens) + len(r.produced)
+                    if len(cc) < need:
+                        cc.extend(r.produced[len(cc) - len(r.tokens):])
+                    rows.append((id(r), r.name, cc, c, branch))
+            if not rows:
+                return None
+            pinned = tuple(r.name for r in self._active)
+            try:
+                proposals = dd.propose(engine, rows, pinned=pinned,
+                                       dispatch=dispatch, read=read)
+            except DraftUnavailable as e:
+                # Slot/page pressure ONLY (the drafter's own benign
+                # capacity signal): the batch is too big to shadow —
+                # serve plain decode this tick (never evict live rows
+                # to draft for them) with the reason on record. Device
+                # dispatch failures propagate to _run_spec_segment's
+                # ragged failure ladder (donation-death check included).
+                self._event("spec_draft_unavailable",
+                            error=str(e)[:160])
+                return None
+            drafts = {id(r): proposals.get(id(r), []) for r in live}
+            return drafts if any(drafts.values()) else None
+
+        drafts: dict[int, list[list[int]]] = {}
         any_draft = False
         for r in live:
-            d: list[int] = []
-            if r.spec is not None and not r.spec.disabled:
-                cap = min(engine.spec_max_draft,
-                          r.max_new - len(r.produced) - 1)
-                if cap >= 1:
-                    r.spec.drafter.sync_parts(r.tokens, r.produced)
+            paths: list[list[int]] = []
+            cap = cap_of(r)
+            if cap >= 1:
+                if r.spec.drafter is None:
+                    # Hot-swapped from a device drafter to ngram
+                    # mid-flight: build this row's index lazily (the
+                    # admission-time build is skipped under device
+                    # drafters — whole-transcript prompts make it real
+                    # host CPU/memory).
+                    from .spec_decode import NGramDrafter
+                    r.spec.drafter = NGramDrafter(list(r.tokens))
+                    r.spec.kind = "ngram"
+                r.spec.drafter.sync_parts(r.tokens, r.produced)
+                if branch > 1:
+                    paths = r.spec.drafter.draft_paths(cap, branch)
+                else:
+                    # Chain config keeps the PR-9 seam exactly
+                    # (draft_paths(n, 1)[0] is byte-identical, but
+                    # draft() is the method fakes/benches intercept).
                     d = r.spec.drafter.draft(cap)
-            drafts[id(r)] = d
-            if d:
+                    paths = [d] if d else []
+                if not paths and not probe:
+                    # The probe reached the drafter and it proposed
+                    # NOTHING (context not draftable): the probe is
+                    # resolved FAILED — wait a whole interval again
+                    # instead of re-drafting every tick (no-op for
+                    # unthrottled rows).
+                    r.spec.probe_failed(len(r.produced))
+            drafts[id(r)] = paths
+            if paths:
                 any_draft = True
                 if probe:
                     # The _may_speculate caller only asks WHETHER a
@@ -1690,22 +1778,37 @@ class SessionScheduler:
 
     def _run_spec_segment(self, live: list[_Row]) -> bool:
         """One speculative verify dispatch over the live rows (ISSUE 9
-        tentpole): every speculating row packs ``[last, drafts...]`` as
-        a short multi-token run of the PR-8 flat buffer (throttled /
-        draftless rows ride as plain 1-token runs — mixed widths are
-        VALUES, not shapes), forward_ragged scores every draft position
-        in one forward via the static score_width gather, and the host
-        commits the longest accepted prefix plus the correction/bonus
-        token. Greedy rows are byte-identical to 1-token decode by the
-        argmax-prefix rule; sampled rows follow exact rejection
+        tentpole, ISSUE 13 tree generalization): every speculating row
+        packs its candidate paths as short multi-token runs of the PR-8
+        flat buffer (throttled / draftless rows ride as plain 1-token
+        runs — mixed chain/tree/no-spec widths are VALUES, not shapes),
+        forward_ragged scores every draft position in one forward via
+        the static score_width gather, and the host walks the accepted
+        chain/tree path and commits it plus the correction/bonus token.
+
+        Tree rows: path 0 (the main chain) writes through the row's
+        REAL page table exactly like PR-9; each extra root branch
+        becomes one more sequence whose table swaps the touched pages
+        for pages LOANED from the free list (take_free_pages — never
+        evicting resident state; a short free list degrades the row
+        back to chain), with the partially-committed frontier page
+        pre-COW'd in-dispatch (build_ragged_batch copy_pairs) so every
+        path's causal reads see the committed cells. When the accepted
+        walk ends on a non-trunk path, its loaned pages ARE the
+        committed K/V — swap_in_page adopts them into the row's table
+        and the trunk's rejected bytes go back to the free list; every
+        other loan returns untouched. PagedKVCache.commit still
+        publishes only literally-committed tokens, so the prefix cache
+        can never attach a rejected branch.
+
+        Greedy rows are byte-identical to 1-token decode by the argmax
+        walk rule; sampled rows follow exact per-edge rejection
         sampling (engine/spec_decode docstring). Returns False WITHOUT
         dispatching when no row drafts; a dispatch failure is handled
-        exactly like a ragged decode failure (drafts discarded, the
-        preempt-isolate ladder re-dispatches from intact host state)."""
+        exactly like a ragged decode failure (drafts discarded, loans
+        returned, the preempt-isolate ladder re-dispatches from intact
+        host state)."""
         engine = self.engine
-        drafts_of = self._spec_drafts(live)
-        if drafts_of is None:
-            return False
         reqs = self._reqs_of(live)
         remaining = min((req.turn_budget.remaining() for req in reqs),
                         default=float("inf"))
@@ -1715,27 +1818,110 @@ class SessionScheduler:
         deadline = min((req.deadline for req in reqs),
                        default=float("inf"))
 
+        def draft_dispatch(b):
+            # Draft dispatches ride the SAME watchdog/retry/budget
+            # seams the verify dispatch uses — a hang mid-propose must
+            # hit the deadline ladder, not block the scheduler thread.
+            return run_dispatch(lambda: engine._ragged_dispatch(b),
+                                engine.retry, deadline,
+                                budget=seg_budget)
+
+        def draft_read(h):
+            if isinstance(h, tuple):
+                return host_sync(
+                    lambda: tuple(np.asarray(x) for x in h),
+                    seg_budget, "decode")
+            return host_sync(lambda: np.asarray(h), seg_budget,
+                             "decode")
+
+        try:
+            drafts_of = self._spec_drafts(live, dispatch=draft_dispatch,
+                                          read=draft_read)
+        except Exception as e:  # noqa: BLE001 — preempt-isolate ladder
+            # A DEVICE failure during drafting is indistinguishable
+            # from a decode failure (draft dispatches donate the same
+            # pools): the ragged failure path's donation-death check +
+            # per-session re-dispatch applies verbatim. Benign capacity
+            # pressure (DraftUnavailable) was already absorbed inside
+            # _spec_drafts.
+            self._handle_ragged_failure(live, [], e)
+            return True
+        if drafts_of is None:
+            return False
+
         from .serving_loop import ragged_pick_shape
-        want = sum(
-            -(-(1 + len(drafts_of[id(r)])) // RAGGED_BLOCK_Q)
-            * RAGGED_BLOCK_Q for r in live)
-        shape = ragged_pick_shape(engine.ragged_shapes,
-                                  min(want, engine.ragged_tokens))
+        kv = engine.kv
+        ps = kv.page_size
+        # Pack main runs first (chain behavior unchanged), then extra
+        # tree paths while the flat buffer, the static copy-slot block
+        # and the free list allow — degradation is per-path and the
+        # batch stays pure values.
         seqs: list[RaggedSeq] = []
+        entries: list[dict] = []
+        copy_pairs: list[tuple[int, int]] = []
+        blocks_budget = engine.ragged_tokens // RAGGED_BLOCK_Q
+        copy_budget = engine.spec_copy_slots
         for r in live:
-            d = drafts_of[id(r)]
+            paths = drafts_of.get(id(r)) or []
+            main = list(paths[0]) if paths else []
+            e = {"row": r, "used": ([main] if paths else []),
+                 "rows_idx": [len(seqs)], "loans": []}
             seqs.append(RaggedSeq(
-                [r.last] + d, r.valid, engine.kv.table_for([r.name])[0],
+                [r.last] + main, r.valid, kv.table_for([r.name])[0],
                 temperature=r.sampling.temperature,
                 top_k=r.sampling.top_k, top_p=r.sampling.top_p,
-                n_scores=len(d) + 1, adapter=r.adapter_slot))
+                n_scores=len(main) + 1, adapter=r.adapter_slot))
+            entries.append(e)
+        for e in entries:
+            r = e["row"]
+            paths = drafts_of.get(id(r)) or []
+            if len(paths) <= 1:
+                continue
+            state = kv.acquire(r.name)
+            base_table = kv.table_for([r.name])[0]
+            for p in paths[1:]:
+                if len(seqs) >= blocks_budget or copy_budget <= 0:
+                    break
+                lo = r.valid // ps
+                hi = (r.valid + len(p)) // ps
+                loan = kv.take_free_pages(hi - lo + 1,
+                                          replica=state.replica)
+                if loan is None:
+                    break  # free list short: this row degrades to chain
+                ptable = np.array(base_table, copy=True)
+                for k, j in enumerate(range(lo, hi + 1)):
+                    ptable[j] = loan[k]
+                # Only the frontier page holds committed cells the
+                # path's causal reads need — deeper touched pages start
+                # past `valid` and are written before they are read.
+                copy_pairs.append((int(base_table[lo]), loan[0]))
+                copy_budget -= 1
+                e["rows_idx"].append(len(seqs))
+                e["loans"].append((lo, loan))
+                e["used"].append(list(p))
+                seqs.append(RaggedSeq(
+                    [r.last] + list(p), r.valid, ptable,
+                    temperature=r.sampling.temperature,
+                    top_k=r.sampling.top_k, top_p=r.sampling.top_p,
+                    n_scores=len(p) + 1, adapter=r.adapter_slot))
+
+        def return_all_loans():
+            for e in entries:
+                for _lo, loan in e["loans"]:
+                    kv.give_back_pages(loan)
+
+        want = RAGGED_BLOCK_Q * len(seqs)
+        shape = ragged_pick_shape(engine.ragged_shapes,
+                                  min(want, engine.ragged_tokens))
         batch = build_ragged_batch(
-            seqs, t_budget=shape, s_max=engine.kv.num_slots + 1,
-            pages_per_seq=engine.kv.pages_per_seq,
-            scratch_page=engine.kv.scratch_page(0),
+            seqs, t_budget=shape, s_max=engine.spec_s_max,
+            pages_per_seq=kv.pages_per_seq,
+            scratch_page=kv.scratch_page(0),
             pad_id=engine.tokenizer.pad_id,
-            page_size=engine.kv.page_size,
-            score_width=engine.spec_max_draft + 1)
+            page_size=ps,
+            score_width=engine.spec_max_draft + 1,
+            copy_pairs=copy_pairs,
+            copy_slots=engine.spec_copy_slots)
 
         t0 = time.monotonic()
         try:
@@ -1749,24 +1935,41 @@ class SessionScheduler:
                                 "decode")
         except Exception as e:  # noqa: BLE001 — preempt-isolate ladder
             # Indistinguishable from a decode failure: host state is
-            # untouched (the drafts are discarded with the dispatch),
-            # so the ragged failure path's donation-death check +
-            # per-session re-dispatch applies verbatim.
+            # untouched (the drafts are discarded with the dispatch and
+            # the loaned pages return to the free list), so the ragged
+            # failure path's donation-death check + per-session
+            # re-dispatch applies verbatim.
+            return_all_loans()
             self._handle_ragged_failure(live, [], e)
             return True
         wall = time.monotonic() - t0
 
         eos = engine.tokenizer.eos_id
-        from .spec_decode import accept_prefix
+        from .spec_decode import (accept_prefix, accept_tree,
+                                  note_tree_row)
         n_emit = 0
         lora_toks = 0
         drafted_tot = 0
         accepted_tot = 0
+        tree_nodes_tot = 0
+        tree_rows_tot = 0
         emits: dict[int, tuple[_Request, int]] = {}
-        for i, r in enumerate(live):
-            d = drafts_of[id(r)]
-            props = [int(x) for x in nxt[i, :len(d) + 1]]
-            emit, a = accept_prefix(d, props)
+        for e in entries:
+            r = e["row"]
+            used = e["used"]
+            if len(used) <= 1:
+                d = used[0] if used else []
+                props = [int(x)
+                         for x in nxt[e["rows_idx"][0], :len(d) + 1]]
+                emit, a = accept_prefix(d, props)
+                winner = 0
+                drafted_row = len(d)
+            else:
+                props_list = [
+                    [int(x) for x in nxt[si, :len(used[k]) + 1]]
+                    for k, si in enumerate(e["rows_idx"])]
+                emit, a, winner = accept_tree(used, props_list)
+                drafted_row = sum(len(p) for p in used)
             # EOS inside an accepted prefix truncates exactly as
             # eos_trim does: tokens past the eos are never committed
             # (plain decode would never have produced them).
@@ -1787,21 +1990,49 @@ class SessionScheduler:
             # [A, eos, B, C] draft commits 2 tokens, not 4). min(a,
             # len(emit)) also covers the eos-was-a-draft case, where
             # every emitted token is a matched draft and none is the
-            # free correction.
+            # free correction — the rule holds for tree EDGES verbatim
+            # (ISSUE 13 satellite: EOS inside an accepted path counts
+            # only committed tokens).
             acc = min(a, len(emit))
+            if e["loans"]:
+                # Loan settlement: the winner path's pages covering the
+                # committed span adopt into the row's table (their
+                # cells hold the accepted K/V, pre-COW'd + written
+                # in-dispatch); everything else returns to the free
+                # list. Winner 0 is the trunk — its writes went through
+                # the real table, so every loan returns.
+                for m, (lo, loan) in enumerate(e["loans"]):
+                    if m == winner - 1:
+                        keep_hi = (r.valid - 1) // ps
+                        for k, j in enumerate(range(lo, lo + len(loan))):
+                            if j <= keep_hi:
+                                kv.swap_in_page(r.name, j, loan[k])
+                            else:
+                                kv.give_back_pages([loan[k]])
+                    else:
+                        kv.give_back_pages(loan)
+            if len(used) > 1:
+                tree_nodes_tot += drafted_row
+                tree_rows_tot += 1
+                note_tree_row(drafted_row, acc)
             req = self._row_req.get(id(r))
             if req is not None:
                 prev = emits.get(id(req))
                 emits[id(req)] = (req,
                                   (prev[1] if prev else 0) + len(emit))
-                if d:
-                    req.spec_drafted += len(d)
+                if drafted_row:
+                    req.spec_drafted += drafted_row
                     req.spec_accepted += acc
             n_emit += len(emit)
-            if d and r.spec is not None:
-                drafted_tot += len(d)
+            if drafted_row and r.spec is not None:
+                drafted_tot += drafted_row
                 accepted_tot += acc
-                tripped = r.spec.note(len(d), acc)
+                tripped = r.spec.note(drafted_row, acc)
+                if r.spec.disabled:
+                    # Throttled (now or still): restart the re-probe
+                    # interval from the row's current committed length
+                    # (ISSUE 13 hysteresis satellite).
+                    r.spec.mark_idle(len(r.produced))
                 # Gauge AFTER note: the window now includes this
                 # dispatch, so the first drafted dispatch reports its
                 # real rate instead of a false 0.0 (and later values
@@ -1812,8 +2043,9 @@ class SessionScheduler:
                     engine=self._tname, row=r.name)
                 if tripped:
                     # Adaptive throttle tripped: this row decodes
-                    # 1-token from here on — one flight event, the
-                    # ISSUE 9 telemetry satellite.
+                    # 1-token (with periodic re-probes) from here on —
+                    # one flight event, the ISSUE 9 telemetry
+                    # satellite.
                     engine.note_spec_throttle()
                     telemetry.recorder().record(
                         "spec_throttle", engine=self._tname,
@@ -1823,7 +2055,9 @@ class SessionScheduler:
                                 rate=round(r.spec.rate(), 3))
         engine.note_lora_tokens(lora_toks)
         engine.note_spec_dispatch(drafted_tot, accepted_tot,
-                                  rows=len(live))
+                                  rows=len(live),
+                                  tree_nodes=tree_nodes_tot,
+                                  tree_rows=tree_rows_tot)
 
         self.spec_segments += 1
         telemetry.inc("roundtable_sched_spec_segments_total",
@@ -2265,8 +2499,17 @@ class SessionScheduler:
     def _drop_request(self, req: _Request) -> None:
         if req in self._active_reqs:
             self._active_reqs.remove(req)
+        dd = getattr(self.engine, "spec_device_drafter", None)
         for r in req.rows:
             self._row_req.pop(id(r), None)
+            if dd is not None:
+                # The row's shadow draft slot dies with it (ISSUE 13):
+                # its pages free, and a future session reusing the name
+                # starts its drafter cold instead of diverged.
+                try:
+                    dd.end_row(self.engine, r.name)
+                except Exception:  # noqa: BLE001 — cleanup best-effort
+                    pass
             if r.spec is not None and r.spec.drafted:
                 # Row-labeled acceptance gauges die with the row:
                 # session-scoped names are uuid-tagged per serve call,

@@ -503,7 +503,9 @@ class RaggedSeq:
 def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
                        s_max: int, pages_per_seq: int, scratch_page: int,
                        pad_id: int, page_size: int,
-                       score_width: int = 0) -> dict:
+                       score_width: int = 0,
+                       copy_pairs: Optional[list] = None,
+                       copy_slots: int = 0) -> dict:
     """Device inputs for one ragged mixed prefill/decode dispatch.
 
     Every array has a STATIC shape derived from (t_budget, s_max) alone
@@ -530,6 +532,18 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
     is a function of (s_max, score_width) alone — score_width is the
     STATIC spec_max_draft+1, so acceptance drift and per-row throttle
     flips change only values, never the compiled program.
+
+    `copy_slots` > 0 (ISSUE 13, tree verify): the dict also carries
+    `copy_src`/`copy_dst` [copy_slots] — page pairs the dispatch must
+    device-copy BEFORE its K/V scatter (forward_ragged does it per
+    layer). A tree row's candidate paths are separate sequences whose
+    tables alias private frontier pages, and the partially-committed
+    frontier page's committed cells must exist in each private copy —
+    a pre-COW folded into the dispatch. The arrays are padded with
+    scratch->scratch self-copies, so how many tree rows (0 included)
+    actually need copies is a VALUE; copy_slots is static from engine
+    config alone (num_slots), so chain/tree/no-spec mixes never
+    compile a new program.
     """
     bq = RAGGED_BLOCK_Q
     if t_budget % bq:
@@ -557,6 +571,19 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
     top_ps = np.ones(s_max, np.float32)
     sample_rows = (np.zeros((s_max, score_width), np.int32)
                    if score_width > 0 else None)
+    copy_src = copy_dst = None
+    if copy_slots > 0:
+        pairs = list(copy_pairs or [])
+        if len(pairs) > copy_slots:
+            raise ValueError(
+                f"{len(pairs)} copy pairs > copy_slots {copy_slots}")
+        copy_src = np.full(copy_slots, scratch_page, np.int32)
+        copy_dst = np.full(copy_slots, scratch_page, np.int32)
+        for k, (src, dst) in enumerate(pairs):
+            copy_src[k] = src
+            copy_dst[k] = dst
+    elif copy_pairs:
+        raise ValueError("copy_pairs given without copy_slots")
 
     row = 0
     n_tokens = 0
@@ -617,6 +644,8 @@ def build_ragged_batch(seqs: list[RaggedSeq], *, t_budget: int,
         "score_width": score_width,
         **({"sample_rows": sample_rows} if sample_rows is not None
            else {}),
+        **({"copy_src": copy_src, "copy_dst": copy_dst}
+           if copy_src is not None else {}),
     }
 
 

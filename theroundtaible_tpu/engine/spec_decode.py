@@ -1,30 +1,52 @@
-"""Self-drafting speculative decoding on the shared batch (ISSUE 9).
+"""Speculative decoding on the shared batch (ISSUE 9 + ISSUE 13).
 
 int8 decode sits at 0.63-0.69 of the HBM-streaming ceiling — past
 kernel wins the only way above the roofline is accepting more than one
-token per forward pass. This module is the HOST side of that: a
-zero-model drafter over each row's own token history, the acceptance
-rule, and the per-row adaptive throttle. The DEVICE side is the PR-8
-ragged seam: a verify dispatch packs each speculating row's drafts as a
-short multi-token run in the flat token buffer and scores every draft
-position in ONE forward (engine._ragged_dispatch with a static
-`score_width` — build_ragged_batch shapes stay a function of the token
-budget alone, so mixed 1-draft/4-draft compositions compile nothing).
+token per forward pass. This module is the HOST side of that: the
+drafter abstraction (n-gram, draft-model, LoRA-draft-head), the chain
+and TREE acceptance rules, and the per-row adaptive throttle with
+re-probe hysteresis. The DEVICE side is the PR-8 ragged seam: a verify
+dispatch packs each speculating row's candidates as short multi-token
+runs in the flat token buffer and scores every draft position in ONE
+forward (engine._ragged_dispatch with a static `score_width` —
+build_ragged_batch shapes stay a function of the token budget alone,
+so mixed chain/tree/no-spec compositions compile nothing).
 
-Why a drafter with no model works here: roundtable transcripts are
-unusually repetitive — quoted proposals, score scaffolding, and knight
-boilerplate recur verbatim across rounds — so an n-gram lookup over the
-row's OWN prompt (which carries the whole transcript) plus its
-committed output proposes long runs that the target model then verifies
-wholesale. RTP-LLM (PAPERS.md) ships the same composition — speculation
-folded into continuous batching — in production.
+Drafters (ISSUE 13 — the `Drafter` protocol):
+
+- ``ngram`` — the PR-9 zero-model prompt-lookup drafter. Roundtable
+  transcripts are unusually repetitive (quoted proposals, score
+  scaffolding, knight boilerplate recur verbatim across rounds), so an
+  n-gram lookup over the row's OWN prompt plus committed output
+  proposes long runs — but ONLY on scripted/repetitive traffic. On
+  sampled real-weights traffic the lookup collapses and the throttle
+  quietly turns speculation off fleet-wide (SPEC_r09's acceptance 1.0
+  was a property of the scripted rounds, not the mechanism).
+- ``model`` — a draft model served as EXTRA ROW SETS on the SAME
+  engine: each target row gets a shadow draft slot in the same paged
+  pool, and drafting dispatches are ordinary ragged dispatches with a
+  `params` override (the draft checkpoint shares the ModelConfig
+  shapes, so no second engine and no new compile shapes — different
+  VALUES through already-warm programs). Default draft weights are the
+  engine's own params (the distillation placeholder: zero extra HBM,
+  proposals = the target's own greedy chain — on sampled traffic
+  acceptance is then exactly the sampler's peakedness, which is what a
+  well-distilled drafter approaches).
+- ``lora`` — drafting as an ADAPTER: the draft head is a LoRA pair in
+  the PR-10 `LoraStore`, so the drafter is hot-swappable per workload
+  through the store's existing setter (zero recompiles), costs
+  rank·(in+out) bytes, and draft rows ride the normal per-token
+  adapter ids. RTP-LLM (PAPERS.md) ships draft-model speculation over
+  continuous batching in production; the heterogeneous-LoRA-serving
+  line motivates serving the drafter as just another adapter.
 
 Acceptance (the output-invariance contract):
 
-- The verify run for a row is ``[last, d_0, ..., d_{k-1}]`` fed at
-  positions ``valid..valid+k``. The causal mask means the scored logits
-  at the row of ``last`` are EXACTLY what plain decode would compute,
-  the logits at ``d_0`` are exact given ``d_0`` in context, and so on.
+- The verify run for a chain row is ``[last, d_0, ..., d_{k-1}]`` fed
+  at positions ``valid..valid+k``. The causal mask means the scored
+  logits at the row of ``last`` are EXACTLY what plain decode would
+  compute, the logits at ``d_0`` are exact given ``d_0`` in context,
+  and so on.
 - Greedy: the device returns per-position argmax ``t_0..t_k``; the
   accepted prefix is the longest ``j`` with ``d_j == t_j`` and the row
   commits ``t_0..t_a`` (the first mismatch — or the bonus token after a
@@ -37,6 +59,24 @@ Acceptance (the output-invariance contract):
   acceptance fires with probability ``p(d_j)``, and the first
   mismatching ``t_j`` is distributed as the renormalized residual — so
   the emitted stream is an exact ancestral sample of the target model.
+
+Tree acceptance (ISSUE 13, `accept_tree`): a token TREE is expanded
+into its root-to-leaf PATHS, each path a separate ``[last, path...]``
+run of the SAME verify dispatch (per-path page tables keep sibling
+K/V writes apart — engine/scheduler.py owns that metadata; causality
+within each run is ordinary, which is why tree verify needs no new
+Pallas kernel). The host then walks the tree from the root: at depth
+j it takes the device's token for the CURRENT path at position j and
+emits it — that token is a genuine target-model token (argmax or
+exact sample) given the emitted prefix, so the emitted stream is
+exact REGARDLESS of how the walk continues; if some path's node at
+depth j equals the emitted token, the walk descends that path (its
+deeper positions condition on exactly the accepted prefix) and the
+edge counts as accepted. Greedy: at most one child can match the
+argmax, so the walk is deterministic and byte-identical to 1-token
+decode by the chain argument applied along the accepted path.
+Sampled: each emitted token is one exact ancestral sample; matching a
+point-mass child is precisely per-edge rejection sampling.
 
 Rollback is free: rejected tail tokens only wrote K/V at positions
 beyond the new committed ``valid``; every later dispatch's ``kv_valid``
@@ -51,11 +91,13 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Optional
+from typing import Any, Optional, Protocol, runtime_checkable
 
 from .prefix_cache import env_flag
 
 SPEC_ENV = "ROUNDTABLE_SPEC_DECODE"
+
+DRAFTER_KINDS = ("ngram", "model", "lora")
 
 # Drafts per row per verify dispatch (config `spec_max_draft`). The
 # default keeps a row's verify run (1 + drafts) inside ONE
@@ -80,6 +122,16 @@ SPEC_WINDOW = 16
 SPEC_MIN_DISPATCHES = 6
 SPEC_ACCEPT_FLOOR = 0.2
 
+# Re-probe hysteresis (ISSUE 13 satellite): a throttled row re-drafts
+# ONCE every SPEC_REPROBE_DISPATCHES committed tokens (~dispatches while
+# throttled) — a row whose context BECOMES draftable (the discussion
+# looped back onto quoted scaffolding, the draft head warmed up)
+# recovers speculation instead of decoding 1-token for the rest of its
+# turn. A successful probe (its own acceptance >= the floor) re-enables
+# with a FRESH window, so one stale all-zero window cannot instantly
+# re-trip; a failed probe waits a whole interval again.
+SPEC_REPROBE_DISPATCHES = 16
+
 
 def accept_floor() -> float:
     import os
@@ -90,12 +142,111 @@ def accept_floor() -> float:
         return SPEC_ACCEPT_FLOOR
 
 
-def spec_enabled(flag: Optional[bool]) -> bool:
+def reprobe_interval() -> int:
+    import os
+    raw = os.environ.get("ROUNDTABLE_SPEC_REPROBE")
+    try:
+        n = int(raw) if raw else SPEC_REPROBE_DISPATCHES
+    except ValueError:
+        n = SPEC_REPROBE_DISPATCHES
+    return max(n, 1)
+
+
+def spec_enabled(flag) -> bool:
     """The speculative-decode on/off decision for a paged+ragged engine
     (explicit config wins, then the env kill-switch, then default ON —
     the prefix_cache/ragged_attn precedent: the fast path is the
-    serving path, not an experiment)."""
+    serving path, not an experiment). A dict config (ISSUE 13) decides
+    through its optional "enabled" key, so `spec_decode: {drafter: ...}`
+    keeps the ROUNDTABLE_SPEC_DECODE=0 kill-switch live while
+    `{enabled: true, ...}` pins it on."""
+    if isinstance(flag, dict):
+        flag = flag.get("enabled")
     return env_flag(flag, SPEC_ENV)
+
+
+class SpecOptions:
+    """Resolved `spec_decode:` block (ISSUE 13). The config accepts the
+    PR-9 bool OR a dict::
+
+        spec_decode: {enabled?: bool, drafter: ngram|model|lora,
+                      max_draft?: int, tree?: {branch: B, depth: D},
+                      draft_checkpoint?: path, adapter?: name}
+
+    Validation lives here so the engine constructor and from_config
+    fail identically; drafter AVAILABILITY fallbacks (no lora store,
+    say) are the engine's job and are recorded, not raised."""
+
+    __slots__ = ("drafter", "tree", "max_draft", "draft_checkpoint",
+                 "adapter")
+
+    def __init__(self, drafter: str = "ngram",
+                 tree: Optional[dict] = None,
+                 max_draft: Optional[int] = None,
+                 draft_checkpoint: Optional[str] = None,
+                 adapter: Optional[str] = None):
+        self.drafter = drafter
+        self.tree = tree
+        self.max_draft = max_draft
+        self.draft_checkpoint = draft_checkpoint
+        self.adapter = adapter
+
+    @classmethod
+    def resolve(cls, flag) -> "SpecOptions":
+        if not isinstance(flag, dict):
+            return cls()
+        drafter = flag.get("drafter", "ngram")
+        if drafter not in DRAFTER_KINDS:
+            raise ValueError(
+                f"spec_decode drafter must be one of {DRAFTER_KINDS}, "
+                f"got {drafter!r}")
+        tree = flag.get("tree") or None
+        if tree is not None:
+            if not isinstance(tree, dict):
+                raise ValueError(
+                    "spec_decode tree must be {branch: B, depth: D}")
+            branch = int(tree.get("branch", 2))
+            depth = int(tree.get("depth", 2))
+            if branch < 2:
+                raise ValueError(
+                    f"spec_decode tree branch must be >= 2 (a 1-branch "
+                    f"tree is the chain), got {branch}")
+            if depth < 1:
+                raise ValueError(
+                    f"spec_decode tree depth must be >= 1, got {depth}")
+            tree = {"branch": branch, "depth": depth}
+        if drafter == "lora" and not flag.get("adapter"):
+            raise ValueError(
+                "spec_decode drafter 'lora' needs an `adapter:` name "
+                "registered in the engine's lora: block")
+        max_draft = flag.get("max_draft")
+        return cls(drafter=drafter, tree=tree,
+                   max_draft=(int(max_draft)
+                              if max_draft is not None else None),
+                   draft_checkpoint=flag.get("draft_checkpoint"),
+                   adapter=flag.get("adapter"))
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Per-row host-side proposer (ISSUE 13). `sync_parts` brings the
+    drafter's view up to the row's committed context before every
+    draft; `draft` proposes one chain; `draft_paths` proposes up to
+    `branch` root-distinct candidate paths for tree verify (chain
+    drafters return a single-element list). NGramDrafter implements
+    this directly; the model/LoRA drafters are device-batched across
+    rows (DeviceDrafter below), so their per-row view is the draft
+    slot the coordinator maintains."""
+
+    kind: str
+
+    def sync_parts(self, prompt: list[int],
+                   produced: list[int]) -> None: ...
+
+    def draft(self, max_n: int) -> list[int]: ...
+
+    def draft_paths(self, max_n: int,
+                    branch: int = 1) -> list[list[int]]: ...
 
 
 class NGramDrafter:
@@ -112,6 +263,8 @@ class NGramDrafter:
     carries no continuation."""
 
     __slots__ = ("_toks", "_index")
+
+    kind = "ngram"
 
     def __init__(self, tokens: Optional[list[int]] = None):
         self._toks: list[int] = []
@@ -162,52 +315,137 @@ class NGramDrafter:
         context, from the most recent PRIOR occurrence of the longest
         matching tail gram; [] when nothing matches (the row then runs
         plain 1-token decode this step)."""
+        paths = self.draft_paths(max_n, branch=1)
+        return paths[0] if paths else []
+
+    def draft_paths(self, max_n: int,
+                    branch: int = 1) -> list[list[int]]:
+        """Up to `branch` candidate continuation paths with DISTINCT
+        first tokens (tree verify, ISSUE 13): the two stored
+        occurrences of the longest matching tail gram propose the
+        primary candidates, and shorter-gram backoff supplements extra
+        branches only when the longer grams could not fill them — so
+        `draft_paths(n, 1)[0]` is byte-identical to the PR-9 chain
+        draft. [] when nothing matches."""
         toks = self._toks
-        if max_n < 1 or not toks:
+        if max_n < 1 or not toks or branch < 1:
             return []
+        paths: list[list[int]] = []
+        seen_first: set[int] = set()
         for n in range(min(NGRAM_MAX, len(toks)), 0, -1):
             entry = self._index.get(tuple(toks[len(toks) - n:]))
             if entry is None:
                 continue
-            last, prev = entry
             # The tail gram itself is always the most recent occurrence;
             # a continuation needs an occurrence that ENDS before the
             # corpus does.
-            pos = last if last < len(toks) else prev
-            if pos is not None and 0 < pos < len(toks):
-                return list(toks[pos:pos + max_n])
-        return []
+            for pos in entry:
+                if not 0 < pos < len(toks):
+                    continue
+                p = list(toks[pos:pos + max_n])
+                if p and p[0] not in seen_first:
+                    paths.append(p)
+                    seen_first.add(p[0])
+                    if len(paths) >= branch:
+                        return paths
+            if paths and branch == 1:
+                return paths
+        return paths
 
 
 class RowSpec:
     """Per-row speculation state: the drafter plus the adaptive
-    throttle's acceptance window."""
+    throttle's acceptance window and re-probe hysteresis (ISSUE 13:
+    drafter-aware — `kind` labels the metrics, and a throttled row
+    periodically re-probes instead of staying dark for its whole
+    turn)."""
 
-    __slots__ = ("drafter", "drafted", "accepted", "recent", "disabled")
+    __slots__ = ("drafter", "kind", "drafted", "accepted", "recent",
+                 "disabled", "probing", "_idle_mark", "ctx")
 
-    def __init__(self, prompt_tokens: list[int]):
-        self.drafter = NGramDrafter(prompt_tokens)
+    def __init__(self, prompt_tokens: Optional[list[int]] = None,
+                 kind: str = "ngram"):
+        # Device-batched drafters (model/lora) keep their state in the
+        # draft slots the DeviceDrafter coordinator owns; only the
+        # ngram drafter lives here per row.
+        self.drafter = (NGramDrafter(prompt_tokens)
+                        if kind == "ngram" else None)
+        self.kind = kind
         self.drafted = 0
         self.accepted = 0
         # (drafted, accepted) per verify dispatch that actually drafted.
         self.recent: deque = deque(maxlen=SPEC_WINDOW)
         self.disabled = False
+        # Re-probe bookkeeping: produced-token mark at throttle time —
+        # pure function of row state, so the probe decision is
+        # idempotent across the scheduler's probe and real calls.
+        self.probing = False
+        self._idle_mark = 0
+        # Device-drafter context cache (prompt + produced), extended
+        # O(delta) per tick by the scheduler instead of re-concatenated
+        # O(transcript) — read-only inside DeviceDrafter.propose.
+        self.ctx: Optional[list[int]] = None
 
     def rate(self) -> float:
         d = sum(x for x, _ in self.recent)
         return (sum(a for _, a in self.recent) / d) if d else 0.0
 
+    def should_draft(self, produced_len: int) -> bool:
+        """Whether this row drafts this tick: unthrottled rows always;
+        throttled rows once every `reprobe_interval()` committed tokens
+        (the re-probe — ISSUE 13 satellite). Once a probe fires it
+        stays armed until the next note(), so the scheduler's probe
+        call and the real segment see the same answer."""
+        if not self.disabled:
+            return True
+        if self.probing:
+            return True
+        if produced_len - self._idle_mark >= reprobe_interval():
+            self.probing = True
+            return True
+        return False
+
+    def mark_idle(self, produced_len: int) -> None:
+        """Restart the re-probe interval (called by the scheduler when
+        a dispatch leaves the row throttled)."""
+        self._idle_mark = produced_len
+
+    def probe_failed(self, produced_len: int) -> None:
+        """Resolve an armed probe that never reached a verify dispatch
+        (the drafter proposed NOTHING for the probing row): clear the
+        arm and restart the interval — otherwise `probing` stays True
+        forever and the row pays per-tick draft host work for the rest
+        of its turn, exactly the overhead the throttle exists to
+        remove."""
+        if self.probing:
+            self.probing = False
+            self._idle_mark = produced_len
+            note_spec_reprobe(recovered=False)
+
     def note(self, drafted: int, accepted: int) -> bool:
         """Record one verify dispatch's outcome. Returns True when THIS
         call tripped the throttle (the caller emits the one flight
-        event)."""
+        event). A throttled row's re-probe RECOVERS here: when the
+        probe's own acceptance clears the floor, the row re-enables
+        with a fresh window (hysteresis — the stale all-zero window
+        must not immediately re-trip it)."""
         if drafted <= 0:
             return False
         self.drafted += drafted
         self.accepted += accepted
+        if self.disabled:
+            self.probing = False
+            if accepted / drafted >= accept_floor():
+                self.disabled = False
+                self.recent.clear()
+                self.recent.append((drafted, accepted))
+                note_spec_reprobe(recovered=True)
+            else:
+                self.recent.append((drafted, accepted))
+                note_spec_reprobe(recovered=False)
+            return False
         self.recent.append((drafted, accepted))
-        if (not self.disabled
-                and len(self.recent) >= SPEC_MIN_DISPATCHES
+        if (len(self.recent) >= SPEC_MIN_DISPATCHES
                 and self.rate() < accept_floor()):
             self.disabled = True
             return True
@@ -216,15 +454,297 @@ class RowSpec:
 
 def accept_prefix(drafts: list[int],
                   proposals: list[int]) -> tuple[list[int], int]:
-    """The acceptance rule: `proposals` are the device's per-position
-    tokens for the run ``[last, d_0, ..., d_{k-1}]`` (len == k+1).
-    Returns (emit, accepted): the committed tokens ``t_0..t_a`` —
-    accepted drafts plus the correction/bonus token — and the accepted
-    draft count a."""
+    """The chain acceptance rule: `proposals` are the device's
+    per-position tokens for the run ``[last, d_0, ..., d_{k-1}]``
+    (len == k+1). Returns (emit, accepted): the committed tokens
+    ``t_0..t_a`` — accepted drafts plus the correction/bonus token —
+    and the accepted draft count a."""
     a = 0
     while a < len(drafts) and drafts[a] == proposals[a]:
         a += 1
     return list(proposals[:a + 1]), a
+
+
+def accept_tree(paths: list[list[int]],
+                props: list[list[int]]) -> tuple[list[int], int, int]:
+    """The tree acceptance walk (ISSUE 13): `paths[i]` is root-to-leaf
+    candidate path i of the row's token tree, `props[i]` the device's
+    per-position tokens for path i's run ``[last, paths[i]...]``
+    (len == len(paths[i]) + 1, every position conditioned on path i's
+    own prefix by the causal mask).
+
+    Walk from the root: at depth j, emit the CURRENT path's device
+    token `t = props[cur][j]` — an exact target-model token (argmax or
+    sample) given the emitted prefix, so the output stream is exact no
+    matter what happens next — then descend into any still-prefix-
+    consistent path whose node j equals t (greedy: at most one child
+    can match the argmax; sampled: matching a point-mass child is
+    per-edge rejection sampling). Returns (emit, accepted_edges,
+    winner_path): the committed tokens (accepted path nodes plus the
+    correction/bonus token), how many tree edges were accepted, and
+    the index of the path whose cells hold every accepted token's K/V
+    (the page-adoption source — scheduler tentpole)."""
+    emit: list[int] = []
+    a, cur, j = 0, 0, 0
+    alive = list(range(len(paths)))
+    while True:
+        t = int(props[cur][j])
+        emit.append(t)
+        alive = [i for i in alive
+                 if len(paths[i]) > j and paths[i][j] == t]
+        if not alive:
+            return emit, a, cur
+        cur = alive[0]
+        a += 1
+        j += 1
+
+
+# --- device-batched drafters: draft model / LoRA draft head ---
+
+
+class DraftUnavailable(RuntimeError):
+    """Raised when the drafter cannot shadow the batch for a BENIGN
+    capacity reason (no free slot for a draft slot, pool pressure) —
+    the scheduler serves plain decode this tick with the reason on
+    record. Deliberately distinct from device dispatch failures, which
+    must flow into the donation-death / preempt-isolate ladder like
+    any other ragged failure."""
+
+
+DRAFT_SCOPE = "__spec_draft__"
+
+# A draft run fed through the propose/extend dispatches never exceeds
+# one RAGGED_BLOCK_Q tile, so the propose-variant program only ever
+# compiles at the small end of the shape grid (engine.warmup warms
+# exactly those shapes).
+PROPOSE_RUN = 7
+
+
+def draft_slot_name(row_name: str) -> str:
+    """The shadow draft slot of a target row — namespaced under its own
+    pseudo-session (kvcache.SESSION_SEP), so intra-session prefix
+    DONATION can never move draft-model K/V into a real row (sessions
+    are isolation domains and `__spec_draft__` is nobody's session).
+    Draft slots are never committed, so the cross-session prefix cache
+    never sees their pages either."""
+    from .kvcache import SESSION_SEP
+    return f"{DRAFT_SCOPE}{SESSION_SEP}{row_name}"
+
+
+class DeviceDrafter:
+    """Batch-level coordinator for the model/LoRA drafters (ISSUE 13
+    tentpole): each target row gets a shadow DRAFT SLOT in the same
+    paged pool ("extra row sets on the SAME engine"), kept in sync with
+    the row's committed context and advanced autoregressively through
+    ordinary ragged dispatches — a `params` override for the `model`
+    kind (same pytree shapes, so no second engine and no new compiled
+    programs), per-token adapter ids for the `lora` kind (drafting as a
+    hot-swappable adapter on the PR-10 store).
+
+    Per spec tick, `propose` runs:
+      1. catch-up — plain ragged chunk dispatches feed each draft slot
+         the target context it is missing (first tick: the whole
+         prompt; steady state: the last verify's committed tokens);
+         a diverged slot (a non-trunk tree path won) simply overwrites
+         its stale cells in place, the established rollback contract.
+      2. propose — ONE small dispatch scores every row's context tip;
+         greedy argmax is the main chain's first node and, under tree
+         config, `propose_width` top-k ids seed the root branches.
+      3. extend — depth-1 plain 1-token dispatches grow the main chain
+         through the draft model (root alternatives stay depth-1
+         leaves: the draft slot's K/V follows the main chain only, and
+         a verify that accepts an alternative root just makes the next
+         catch-up overwrite from the divergence).
+
+    The coordinator never commits draft slots (their pages can never
+    enter the prefix cache) and keeps `slot.tokens` = REAL target
+    context only — speculative extension cells beyond it are
+    overwritten in place by the next catch-up, exactly like rejected
+    verify drafts."""
+
+    def __init__(self, kind: str, adapter_slot: int = 0,
+                 params: Any = None):
+        if kind not in ("model", "lora"):
+            raise ValueError(f"DeviceDrafter kind must be model|lora, "
+                             f"got {kind!r}")
+        self.kind = kind
+        self.adapter_slot = adapter_slot
+        self.params = params  # None = the engine's own params
+        self.draft_dispatches = 0
+
+    # -- slot lifecycle --
+
+    def end_row(self, engine, row_name: str) -> None:
+        """Release the row's draft slot (scheduler retire/fail path)."""
+        engine.kv.release(draft_slot_name(row_name))
+
+    # -- the per-tick batched proposal --
+
+    def _batch(self, engine, seqs, shape, propose_width=0):
+        from .serving_loop import build_ragged_batch
+        batch = build_ragged_batch(
+            seqs, t_budget=shape, s_max=engine.kv.num_slots + 1,
+            pages_per_seq=engine.kv.pages_per_seq,
+            scratch_page=engine.kv.scratch_page(0),
+            pad_id=engine.tokenizer.pad_id,
+            page_size=engine.kv.page_size)
+        batch["draft"] = True
+        if propose_width:
+            batch["propose_width"] = propose_width
+        if self.params is not None:
+            batch["draft_params"] = self.params
+        return batch
+
+    def propose(self, engine, rows, pinned=(),
+                dispatch=None, read=None) -> dict:
+        """rows: list of (key, row_name, ctx_tokens, depth, branch).
+        Returns {key: [path, ...]} — the main chain plus up to
+        branch-1 single-node root alternatives; every path non-empty.
+        `dispatch`/`read` let the scheduler route the device calls
+        through its run_dispatch/host_sync watchdog seams."""
+        import numpy as np
+
+        from .serving_loop import RAGGED_BLOCK_Q, RaggedSeq, \
+            ragged_pick_shape
+
+        if dispatch is None:
+            dispatch = engine._ragged_dispatch
+        if read is None:
+            def read(h):
+                # The propose dispatch returns (next_ids, top_k_ids)
+                # when propose_width > 0; plain dispatches one array.
+                if isinstance(h, tuple):
+                    return tuple(np.asarray(x) for x in h)
+                return np.asarray(h)
+        kv = engine.kv
+        temps = 0.0  # point-mass drafter: always greedy
+        pinned = tuple(pinned) + tuple(
+            draft_slot_name(name) for _, name, _, _, _ in rows)
+
+        # 1. slots + capacity + catch-up plans. Capacity failures here
+        # are BENIGN (the batch is too big to shadow — serve plain
+        # decode, never evict live rows to draft for them) and must not
+        # be confused with device dispatch failures below, which take
+        # the ragged failure ladder.
+        infos = []
+        try:
+            for key, name, ctx, depth, branch in rows:
+                dname = draft_slot_name(name)
+                st = kv.acquire(dname, pinned)
+                common = kv.common_prefix_len(st.tokens, ctx)
+                if common < len(st.tokens):
+                    # Diverged (or freshly evicted): keep the common
+                    # prefix, overwrite the rest in place.
+                    st.tokens = st.tokens[:common]
+                kv.ensure_capacity(dname, len(ctx) + depth,
+                                   write_from=common, pinned=pinned)
+                table = kv.table_for([dname])[0]
+                infos.append({"key": key, "st": st, "ctx": list(ctx),
+                              "depth": depth, "branch": branch,
+                              "table": table})
+        except RuntimeError as e:
+            raise DraftUnavailable(str(e)) from e
+
+        # 2. catch-up chunks until every remainder fits the propose run.
+        while True:
+            longs = [i for i in infos
+                     if len(i["ctx"]) - len(i["st"].tokens) > PROPOSE_RUN]
+            if not longs:
+                break
+            per_row = max((engine.ragged_tokens // len(longs))
+                          // RAGGED_BLOCK_Q * RAGGED_BLOCK_Q,
+                          RAGGED_BLOCK_Q)
+            seqs, feeds = [], []
+            for i in longs:
+                done = len(i["st"].tokens)
+                rem = len(i["ctx"]) - done
+                take = min(rem - PROPOSE_RUN, per_row)
+                if take < 1:
+                    continue
+                chunk = i["ctx"][done:done + take]
+                seqs.append(RaggedSeq(chunk, done, i["table"],
+                                      temperature=temps,
+                                      adapter=self.adapter_slot))
+                feeds.append((i, chunk))
+            if not seqs:
+                break
+            want = sum(-(-len(s.tokens) // RAGGED_BLOCK_Q)
+                       * RAGGED_BLOCK_Q for s in seqs)
+            shape = ragged_pick_shape(engine.ragged_shapes,
+                                      min(want, engine.ragged_tokens))
+            read(dispatch(self._batch(engine, seqs, shape)))
+            self.draft_dispatches += 1
+            for i, chunk in feeds:
+                i["st"].tokens = i["st"].tokens + chunk
+
+        # 3. the propose dispatch: remainder runs (1..PROPOSE_RUN
+        # tokens) score the context tip; top-k seeds the root branches.
+        branch_max = max(i["branch"] for i in infos)
+        seqs = []
+        for i in infos:
+            done = len(i["st"].tokens)
+            rem = i["ctx"][done:]
+            if not rem:
+                # Fully caught up (a verify failed after the previous
+                # propose advanced the slot): re-feed the last context
+                # token — identical K/V bytes at its own position, and
+                # the tip logits still come out.
+                done -= 1
+                rem = i["ctx"][-1:]
+            assert 1 <= len(rem) <= PROPOSE_RUN
+            seqs.append(RaggedSeq(rem, done, i["table"],
+                                  temperature=temps,
+                                  adapter=self.adapter_slot))
+        shape = ragged_pick_shape(
+            engine.ragged_shapes,
+            min(RAGGED_BLOCK_Q * len(seqs), engine.ragged_tokens))
+        out = read(dispatch(self._batch(
+            engine, seqs, shape,
+            propose_width=(branch_max if branch_max > 1 else 0))))
+        self.draft_dispatches += 1
+        if branch_max > 1:
+            nxt, tops = out
+        else:
+            nxt, tops = out, None
+        for idx, i in enumerate(infos):
+            # Snapshot, never alias: the scheduler's per-row ctx cache
+            # keeps growing across ticks, and an aliased st.tokens
+            # growing with it would claim K/V the slot never received.
+            i["st"].tokens = list(i["ctx"])
+            c1 = int(nxt[idx])
+            i["main"] = [c1]
+            alts = []
+            if tops is not None:
+                for t in list(tops[idx])[:i["branch"]]:
+                    t = int(t)
+                    if t != c1 and t not in alts:
+                        alts.append(t)
+            i["alts"] = alts[:max(i["branch"] - 1, 0)]
+
+        # 4. extend the main chain through the draft model.
+        max_depth = max(i["depth"] for i in infos)
+        for step in range(1, max_depth):
+            seqs, growing = [], []
+            for i in infos:
+                if i["depth"] <= step:
+                    continue
+                pos = len(i["ctx"]) + step - 1
+                seqs.append(RaggedSeq([i["main"][-1]], pos, i["table"],
+                                      temperature=temps,
+                                      adapter=self.adapter_slot))
+                growing.append(i)
+            if not seqs:
+                break
+            shape = ragged_pick_shape(
+                engine.ragged_shapes,
+                min(RAGGED_BLOCK_Q * len(seqs), engine.ragged_tokens))
+            nxt = read(dispatch(self._batch(engine, seqs, shape)))
+            self.draft_dispatches += 1
+            for idx, i in enumerate(growing):
+                i["main"].append(int(nxt[idx]))
+
+        return {i["key"]: [i["main"]] + [[t] for t in i["alts"]]
+                for i in infos}
 
 
 # --- test-visibility counters (tests/conftest.py `spec_decode` guard) ---
@@ -233,12 +753,19 @@ _lock = threading.Lock()
 _drafted = 0
 _accepted = 0
 _dispatches = 0
+_tree_accepted_paths = 0
+_tree_nodes = 0
+_reprobes = 0
+_reprobe_recoveries = 0
 
 
 def reset_test_counters() -> None:
-    global _drafted, _accepted, _dispatches
+    global _drafted, _accepted, _dispatches, _tree_accepted_paths
+    global _tree_nodes, _reprobes, _reprobe_recoveries
     with _lock:
         _drafted = _accepted = _dispatches = 0
+        _tree_accepted_paths = _tree_nodes = 0
+        _reprobes = _reprobe_recoveries = 0
 
 
 def note_spec_dispatch(drafted: int, accepted: int) -> None:
@@ -247,6 +774,27 @@ def note_spec_dispatch(drafted: int, accepted: int) -> None:
         _drafted += drafted
         _accepted += accepted
         _dispatches += 1
+
+
+def note_tree_row(nodes: int, accepted_edges: int) -> None:
+    """One multi-path (tree) row through a verify dispatch: `nodes`
+    tree nodes packed, `accepted_edges` edges the walk accepted. A
+    MULTI-NODE accepted path (>= 2 edges) is what the conftest
+    `tree=True` guard requires — single-edge acceptance is
+    indistinguishable from a lucky chain."""
+    global _tree_nodes, _tree_accepted_paths
+    with _lock:
+        _tree_nodes += nodes
+        if accepted_edges >= 2:
+            _tree_accepted_paths += 1
+
+
+def note_spec_reprobe(recovered: bool) -> None:
+    global _reprobes, _reprobe_recoveries
+    with _lock:
+        _reprobes += 1
+        if recovered:
+            _reprobe_recoveries += 1
 
 
 def drafted_seen() -> int:
@@ -259,3 +807,19 @@ def accepted_seen() -> int:
 
 def dispatches_seen() -> int:
     return _dispatches
+
+
+def tree_accepted_paths_seen() -> int:
+    return _tree_accepted_paths
+
+
+def tree_nodes_seen() -> int:
+    return _tree_nodes
+
+
+def reprobes_seen() -> int:
+    return _reprobes
+
+
+def reprobe_recoveries_seen() -> int:
+    return _reprobe_recoveries

@@ -746,16 +746,36 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
                           "(same per-scheduler split)",
         "events": "flight recorder ring (sched_* kinds)",
     },
-    # engine.describe()["spec_decode"] (ISSUE 9): the speculation
+    # engine.describe()["spec_decode"] (ISSUE 9 + 13): the speculation
     # provenance sink's registry bindings — drafted/accepted/rejected
     # counters move in lockstep with the describe() totals
-    # (engine.note_spec_dispatch is the one writer for both).
+    # (engine.note_spec_dispatch is the one writer for both). ISSUE 13:
+    # every counter/gauge carries a `drafter` label (ngram|model|lora)
+    # so dashboards attribute an acceptance collapse to the PROPOSER,
+    # not the throttle; the active drafter + tree shape ride describe()
+    # so a snapshot says which proposer produced the numbers.
     "engine_spec_decode": {
-        "drafted_tokens": "roundtable_spec_drafted_tokens_total",
-        "accepted_tokens": "roundtable_spec_accepted_tokens_total",
-        "rejected_tokens": "roundtable_spec_rejected_tokens_total",
-        "acceptance_rate": "roundtable_spec_acceptance_rate gauge",
+        "drafter": "label value on every roundtable_spec_* series",
+        "drafter_reason": "derived (drafter-availability fallback; "
+                          "describe-only)",
+        "tree": "static config (branch x depth); labels "
+                "roundtable_spec_tree_nodes_total",
+        "drafted_tokens": "roundtable_spec_drafted_tokens_total"
+                          "{drafter=...}",
+        "accepted_tokens": "roundtable_spec_accepted_tokens_total"
+                           "{drafter=...}",
+        "rejected_tokens": "roundtable_spec_rejected_tokens_total"
+                           "{drafter=...}",
+        "acceptance_rate": "roundtable_spec_acceptance_rate gauge "
+                           "(per-drafter: labeled with the drafter "
+                           "whose dispatches moved it)",
+        "by_drafter": "per-drafter split of the drafted/accepted "
+                      "counters (same writer)",
         "throttled_rows": "spec_throttle flight events (one per trip)",
+        "tree_nodes": "roundtable_spec_tree_nodes_total{drafter=...}",
+        "tree_rows": "derived (tree-row share of verify dispatches)",
+        "draft_dispatches": "ragged provenance ring entries with "
+                            "draft=True (DeviceDrafter counter)",
         "verify_dispatches": "roundtable_sched_spec_segments_total "
                              "(+ warmup dispatches)",
     },
