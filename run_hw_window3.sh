@@ -21,6 +21,20 @@ cd "$(dirname "$0")" || exit 1
 OUT=BENCH_r05_builder.jsonl
 . ./hw_window_lib.sh
 
+# Preflight (ISSUE 15): the static serving-invariant analyzer runs
+# BEFORE the probe loop, on CPU, with zero devices — a statically
+# detectable violation (gauge leak, static-arg recompile, callback in
+# the hot loop, donation misuse) must never cost a tunnel window. The
+# --jaxpr audit traces every registered serving program; --json output
+# lands next to the bench artifacts for the record.
+if ! env JAX_PLATFORMS=cpu python -m theroundtaible_tpu lint --jaxpr \
+    --json > LINT_preflight.json 2>> "$OUT.log"; then
+  echo "window3: roundtable lint FAILED $(stamp) — fix the findings" \
+       "in LINT_preflight.json before spending a window" >> "$OUT.log"
+  exit 1
+fi
+echo "window3: lint preflight clean $(stamp)" >> "$OUT.log"
+
 while :; do
   python - <<'PY' 2>> "$OUT.log"
 import sys

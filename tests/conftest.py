@@ -46,6 +46,12 @@ import threading
 
 import pytest
 
+# The static-analysis fixture corpus (ISSUE 15) is lint INPUT — seeded
+# rule violations and mini test trees the analyzer runs over — never
+# test code to collect (its deliberate test_*.py twins would otherwise
+# collide at import time and carry unregistered fixture markers).
+collect_ignore = ["fixtures"]
+
 # Per-test wall-clock guard (ISSUE 2 tooling satellite): a regression
 # that reintroduces an unbounded device wait must fail ITS test fast
 # with a named culprit instead of eating the whole 870 s tier-1 budget

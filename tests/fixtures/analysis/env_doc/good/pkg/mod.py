@@ -1,0 +1,9 @@
+import os
+
+KNOB_ENV = "ROUNDTABLE_FIXTURE_ASSIGNED"
+
+
+def knobs():
+    return (os.environ.get("ROUNDTABLE_FIXTURE_SECRET"),
+            os.environ.get(KNOB_ENV),
+            os.environ.get("ROUNDTABLE_FIXTURE_DOCUMENTED"))

@@ -1,0 +1,3 @@
+ERROR_KIND_TABLE = {
+    "RegisteredError": "timeout",
+}

@@ -1,0 +1,6 @@
+class RogueError(RuntimeError):
+    pass
+
+
+def fail():
+    raise RogueError("engine failure nobody can classify")

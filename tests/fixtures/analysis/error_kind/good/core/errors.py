@@ -1,0 +1,7 @@
+ERROR_KIND_TABLE = {
+    "RegisteredError": "timeout",
+}
+
+
+class RoundtableError(Exception):
+    pass

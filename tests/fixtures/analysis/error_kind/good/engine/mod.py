@@ -1,0 +1,15 @@
+from ..core.errors import RoundtableError
+
+
+class RegisteredError(RuntimeError):
+    pass
+
+
+class TypedError(RoundtableError):
+    pass
+
+
+def fail(which):
+    if which:
+        raise RegisteredError("in the table")
+    raise TypedError("a RoundtableError descendant")

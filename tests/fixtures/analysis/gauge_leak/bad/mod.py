@@ -1,0 +1,6 @@
+"""Seeded RT-GAUGE-LEAK violation: per-session gauge, no remove."""
+from somewhere import telemetry
+
+
+def publish(session, n):
+    telemetry.set_gauge("fixture_session_bytes", n, session=session)

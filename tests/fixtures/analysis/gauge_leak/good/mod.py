@@ -1,0 +1,11 @@
+"""Clean twin: the per-session series has a remove path (it may live
+in another file; same-file here for brevity)."""
+from somewhere import telemetry
+
+
+def publish(session, n):
+    if n <= 0:
+        telemetry.REGISTRY.remove_gauge("fixture_session_bytes",
+                                        session=session)
+        return
+    telemetry.set_gauge("fixture_session_bytes", n, session=session)

@@ -1,0 +1,9 @@
+"""Seeded RT-LOCK-BUMP violation: unlocked bump, no contract."""
+
+
+class SessionScheduler:
+    def submit(self, req):
+        self._bump("admitted")
+
+    def _bump(self, counter, n=1):
+        setattr(self, counter, getattr(self, counter, 0) + n)

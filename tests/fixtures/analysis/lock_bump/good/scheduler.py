@@ -1,0 +1,16 @@
+"""Clean twin: one bump under the cv, one in a documented
+loop-thread-only method."""
+
+
+class SessionScheduler:
+    def submit(self, req):
+        with self._cv:
+            self._bump("admitted")
+
+    def _retire(self):
+        """Retire finished requests. Loop-thread only (single-writer
+        counter bumps need no cv)."""
+        self._bump("completed")
+
+    def _bump(self, counter, n=1):
+        setattr(self, counter, getattr(self, counter, 0) + n)

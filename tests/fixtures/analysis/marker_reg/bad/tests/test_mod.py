@@ -1,0 +1,6 @@
+import pytest
+
+
+@pytest.mark.fixture_subsystem
+def test_covered():
+    pass

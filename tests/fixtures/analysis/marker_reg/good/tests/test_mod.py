@@ -1,0 +1,7 @@
+import pytest
+
+
+@pytest.mark.fixture_subsystem
+@pytest.mark.parametrize("x", [1])
+def test_covered(x):
+    pass

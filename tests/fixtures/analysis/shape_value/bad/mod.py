@@ -1,0 +1,7 @@
+"""Seeded RT-SHAPE-VALUE violation: occupancy reaches a static arg."""
+from serving import build_ragged_batch
+
+
+def dispatch(rows, grid, kv):
+    return build_ragged_batch(rows, t_budget=len(rows) * 8,
+                              s_max=kv.free_pages() + 1)

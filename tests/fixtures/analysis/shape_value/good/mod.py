@@ -1,0 +1,8 @@
+"""Clean twin: runtime size laundered through the bounded grid."""
+from serving import build_ragged_batch, pow2_bucket, ragged_pick_shape
+
+
+def dispatch(rows, grid, s_max):
+    shape = ragged_pick_shape(grid, len(rows) * 8)
+    return build_ragged_batch(rows, t_budget=shape,
+                              s_max=pow2_bucket(len(rows)) + 1)

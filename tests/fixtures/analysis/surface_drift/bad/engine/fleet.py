@@ -1,0 +1,6 @@
+def fleet_health():
+    return {
+        "engines": [],
+        "open": 0,
+        "mystery_key": 1,
+    }

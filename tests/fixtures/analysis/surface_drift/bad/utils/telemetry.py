@@ -1,0 +1,6 @@
+SURFACE_BINDINGS = {
+    "fleet_health": {
+        "engines": "roundtable_breaker_failures_total",
+        "open": "roundtable_breaker_open gauge",
+    },
+}

@@ -1,0 +1,7 @@
+SURFACE_BINDINGS = {
+    "fleet_health": {
+        "engines": "roundtable_breaker_failures_total",
+        "open": "roundtable_breaker_open gauge",
+        "mystery_key": "roundtable_mystery gauge",
+    },
+}

@@ -1,0 +1,65 @@
+"""Static-analysis subsystem — `roundtable lint` (ISSUE 15).
+
+Two halves behind one driver:
+
+- AST rule engine (`astlint` + `rules/`): file/line findings with
+  machine-readable ids, encoding the serving invariants PRs 4-13
+  learned dynamically; suppressions live in `allowlist.toml`, every
+  entry carrying a written reason.
+- jaxpr auditor (`jaxpr_audit`): abstract CPU traces of every
+  registered serving program, asserting donation safety, callback-free
+  hot loops, and the warmed-variant count across the shape grid.
+
+Lazy exports (PEP 562): the engine modules import
+`jaxpr_audit.analysis_register` at import time, and that must not drag
+the AST machinery (or anything heavier) into the serving path.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "Allowlist": ("astlint", "Allowlist"),
+    "Finding": ("astlint", "Finding"),
+    "LintConfigError": ("astlint", "LintConfigError"),
+    "ProjectIndex": ("astlint", "ProjectIndex"),
+    "Rule": ("astlint", "Rule"),
+    "default_allowlist_path": ("astlint", "default_allowlist_path"),
+    "run_rules": ("astlint", "run_rules"),
+    "unallowlisted": ("astlint", "unallowlisted"),
+    "ALL_RULES": ("rules", "ALL_RULES"),
+    "get_rules": ("rules", "get_rules"),
+    "analysis_register": ("jaxpr_audit", "analysis_register"),
+    "audit_engine": ("jaxpr_audit", "audit_engine"),
+    "audit_programs": ("jaxpr_audit", "audit_programs"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    return getattr(mod, attr)
+
+
+def run_lint(root: str, rule_ids=None, allowlist_path=None,
+             extra_findings=None, extra_active=None):
+    """One-call lint driver: rules + allowlist over `root`. Returns
+    the full finding list (allowlisted findings marked). The jaxpr
+    audit's findings ride in via `extra_findings`/`extra_active` so
+    both halves suppress (and go stale) through the one allowlist."""
+    from .astlint import Allowlist, default_allowlist_path, run_rules
+    from .rules import get_rules
+
+    if allowlist_path is None:
+        allowlist_path = default_allowlist_path()
+    return run_rules(root, get_rules(rule_ids),
+                     allowlist=Allowlist.load(allowlist_path),
+                     extra_findings=extra_findings,
+                     extra_active=extra_active)
+
+
+__all__ = sorted(_EXPORTS) + ["run_lint"]

@@ -1,0 +1,413 @@
+"""AST rule engine — the static half of `roundtable lint` (ISSUE 15).
+
+PRs 4-13 accumulated serving invariants (shapes-are-config-only,
+per-entity gauges removed at retire, lock-held counter bumps, error
+kinds classified, donation never read-after-dispatch) that were only
+ever enforced DYNAMICALLY: runtime sentinels and conftest guards that
+fire late and only on exercised paths. This module makes them checkable
+at import time, on CPU, with zero devices: a file-walking visitor
+framework with file/line findings, machine-readable rule ids, and an
+explicit allowlist whose every entry carries a written reason.
+
+Architecture:
+
+- `ProjectIndex` walks a root, parses every .py into an AST once, and
+  hands rules cheap access to trees, sources and sibling text files
+  (README/pyproject) — rules never re-read the disk.
+- `Rule` subclasses (analysis/rules/*.py) each encode ONE lesson the
+  repo already paid for, returning `Finding`s with a stable id.
+- `Allowlist` (analysis/allowlist.toml) suppresses findings one
+  written-reason entry at a time; an entry with no reason is a lint
+  CONFIG error, and an entry matching nothing is reported stale
+  (`RT-ALLOWLIST-STALE`) so dead suppressions can't accumulate.
+
+The engine is root-relative on purpose: the fixture corpus under
+tests/fixtures/analysis/ runs each rule over a mini-root proving it
+catches its seeded violation and passes its clean twin.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    """One rule violation at a file/line — the machine-readable unit
+    the CLI renders, --json emits, and the allowlist matches on."""
+
+    rule: str
+    path: str            # root-relative, "/"-separated
+    line: int
+    message: str
+    severity: str = "error"
+    allowed: bool = False
+    allow_reason: Optional[str] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "severity": self.severity, "message": self.message}
+        if self.allowed:
+            d["allowed"] = True
+            d["allow_reason"] = self.allow_reason
+        return d
+
+    def render(self) -> str:
+        mark = " (allowlisted)" if self.allowed else ""
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}]{mark} {self.message}")
+
+
+class LintConfigError(RuntimeError):
+    """The lint CONFIGURATION is broken (malformed allowlist, entry
+    without a reason) — distinct from findings: a broken config must
+    fail the run loudly, never silently suppress everything."""
+
+
+# ---------------------------------------------------------------------------
+# project index
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_xla_cache", "node_modules",
+              ".venv", "venv"}
+
+
+class ProjectIndex:
+    """Parsed view of a source root.
+
+    On the real repo the scan is the package + tests (bench scripts and
+    build artifacts are out of scope); a fixture mini-root without the
+    package directory scans every .py under it."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.trees: dict[str, ast.Module] = {}
+        self.sources: dict[str, str] = {}
+        self.parse_errors: dict[str, str] = {}
+        self._parents: dict[str, dict[ast.AST, ast.AST]] = {}
+        for rel in self._discover():
+            full = os.path.join(self.root, rel)
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    src = f.read()
+                self.sources[rel] = src
+                self.trees[rel] = ast.parse(src, filename=rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.parse_errors[rel] = str(e)
+
+    def _discover(self) -> list[str]:
+        pkg = os.path.join(self.root, "theroundtaible_tpu")
+        repo_layout = os.path.isdir(pkg)
+        roots = ([os.path.join(self.root, d)
+                  for d in ("theroundtaible_tpu", "tests")
+                  if os.path.isdir(os.path.join(self.root, d))]
+                 if repo_layout else [self.root])
+        out: list[str] = []
+        for base in roots:
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+                if repo_layout:
+                    # The seeded-violation corpus (tests/fixtures/...)
+                    # is lint INPUT for the per-rule tests, not part of
+                    # the tree: scanning it would make the live-tree
+                    # clean run impossible by construction.
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "fixtures"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root)
+                        out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    # --- access helpers rules share ---
+
+    def files(self, prefix: str = "") -> list[str]:
+        return [p for p in sorted(self.trees) if p.startswith(prefix)]
+
+    def tree(self, rel: str) -> Optional[ast.Module]:
+        return self.trees.get(rel)
+
+    def text(self, *names: str) -> str:
+        """Concatenated contents of sibling non-.py files at the root
+        (README.md, pyproject.toml, ...) — empty when absent."""
+        parts = []
+        for name in names:
+            full = os.path.join(self.root, name)
+            if os.path.isfile(full):
+                try:
+                    with open(full, "r", encoding="utf-8") as f:
+                        parts.append(f.read())
+                except OSError:
+                    pass
+        return "\n".join(parts)
+
+    def find_file(self, suffix: str) -> Optional[str]:
+        """First indexed file whose path ends with `suffix` (resource
+        lookups like core/errors.py that must also resolve inside
+        fixture mini-roots)."""
+        for rel in sorted(self.trees):
+            if rel.endswith(suffix):
+                return rel
+        return None
+
+    def parents(self, rel: str) -> dict[ast.AST, ast.AST]:
+        """Child -> parent map for one file's tree (lazily built): the
+        lexical-enclosure walks (with-blocks, enclosing defs) rules
+        need and ast doesn't provide."""
+        cached = self._parents.get(rel)
+        if cached is not None:
+            return cached
+        parent: dict[ast.AST, ast.AST] = {}
+        tree = self.trees[rel]
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parent[child] = node
+        self._parents[rel] = parent
+        return parent
+
+    def enclosing(self, rel: str, node: ast.AST,
+                  kinds: tuple[type, ...]) -> list[ast.AST]:
+        """All ancestors of `node` (innermost first) matching `kinds`."""
+        parent = self.parents(rel)
+        out = []
+        cur = parent.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                out.append(cur)
+            cur = parent.get(cur)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule base
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """One invariant. Subclasses set the class attrs and implement
+    run(); findings carry the rule id so the allowlist and --json
+    stay machine-readable."""
+
+    id: str = "RT-UNSET"
+    severity: str = "error"
+    description: str = ""
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(rule=self.id, path=path, line=line,
+                       message=message, severity=self.severity)
+
+
+# --- shared AST helpers ---
+
+
+def call_name(node: ast.Call) -> str:
+    """Rightmost name of the callee: `telemetry.REGISTRY.set_gauge(...)`
+    -> "set_gauge", `set_gauge(...)` -> "set_gauge"."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Full dotted rendering of a Name/Attribute chain ("" when the
+    chain contains calls/subscripts)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    reason: str
+    path: str = "*"
+    match: str = ""
+    line: int = 0            # line in allowlist.toml (stale reporting)
+    hits: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule != f.rule:
+            return False
+        if not fnmatch.fnmatch(f.path, self.path):
+            return False
+        return self.match in f.message
+
+
+def _parse_allowlist_toml(text: str, source: str) -> list[AllowEntry]:
+    """Minimal TOML-subset parser for the allowlist: `[[allow]]` array
+    tables with single-line `key = "string"` pairs. Python 3.10 has no
+    tomllib and the container must not grow a dependency; the subset is
+    pinned by tests so drift fails loudly."""
+    entries: list[AllowEntry] = []
+    cur: Optional[dict[str, Any]] = None
+
+    def close(d: Optional[dict]) -> None:
+        if d is None:
+            return
+        if not d.get("rule"):
+            raise LintConfigError(
+                f"{source}:{d['_line']}: allowlist entry missing "
+                "required key 'rule'")
+        if not str(d.get("reason", "")).strip():
+            raise LintConfigError(
+                f"{source}:{d['_line']}: allowlist entry for "
+                f"{d['rule']!r} carries no reason — every suppression "
+                "must say WHY (the allowlist policy, ISSUE 15)")
+        entries.append(AllowEntry(
+            rule=d["rule"], reason=d["reason"].strip(),
+            path=d.get("path", "*"), match=d.get("match", ""),
+            line=d["_line"]))
+
+    for i, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[allow]]":
+            close(cur)
+            cur = {"_line": i}
+            continue
+        if line.startswith("["):
+            raise LintConfigError(
+                f"{source}:{i}: unsupported table {line!r} — the "
+                "allowlist holds only [[allow]] entries")
+        if cur is None:
+            raise LintConfigError(
+                f"{source}:{i}: key/value outside an [[allow]] entry")
+        if "=" not in line:
+            raise LintConfigError(f"{source}:{i}: expected key = "
+                                  f"\"value\", got {line!r}")
+        key, _, val = line.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if not (len(val) >= 2 and val[0] == '"' and val[-1] == '"'):
+            raise LintConfigError(
+                f"{source}:{i}: value for {key!r} must be a one-line "
+                "double-quoted string")
+        cur[key] = val[1:-1].replace('\\"', '"')
+    close(cur)
+    return entries
+
+
+class Allowlist:
+    """Written-reason suppressions. apply() marks matching findings
+    allowed (first matching entry wins) and appends one STALE finding
+    per entry that matched nothing this run."""
+
+    def __init__(self, entries: list[AllowEntry], source: str = ""):
+        self.entries = entries
+        self.source = source
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Allowlist":
+        if path is None or not os.path.isfile(path):
+            return cls([], source=path or "")
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        return cls(_parse_allowlist_toml(text, os.path.basename(path)),
+                   source=path)
+
+    def apply(self, findings: list[Finding],
+              active_rules: Optional[set[str]] = None) -> list[Finding]:
+        """`active_rules` is the set of rule ids that actually RAN this
+        invocation (None = all): an entry whose rule was filtered out
+        by --rules (or whose jaxpr half didn't run) legitimately
+        matches nothing and must not be reported stale."""
+        for e in self.entries:
+            e.hits = 0
+        for f in findings:
+            for e in self.entries:
+                if e.matches(f):
+                    f.allowed = True
+                    f.allow_reason = e.reason
+                    e.hits += 1
+                    break
+        out = list(findings)
+        for e in self.entries:
+            if e.hits == 0 and (active_rules is None
+                                or e.rule in active_rules):
+                out.append(Finding(
+                    rule="RT-ALLOWLIST-STALE",
+                    path=os.path.basename(self.source or
+                                          "allowlist.toml"),
+                    line=e.line, severity="error",
+                    message=(f"allowlist entry for {e.rule} "
+                             f"(path={e.path!r}, match={e.match!r}) "
+                             "matched no finding — the violation it "
+                             "suppressed is gone; delete the entry")))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "allowlist.toml")
+
+
+def run_rules(root: str, rules: Iterable[Rule],
+              allowlist: Optional[Allowlist] = None,
+              index: Optional[ProjectIndex] = None,
+              extra_findings: Optional[list[Finding]] = None,
+              extra_active: Optional[set[str]] = None) -> list[Finding]:
+    """Run `rules` over `root`; returns ALL findings (allowlisted ones
+    marked, stale-allowlist findings appended), sorted by path/line.
+    Unparseable files are findings too — a syntax error must not make
+    its invariants unenforceable silently.
+
+    `extra_findings` (the jaxpr audit's output) joins the set BEFORE
+    the allowlist applies, so both halves suppress through the one
+    mechanism; `extra_active` names their rule ids for staleness
+    accounting even when the extra pass found nothing."""
+    rules = list(rules)
+    index = index or ProjectIndex(root)
+    findings: list[Finding] = []
+    for rel, err in sorted(index.parse_errors.items()):
+        findings.append(Finding(
+            rule="RT-PARSE", path=rel, line=0, severity="error",
+            message=f"file failed to parse — unlintable: {err}"))
+    for rule in rules:
+        findings.extend(rule.run(index))
+    findings.extend(extra_findings or [])
+    if allowlist is not None:
+        active = {r.id for r in rules} | {"RT-PARSE"}
+        active |= extra_active or set()
+        active |= {f.rule for f in findings}
+        findings = allowlist.apply(findings, active_rules=active)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def unallowlisted(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.allowed]

@@ -1,0 +1,277 @@
+"""Device-free jaxpr audit of the serving programs (ISSUE 15, half 2).
+
+The AST rules see source; this half sees the PROGRAMS. Every serving
+program family (prefill / decode / ragged / spec-verify / propose /
+LoRA-setter) registers a provider via `analysis_register()` at its
+defining seam (engine.py / paged_forward.py / spec_decode.py /
+lora.py); `audit_engine(engine)` abstractly traces each registered
+program on CPU with `jax.make_jaxpr` — tracing never dispatches, so
+the whole audit runs with zero devices — across the SAME shape grid
+warmup compiles, and statically asserts three invariants:
+
+- **RT-JAXPR-DONATION** — inside every traced composition, a pjit
+  eqn's donated invars are dead afterwards: not consumed by any later
+  eqn and not returned as outputs. A donated buffer read after the
+  dispatch is the deleted-array crash the PR-1 ladder can only clean
+  up after; here it is a parse-time finding.
+- **RT-JAXPR-CALLBACK** — no `pure_callback` / `io_callback` /
+  `debug_callback` primitive (recursively, through pjit/while/cond
+  sub-jaxprs) in a decode / ragged / verify-phase program: a host
+  callback in the hot loop is a per-token host sync.
+- **RT-JAXPR-VARIANTS** — the variant grid replays runtime drift
+  (occupancies, compositions) through the REAL static-argument
+  computation the serving path uses; every declared variant label must
+  map to EXACTLY ONE distinct jaxpr. A static-arg leak (a value
+  derived from runtime state reaching a static parameter) shows up as
+  extra distinct jaxprs under one label — RECOMPILE_STRICT proven
+  before a device exists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .astlint import Finding
+
+# Program phases whose jaxprs must be host-callback-free: these run
+# per decode tick, so one callback is one host sync per token.
+HOT_PHASES = frozenset({"decode", "ragged", "verify"})
+
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+# The audit's finding ids — the CLI passes these as the allowlist's
+# active set when --jaxpr runs, so a jaxpr suppression can go stale.
+JAXPR_RULE_IDS = frozenset({"RT-JAXPR-DONATION", "RT-JAXPR-CALLBACK",
+                            "RT-JAXPR-VARIANTS", "RT-JAXPR-TRACE"})
+
+
+@dataclass
+class Variant:
+    """One grid point: a runtime-ish situation (occupancy, composition)
+    mapped onto the label of the compiled program that SHOULD serve it.
+    `thunk()` returns the traced ClosedJaxpr for that situation."""
+
+    label: str
+    thunk: Callable[[], Any]
+    situation: str = ""      # human description ("occupancy 3", ...)
+
+
+@dataclass
+class ProgramSpec:
+    """One serving program family across its warmed-variant grid."""
+
+    name: str                # "decode[paged]", "ragged", ...
+    phase: str               # prefill|decode|ragged|verify|propose|setter
+    variants: list[Variant] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# provider registry — the analysis_register() hook
+# ---------------------------------------------------------------------------
+
+_PROVIDERS: dict[str, Callable[[Any], list[ProgramSpec]]] = {}
+
+
+def analysis_register(name: str):
+    """Register a serving-program provider at its defining seam.
+
+    `fn(engine) -> list[ProgramSpec]` builds trace thunks from a LIVE
+    engine's own state (params, pools, shape grids) exactly the way
+    the serving path builds dispatch arguments — returning [] when the
+    engine does not serve that family. Decorating at module scope
+    keeps registration import-time cheap; nothing traces until
+    audit_engine() runs."""
+
+    def deco(fn: Callable[[Any], list[ProgramSpec]]):
+        _PROVIDERS[name] = fn
+        return fn
+
+    return deco
+
+
+def registered_providers() -> dict[str, Callable]:
+    return dict(_PROVIDERS)
+
+
+# The modules that register providers at import time. Several are
+# imported LAZILY by the serving path (paged_forward inside the jitted
+# closures, lora on first store construction), so an audit run must
+# pull them in itself — a provider that silently never registered
+# would silently audit nothing.
+_PROVIDER_MODULES = ("engine.engine", "engine.paged_forward",
+                     "engine.spec_decode", "engine.lora")
+
+
+def _ensure_provider_modules() -> None:
+    import importlib
+
+    pkg = __name__.rsplit(".", 2)[0]    # theroundtaible_tpu
+    for mod in _PROVIDER_MODULES:
+        importlib.import_module(f"{pkg}.{mod}")
+
+
+def collect_programs(engine) -> list[ProgramSpec]:
+    _ensure_provider_modules()
+    specs: list[ProgramSpec] = []
+    for name in sorted(_PROVIDERS):
+        specs.extend(_PROVIDERS[name](engine) or [])
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# jaxpr checks
+# ---------------------------------------------------------------------------
+
+
+def _iter_sub_jaxprs(jaxpr):
+    """jaxpr plus every nested jaxpr reachable through eqn params
+    (pjit bodies, while/cond branches, custom calls)."""
+    import jax.core as jcore  # noqa: F401 — jax import kept local
+
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        yield j
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for sub in _as_jaxprs(v):
+                    stack.append(sub)
+
+
+def _as_jaxprs(value):
+    out = []
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    for v in vals:
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            out.append(inner)          # ClosedJaxpr
+        elif hasattr(v, "eqns"):
+            out.append(v)              # raw Jaxpr
+    return out
+
+
+def find_callbacks(closed_jaxpr) -> list[str]:
+    """Callback primitive names present anywhere in the program."""
+    found = []
+    for j in _iter_sub_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if any(name.startswith(p) for p in _CALLBACK_PRIMS):
+                found.append(name)
+    return sorted(set(found))
+
+
+def donation_violations(closed_jaxpr) -> list[str]:
+    """For each pjit eqn with donated invars in the TOP-LEVEL
+    composition, the donated vars must be dead afterwards: consumed by
+    no later eqn and absent from the jaxpr's outputs. Returns
+    human-readable violation strings."""
+    jaxpr = closed_jaxpr.jaxpr
+    out: list[str] = []
+    outvars = {id(v) for v in jaxpr.outvars if hasattr(v, "aval")}
+    for i, eqn in enumerate(jaxpr.eqns):
+        donated = eqn.params.get("donated_invars")
+        if not donated or not any(donated):
+            continue
+        dead = [v for v, d in zip(eqn.invars, donated)
+                if d and hasattr(v, "aval")]
+        for v in dead:
+            later = [j for j in range(i + 1, len(jaxpr.eqns))
+                     if any(u is v for u in jaxpr.eqns[j].invars
+                            if hasattr(u, "aval"))]
+            if later:
+                out.append(
+                    f"donated input {v} of eqn #{i} "
+                    f"({eqn.params.get('name', eqn.primitive.name)}) is "
+                    f"read again by eqn #{later[0]} "
+                    f"({jaxpr.eqns[later[0]].primitive.name}) — "
+                    "use-after-donation")
+            if id(v) in outvars:
+                out.append(
+                    f"donated input {v} of eqn #{i} "
+                    f"({eqn.params.get('name', eqn.primitive.name)}) is "
+                    "returned by the composition — the caller receives "
+                    "a deleted buffer")
+    return out
+
+
+def jaxpr_fingerprint(closed_jaxpr) -> str:
+    """Stable identity of a traced program: avals + the full pretty-
+    printed jaxpr (shapes, primitives, static literals). Two traces
+    that would compile the same executable fingerprint identically;
+    a static-arg change shows up as a new fingerprint."""
+    h = hashlib.sha1()
+    for a in closed_jaxpr.in_avals:
+        h.update(str(a).encode())
+    h.update(b"|")
+    h.update(str(closed_jaxpr.jaxpr).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# audit driver
+# ---------------------------------------------------------------------------
+
+
+def audit_programs(specs: list[ProgramSpec]) -> list[Finding]:
+    """Trace every variant of every spec and run the three checks.
+    Findings reuse the astlint Finding type with a pseudo-path
+    `<jaxpr:program>` so the CLI/allowlist treat both halves
+    uniformly. A variant whose trace itself fails is a finding
+    (RT-JAXPR-TRACE) — an untraceable serving program is unauditable,
+    which must be loud, not skipped."""
+    findings: list[Finding] = []
+    for spec in specs:
+        path = f"<jaxpr:{spec.name}>"
+        by_label: dict[str, dict[str, str]] = {}
+        for var in spec.variants:
+            try:
+                traced = var.thunk()
+            except Exception as e:  # noqa: BLE001 — finding, not crash
+                findings.append(Finding(
+                    rule="RT-JAXPR-TRACE", path=path, line=0,
+                    message=(f"variant {var.label!r} "
+                             f"({var.situation}) failed to trace: "
+                             f"{type(e).__name__}: {str(e)[:300]}")))
+                continue
+            fp = jaxpr_fingerprint(traced)
+            by_label.setdefault(var.label, {})[fp] = var.situation
+            if spec.phase in HOT_PHASES:
+                cbs = find_callbacks(traced)
+                if cbs:
+                    findings.append(Finding(
+                        rule="RT-JAXPR-CALLBACK", path=path, line=0,
+                        message=(f"{spec.phase} program variant "
+                                 f"{var.label!r} contains host "
+                                 f"callback primitive(s) "
+                                 f"{', '.join(cbs)} — a host sync "
+                                 "per hot-loop dispatch")))
+            for viol in donation_violations(traced):
+                findings.append(Finding(
+                    rule="RT-JAXPR-DONATION", path=path, line=0,
+                    message=f"variant {var.label!r}: {viol}"))
+        for label, fps in sorted(by_label.items()):
+            if len(fps) > 1:
+                sits = "; ".join(sorted(fps.values()))
+                findings.append(Finding(
+                    rule="RT-JAXPR-VARIANTS", path=path, line=0,
+                    message=(f"declared variant {label!r} traced to "
+                             f"{len(fps)} DISTINCT jaxprs across the "
+                             f"grid ({sits}) — a static argument is "
+                             "leaking runtime state: one compile per "
+                             "runtime value in steady state "
+                             "(RECOMPILE_STRICT violation, proven "
+                             "device-free)")))
+    return findings
+
+
+def audit_engine(engine) -> list[Finding]:
+    """Run every registered provider against a live (CPU) engine and
+    audit the produced program grid."""
+    return audit_programs(collect_programs(engine))
+
+
+def audited_program_names(engine) -> list[str]:
+    return sorted(s.name for s in collect_programs(engine))

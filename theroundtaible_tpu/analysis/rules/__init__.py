@@ -1,0 +1,47 @@
+"""Rule registry — one class per serving invariant (ISSUE 15).
+
+Each rule encodes a lesson a previous PR paid for dynamically; the ids
+are stable machine-readable handles the allowlist, --rules filter and
+--json output key on.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..astlint import Rule
+from .envdoc import EnvDocRule
+from .error_kinds import ErrorKindRule
+from .gauges import GaugeLeakRule
+from .locking import LockBumpRule
+from .markers import MarkerRegRule
+from .shapes import ShapeValueRule
+from .surface_drift import SurfaceDriftRule
+
+ALL_RULES: tuple[type[Rule], ...] = (
+    GaugeLeakRule,
+    LockBumpRule,
+    ErrorKindRule,
+    ShapeValueRule,
+    MarkerRegRule,
+    EnvDocRule,
+    SurfaceDriftRule,
+)
+
+RULES_BY_ID = {cls.id: cls for cls in ALL_RULES}
+
+
+def get_rules(ids: Optional[Iterable[str]] = None) -> list[Rule]:
+    """Instantiate the requested rules (all by default). Unknown ids
+    raise — a typo'd --rules filter must not silently lint nothing."""
+    if ids is None:
+        return [cls() for cls in ALL_RULES]
+    out = []
+    for rid in ids:
+        cls = RULES_BY_ID.get(rid)
+        if cls is None:
+            raise ValueError(
+                f"unknown rule id {rid!r} — known: "
+                f"{', '.join(sorted(RULES_BY_ID))}")
+        out.append(cls())
+    return out

@@ -1,0 +1,69 @@
+"""RT-ENV-DOC — every ROUNDTABLE_* environment variable the package
+reads is documented in README.md or ARCHITECTURE.md.
+
+Env vars are this repo's operational surface (kill switches, STRICT
+mode, budgets); an undocumented one is a control an operator cannot
+find during an incident. Detection is read-context-based so doc prose
+and rule source never self-flag: a ROUNDTABLE_* string literal counts
+only when it is (a) an argument of an os.environ/getenv read, (b) a
+subscript key of environ, or (c) assigned to a `*_ENV` constant (the
+serving_loop pattern, read later through the constant).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astlint import Finding, ProjectIndex, Rule, dotted_name, str_const
+
+_VAR = re.compile(r"^ROUNDTABLE_[A-Z0-9_]+$")
+
+
+def _env_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return (name.endswith("environ.get")
+            or name.endswith("environ.setdefault")
+            or name.endswith("environ.pop")
+            or name.endswith("os.getenv")
+            or name == "getenv")
+
+
+class EnvDocRule(Rule):
+    id = "RT-ENV-DOC"
+    severity = "error"
+    description = ("ROUNDTABLE_* env var read in the package with no "
+                   "README.md / ARCHITECTURE.md mention")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        docs = index.text("README.md", "ARCHITECTURE.md")
+        documented = set(re.findall(r"ROUNDTABLE_[A-Z0-9_]+", docs))
+        reads: dict[str, tuple[str, int]] = {}
+        for rel in index.files():
+            if rel.split("/")[0] == "tests":
+                continue
+            for node in ast.walk(index.tree(rel)):
+                var = None
+                if isinstance(node, ast.Call) and _env_call(node):
+                    for arg in node.args[:1]:
+                        var = str_const(arg)
+                elif (isinstance(node, ast.Subscript)
+                      and dotted_name(node.value).endswith("environ")):
+                    var = str_const(node.slice)
+                elif isinstance(node, ast.Assign):
+                    if any(isinstance(t, ast.Name)
+                           and t.id.endswith("_ENV")
+                           for t in node.targets):
+                        var = str_const(node.value)
+                if var is not None and _VAR.match(var):
+                    reads.setdefault(var, (rel, node.lineno))
+        out = []
+        for var in sorted(set(reads) - documented):
+            rel, line = reads[var]
+            out.append(self.finding(
+                rel, line,
+                f"env var {var} is read here but appears nowhere in "
+                "README.md or ARCHITECTURE.md — an operational control "
+                "nobody can find during an incident; document it (or "
+                "delete the dead read)"))
+        return out

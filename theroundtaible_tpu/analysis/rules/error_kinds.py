@@ -1,0 +1,124 @@
+"""RT-ERROR-KIND — every in-tree exception class raised under engine/
+must be classifiable (core/errors.py), not just raisable.
+
+PR 12's `device_lost` bug class: an engine error the classifier had
+never heard of took the wrong recovery ladder (a blind retry on a dead
+chip) because classification is message-sniffing and nobody registered
+the new class. The static check: for every `raise X(...)` in engine/
+where X is a class DEFINED in this tree, X must either
+
+- subclass (transitively, by the in-tree class graph) the
+  RoundtableError family — those carry exit codes and, for
+  AdapterError, an explicit `kind`; or
+- appear as a key of core/errors.py's `ERROR_KIND_TABLE` — the
+  declarative class→kind classification table `classify_error`
+  consults when message sniffing comes up empty.
+
+Stdlib raises (ValueError, RuntimeError, ...) are out of scope: their
+classification IS the message-marker sniffing, by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..astlint import Finding, ProjectIndex, Rule
+
+_ROOT_FAMILY = {"RoundtableError", "ConfigError", "AdapterError",
+                "SessionError", "FileWriteError", "ConsensusError"}
+
+
+def _base_names(cls: ast.ClassDef) -> list[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _table_keys(index: ProjectIndex, errors_rel: str) -> set[str]:
+    """Keys of the ERROR_KIND_TABLE dict literal in core/errors.py."""
+    keys: set[str] = set()
+    tree = index.tree(errors_rel)
+    if tree is None:
+        return keys
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if "ERROR_KIND_TABLE" not in names:
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    keys.add(k.value)
+    return keys
+
+
+class ErrorKindRule(Rule):
+    id = "RT-ERROR-KIND"
+    severity = "error"
+    description = ("in-tree exception class raised in engine/ that is "
+                   "neither a RoundtableError descendant nor "
+                   "registered in core/errors.py ERROR_KIND_TABLE")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        # In-tree class graph (name -> base names), tree-wide.
+        bases: dict[str, list[str]] = {}
+        for rel in index.files():
+            for node in ast.walk(index.tree(rel)):
+                if isinstance(node, ast.ClassDef):
+                    bases.setdefault(node.name, _base_names(node))
+
+        def is_roundtable(name: str,
+                          seen: Optional[set] = None) -> bool:
+            if name in _ROOT_FAMILY:
+                return True
+            seen = seen or set()
+            if name in seen or name not in bases:
+                return False
+            seen.add(name)
+            return any(is_roundtable(b, seen) for b in bases[name])
+
+        errors_rel = index.find_file("core/errors.py")
+        table = (_table_keys(index, errors_rel)
+                 if errors_rel is not None else set())
+
+        out: list[Finding] = []
+        reported: set[tuple[str, str]] = set()
+        for rel in index.files():
+            if "engine/" not in rel:
+                continue
+            for node in ast.walk(index.tree(rel)):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call) and isinstance(exc.func,
+                                                            ast.Name):
+                    name = exc.func.id
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name is None or name not in bases:
+                    continue    # stdlib / out-of-tree: sniffing's job
+                if is_roundtable(name) or name in table:
+                    continue
+                if (rel, name) in reported:
+                    continue
+                reported.add((rel, name))
+                out.append(self.finding(
+                    rel, node.lineno,
+                    f"engine code raises in-tree exception {name!r} "
+                    "which neither descends from RoundtableError nor "
+                    "appears in core/errors.py ERROR_KIND_TABLE — an "
+                    "unregistered class takes the wrong recovery "
+                    "ladder (the PR-12 device_lost ordering bug "
+                    "class); register it with its actionable kind"))
+        return out

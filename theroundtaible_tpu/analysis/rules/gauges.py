@@ -1,0 +1,74 @@
+"""RT-GAUGE-LEAK — per-entity gauge series must have a reachable
+remove_gauge (the PR-6 lesson PRs 9, 10 and 13 each re-fixed by hand).
+
+A `set_gauge(name, ..., session=... | adapter=... | row=... |
+drafter=...)` call creates one labeled series per ENTITY, and sessions/
+adapters/rows are uuid-tagged per serve call: a long-lived serving
+process grows the registry (and every metrics.prom export) one dead
+series per entity ever served unless retirement removes the series.
+The static check: for every gauge series name set with a per-entity
+label key anywhere in the tree, a `remove_gauge` call naming the SAME
+series literal must exist somewhere in the tree — set and remove are
+allowed to live in different files (the scheduler removes what the
+perfmodel publishes), but a series with no remove path at all is the
+exact leak shipped three times already.
+
+Bounded-domain labels (a `drafter` whose values are the closed set
+ngram|model|lora) are real findings too: the boundedness is a fact
+about TODAY's call sites, not the registry — such series are
+allowlisted with the boundedness written down as the reason, so the
+next person adding a drafter kind sees the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astlint import Finding, ProjectIndex, Rule, call_name, str_const
+
+# Label keys whose value domain is an open per-entity namespace (or a
+# domain the registry cannot bound). `engine`/`phase`/`rung` label
+# domains are config-bounded and excluded on purpose.
+PER_ENTITY_KEYS = frozenset(
+    {"session", "session_id", "adapter", "row", "request", "drafter"})
+
+
+class GaugeLeakRule(Rule):
+    id = "RT-GAUGE-LEAK"
+    severity = "error"
+    description = ("per-entity labeled gauge series set without any "
+                   "reachable remove_gauge for the same series name")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        sets: list[tuple[str, int, str, str]] = []  # path,line,series,key
+        removed: set[str] = set()
+        for rel in index.files():
+            for node in ast.walk(index.tree(rel)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                series = str_const(node.args[0]) if node.args else None
+                if series is None:
+                    continue
+                if name == "remove_gauge":
+                    removed.add(series)
+                elif name == "set_gauge":
+                    for kw in node.keywords:
+                        if kw.arg in PER_ENTITY_KEYS:
+                            sets.append((rel, node.lineno, series,
+                                         kw.arg))
+                            break
+        out = []
+        for rel, line, series, key in sets:
+            if series in removed:
+                continue
+            out.append(self.finding(
+                rel, line,
+                f"gauge series {series!r} is set with per-entity label "
+                f"{key}= but no remove_gauge({series!r}, ...) exists "
+                "anywhere in the tree — a long-lived serving process "
+                "keeps one dead series per retired entity (the PR-6 "
+                "gauge-leak lesson); remove the series at retirement, "
+                "or allowlist with the label's boundedness written "
+                "down"))
+        return out

@@ -1,0 +1,83 @@
+"""RT-LOCK-BUMP — SessionScheduler counter bumps happen under the cv
+or in a documented loop-thread-only method.
+
+The scheduler's provenance counters (`self._bump(...)` — which moves
+the attribute AND its registry series in lockstep) have exactly two
+sanctioned writers: code holding `self._cv`/`self._lock` (submitter /
+drain / monitoring threads racing each other), and the single-writer
+scheduler loop thread. The second case is a THREADING CONTRACT the
+code cannot show lexically, so this rule requires it written down: a
+bump outside a `with self._cv:` block is only clean when the enclosing
+method's docstring declares the loop-thread contract ("loop thread" /
+"loop-thread" / "scheduler thread"). A bump that is neither locked nor
+documented is exactly the racy increment PR 4's review passes kept
+finding by hand.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astlint import Finding, ProjectIndex, Rule, call_name, dotted_name
+
+_LOCK_ATTRS = ("self._cv", "self._lock")
+_LOOP_MARKERS = ("loop thread", "loop-thread", "scheduler thread")
+_COUNTER_CALLS = frozenset({"_bump"})
+
+
+def _with_holds_lock(node: ast.With) -> bool:
+    for item in node.items:
+        if dotted_name(item.context_expr) in _LOCK_ATTRS:
+            return True
+    return False
+
+
+class LockBumpRule(Rule):
+    id = "RT-LOCK-BUMP"
+    severity = "error"
+    description = ("scheduler counter mutation outside a with "
+                   "self._cv/_lock block in a method not documented "
+                   "loop-thread-only")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        out: list[Finding] = []
+        for rel in index.files():
+            tree = index.tree(rel)
+            for cls in ast.walk(tree):
+                if (isinstance(cls, ast.ClassDef)
+                        and cls.name == "SessionScheduler"):
+                    out.extend(self._check_class(index, rel, cls))
+        return out
+
+    def _check_class(self, index: ProjectIndex, rel: str,
+                     cls: ast.ClassDef) -> list[Finding]:
+        out = []
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in _COUNTER_CALLS
+                    and dotted_name(node.func).startswith("self.")):
+                continue
+            encl = index.enclosing(
+                rel, node, (ast.With, ast.FunctionDef,
+                            ast.AsyncFunctionDef))
+            method = next((e for e in encl
+                           if isinstance(e, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))),
+                          None)
+            if method is not None and method.name in _COUNTER_CALLS:
+                continue    # the definition itself
+            if any(isinstance(e, ast.With) and _with_holds_lock(e)
+                   for e in encl):
+                continue
+            doc = (ast.get_docstring(method) or "") if method else ""
+            if any(m in doc.lower() for m in _LOOP_MARKERS):
+                continue
+            where = method.name if method else "<module>"
+            out.append(self.finding(
+                rel, node.lineno,
+                f"self._bump(...) in {where}() runs outside a `with "
+                "self._cv:`/`with self._lock:` block and the method's "
+                "docstring does not declare the loop-thread-only "
+                "contract — either take the cv (it is reentrant) or "
+                "document which single thread owns this path"))
+        return out

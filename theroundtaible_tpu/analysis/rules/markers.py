@@ -1,0 +1,72 @@
+"""RT-MARKER-REG — every pytest.mark.<x> used under tests/ is
+registered in pyproject.toml.
+
+The conftest guards (scheduler / spec_decode / lora / ... markers) are
+how this repo fails LOUD when a subsystem silently serves its
+fallback; an unregistered marker is exactly the silent failure mode —
+pytest treats it as an unknown no-op mark, the guard never arms, and
+the test "passes" while covering nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astlint import Finding, ProjectIndex, Rule
+
+# pytest's own marks plus the plugin marks this tree may legitimately
+# carry without a [tool.pytest.ini_options] registration.
+_BUILTIN = frozenset({
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "timeout",
+})
+
+_MARKERS_BLOCK = re.compile(
+    r"markers\s*=\s*\[(?P<body>.*?)\]", re.DOTALL)
+_MARKER_NAME = re.compile(r"[\"']\s*([A-Za-z_][A-Za-z0-9_]*)\s*[:(\"']")
+
+
+def registered_markers(pyproject_text: str) -> set[str]:
+    m = _MARKERS_BLOCK.search(pyproject_text)
+    if not m:
+        return set()
+    return set(_MARKER_NAME.findall(m.group("body")))
+
+
+class MarkerRegRule(Rule):
+    id = "RT-MARKER-REG"
+    severity = "error"
+    description = ("pytest.mark used in tests/ without a pyproject "
+                   "markers registration — the mark (and its conftest "
+                   "guard) is a silent no-op")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        registered = registered_markers(index.text("pyproject.toml"))
+        test_files = [p for p in index.files()
+                      if p.split("/")[0] == "tests"
+                      or p.startswith("test_")]
+        out: list[Finding] = []
+        reported: set[tuple[str, str]] = set()
+        for rel in test_files:
+            for node in ast.walk(index.tree(rel)):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "mark"
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "pytest"):
+                    continue
+                name = node.attr
+                if name in _BUILTIN or name in registered:
+                    continue
+                if (rel, name) in reported:
+                    continue
+                reported.add((rel, name))
+                out.append(self.finding(
+                    rel, node.lineno,
+                    f"pytest.mark.{name} is not registered under "
+                    "[tool.pytest.ini_options] markers in "
+                    "pyproject.toml — pytest treats it as an unknown "
+                    "no-op mark and any conftest guard keyed on it "
+                    "never arms"))
+        return out

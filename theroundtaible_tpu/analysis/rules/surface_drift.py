@@ -1,0 +1,115 @@
+"""RT-SURFACE-DRIFT — observability surface keys must be bound to
+registry series in telemetry.SURFACE_BINDINGS (the ISSUE-5 single-
+source-of-truth contract, now with file/line findings).
+
+This is the static migration of the tests/test_telemetry.py
+TestSurfaceDrift pair (which stays in place — the dynamic test proves
+the RUNTIME dict matches; this rule points at the exact offending key
+expression without constructing an engine): the dict literals returned
+by `fleet_health()` (engine/fleet.py) and `SessionScheduler.describe()`
+(engine/scheduler.py) may only carry keys declared in
+`utils/telemetry.py`'s SURFACE_BINDINGS — a new surface key with no
+declared registry backing is how the four PR-1..4 provenance stores
+forked in the first place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..astlint import Finding, ProjectIndex, Rule
+
+# surface name in SURFACE_BINDINGS -> (file suffix, locator)
+_SURFACES = (
+    ("fleet_health", "engine/fleet.py", ("function", "fleet_health")),
+    ("scheduler_describe", "engine/scheduler.py",
+     ("method", "SessionScheduler", "describe")),
+)
+
+
+def _literal_keys(fn: ast.FunctionDef) -> list[tuple[str, int]]:
+    """String keys (with lines) of every dict literal returned by
+    `fn`, ignoring nested function bodies."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k in node.value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.append((k.value, k.lineno))
+    return out
+
+
+def _find_fn(tree: ast.Module,
+             locator: tuple) -> Optional[ast.FunctionDef]:
+    if locator[0] == "function":
+        for node in tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == locator[1]):
+                return node
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == locator[1]:
+            for item in node.body:
+                if (isinstance(item, ast.FunctionDef)
+                        and item.name == locator[2]):
+                    return item
+    return None
+
+
+def bound_keys(index: ProjectIndex) -> dict[str, set[str]]:
+    """Surface -> declared keys, parsed from the SURFACE_BINDINGS dict
+    literal in utils/telemetry.py."""
+    rel = index.find_file("utils/telemetry.py")
+    out: dict[str, set[str]] = {}
+    if rel is None:
+        return out
+    for node in ast.walk(index.tree(rel)):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name)
+                   and t.id == "SURFACE_BINDINGS" for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if (isinstance(k, ast.Constant)
+                    and isinstance(v, ast.Dict)):
+                out[k.value] = {
+                    kk.value for kk in v.keys
+                    if isinstance(kk, ast.Constant)
+                    and isinstance(kk.value, str)}
+    return out
+
+
+class SurfaceDriftRule(Rule):
+    id = "RT-SURFACE-DRIFT"
+    severity = "error"
+    description = ("observability surface key with no "
+                   "SURFACE_BINDINGS registry declaration")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        bindings = bound_keys(index)
+        out: list[Finding] = []
+        for surface, suffix, locator in _SURFACES:
+            rel = index.find_file(suffix)
+            if rel is None or surface not in bindings:
+                continue
+            fn = _find_fn(index.tree(rel), locator)
+            if fn is None:
+                continue
+            declared = bindings[surface]
+            for key, line in _literal_keys(fn):
+                if key not in declared:
+                    out.append(self.finding(
+                        rel, line,
+                        f"surface key {key!r} of {surface} has no "
+                        "registry binding — declare how the unified "
+                        "registry sees it in telemetry."
+                        f"SURFACE_BINDINGS[{surface!r}] (the single-"
+                        "source-of-truth contract, ISSUE 5)"))
+        return out

@@ -138,6 +138,25 @@ def build_parser():
                    help="Pre-compile the TPU serving programs so the "
                         "first discuss starts hot")
 
+    li = sub.add_parser(
+        "lint",
+        help="Static serving-invariant analyzer: AST rules + "
+             "device-free jaxpr audit (CI / tunnel preflight)")
+    li.add_argument("--rules", default=None, metavar="ID,ID",
+                    help="Comma-separated rule ids to run "
+                         "(default: all)")
+    li.add_argument("--jaxpr", action="store_true",
+                    help="Also audit every registered serving program "
+                         "(prefill/decode/ragged/spec/LoRA-setter) "
+                         "device-free on CPU: donation safety, "
+                         "callback-free hot loops, warmed-variant "
+                         "count across the shape grid")
+    li.add_argument("--json", dest="as_json", action="store_true",
+                    help="Machine-readable findings (the preflight "
+                         "step consumes this)")
+    li.add_argument("--root", default=None,
+                    help="Tree to lint (default: this checkout)")
+
     return p
 
 
@@ -204,6 +223,12 @@ def dispatch(args) -> int:
     if args.command == "warmup":
         from .commands.warmup_cmd import warmup_command
         return warmup_command()
+    if args.command == "lint":
+        from .commands.lint import lint_command
+        rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                 if args.rules else None)
+        return lint_command(rules=rules, jaxpr=args.jaxpr,
+                            as_json=args.as_json, root=args.root)
     raise RoundtableError(f"Unknown command: {args.command}")
 
 

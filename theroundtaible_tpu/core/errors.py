@@ -118,8 +118,42 @@ _DEVICE_LOST_MARKERS = ("device lost", "device is lost", "data_loss",
                         "(device_lost)")
 
 
+# Declarative class -> kind classification for the IN-TREE exception
+# classes the serving engine raises (ISSUE 15). Message sniffing stays
+# the primary classifier — fault injection deliberately crafts messages
+# that classify like their real counterparts ("hbm" -> oom), and that
+# must keep winning — but a class whose message carries no marker used
+# to fall through to "unknown" and take the wrong recovery ladder (the
+# PR-12 device_lost ordering bug class). This table is consulted LAST,
+# by class name up the MRO, and is also the registration the static
+# analyzer checks: `roundtable lint` (RT-ERROR-KIND) fails when engine
+# code raises an in-tree class that neither descends from
+# RoundtableError nor appears here. AdapterError subclasses (EngineDead)
+# carry their kind directly and need no entry.
+ERROR_KIND_TABLE: dict[str, str] = {
+    # engine/deadlines.py — the time ladder
+    "HangDetected": "hang",          # wedged program, not a polite timeout
+    "StaleWait": "hang",             # watchdog-abandoned wait completed late
+    "BudgetExceeded": "timeout",     # the rung's deadline authority fired
+    "Cancelled": "timeout",          # cooperative cancel at a rung boundary
+    "DrainingError": "draining",     # admission gate closed, not a failure
+    # engine/faults.py — chaos injection (plain-message injections only;
+    # kind-mimicking messages classify by their markers above)
+    "FaultInjected": "fault_injected",
+    # engine/scheduler.py — admission verdicts
+    "SchedulerRefused": "refused",   # never-fits: actionable config change
+    "SchedulerClosed": "closed",
+    # engine/compile_watch.py — the steady-state sentinel
+    "RecompileInSteadyState": "recompile",
+    # engine/spec_decode.py — benign capacity pressure, drafting skipped
+    "DraftUnavailable": "draft_unavailable",
+}
+
+
 def classify_error(err: BaseException) -> str:
-    """Map a raw exception onto an actionable kind by message sniffing."""
+    """Map a raw exception onto an actionable kind: message sniffing
+    first (fault injections mimic real kinds by message), then the
+    declarative in-tree class table for marker-less classes."""
     if isinstance(err, AdapterError):
         return err.kind
     msg = str(err).lower()
@@ -137,6 +171,10 @@ def classify_error(err: BaseException) -> str:
         return "auth"
     if any(m in msg for m in _API_MARKERS):
         return "api"
+    for cls in type(err).__mro__:
+        kind = ERROR_KIND_TABLE.get(cls.__name__)
+        if kind is not None:
+            return kind
     return "unknown"
 
 

@@ -2748,3 +2748,101 @@ class InferenceEngine:
         info["compile_cache"] = get_compile_cache_decision()
         info["compile_observatory"] = compile_watch.summary()
         return info
+
+
+# ---------------------------------------------------------------------------
+# static-analysis program registration (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+from ..analysis.jaxpr_audit import (ProgramSpec, Variant,  # noqa: E402
+                                    analysis_register)
+
+
+def _audit_sds(x):
+    """Pytree of ShapeDtypeStructs — the device-free trace argument:
+    make_jaxpr abstracts by aval, so no buffer is ever materialized."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x)
+
+
+@analysis_register("engine_core")
+def _analysis_engine_programs(engine) -> list:
+    """Prefill + decode serving programs for the jaxpr audit
+    (`roundtable lint --jaxpr`).
+
+    The variant grid replays runtime drift the way SERVING computes its
+    shapes: prefill batches are per-(batch, bucket) programs; decode
+    occupancies map through `pow2_bucket` onto the warmed batch grid —
+    so two occupancies in one bucket MUST trace to one jaxpr, and a
+    static argument leaking occupancy shows up as an extra distinct
+    jaxpr under that label (the RECOMPILE_STRICT invariant, proven
+    without a device). Argument construction mirrors
+    `_prefill`/`_decode_dispatch_*`; drift between the twins fails the
+    audit's trace step loudly rather than silently auditing nothing.
+    """
+    if not isinstance(engine, InferenceEngine):
+        return []
+    from .serving_loop import pow2_bucket
+    paged = engine.kv_layout == "paged"
+    params = _audit_sds(engine.params)
+    pools = _audit_sds(engine.kv.combined_pools()) if paged else None
+    layers = None if paged else _audit_sds(engine.kv.layers)
+    key = jax.random.PRNGKey(0)
+    num_slots = engine.kv.num_slots
+    pps = engine.kv.pages_per_seq if paged else 0
+
+    def ints(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+    def floats(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    def prefill_variant(b: int, bucket: int) -> Variant:
+        def thunk():
+            tokens = ints(b, bucket)
+            if paged:
+                return jax.make_jaxpr(engine._prefill_step_paged)(
+                    params, pools, ints(b, pps), tokens, ints(b),
+                    ints(b))
+            return jax.make_jaxpr(engine._prefill_step)(
+                params, layers, ints(b), tokens, ints(b), ints(b))
+        return Variant(label=f"b{b}x{bucket}", thunk=thunk,
+                       situation=f"batch {b}, bucket {bucket}")
+
+    def decode_variant(occ: int) -> Variant:
+        b = pow2_bucket(occ)
+
+        def thunk():
+            budget = jnp.int32(DECODE_SEGMENT)
+            # first_token, start_valid, key, budget, temps, top_ks,
+            # top_ps, row_budgets, done0 — _decode_dispatch_*'s order.
+            args = (ints(b), ints(b), key, budget, floats(b), ints(b),
+                    floats(b), ints(b),
+                    jax.ShapeDtypeStruct((b,), jnp.bool_))
+            if paged:
+                fn = engine._decode_loop_paged
+                return jax.make_jaxpr(
+                    lambda p, pl, t, *a: fn(
+                        p, pl, t, *a, max_new=DECODE_SEGMENT,
+                        greedy=True))(params, pools, ints(b, pps),
+                                      *args)
+            fn = engine._decode_loop
+            return jax.make_jaxpr(
+                lambda p, cl, s, *a: fn(
+                    p, cl, s, *a, max_new=DECODE_SEGMENT,
+                    greedy=True))(params, layers, ints(b), *args)
+        return Variant(label=f"b{b}", thunk=thunk,
+                       situation=f"occupancy {occ}")
+
+    bucket = PREFILL_BUCKETS[0]
+    prefill = ProgramSpec(
+        name=f"prefill[{'paged' if paged else 'slots'}]",
+        phase="prefill",
+        variants=[prefill_variant(b, bucket)
+                  for b in (1, 2) if b <= num_slots])
+    decode = ProgramSpec(
+        name=f"decode[{'paged' if paged else 'slots'}]",
+        phase="decode",
+        variants=[decode_variant(occ)
+                  for occ in (1, 2, 3, 4) if occ <= num_slots])
+    return [prefill, decode]

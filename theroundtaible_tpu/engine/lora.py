@@ -737,3 +737,43 @@ def dispatches_seen() -> int:
 
 def max_mixed_seen() -> int:
     return _max_mixed
+
+
+# ---------------------------------------------------------------------------
+# static-analysis program registration (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+from ..analysis.jaxpr_audit import (ProgramSpec, Variant,  # noqa: E402
+                                    analysis_register)
+
+
+@analysis_register("lora_setter")
+def _analysis_lora_setter(engine) -> list:
+    """The adapter hot-swap setter (`LoraStore._set_slot`) for the
+    jaxpr audit: per target stack, both the A and B writes trace across
+    two slot values onto ONE label — a steady-state swap must be pure
+    values (the warm() fixpoint contract), and the setter deliberately
+    donates NOTHING (an in-flight dispatch may still hold the pre-swap
+    arrays), which RT-JAXPR-DONATION confirms by absence. int8 stores
+    are skipped: their stacks swap through quantize_lora_slot's
+    composite write, audited transitively via the same _set_slot."""
+    store = getattr(engine, "lora", None)
+    if store is None or store.quant not in (None, "none"):
+        return []
+
+    def variant(key: str, tensor: str, slot: int) -> Variant:
+        def thunk():
+            stack = store.stacked[key][tensor]
+            value = jax.ShapeDtypeStruct(stack.shape[1:], jnp.float32)
+            sds = jax.ShapeDtypeStruct(stack.shape, stack.dtype)
+            return jax.make_jaxpr(store._set_slot)(
+                sds, jnp.int32(slot), value)
+        return Variant(label=f"{key}.{tensor}", thunk=thunk,
+                       situation=f"swap into slot {slot}")
+
+    variants = [variant(key, tensor, slot)
+                for key in sorted(store.stacked)
+                for tensor in ("a", "b")
+                for slot in (1, 2) if slot <= store.max_adapters]
+    return [ProgramSpec(name="lora_setter", phase="setter",
+                        variants=variants)]

@@ -382,3 +382,111 @@ def forward_ragged(
     if sample_rows is not None:
         return logits[0].reshape(s, r, -1), new_pools + new_scales
     return logits[0], new_pools + new_scales
+
+
+# ---------------------------------------------------------------------------
+# static-analysis program registration (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+from ..analysis.jaxpr_audit import (ProgramSpec, Variant,  # noqa: E402
+                                    analysis_register)
+
+
+def trace_ragged_batch(engine, batch: dict):
+    """Trace one ragged dispatch's program (`engine._ragged_step`) to a
+    ClosedJaxpr without dispatching — the device-free twin of
+    `InferenceEngine._ragged_dispatch.run`. Argument mapping mirrors
+    that seam one-to-one (same array order, same static kwargs); if the
+    twins drift, the audit's trace step fails loudly, which is the
+    contract — an unauditable serving program must never be skipped
+    silently. Shared by the ragged provider here and the spec-decode
+    provider (verify/propose variants)."""
+    score_width = int(batch.get("score_width", 0) or 0)
+    propose_width = int(batch.get("propose_width", 0) or 0)
+    from .engine import _audit_sds
+    params = _audit_sds(engine.params)
+    pools = _audit_sds(engine.kv.combined_pools())
+    attn_path = ("kernel" if engine.ragged_path == "pallas_ragged"
+                 else "xla")
+    copy_src = batch.get("copy_src")
+    arrs = dict(
+        tables=jnp.asarray(batch["tables"]),
+        tokens=jnp.asarray(batch["tokens"]),
+        positions=jnp.asarray(batch["positions"]),
+        token_pages=jnp.asarray(batch["token_pages"]),
+        token_offs=jnp.asarray(batch["token_offs"]),
+        token_seq=jnp.asarray(batch["token_seq"]),
+        seq_of_block=jnp.asarray(batch["seq_of_block"]),
+        block_qstart=jnp.asarray(batch["block_qstart"]),
+        query_offsets=jnp.asarray(batch["query_offsets"]),
+        kv_valid=jnp.asarray(batch["kv_valid"]),
+        last_rows=jnp.asarray(batch["last_rows"]),
+        key=jax.random.PRNGKey(0),
+        temps=jnp.asarray(batch["temps"]),
+        top_ks=jnp.asarray(batch["top_ks"]),
+        top_ps=jnp.asarray(batch["top_ps"]),
+    )
+    opt = {}
+    if score_width:
+        opt["sample_rows"] = jnp.asarray(batch["sample_rows"])
+    if copy_src is not None:
+        opt["copy_src"] = jnp.asarray(copy_src)
+        opt["copy_dst"] = jnp.asarray(batch["copy_dst"])
+    names = list(arrs) + list(opt)
+
+    def call(p, pl, *flat):
+        kw = dict(zip(names, flat))
+        pos = [kw.pop(n) for n in arrs]
+        return engine._ragged_step(
+            p, pl, *pos, greedy=batch["greedy"], attn_path=attn_path,
+            score_width=score_width, lora=None,
+            propose_width=propose_width, **kw)
+
+    return jax.make_jaxpr(call)(params, pools, *arrs.values(),
+                                *opt.values())
+
+
+def analysis_warm_seqs(engine, n_seqs: int = 2):
+    """Toy RaggedSeq compositions over scratch-page tables (shape-only
+    — the audit traces, never dispatches, so no page is ever really
+    read or allocated). Mirrors _warm_ragged's two-seq mixed batch."""
+    import numpy as np
+    from .serving_loop import RaggedSeq
+    kv = engine.kv
+    scratch = kv.scratch_page(0)
+    table = np.full((kv.pages_per_seq,), scratch, np.int32)
+    bos = engine.tokenizer.bos_id
+    seqs = [RaggedSeq([bos] + [5] * 23, 0, table)]
+    if n_seqs > 1:
+        seqs.append(RaggedSeq([7], 8, table))
+    return seqs[:n_seqs]
+
+
+@analysis_register("ragged")
+def _analysis_ragged_programs(engine) -> list:
+    """The plain ragged mixed-dispatch program across the warmed shape
+    grid. Two compositions (one-seq, two-seq) trace under EVERY shape
+    label: composition is values, so both must produce the one jaxpr
+    that shape warmed — a leak of composition into a static argument
+    fails RT-JAXPR-VARIANTS."""
+    if not getattr(engine, "ragged_enabled", False):
+        return []
+    from .serving_loop import build_ragged_batch
+    kv = engine.kv
+
+    def variant(shape: int, n_seqs: int) -> Variant:
+        def thunk():
+            batch = build_ragged_batch(
+                analysis_warm_seqs(engine, n_seqs), t_budget=shape,
+                s_max=kv.num_slots + 1, pages_per_seq=kv.pages_per_seq,
+                scratch_page=kv.scratch_page(0),
+                pad_id=engine.tokenizer.pad_id,
+                page_size=kv.page_size)
+            return trace_ragged_batch(engine, batch)
+        return Variant(label=f"t{shape}", thunk=thunk,
+                       situation=f"{n_seqs} seq(s) in shape {shape}")
+
+    return [ProgramSpec(
+        name="ragged", phase="ragged",
+        variants=[variant(shape, n)
+                  for shape in engine.ragged_shapes for n in (1, 2)])]

@@ -1147,7 +1147,8 @@ class SessionScheduler:
         prefix share → chunked prefill → first-token sample; ONE
         definition, so scheduler admission can never drift from
         generate_batch on token parity), with every live row pinned
-        against eviction."""
+        against eviction. Loop-thread only (single-writer counter
+        bumps need no cv — RT-LOCK-BUMP contract)."""
         engine = self.engine
         # Admission STARTS the request's clock (queue time is bounded
         # separately in _admit_queued): the scheduler-side deadline and
@@ -1628,7 +1629,8 @@ class SessionScheduler:
         PREEMPT: requests with rows mid-prefill fail alone (their pages
         hold a half-written chunk; the adapter ladder re-prefills from
         the prompt), while decode-only sessions re-dispatch through the
-        compiled segment path from intact host+KV state."""
+        compiled segment path from intact host+KV state. Loop-thread
+        only (single-writer counter bumps need no cv)."""
         if self._supervisor_intervened(err):
             return
         if self._after_engine_failure(err):
@@ -2156,7 +2158,8 @@ class SessionScheduler:
         """Occupancy provenance for one consumed segment; returns the
         per-request live-row counts ({id: (req, n)}) the wall
         attribution reuses — one pass over the rows, not a rescan per
-        row."""
+        row. Loop-thread only (single-writer counter bumps need no
+        cv)."""
         counts: dict[int, tuple[_Request, int]] = {}
         for r in alive:
             req = self._row_req.get(id(r))
@@ -2415,7 +2418,8 @@ class SessionScheduler:
         all into their adapters' revive/serial-retry ladders. Otherwise
         PREEMPT the batch into per-session dispatches: the session the
         fault follows fails alone; everyone else's rows re-run their
-        segment from intact host+KV state, byte-identical."""
+        segment from intact host+KV state, byte-identical. Loop-thread
+        only (single-writer counter bumps need no cv)."""
         if self._supervisor_intervened(err):
             return
         if self._after_engine_failure(err):
@@ -2475,6 +2479,10 @@ class SessionScheduler:
 
     def _fail_request(self, req: _Request, err: BaseException,
                       release: bool = True) -> None:
+        """Fail one active request into its submitter. Loop-thread
+        only — request state is single-writer (external threads go
+        through force_fail_active's mailbox), so counter bumps here
+        need no cv."""
         self._release_adapters(req)
         if release:
             for r in req.rows:
@@ -2523,6 +2531,9 @@ class SessionScheduler:
     # --- retirement ---
 
     def _retire_finished(self) -> None:
+        """Retire every all-done request: eos-trim, journal, stats,
+        per-session gauge removal. Loop-thread only (single-writer
+        counter bumps need no cv)."""
         engine = self.engine
         eos = engine.tokenizer.eos_id
         for req in list(self._active_reqs):

@@ -823,3 +823,80 @@ def reprobes_seen() -> int:
 
 def reprobe_recoveries_seen() -> int:
     return _reprobe_recoveries
+
+
+# ---------------------------------------------------------------------------
+# static-analysis program registration (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+from ..analysis.jaxpr_audit import (ProgramSpec, Variant,  # noqa: E402
+                                    analysis_register)
+
+
+@analysis_register("spec")
+def _analysis_spec_programs(engine) -> list:
+    """Speculative verify + propose program variants for the jaxpr
+    audit — the same (score_width, s_max, copy_slots) and
+    propose_width statics `_warm_ragged` compiles, traced device-free
+    across the shape grid. Two verify compositions (one speculating
+    row alone; speculating + plain rows mixed) share each shape label:
+    acceptance drift and chain/tree mixes are VALUES, so extra
+    distinct jaxprs under one label are a static-arg leak
+    (RT-JAXPR-VARIANTS), and a host callback in a verify program is a
+    per-verify host sync (RT-JAXPR-CALLBACK)."""
+    if not getattr(engine, "spec_decode", False) \
+            or not getattr(engine, "ragged_enabled", False):
+        return []
+    import numpy as np
+
+    from .paged_forward import trace_ragged_batch
+    from .serving_loop import RaggedSeq, build_ragged_batch
+    kv = engine.kv
+    scratch = kv.scratch_page(0)
+    table = np.full((kv.pages_per_seq,), scratch, np.int32)
+    r = engine.spec_max_draft + 1
+
+    def batch(seqs, shape, score_width=0, s_max=None, copy_slots=0,
+              propose_width=0):
+        b = build_ragged_batch(
+            seqs, t_budget=shape,
+            s_max=s_max if s_max is not None else kv.num_slots + 1,
+            pages_per_seq=kv.pages_per_seq, scratch_page=scratch,
+            pad_id=engine.tokenizer.pad_id, page_size=kv.page_size,
+            score_width=score_width, copy_slots=copy_slots)
+        if propose_width:
+            b["propose_width"] = propose_width
+        return b
+
+    def verify_variant(shape: int, mixed: bool) -> Variant:
+        def thunk():
+            seqs = [RaggedSeq([7] * r, 8, table, n_scores=r)]
+            if mixed:
+                seqs.append(RaggedSeq([9], 4, table, n_scores=1))
+            return trace_ragged_batch(engine, batch(
+                seqs, shape, score_width=r, s_max=engine.spec_s_max,
+                copy_slots=engine.spec_copy_slots))
+        return Variant(
+            label=f"t{shape}", thunk=thunk,
+            situation=("speculating+plain rows" if mixed
+                       else "one speculating row") + f" in {shape}")
+
+    specs = [ProgramSpec(
+        name="spec_verify", phase="verify",
+        variants=[verify_variant(shape, mixed)
+                  for shape in engine.ragged_shapes
+                  for mixed in (False, True)])]
+    if engine.spec_branch > 1:
+        def propose_variant(shape: int) -> Variant:
+            def thunk():
+                seqs = [RaggedSeq([7], 8, table),
+                        RaggedSeq([9], 4, table)]
+                return trace_ragged_batch(engine, batch(
+                    seqs, shape, propose_width=engine.spec_branch))
+            return Variant(label=f"t{shape}", thunk=thunk,
+                           situation=f"propose in shape {shape}")
+        specs.append(ProgramSpec(
+            name="spec_propose", phase="propose",
+            variants=[propose_variant(shape)
+                      for shape in engine.ragged_shapes]))
+    return specs

@@ -424,6 +424,13 @@ class EngineSupervisor:
                     report["evacuation_error"] = str(e)[:200]
                     report["sessions_lost"] = len(lost)
                     self._note_lost(len(lost))
+                    # A lost session never retires through the
+                    # scheduler, so nothing downstream removes its
+                    # per-session KV gauge — drop it here or the
+                    # registry keeps one dead series per session the
+                    # dead pool took (the RT-GAUGE-LEAK rule's
+                    # first real-world target, ISSUE 15).
+                    self._drop_session_gauges(engine, lost)
 
             # --- rebuild (bounded exponential backoff) ---
             build = rebuild
@@ -567,11 +574,44 @@ class EngineSupervisor:
                 get_breaker(cfg).trip(dead)
             except Exception:  # noqa: BLE001 — breaker is advisory
                 pass
+        # Every session this dead engine still holds — evacuated to
+        # the host tier in an earlier (failed) cycle, or active on a
+        # loop the force-fail may never reach — is lost WITHOUT ever
+        # retiring through the scheduler, which is the only path that
+        # removes its roundtable_session_kv_bytes series. Remove them
+        # here: the registry (and every metrics.prom export) must not
+        # carry one stale series per session a dead engine took down
+        # (ISSUE 15 bugfix; regression-tested in tests/test_analysis).
+        stale: set = set()
+        tier = getattr(engine, "kv_offload", None)
+        if tier is not None:
+            try:
+                stale |= set(tier.spilled_sessions())
+            except Exception:  # noqa: BLE001 — dead tier
+                pass
+        if sched is not None:
+            stale |= {r.session for r in list(sched._active_reqs)}
+        self._drop_session_gauges(engine, stale)
         telemetry.set_gauge("roundtable_engine_dead", 1.0,
                             engine=st.name)
         telemetry.recorder().record(
             "supervisor_engine_dead", engine=st.name,
             reason=st.dead_reason)
+
+    @staticmethod
+    def _drop_session_gauges(engine, sessions) -> None:
+        """Remove the per-session KV gauge series for sessions the
+        supervisor counted LOST — they will never retire through the
+        scheduler's remove path. Best-effort: gauge hygiene must never
+        turn a restart failure into a crash."""
+        perf = getattr(engine, "perf", None)
+        if perf is None:
+            return
+        for s in sessions:
+            try:
+                perf.publish_session_kv(s, 0)
+            except Exception:  # noqa: BLE001 — hygiene only
+                pass
 
     def _note_recovered(self, n: int) -> None:
         if n:

@@ -683,7 +683,10 @@ def start_span(rung: str, sink: Optional[SpanSink] = None,
 # (tests/test_telemetry.py) asserts the ACTUAL keys of fleet_health()
 # and SessionScheduler.describe() are a subset of these — adding a new
 # surface key without declaring how the registry sees it fails CI, so
-# the four stores can never quietly fork again.
+# the four stores can never quietly fork again. The static analyzer
+# enforces the same contract at parse time with file/line findings
+# (`roundtable lint`, rule RT-SURFACE-DRIFT — it reads this dict
+# LITERAL, so keep it a plain literal of string keys).
 SURFACE_BINDINGS: dict[str, dict[str, str]] = {
     "fleet_health": {
         "engines": "roundtable_breaker_failures_total{engine=...} "
