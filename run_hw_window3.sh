@@ -35,6 +35,18 @@ if ! env JAX_PLATFORMS=cpu python -m theroundtaible_tpu lint --jaxpr \
 fi
 echo "window3: lint preflight clean $(stamp)" >> "$OUT.log"
 
+# Gateway preflight (ISSUE 16): the serving front door must survive a
+# kill -9 + --resume round-trip and shed overload well-formed on CPU
+# before any window time is spent — a gateway that can't restart
+# cleanly would strand every client mid-stream on the real chips.
+if ! env JAX_PLATFORMS=cpu python bench_gateway.py --smoke \
+    >> "$OUT.log" 2>&1; then
+  echo "window3: gateway smoke FAILED $(stamp) — fix the serving" \
+       "front door before spending a window" >> "$OUT.log"
+  exit 1
+fi
+echo "window3: gateway smoke clean $(stamp)" >> "$OUT.log"
+
 while :; do
   python - <<'PY' 2>> "$OUT.log"
 import sys

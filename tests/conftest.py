@@ -386,6 +386,33 @@ def _supervision_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _gateway_guard(request):
+    """Tier-1 guard for @pytest.mark.gateway (ISSUE 16 satellite): a
+    test that CLAIMS serving-gateway coverage must actually stream
+    tokens over a REAL socket — if no SSE token event was written (and
+    drained) to a connection during the test, the HTTP front door
+    silently never served (in-memory shortcuts, dead pump, unopened
+    stream) and the test's serving claims are vacuous; fail LOUD.
+    Admission/journal/event-id unit tests (which legitimately never
+    open a socket) mark allow_no_stream=True."""
+    marker = request.node.get_closest_marker("gateway")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.gateway import streams as streams_mod
+
+    streams_mod.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_no_stream"):
+        return
+    assert streams_mod.tokens_streamed() > 0, (
+        "gateway-marked test streamed ZERO tokens over a real socket: "
+        "the SSE serving path silently never ran (mark "
+        "allow_no_stream=True only for admission/journal/event-id "
+        "units)")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

@@ -544,12 +544,16 @@ class TestDrainInterplay:
         sched = SessionScheduler(engine, admit_hold_s=0.2)
         try:
             tight = deadlines.Budget.root(0.0, rung="turn")  # born expired
-            bad = sched.submit_async("s0", PROMPTS["s0"],
-                                     max_new_tokens=200, budget=tight)
+            # ISSUE 16 deadline propagation: an already-spent budget
+            # fails fast AT SUBMIT (its own classified kind, zero
+            # prefill consumed) instead of queueing just to time out.
+            from theroundtaible_tpu.engine.scheduler import \
+                DeadlineExpired
+            with pytest.raises(DeadlineExpired):
+                sched.submit_async("s0", PROMPTS["s0"],
+                                   max_new_tokens=200, budget=tight)
             good = sched.submit_async("s1", PROMPTS["s1"],
                                       max_new_tokens=40)
-            with pytest.raises(Exception):
-                sched.wait(bad)
             texts, _ = sched.wait(good)
             assert texts
         finally:

@@ -83,6 +83,30 @@ def build_parser():
                    action="store_false",
                    help="Skip reading source code without asking")
 
+    g = sub.add_parser(
+        "gateway",
+        help="Serve the streaming HTTP/SSE front door: OpenAI-"
+             "compatible /v1/chat/completions + native /v1/discussions "
+             "over the shared engine, with SLO-driven admission, load "
+             "shedding and crash-consistent mid-stream resume")
+    g.add_argument("--host", default=None,
+                   help="Bind address (default ROUNDTABLE_GATEWAY_HOST "
+                        "or 127.0.0.1)")
+    g.add_argument("--port", type=int, default=None,
+                   help="Bind port (default ROUNDTABLE_GATEWAY_PORT "
+                        "or 8080; 0 = ephemeral)")
+    g.add_argument("--journal", default=None, metavar="DIR",
+                   help="Journal every committed turn + stream intent "
+                        "to DIR so a kill -9'd gateway resumes with "
+                        "--resume DIR")
+    g.add_argument("--resume", dest="resume_dir", default=None,
+                   metavar="DIR",
+                   help="Replay DIR's session journal on boot (library "
+                        "seam shared with `serve --resume`), restoring "
+                        "every session's KV at its last committed turn "
+                        "so clients reconnect via Last-Event-ID with "
+                        "no token loss or duplication")
+
     s = sub.add_parser("summon", help="Review the current git diff")
     s.add_argument("--read-code", action="store_true", default=None,
                    help="Read source code into context without asking")
@@ -105,6 +129,11 @@ def build_parser():
                     help="Render fleet health: breakers, admission "
                          "gates, scheduler queues, and the supervisor's "
                          "engine-restart history")
+    st.add_argument("--gateway", action="store_true",
+                    help="Render the serving gateway's admission/shed "
+                         "ledger: admitted/shed/expired counters by "
+                         "reason, inflight streams, drop-to-summary "
+                         "and resume counts")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -193,13 +222,19 @@ def dispatch(args) -> int:
     if args.command == "summon":
         from .commands.summon import summon_command
         return summon_command(read_code=args.read_code)
+    if args.command == "gateway":
+        from .commands.gateway_cmd import gateway_command
+        return gateway_command(host=args.host, port=args.port,
+                               journal_dir=args.journal,
+                               resume_dir=args.resume_dir)
     if args.command == "status":
         from .commands.status import status_command
         return status_command(
             telemetry_view=getattr(args, "telemetry", False),
             perf_view=getattr(args, "perf", False),
             kv_view=getattr(args, "kv", False),
-            health_view=getattr(args, "health", False))
+            health_view=getattr(args, "health", False),
+            gateway_view=getattr(args, "gateway", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

@@ -1,0 +1,85 @@
+"""`roundtable gateway` — serve the streaming HTTP/SSE front door.
+
+Seats the configured adapters, acquires the first tpu-llm engine's
+shared SessionScheduler (the same seam `serve --resume` uses), wires
+the durable journals, optionally replays a crashed process's committed
+turns, and blocks serving HTTP until interrupted.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core.config import load_config
+from ..core.errors import ConfigError
+from ..utils.ui import style
+
+
+def _build_scheduler(config, journal_dir: Optional[str]):
+    """First tpu-llm engine's shared scheduler (+ attached journal)."""
+    from ..adapters.factory import initialize_adapters
+    from ..engine.scheduler import acquire_scheduler
+
+    adapters = initialize_adapters(config)
+    sched = None
+    for adapter in adapters.values():
+        if not hasattr(adapter, "attach_scheduler"):
+            continue
+        try:
+            engine = adapter._get_engine()
+            sched, _created = acquire_scheduler(engine)
+            break
+        except Exception:  # noqa: BLE001 — try the next seat
+            continue
+    if sched is None:
+        raise ConfigError(
+            "gateway needs at least one tpu-llm knight whose engine "
+            "can be built — no scheduler available to serve")
+    if journal_dir is not None and sched.journal is None:
+        from ..engine.session_journal import SessionJournal
+        sched.attach_journal(SessionJournal(journal_dir))
+    return sched
+
+
+def gateway_command(host: Optional[str] = None,
+                    port: Optional[int] = None,
+                    journal_dir: Optional[str] = None,
+                    resume_dir: Optional[str] = None,
+                    project_root: Optional[str] = None) -> int:
+    project_root = project_root or os.getcwd()
+    config = load_config(project_root)
+    from ..gateway import Gateway
+
+    if resume_dir is not None:
+        # Boot-time recovery through the library seam
+        # (engine/recovery.py — the factored `serve --resume` path):
+        # committed turns replay into KV BEFORE the socket opens, so
+        # the first Last-Event-ID reconnect finds its session restored.
+        print(style.bold(f"\n  Resuming sessions from journal "
+                         f"{resume_dir}..."))
+        from ..engine.recovery import resume_from_journal
+        r = resume_from_journal(resume_dir, config=config,
+                                project_root=project_root)
+        sched = r["scheduler"]
+        print(style.dim(
+            f"  replayed {r['turns']} committed turn(s) across "
+            f"{r['sessions']} session(s)"))
+        journal_dir = journal_dir or resume_dir
+        if journal_dir != str(sched.journal.root):
+            from ..engine.session_journal import SessionJournal
+            sched.attach_journal(SessionJournal(journal_dir))
+    else:
+        sched = _build_scheduler(config, journal_dir)
+
+    gw = Gateway(sched, host=host, port=port, intent_dir=journal_dir)
+    print(style.bold(f"\n  Gateway listening on "
+                     f"http://{gw.host}:{gw.port}"))
+    print(style.dim(
+        "    POST /v1/chat/completions   (OpenAI-compatible, SSE)\n"
+        "    POST /v1/discussions        (native multi-knight, SSE)\n"
+        "    GET  /v1/streams/<id>       (Last-Event-ID reconnect)\n"
+        "    GET  /healthz · GET /metrics\n"))
+    gw.run()
+    gw.stop()
+    return 0

@@ -188,57 +188,11 @@ def serve_discussions(
     return report
 
 
-def resume_from_journal(resume_dir: str, *,
-                        config=None,
-                        project_root: Optional[str] = None,
-                        scheduler=None) -> dict[str, Any]:
-    """Replay a session journal through the normal submit path
-    (ISSUE 12 crash recovery): every committed turn of every journaled
-    session is re-submitted with a 1-token budget, so the fresh
-    engine re-prefills the exact committed token stream through the
-    same reuse/prefix-cache/commit machinery as live serving and each
-    session's KV ends at its last committed turn. Re-prefill is
-    acceptable on the crash path — the prefix cache makes repeated
-    spans cheap.
-
-    `scheduler` (tests / embedding callers) replays onto that
-    scheduler directly; otherwise adapters are seated from `config`
-    (or the project's config) and the first tpu-llm engine's shared
-    scheduler is used. The journal is attached to the scheduler
-    afterwards, so the resumed process keeps journaling new turns into
-    the same directory with continued turn numbering.
-
-    Returns {"sessions", "turns", "scheduler"}."""
-    from ..engine.session_journal import SessionJournal, replay_turns
-
-    journal = SessionJournal(resume_dir)
-    sched = scheduler
-    if sched is None:
-        config = config or load_config(project_root or os.getcwd())
-        adapters = initialize_adapters(config)
-        from ..engine.scheduler import acquire_scheduler
-        for adapter in adapters.values():
-            if not hasattr(adapter, "attach_scheduler"):
-                continue
-            try:
-                engine = adapter._get_engine()
-                sched, _created = acquire_scheduler(engine)
-                break
-            except Exception:  # noqa: BLE001 — try the next seat
-                continue
-        if sched is None:
-            raise ConfigError(
-                "serve --resume needs at least one tpu-llm knight "
-                "whose engine can be built — no scheduler available "
-                "to replay onto")
-    report: dict[str, Any] = {"sessions": 0, "turns": 0,
-                              "scheduler": sched}
-    for session in journal.sessions():
-        report["turns"] += replay_turns(journal, session, sched.submit)
-        report["sessions"] += 1
-    if sched.journal is None:
-        sched.attach_journal(journal)
-    return report
+# Factored into the engine layer (ISSUE 16): the gateway restores
+# committed sessions on boot through the same seam the CLI uses. The
+# re-export keeps `commands.serve.resume_from_journal` — and the
+# `serve --resume` behavior behind it — byte-identical.
+from ..engine.recovery import resume_from_journal  # noqa: E402,F401
 
 
 def serve_command(topics: list[str], sessions: Optional[int] = None,

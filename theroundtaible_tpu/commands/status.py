@@ -40,12 +40,16 @@ def status_command(project_root: Optional[str] = None,
                    telemetry_view: bool = False,
                    perf_view: bool = False,
                    kv_view: bool = False,
-                   health_view: bool = False) -> int:
+                   health_view: bool = False,
+                   gateway_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     if health_view:
         # Fleet health needs no session dir — it reads the live
         # process's breaker/scheduler/supervisor state.
         return health_status()
+    if gateway_view:
+        # Gateway ledger is live-registry state too — no session dir.
+        return gateway_status()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
@@ -217,6 +221,77 @@ def health_status() -> int:
             print(style.dim(
                 f"      #{ev.get('restart', '?')} {ev.get('reason')}: "
                 f"{ok} in {ev.get('wall_s', 0):.3f}s{extra}"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --gateway` (ISSUE 16) ---
+
+
+def gateway_status() -> int:
+    """`roundtable status --gateway` — the serving gateway's
+    admission/shed ledger, rendered from the live registry's
+    roundtable_gateway_* series: admitted/shed/queued/expired totals
+    broken down by reason label, the inflight-stream gauge, and the
+    resume / drop-to-summary counters. Live-process state like
+    --health: meaningful from the serving process; a fresh CLI process
+    reports an idle gateway."""
+    from ..utils import telemetry
+
+    series = telemetry.REGISTRY.snapshot_compact()
+    print(style.bold("\n  Serving gateway"))
+
+    def by_reason(outcome: str) -> dict[str, float]:
+        name = f"roundtable_gateway_{outcome}_total"
+        out: dict[str, float] = {}
+        for key, val in series.items():
+            if key.split("{", 1)[0] != name:
+                continue
+            out[_labels(key).get("reason", "?")] = val
+        return out
+
+    any_out = False
+    for outcome in ("admitted", "shed", "queued", "expired"):
+        reasons = by_reason(outcome)
+        if not reasons:
+            continue
+        any_out = True
+        total = sum(reasons.values())
+        print(style.bold(f"\n  {outcome.capitalize()}: {total:g}"))
+        for reason in sorted(reasons):
+            print(style.dim(f"    {reason:<20} {reasons[reason]:g}"))
+
+    inflight = [k for k in series
+                if k.split("{", 1)[0]
+                == "roundtable_gateway_inflight_streams"]
+    if inflight:
+        any_out = True
+        print(style.bold(f"\n  Inflight streams: {len(inflight)}"))
+        for k in sorted(inflight):
+            lb = _labels(k)
+            print(style.dim(f"    {lb.get('request', '?')}"))
+
+    extras = [("roundtable_gateway_resumed_streams_total",
+               "reconnects resumed"),
+              ("roundtable_gateway_dropped_events_total",
+               "events coalesced to summary (slow consumers)")]
+    lines = []
+    for name, label in extras:
+        vals = [v for k, v in series.items()
+                if k.split("{", 1)[0] == name]
+        if vals:
+            lines.append(f"    {label:<44} {sum(vals):g}")
+    if lines:
+        any_out = True
+        print(style.bold("\n  Resilience:"))
+        for ln in lines:
+            print(style.dim(ln))
+
+    if not any_out:
+        print(style.dim(
+            "\n  No gateway series in this process. Run `roundtable "
+            "gateway` (or drive a Gateway in-process) to populate the "
+            "admission/shed ledger.\n"))
     print("")
     return 0
 

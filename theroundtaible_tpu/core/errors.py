@@ -83,6 +83,10 @@ _KIND_HINTS = {
                    "supervisor rebuilds it (engine/supervisor.py); if "
                    "this persists past the restart budget, check "
                    "device health / the platform runtime.",
+    "deadline_expired": "The request's SLO budget was already spent at "
+                        "submission — it never ran. Raise the client "
+                        "deadline, or shed load upstream so requests "
+                        "arrive with budget to spare.",
     "unknown": None,
 }
 
@@ -143,6 +147,11 @@ ERROR_KIND_TABLE: dict[str, str] = {
     # engine/scheduler.py — admission verdicts
     "SchedulerRefused": "refused",   # never-fits: actionable config change
     "SchedulerClosed": "closed",
+    # SLO budget spent at submit — failed fast before any prefill
+    # dispatch (gateway deadline propagation, ISSUE 16). Its own kind,
+    # not "timeout": the request never ran, so the timeout ladder's
+    # retry/raise-budget hints would mislead.
+    "DeadlineExpired": "deadline_expired",
     # engine/compile_watch.py — the steady-state sentinel
     "RecompileInSteadyState": "recompile",
     # engine/spec_decode.py — benign capacity pressure, drafting skipped

@@ -1,0 +1,77 @@
+"""Crash-recovery library seam: journal replay as an importable API.
+
+`resume_from_journal` (ISSUE 12) lived inside `commands/serve.py` and
+was reachable only from the CLI, so the streaming gateway (ISSUE 16)
+could not restore committed sessions on boot without shelling out.
+This module is the factored library seam: the gateway calls it at
+startup (`roundtable gateway --resume DIR`) and the CLI re-exports it
+(`commands/serve.py`) so the `serve --resume` path stays byte-identical.
+
+The replay contract is unchanged: every committed turn of every
+journaled session re-submits through the NORMAL scheduler path with a
+1-token budget, so the fresh engine re-prefills the exact committed
+token stream through the same reuse/prefix-cache/commit machinery as
+live serving, and each session's KV ends at its last committed turn.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ..core.errors import ConfigError
+
+
+def resume_from_journal(resume_dir: str, *,
+                        config=None,
+                        project_root: Optional[str] = None,
+                        scheduler=None) -> dict[str, Any]:
+    """Replay a session journal through the normal submit path
+    (ISSUE 12 crash recovery): every committed turn of every journaled
+    session is re-submitted with a 1-token budget, so the fresh
+    engine re-prefills the exact committed token stream through the
+    same reuse/prefix-cache/commit machinery as live serving and each
+    session's KV ends at its last committed turn. Re-prefill is
+    acceptable on the crash path — the prefix cache makes repeated
+    spans cheap.
+
+    `scheduler` (tests / embedding callers) replays onto that
+    scheduler directly; otherwise adapters are seated from `config`
+    (or the project's config) and the first tpu-llm engine's shared
+    scheduler is used. The journal is attached to the scheduler
+    afterwards, so the resumed process keeps journaling new turns into
+    the same directory with continued turn numbering.
+
+    Returns {"sessions", "turns", "scheduler"}."""
+    from .session_journal import SessionJournal, replay_turns
+
+    journal = SessionJournal(resume_dir)
+    sched = scheduler
+    if sched is None:
+        from ..adapters.factory import initialize_adapters
+        from ..core.config import load_config
+        config = config or load_config(project_root or os.getcwd())
+        adapters = initialize_adapters(config)
+        from .scheduler import acquire_scheduler
+        for adapter in adapters.values():
+            if not hasattr(adapter, "attach_scheduler"):
+                continue
+            try:
+                engine = adapter._get_engine()
+                sched, _created = acquire_scheduler(engine)
+                break
+            except Exception:  # noqa: BLE001 — try the next seat
+                continue
+        if sched is None:
+            raise ConfigError(
+                "serve --resume needs at least one tpu-llm knight "
+                "whose engine can be built — no scheduler available "
+                "to replay onto")
+    report: dict[str, Any] = {"sessions": 0, "turns": 0,
+                              "scheduler": sched}
+    for session in journal.sessions():
+        report["turns"] += replay_turns(journal, session, sched.submit)
+        report["sessions"] += 1
+    if sched.journal is None:
+        sched.attach_journal(journal)
+    return report

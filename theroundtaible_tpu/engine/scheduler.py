@@ -141,11 +141,30 @@ def schedulers() -> list["SessionScheduler"]:
 class SchedulerRefused(RuntimeError):
     """The request can NEVER fit this engine (more knights than slots,
     or more pages than the whole pool) — refused at submission, not
-    queued to deadlock."""
+    queued to deadlock. `reason` (ISSUE 16) is the machine-readable
+    refusal tag the gateway's shed accounting keys on: the never-fits
+    tags ("rows_never_fit", "adapters_never_fit", "pages_never_fit")
+    or, for a submit that opted out of queueing behind a closed gate
+    (queue_when_paused=False), the pause_admission reason verbatim —
+    so shed vs drain vs quiesce refusals stay distinguishable at the
+    HTTP boundary instead of dying inside the scheduler."""
+
+    def __init__(self, message: str, reason: Optional[str] = None):
+        super().__init__(message)
+        self.reason = reason
 
 
 class SchedulerClosed(RuntimeError):
     """submit() after close()."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's SLO budget was already spent at submission — it
+    fails fast at the queue mouth, before any prefill dispatch or slot
+    acquisition (gateway deadline propagation, ISSUE 16). The message
+    deliberately carries no classify_error marker words so the
+    ERROR_KIND_TABLE entry ("deadline_expired") wins over the
+    message-sniffing timeout ladder."""
 
 
 @dataclass(eq=False)
@@ -182,6 +201,12 @@ class _Row:
     # engine's LoraStore (0 = base). A value, never a shape: mixed-
     # adapter segments run the same compiled programs as base ones.
     adapter_slot: int = 0
+    # Committed-token streaming seam (ISSUE 16): how many eos-trimmed
+    # tokens of this row have already been flushed to the request's
+    # on_commit callback. eos_trim is prefix-stable as `produced`
+    # grows, so ids[streamed:] is exactly the new committed span —
+    # tree-spec multi-token commits stream for free.
+    streamed: int = 0
 
 
 class _Request:
@@ -194,7 +219,7 @@ class _Request:
                  "occ_sum", "occ_max", "sess_max", "requeues",
                  "fits_below", "tele_ctx", "tele", "first_token_at",
                  "share_plans", "spec_drafted", "spec_accepted",
-                 "adapters", "adapters_held")
+                 "adapters", "adapters_held", "on_commit")
 
     def __init__(self, session, turns, sampling_per_turn, max_new,
                  timeout_s, budget, stats, adapters=None):
@@ -246,6 +271,11 @@ class _Request:
         self.tele_ctx = telemetry.current_context() \
             if telemetry.ACTIVE else None
         self.tele = None
+        # Committed-token streaming (ISSUE 16): called on the LOOP
+        # thread with {"type": "tokens"|"retired"|"failed", ...} events
+        # at segment-commit boundaries. A raising callback is disabled
+        # (set to None) — a broken consumer must never wedge serving.
+        self.on_commit = None
 
 
 class SessionScheduler:
@@ -328,6 +358,7 @@ class SessionScheduler:
         self.failed = 0
         self.rejected_draining = 0
         self.rejected_other = 0       # close()/loop-error rejections
+        self.deadline_expired = 0     # SLO-spent submits failed fast
         self.preemptions = 0          # fault-isolation preempts
         self.segments = 0
         self.max_occupancy = 0
@@ -389,7 +420,9 @@ class SessionScheduler:
 
     def submit_async(self, session, turns, *, max_new_tokens=None,
                      timeout_s: float = 600.0, sampling_per_turn=None,
-                     budget=None, adapters_per_turn=None) -> _Request:
+                     budget=None, adapters_per_turn=None,
+                     on_commit=None,
+                     queue_when_paused: bool = True) -> _Request:
         if self.closed:
             raise SchedulerClosed("scheduler is closed")
         if not turns:
@@ -398,6 +431,32 @@ class SessionScheduler:
         # wait out its budget behind a drain fails fast instead
         # (fleet.drain satellite).
         deadlines.check_admission()
+        # Deadline propagation (ISSUE 16): a request whose SLO budget
+        # is ALREADY spent fails fast here — before slot acquisition or
+        # any prefill dispatch — with its own classified kind, instead
+        # of occupying queue/batch capacity just to time out.
+        if budget is not None and budget.expired:
+            with self._cv:  # submitter threads race each other here
+                self._bump("deadline_expired")
+            self._event("deadline_expired", session=session)
+            raise DeadlineExpired(
+                f"session {session!r} submitted with its SLO budget "
+                "already spent — refused before any prefill dispatch")
+        # Gateway shed seam (ISSUE 16): callers that shed instead of
+        # queueing (the HTTP front door) opt out of the pause gate's
+        # wait-in-queue default; the refusal carries the pause reason
+        # verbatim so drain/quiesce/shed are machine-distinguishable.
+        if not queue_when_paused:
+            paused = self._paused
+            if paused is not None:
+                with self._cv:
+                    self._bump("refused")
+                self._event("refuse", session=session,
+                            reason=f"admission paused: {paused}")
+                raise SchedulerRefused(
+                    f"session {session!r} refused while admission is "
+                    f"paused ({paused}) — caller sheds instead of "
+                    "queueing behind a closed gate", reason=paused)
         engine = self.engine
         # Dead-engine gate (ISSUE 12): the supervisor exhausted this
         # engine's restart budget — every submit fails fast with the
@@ -425,7 +484,8 @@ class SessionScheduler:
             raise SchedulerRefused(
                 f"session {session!r} needs {len(turns)} rows but this "
                 f"scheduler batches at most {self.max_rows} (num_slots "
-                f"{engine.kv.num_slots}) — raise num_slots / max_rows")
+                f"{engine.kv.num_slots}) — raise num_slots / max_rows",
+                reason="rows_never_fit")
         max_new = max_new_tokens or engine.sampling.max_new_tokens
         store = getattr(engine, "lora", None)
         if store is None:
@@ -450,7 +510,7 @@ class SessionScheduler:
                     f"session {session!r} names {len(distinct)} "
                     f"distinct lora adapters but the store holds at "
                     f"most {store.max_adapters} — raise "
-                    "lora.max_adapters")
+                    "lora.max_adapters", reason="adapters_never_fit")
             store.validate(adapters_per_turn, len(turns))
         if engine.kv_layout == "paged":
             # Never-fits = LOWER bound (1-token prompts): a request
@@ -465,10 +525,12 @@ class SessionScheduler:
                 raise SchedulerRefused(
                     f"session {session!r} needs at least {need} KV pages "
                     f"but the pool holds {engine.kv.usable_pages()} — "
-                    "raise num_pages or lower max_new_tokens")
+                    "raise num_pages or lower max_new_tokens",
+                    reason="pages_never_fit")
         req = _Request(session, list(turns), sampling_per_turn, max_new,
                        timeout_s, budget, self._fresh_stats(),
                        adapters=adapters_per_turn)
+        req.on_commit = on_commit
         with self._cv:
             # Re-checked under the lock: close() flips `closed` and
             # drains the queue under this same lock, so a request can
@@ -585,6 +647,7 @@ class SessionScheduler:
             "failed": self.failed,
             "rejected_draining": self.rejected_draining,
             "rejected_other": self.rejected_other,
+            "deadline_expired": self.deadline_expired,
             "preemptions": self.preemptions,
             "segments": self.segments,
             "ragged_segments": self.ragged_segments,
@@ -605,6 +668,15 @@ class SessionScheduler:
             if getattr(self.engine, "kv_offload", None) is not None
             else 0,
             "paused": self._paused,
+            # Machine-readable admission state (ISSUE 16): the gateway
+            # and status views key shed decisions on this instead of
+            # string-matching events. Nested keys ride under the one
+            # bound top-level key.
+            "admission": {
+                "paused": self._paused,
+                "open": self._paused is None and not self.closed,
+                "queued": len(self._queue),
+            },
             "journal_turns": self.journal_turns,
             "journal_errors": self.journal_errors,
             "events": events,
@@ -856,6 +928,7 @@ class SessionScheduler:
             # _may_speculate composition rules by construction.
             if not self._run_spec_segment(live):
                 self._run_segment(live)
+        self._flush_streams()
         self._retire_finished()
         self._check_request_health()
 
@@ -2490,6 +2563,11 @@ class SessionScheduler:
                     self.engine.kv.release(r.name)
                 except Exception:  # noqa: BLE001 — the error wins
                     pass
+        if req.on_commit is not None:
+            from ..core.errors import classify_error
+            self._stream_notify(req, {
+                "type": "failed", "error": str(err)[:200],
+                "kind": classify_error(err)})
         self._drop_request(req)
         self._last_active[req.session] = time.monotonic()
         req.error = err
@@ -2527,6 +2605,57 @@ class SessionScheduler:
                     "roundtable_spec_row_acceptance_rate",
                     engine=self._tname, row=r.name)
         self._active = [r for r in self._active if r not in req.rows]
+
+    # --- committed-token streaming (ISSUE 16) ---
+
+    def _stream_notify(self, req: _Request, event: dict) -> None:
+        """Deliver one stream event to req.on_commit — loop-thread
+        only. A raising callback is disabled for the rest of the
+        request (counted + evented): a broken consumer costs ITS
+        stream, never the batch."""
+        cb = req.on_commit
+        if cb is None:
+            return
+        try:
+            cb(event)
+        except Exception as e:  # noqa: BLE001 — consumer must not wedge serving
+            req.on_commit = None
+            telemetry.inc("roundtable_sched_stream_errors_total",
+                          engine=self._tname)
+            self._event("stream_error", session=req.session,
+                        error=str(e)[:200])
+
+    def _stream_flush(self, req: _Request) -> None:
+        """Push each row's NEW committed tokens (eos-trimmed, so the
+        stream never carries post-eos filler and matches the journal's
+        `produced` exactly) to the request's on_commit callback."""
+        if req.on_commit is None:
+            return
+        engine = self.engine
+        eos = engine.tokenizer.eos_id
+        max_new, _padded = clamp_max_new(req.max_new,
+                                         engine.max_seq_len)
+        for i, r in enumerate(req.rows):
+            ids = eos_trim(list(r.produced), eos, max_new)
+            if len(ids) <= r.streamed:
+                continue
+            new = ids[r.streamed:]
+            self._stream_notify(req, {
+                "type": "tokens", "row": i, "knight": req.turns[i][0],
+                "tokens": new, "done": r.done})
+            if req.on_commit is None:
+                return  # callback died mid-flush
+            r.streamed = len(ids)
+
+    def _flush_streams(self) -> None:
+        """The streaming seam's tick hook: after every segment fold
+        (ragged, spec, while-loop — all land in rows' `produced`),
+        flush each streaming request's newly committed span. Tokens
+        flush at SEGMENT boundaries, the same grain retirement and the
+        journal observe — a streamed token is always a committed one."""
+        for req in list(self._active_reqs):
+            if req.on_commit is not None:
+                self._stream_flush(req)
 
     # --- retirement ---
 
@@ -2594,6 +2723,12 @@ class SessionScheduler:
                 # Persona provenance (ISSUE 10): which LoRA adapter
                 # served each knight of this round.
                 req.stats.sched["lora_adapters"] = list(req.adapters)
+            if req.on_commit is not None:
+                # Streaming epilogue (ISSUE 16): the journal record is
+                # already fsynced above, so "retired" tells the gateway
+                # the turn is DURABLE — safe to finalize event ids.
+                self._stream_flush(req)
+                self._stream_notify(req, {"type": "retired"})
             self._drop_request(req)
             self._last_active[req.session] = time.monotonic()
             req.result = (texts, req.stats)

@@ -1,0 +1,23 @@
+"""Streaming serving gateway (ISSUE 16): the asyncio HTTP/SSE front
+door over SessionScheduler.
+
+Stdlib-only by design (asyncio + json — no new deps): an
+OpenAI-compatible `/v1/chat/completions` streaming endpoint plus a
+native `/v1/discussions` endpoint, fed by the scheduler's
+committed-token streaming seam (`submit_async(on_commit=...)`), with
+
+- SLO-driven admission + load shedding (gateway/admission.py),
+- bounded per-consumer SSE buffers with drop-to-summary
+  (gateway/streams.py),
+- crash-consistent mid-stream resume via journal-backed SSE event ids
+  and `Last-Event-ID` reconnects (gateway/resume.py),
+- graceful drain: `fleet.drain()` flips admission to 503/draining
+  while in-flight streams finish.
+"""
+
+from .admission import AdmissionController, Decision
+from .app import Gateway
+from .streams import StreamState, reset_test_counters, tokens_streamed
+
+__all__ = ["Gateway", "AdmissionController", "Decision", "StreamState",
+           "reset_test_counters", "tokens_streamed"]

@@ -1,0 +1,228 @@
+"""SLO-driven admission control: shed/queue decisions from live
+serving signals.
+
+Every decision derives from state the serving stack already publishes
+— nothing here samples the device or adds a poll loop:
+
+| signal            | source                                  | shed reason    |
+|-------------------|-----------------------------------------|----------------|
+| fleet drain       | deadlines.DRAINING / scheduler.paused   | draining (503) |
+| dead engine       | supervisor.engine_dead_reason           | engine_dead (503) |
+| spent deadline    | client deadline header <= 0             | deadline_expired (408) |
+| inflight cap      | live gateway stream table               | inflight_cap (429) |
+| queue depth       | scheduler describe()["admission"]       | queue_full (429) |
+| KV page pressure  | paged free pages + spill headroom       | kv_pressure (429) |
+| adapter residency | LoraStore.can_admit (lora.py)           | adapters_busy (429) |
+| p95 turn latency  | gateway's own recent-TTFT window        | slo_p95 (429)  |
+
+Priority classes: "high" requests bypass the soft signals (p95) and
+shed only at hard caps; "low" requests shed at half the inflight/queue
+caps — under pressure the cheap traffic goes first. Every shed carries
+`Retry-After` plus a machine-readable reason so clients back off
+deterministically instead of hammering a collapsing server.
+
+Counters move in lockstep with decisions (`_count` is the one writer):
+roundtable_gateway_{admitted,shed,queued,expired}_total{reason=...}.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..engine import deadlines
+from ..utils import telemetry
+
+_PRIORITY_SCALE = {"high": 1.0, "normal": 1.0, "low": 0.5}
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class Decision:
+    admit: bool
+    reason: str                  # "ok" or the shed reason tag
+    status: int = 200            # HTTP status for sheds
+    retry_after_s: float = 0.0
+
+
+class AdmissionController:
+    """Derives one Decision per request from the live signals above.
+
+    Stateless against the scheduler (reads describe()/engine state);
+    its own state is the shed/admit accounting and a bounded window of
+    recent TTFT samples for the p95 SLO signal."""
+
+    def __init__(self, scheduler, *,
+                 max_inflight: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 page_headroom: Optional[float] = None,
+                 p95_slo_s: Optional[float] = None,
+                 retry_after_s: Optional[float] = None):
+        self.sched = scheduler
+        self.max_inflight = max_inflight if max_inflight is not None \
+            else _env_int("ROUNDTABLE_GATEWAY_MAX_INFLIGHT", 32)
+        self.max_queue_depth = max_queue_depth \
+            if max_queue_depth is not None \
+            else _env_int("ROUNDTABLE_GATEWAY_MAX_QUEUE_DEPTH", 16)
+        self.page_headroom = page_headroom if page_headroom is not None \
+            else _env_float("ROUNDTABLE_GATEWAY_PAGE_HEADROOM", 0.05)
+        self.p95_slo_s = p95_slo_s if p95_slo_s is not None \
+            else _env_float("ROUNDTABLE_GATEWAY_P95_SLO_S", 0.0)
+        self.retry_after_s = retry_after_s if retry_after_s is not None \
+            else _env_float("ROUNDTABLE_GATEWAY_RETRY_AFTER_S", 2.0)
+        self._ttfts: list[float] = []   # bounded window, newest last
+        self.admitted = 0
+        self.shed = 0
+        self.expired = 0
+        self.queued = 0
+
+    # -- accounting (single writer for counters + registry) --
+
+    def _count(self, outcome: str, reason: str) -> None:
+        setattr(self, outcome, getattr(self, outcome) + 1)
+        telemetry.inc(f"roundtable_gateway_{outcome}_total",
+                      reason=reason)
+
+    def note_ttft(self, seconds: float) -> None:
+        self._ttfts.append(seconds)
+        if len(self._ttfts) > 256:
+            del self._ttfts[:-256]
+
+    def p95_ttft(self) -> Optional[float]:
+        if len(self._ttfts) < 8:
+            return None
+        ordered = sorted(self._ttfts)
+        return ordered[min(int(len(ordered) * 0.95),
+                           len(ordered) - 1)]
+
+    # -- the decision ladder --
+
+    def decide(self, *, rows: int, inflight: int,
+               deadline_s: Optional[float] = None,
+               priority: str = "normal",
+               adapters: Optional[list] = None) -> Decision:
+        sched = self.sched
+        scale = _PRIORITY_SCALE.get(priority, 1.0)
+
+        # 1. Drain / pause: finish in-flight, refuse new (503 — the
+        # gate reopens; clients retry the same pod after Retry-After).
+        paused = sched.paused
+        if deadlines.DRAINING or paused is not None:
+            reason = "draining" if (deadlines.DRAINING
+                                    or paused == "fleet.drain") \
+                else f"paused:{paused}"
+            return self._shed(reason, 503)
+
+        # 2. Dead engine: the supervisor exhausted its restart budget —
+        # nothing this pod serves can succeed (503, longer backoff).
+        from ..engine.supervisor import engine_dead_reason
+        if engine_dead_reason(sched.engine) is not None:
+            return self._shed("engine_dead", 503,
+                              retry_after=4 * self.retry_after_s)
+
+        # 3. Spent deadline: the client's SLO budget is already gone —
+        # admitting would burn a slot to produce a guaranteed timeout.
+        if deadline_s is not None and deadline_s <= 0:
+            self._count("expired", "deadline_expired")
+            return Decision(False, "deadline_expired", 408,
+                            self.retry_after_s)
+
+        # 4. Hard caps, priority-scaled: low-priority traffic sheds at
+        # half the cap so paid/interactive traffic keeps headroom.
+        if inflight >= max(int(self.max_inflight * scale), 1):
+            return self._shed("inflight_cap", 429)
+        adm = sched.describe()["admission"]
+        if adm["queued"] >= max(int(self.max_queue_depth * scale), 1):
+            return self._shed("queue_full", 429)
+
+        # 5. KV page pressure: a paged pool within the headroom band
+        # AND no host-RAM spill tier to evacuate into means the next
+        # admission trades page faults for collapse — shed instead.
+        engine = sched.engine
+        if getattr(engine, "kv_layout", None) == "paged":
+            kv = engine.kv
+            free = kv.free_pages()
+            floor = int(kv.usable_pages() * self.page_headroom)
+            if (free <= floor
+                    and getattr(engine, "kv_offload", None) is None):
+                return self._shed("kv_pressure", 429)
+
+        # 6. Adapter residency: every LoRA store slot referenced by
+        # live rows — retirement frees refs; back off rather than park
+        # in the scheduler queue behind an unknown-duration round.
+        store = getattr(engine, "lora", None)
+        if (store is not None and adapters
+                and any(a is not None for a in adapters)
+                and not store.can_admit(adapters)):
+            return self._shed("adapters_busy", 429)
+
+        # 7. Soft SLO: the gateway's own p95 TTFT window over target —
+        # shed everything except high priority until latency recovers.
+        slo = self.p95_slo_s
+        if slo and priority != "high":
+            p95 = self.p95_ttft()
+            if p95 is not None and p95 > slo:
+                return self._shed("slo_p95", 429)
+
+        return Decision(True, "ok")
+
+    def note_admitted(self) -> None:
+        """Counted by the gateway AFTER submit_async succeeds — the
+        scheduler can still refuse between decide() and submit (a
+        drain racing the request), and that lands under `shed`, so the
+        two counters never both claim one request."""
+        self._count("admitted", "ok")
+
+    def note_shed(self, reason: str) -> None:
+        """Submit-time refusals (scheduler raced the decision)."""
+        self._count("shed", reason)
+
+    def _shed(self, reason: str, status: int,
+              retry_after: Optional[float] = None) -> Decision:
+        self._count("shed", reason)
+        return Decision(False, reason, status,
+                        retry_after if retry_after is not None
+                        else self.retry_after_s)
+
+    def describe(self) -> dict:
+        return {
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "expired": self.expired,
+            "queued": self.queued,
+            "p95_ttft_s": self.p95_ttft(),
+            "caps": {
+                "max_inflight": self.max_inflight,
+                "max_queue_depth": self.max_queue_depth,
+                "page_headroom": self.page_headroom,
+                "p95_slo_s": self.p95_slo_s,
+            },
+        }
+
+
+def make_budget(deadline_s: Optional[float]):
+    """The scheduler-facing deadline: a Budget root bounded by the
+    client's remaining SLO (None = unbounded). 0 is born expired —
+    submit_async fails it fast with DeadlineExpired."""
+    if deadline_s is None:
+        return None
+    return deadlines.Budget.root(max(deadline_s, 0.0), rung="turn")
+
+
+def clock() -> float:
+    return time.monotonic()

@@ -1,0 +1,646 @@
+"""The Gateway: asyncio HTTP/SSE front door over one SessionScheduler.
+
+Endpoints:
+
+| route                     | method | behavior                        |
+|---------------------------|--------|---------------------------------|
+| /v1/chat/completions      | POST   | OpenAI-compatible; stream=true → SSE chunks + [DONE] |
+| /v1/discussions           | POST   | native multi-knight round → SSE token events |
+| /v1/streams/<id>          | GET    | reconnect a stream (Last-Event-ID watermark) |
+| /healthz                  | GET    | liveness + drain state          |
+| /metrics                  | GET    | Prometheus exposition snapshot  |
+
+Every admitted stream: one fsynced intent record (gateway/resume.py),
+one scheduler submit with `on_commit` bridged onto the asyncio loop,
+one `roundtable_gateway_inflight_streams{request=...}` gauge removed
+at completion (the PR-6 gauge-leak rule). Generation is GREEDY by
+default — that is what makes post-crash re-generation byte-identical
+and the resume protocol exact.
+
+Deadline propagation: the client deadline (X-Roundtable-Deadline-S
+header or body `deadline_s`, default ROUNDTABLE_GATEWAY_DEFAULT_
+DEADLINE_S) becomes a `deadlines.Budget` root handed to submit_async —
+an already-spent budget fails fast there with DeadlineExpired (its own
+classified kind) before any prefill dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ..engine import deadlines
+from ..engine.sampling import SamplingParams
+from ..engine.scheduler import DeadlineExpired, SchedulerClosed, \
+    SchedulerRefused
+from ..utils import telemetry
+from .admission import AdmissionController, Decision, _env_float, \
+    _env_int, make_budget
+from .http import HttpError, Request, SseWriter, read_request, \
+    send_json, send_text
+from .resume import StreamIntentJournal, committed_rows
+from .streams import StreamState, format_event_id, parse_event_id
+
+_DONE_STREAM_CAP = 256   # completed streams kept for reconnects
+
+
+class _Shed(Exception):
+    def __init__(self, decision: Decision):
+        super().__init__(decision.reason)
+        self.decision = decision
+
+
+class Gateway:
+    """One gateway over one scheduler (one pod, one engine)."""
+
+    def __init__(self, scheduler, *, host: Optional[str] = None,
+                 port: Optional[int] = None,
+                 intent_dir: Optional[str] = None,
+                 admission: Optional[AdmissionController] = None):
+        self.sched = scheduler
+        self.host = host or os.environ.get(
+            "ROUNDTABLE_GATEWAY_HOST", "127.0.0.1")
+        self.port = port if port is not None \
+            else _env_int("ROUNDTABLE_GATEWAY_PORT", 8080)
+        self.admission = admission or AdmissionController(scheduler)
+        self.default_deadline_s = _env_float(
+            "ROUNDTABLE_GATEWAY_DEFAULT_DEADLINE_S", 120.0)
+        self.sse_buffer = _env_int("ROUNDTABLE_GATEWAY_SSE_BUFFER", 512)
+        self.keepalive_s = _env_float(
+            "ROUNDTABLE_GATEWAY_KEEPALIVE_S", 15.0)
+        self.streams: dict[str, StreamState] = {}
+        self.resumed_streams = 0
+        # Stream-intent journal: rides in the session journal's
+        # directory when one is attached (one durable root per pod).
+        root = intent_dir
+        if root is None and scheduler.journal is not None:
+            root = str(scheduler.journal.root)
+        self.intents = StreamIntentJournal(root) if root else None
+        self._intent_cache: dict[str, dict] = (
+            self.intents.load() if self.intents else {})
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop_event: Optional[asyncio.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def boot(cls, scheduler, *, resume_dir: Optional[str] = None,
+             **kw) -> "Gateway":
+        """Build a gateway, optionally restoring committed sessions
+        first: `resume_dir` replays the session journal through the
+        library seam (engine/recovery.py) so every session's KV sits
+        at its last committed turn before the first reconnect."""
+        if resume_dir is not None:
+            from ..engine.recovery import resume_from_journal
+            resume_from_journal(resume_dir, scheduler=scheduler)
+            kw.setdefault("intent_dir", resume_dir)
+        return cls(scheduler, **kw)
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        telemetry.recorder().record("gateway_start", host=self.host,
+                                    port=self.port)
+
+    async def serve_until_stopped(self) -> None:
+        await self.start()
+        async with self._server:
+            await self._stop_event.wait()
+
+    def run(self) -> None:
+        """Blocking entry (the CLI): serve until SIGINT."""
+        try:
+            asyncio.run(self.serve_until_stopped())
+        except KeyboardInterrupt:
+            pass
+
+    def start_in_thread(self, timeout_s: float = 10.0) -> int:
+        """Background entry (tests / embedding): returns the bound
+        port once the socket is listening."""
+        ready = threading.Event()
+
+        async def _main():
+            await self.start()
+            ready.set()
+            async with self._server:
+                await self._stop_event.wait()
+
+        self._thread = threading.Thread(
+            target=lambda: asyncio.run(_main()),
+            name="gateway", daemon=True)
+        self._thread.start()
+        if not ready.wait(timeout_s):
+            raise RuntimeError("gateway did not start listening")
+        return self.port
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        if self._loop is not None and self._stop_event is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._stop_event.set)
+            except RuntimeError:
+                pass  # loop already closed
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+        # Close must not leak per-stream gauges (RT-GAUGE-LEAK): any
+        # stream still marked inflight drops its series here.
+        for sid, st in list(self.streams.items()):
+            if not st.done:
+                telemetry.REGISTRY.remove_gauge(
+                    "roundtable_gateway_inflight_streams", request=sid)
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """Keys ⊆ SURFACE_BINDINGS["gateway"] (drift-tested like the
+        scheduler's describe)."""
+        adm = self.admission
+        return {
+            "admitted": adm.admitted,
+            "shed": adm.shed,
+            "queued": adm.queued,
+            "expired": adm.expired,
+            "inflight": self._inflight(),
+            "draining": bool(deadlines.DRAINING
+                             or self.sched.paused is not None),
+            "resumed_streams": self.resumed_streams,
+            "dropped_events": int(telemetry.REGISTRY.counter_total(
+                "roundtable_gateway_dropped_events_total")),
+            "sessions": len(self.streams),
+            "host": self.host,
+            "port": self.port,
+        }
+
+    def _inflight(self) -> int:
+        return sum(1 for s in self.streams.values() if not s.done)
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            req = await read_request(reader)
+            if req is not None:
+                await self._route(req, writer)
+        except _Shed as s:
+            d = s.decision
+            await send_json(writer, d.status, {
+                "error": f"request shed: {d.reason}",
+                "reason": d.reason,
+            }, {"Retry-After": f"{max(int(d.retry_after_s), 1)}"})
+        except HttpError as e:
+            try:
+                await send_json(writer, e.status,
+                                {"error": str(e), "reason": e.reason})
+            except (ConnectionError, RuntimeError):
+                pass
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away mid-write — its stream state stays
+        except Exception as e:  # noqa: BLE001 — one conn must not kill the server
+            try:
+                await send_json(writer, 500, {
+                    "error": str(e)[:200], "reason": "internal"})
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _route(self, req: Request,
+                     writer: asyncio.StreamWriter) -> None:
+        path = req.path.rstrip("/") or "/"
+        if path == "/healthz" and req.method == "GET":
+            await send_json(writer, 200, {
+                "ok": True,
+                "draining": bool(deadlines.DRAINING
+                                 or self.sched.paused is not None),
+                "paused": self.sched.paused,
+                "inflight": self._inflight(),
+            })
+            return
+        if path == "/metrics" and req.method == "GET":
+            await send_text(writer, 200,
+                            telemetry.REGISTRY.prometheus_text(),
+                            "text/plain; version=0.0.4")
+            return
+        if path == "/v1/chat/completions" and req.method == "POST":
+            await self._chat_completions(req, writer)
+            return
+        if path == "/v1/discussions" and req.method == "POST":
+            await self._discussions(req, writer)
+            return
+        if path.startswith("/v1/streams/") and req.method == "GET":
+            await self._reconnect(req, writer,
+                                  path[len("/v1/streams/"):])
+            return
+        raise HttpError(404, f"no route for {req.method} {req.path}",
+                        "not_found")
+
+    # ------------------------------------------------------------------
+    # admission + submit (the shared front half of both POST routes)
+    # ------------------------------------------------------------------
+
+    def _client_deadline(self, req: Request, body: dict
+                         ) -> Optional[float]:
+        raw = req.header("x-roundtable-deadline-s")
+        if raw is None:
+            raw = body.get("deadline_s")
+        if raw is None:
+            return self.default_deadline_s or None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            raise HttpError(400, f"bad deadline: {raw!r}",
+                            "bad_deadline")
+
+    def _submit_stream(self, *, session: str,
+                       turns: list[tuple[str, Any]], max_new: int,
+                       deadline_s: Optional[float], priority: str,
+                       adapters: Optional[list], kind: str,
+                       temperature: float = 0.0,
+                       record_intent: bool = True) -> StreamState:
+        dec = self.admission.decide(
+            rows=len(turns), inflight=self._inflight(),
+            deadline_s=deadline_s, priority=priority, adapters=adapters)
+        if not dec.admit:
+            raise _Shed(dec)
+        stream_id = uuid.uuid4().hex[:16]
+        journal = self.sched.journal
+        last = journal.last_turn(session) if journal is not None else None
+        turn = 0 if last is None else last + 1
+        state = StreamState(stream_id, session,
+                            [k for k, _p in turns], turn,
+                            buffer_cap=self.sse_buffer)
+        if record_intent and self.intents is not None:
+            rec = self.intents.record(
+                stream_id, session=session,
+                knights=[k for k, _p in turns],
+                prompts=[p for _k, p in turns], turn=turn,
+                max_new=max_new, deadline_s=deadline_s, kind=kind)
+            if rec is not None:
+                self._intent_cache[stream_id] = rec
+        self._submit_state(state, turns, max_new=max_new,
+                           deadline_s=deadline_s, adapters=adapters,
+                           temperature=temperature)
+        self.admission.note_admitted()
+        return state
+
+    def _submit_state(self, state: StreamState,
+                      turns: list[tuple[str, Any]], *, max_new: int,
+                      deadline_s: Optional[float],
+                      adapters: Optional[list],
+                      temperature: float = 0.0) -> None:
+        """The scheduler half: submit with the streaming seam bridged
+        onto the asyncio loop, classify every refusal into the shed
+        taxonomy, and publish the inflight gauge."""
+        loop = self._loop
+        assert loop is not None, "gateway not started"
+
+        def on_commit(event: dict, _st=state) -> None:
+            # Scheduler loop thread → asyncio loop. A closed loop means
+            # the gateway is going down; the journal keeps the story.
+            try:
+                loop.call_soon_threadsafe(self._on_stream_event, _st,
+                                          dict(event))
+            except RuntimeError:
+                pass
+
+        sampling = [SamplingParams(temperature=temperature,
+                                   max_new_tokens=max_new)
+                    for _ in turns]
+        timeout_s = deadline_s if deadline_s else 600.0
+        try:
+            self.sched.submit_async(
+                state.session, turns, max_new_tokens=max_new,
+                timeout_s=timeout_s, sampling_per_turn=sampling,
+                budget=make_budget(deadline_s),
+                adapters_per_turn=adapters, on_commit=on_commit,
+                queue_when_paused=False)
+        except DeadlineExpired as e:
+            self.admission._count("expired", "deadline_expired")
+            raise HttpError(408, str(e), "deadline_expired")
+        except deadlines.DrainingError as e:
+            self.admission.note_shed("draining")
+            raise _Shed(Decision(False, "draining", 503,
+                                 self.admission.retry_after_s)) from e
+        except SchedulerRefused as e:
+            reason = e.reason or "refused"
+            self.admission.note_shed(reason)
+            status = 503 if reason in ("fleet.drain", "quiesce") else 429
+            raise _Shed(Decision(False, reason, status,
+                                 self.admission.retry_after_s)) from e
+        except SchedulerClosed as e:
+            self.admission.note_shed("closed")
+            raise _Shed(Decision(False, "closed", 503,
+                                 self.admission.retry_after_s)) from e
+        except Exception as e:  # noqa: BLE001 — classify dead engines etc.
+            from ..core.errors import classify_error
+            kind = classify_error(e)
+            self.admission.note_shed(kind)
+            raise _Shed(Decision(False, kind, 503,
+                                 4 * self.admission.retry_after_s)) \
+                from e
+        self.streams[state.stream_id] = state
+        telemetry.set_gauge("roundtable_gateway_inflight_streams", 1,
+                            request=state.stream_id)
+
+    def _on_stream_event(self, state: StreamState, event: dict) -> None:
+        """Asyncio-loop side of the scheduler's on_commit bridge."""
+        first = not any(state.history) and event.get("type") == "tokens"
+        state.on_commit_event(event)
+        if first:
+            self.admission.note_ttft(time.monotonic() - state.created)
+        if state.done:
+            # Stream finished (retired or failed): its per-request
+            # gauge series dies NOW — a long-lived gateway must not
+            # keep one series per stream ever served (RT-GAUGE-LEAK).
+            telemetry.REGISTRY.remove_gauge(
+                "roundtable_gateway_inflight_streams",
+                request=state.stream_id)
+            self._evict_done_streams()
+
+    def _evict_done_streams(self) -> None:
+        done = [sid for sid, st in self.streams.items() if st.done]
+        while len(done) > _DONE_STREAM_CAP:
+            self.streams.pop(done.pop(0), None)
+
+    # ------------------------------------------------------------------
+    # POST /v1/chat/completions (OpenAI-compatible)
+    # ------------------------------------------------------------------
+
+    async def _chat_completions(self, req: Request,
+                                writer: asyncio.StreamWriter) -> None:
+        try:
+            body = req.json()
+        except (ValueError, json.JSONDecodeError) as e:
+            raise HttpError(400, f"bad JSON body: {e}", "bad_json")
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise HttpError(400, "messages[] is required",
+                            "bad_request")
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in messages) + "\nassistant:"
+        knight = str(body.get("model") or "assistant")
+        session = str(body.get("session")
+                      or f"chat-{uuid.uuid4().hex[:8]}")
+        max_new = int(body.get("max_tokens") or 128)
+        temperature = float(body.get("temperature") or 0.0)
+        deadline_s = self._client_deadline(req, body)
+        priority = str(req.header("x-roundtable-priority")
+                       or body.get("priority") or "normal")
+        state = self._submit_stream(
+            session=session, turns=[(knight, prompt)], max_new=max_new,
+            deadline_s=deadline_s, priority=priority, adapters=None,
+            kind="chat", temperature=temperature)
+        consumer = state.attach()
+        if body.get("stream"):
+            await self._pump_chat(writer, state, consumer)
+        else:
+            try:
+                failed = await self._await_done(consumer, deadline_s)
+            finally:
+                state.detach(consumer)
+            if failed is not None:
+                raise HttpError(500, failed.get("error", "failed"),
+                                failed.get("kind", "unknown"))
+            text = self._decode(state.history[0])
+            await send_json(writer, 200, {
+                "id": f"chatcmpl-{state.stream_id}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": knight,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant",
+                                         "content": text},
+                             "finish_reason": "stop"}],
+                "usage": {"completion_tokens": len(state.history[0])},
+            })
+
+    async def _await_done(self, consumer,
+                          deadline_s: Optional[float]) -> Optional[dict]:
+        """Drain a consumer without a socket (non-streaming response).
+        Returns the failure payload, or None on clean retirement."""
+        bound = time.monotonic() + (deadline_s or 600.0) + 60.0
+        while not consumer.finished():
+            if time.monotonic() > bound:
+                raise HttpError(500, "stream never finished",
+                                "gateway_wedged")
+            for ev in await consumer.next_events(self.keepalive_s):
+                if ev["type"] == "failed":
+                    return {"error": ev.get("error", ""),
+                            "kind": ev.get("kind", "unknown")}
+        return consumer.state.failed
+
+    # ------------------------------------------------------------------
+    # POST /v1/discussions (native multi-knight)
+    # ------------------------------------------------------------------
+
+    async def _discussions(self, req: Request,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            body = req.json()
+        except (ValueError, json.JSONDecodeError) as e:
+            raise HttpError(400, f"bad JSON body: {e}", "bad_json")
+        raw_turns = body.get("turns")
+        if not isinstance(raw_turns, list) or not raw_turns:
+            raise HttpError(400, "turns[] is required", "bad_request")
+        turns: list[tuple[str, Any]] = []
+        for t in raw_turns:
+            if not isinstance(t, dict) or "knight" not in t \
+                    or "prompt" not in t:
+                raise HttpError(400, "each turn needs knight + prompt",
+                                "bad_request")
+            turns.append((str(t["knight"]), t["prompt"]))
+        session = str(body.get("session")
+                      or f"disc-{uuid.uuid4().hex[:8]}")
+        max_new = int(body.get("max_new_tokens") or 64)
+        adapters = body.get("adapters")
+        deadline_s = self._client_deadline(req, body)
+        priority = str(req.header("x-roundtable-priority")
+                       or body.get("priority") or "normal")
+        state = self._submit_stream(
+            session=session, turns=turns, max_new=max_new,
+            deadline_s=deadline_s, priority=priority,
+            adapters=adapters, kind="native",
+            temperature=float(body.get("temperature") or 0.0))
+        consumer = state.attach()
+        await self._pump_native(writer, state, consumer)
+
+    # ------------------------------------------------------------------
+    # GET /v1/streams/<id> (reconnect)
+    # ------------------------------------------------------------------
+
+    async def _reconnect(self, req: Request,
+                         writer: asyncio.StreamWriter,
+                         stream_id: str) -> None:
+        state = self.streams.get(stream_id)
+        if state is None:
+            state = self._restore_stream(stream_id)
+        watermark = [0] * len(state.knights)
+        leid = req.header("last-event-id")
+        if leid:
+            parsed = parse_event_id(leid, len(state.knights))
+            if parsed is not None and parsed[0] == state.turn:
+                watermark = parsed[1]
+        consumer = state.attach(watermark)
+        self.resumed_streams += 1
+        telemetry.inc("roundtable_gateway_resumed_streams_total")
+        await self._pump_native(writer, state, consumer)
+
+    def _restore_stream(self, stream_id: str) -> StreamState:
+        """Post-restart reconnect: rebuild the stream from the intent
+        journal — from the committed turn when the round finished
+        before the crash, by greedy re-generation otherwise."""
+        intent = self._intent_cache.get(stream_id)
+        if intent is None:
+            raise HttpError(404, f"unknown stream {stream_id!r}",
+                            "unknown_stream")
+        session = intent["session"]
+        knights = intent["knights"]
+        state = StreamState(stream_id, session, knights,
+                            intent["turn"], buffer_cap=self.sse_buffer)
+        rows = committed_rows(self.sched.journal, session,
+                              intent["turn"])
+        if rows is not None:
+            # Leg 2: the round committed before the crash — serve
+            # straight from the durable record, no recompute.
+            for i, row in enumerate(rows[:len(knights)]):
+                state.history[i] = [int(t) for t in
+                                    row.get("produced", [])]
+            state.done = True
+            self.streams[stream_id] = state
+        else:
+            # Leg 3: crash mid-round — greedy re-generation over the
+            # replayed KV produces the identical token stream; the
+            # client's watermark skips what it already saw.
+            turns = list(zip(knights, intent["prompts"]))
+            self._submit_state(state, turns,
+                               max_new=int(intent["max_new"]),
+                               deadline_s=intent.get("deadline_s"),
+                               adapters=None)
+        return state
+
+    # ------------------------------------------------------------------
+    # SSE pumps
+    # ------------------------------------------------------------------
+
+    def _decode(self, ids: list[int]) -> str:
+        try:
+            return self.sched.engine.tokenizer.decode(ids)
+        except Exception:  # noqa: BLE001 — stream ids even if decode trips
+            return ""
+
+    async def _pump_native(self, writer: asyncio.StreamWriter,
+                           state: StreamState, consumer) -> None:
+        sse = SseWriter(writer)
+        await sse.open()
+        # Metadata first: the stream id IS the reconnect handle
+        # (GET /v1/streams/<id>) — a client that only ever saw this
+        # event can still resume from zero after a crash.
+        await sse.event(
+            {"type": "stream", "stream": state.stream_id,
+             "session": state.session, "turn": state.turn,
+             "knights": state.knights},
+            event_id=format_event_id(state.turn, list(consumer.sent)))
+        try:
+            while True:
+                events = await consumer.next_events(self.keepalive_s)
+                if not events:
+                    if consumer.finished():
+                        break
+                    await sse.comment()
+                    continue
+                terminal = False
+                for ev in events:
+                    payload, ntok = self._native_payload(state, ev)
+                    await sse.event(payload, event_id=ev["id"],
+                                    tokens=ntok)
+                    terminal = terminal or ev["type"] in ("retired",
+                                                          "failed")
+                if terminal:
+                    break
+        finally:
+            state.detach(consumer)
+
+    def _native_payload(self, state: StreamState,
+                        ev: dict) -> tuple[dict, int]:
+        if ev["type"] == "tokens":
+            toks = ev["tokens"]
+            return ({"type": "tokens", "row": ev["row"],
+                     "knight": ev["knight"], "tokens": toks,
+                     "text": self._decode(toks)}, len(toks))
+        if ev["type"] == "summary":
+            rows = {str(i): {"tokens": d, "text": self._decode(d),
+                             "knight": state.knights[i]}
+                    for i, d in ev["rows"].items()}
+            n = sum(len(d) for d in ev["rows"].values())
+            return ({"type": "summary", "rows": rows,
+                     "coalesced": True}, n)
+        if ev["type"] == "failed":
+            return ({"type": "failed", "error": ev.get("error", ""),
+                     "kind": ev.get("kind", "unknown")}, 0)
+        return ({"type": "retired", "session": state.session,
+                 "turn": state.turn}, 0)
+
+    async def _pump_chat(self, writer: asyncio.StreamWriter,
+                         state: StreamState, consumer) -> None:
+        sse = SseWriter(writer)
+        await sse.open()
+        cid = f"chatcmpl-{state.stream_id}"
+        model = state.knights[0]
+
+        def chunk(delta: dict, finish: Optional[str] = None) -> dict:
+            return {"id": cid, "object": "chat.completion.chunk",
+                    "created": int(time.time()), "model": model,
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}]}
+
+        try:
+            while True:
+                events = await consumer.next_events(self.keepalive_s)
+                if not events:
+                    if consumer.finished():
+                        break
+                    await sse.comment()
+                    continue
+                terminal = False
+                for ev in events:
+                    if ev["type"] in ("tokens", "summary"):
+                        toks = ev.get("tokens") or [
+                            t for d in ev.get("rows", {}).values()
+                            for t in d]
+                        await sse.event(
+                            chunk({"content": self._decode(toks)}),
+                            event_id=ev["id"], tokens=len(toks))
+                    elif ev["type"] == "failed":
+                        await sse.event(chunk({}, finish="error"),
+                                        event_id=ev["id"])
+                        terminal = True
+                    else:  # retired
+                        await sse.event(chunk({}, finish="stop"),
+                                        event_id=ev["id"])
+                        await sse.event("[DONE]")
+                        terminal = True
+                if terminal:
+                    break
+        finally:
+            state.detach(consumer)

@@ -1,0 +1,161 @@
+"""Minimal asyncio HTTP/1.1 + SSE plumbing (stdlib-only, no deps).
+
+One request per connection (`Connection: close`) keeps the parser
+honest and small: read the request line + headers, read the body by
+Content-Length, dispatch, write either a full JSON response or an SSE
+stream. That is everything the gateway needs — this is a serving seam,
+not a web framework.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+from . import streams as _streams
+
+MAX_HEADER_BYTES = 32 * 1024
+MAX_BODY_BYTES = 4 * 1024 * 1024
+
+
+class Request:
+    __slots__ = ("method", "path", "query", "headers", "body")
+
+    def __init__(self, method: str, path: str, query: str,
+                 headers: dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        obj = json.loads(self.body.decode("utf-8"))
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    def header(self, name: str, default: Optional[str] = None):
+        return self.headers.get(name.lower(), default)
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str,
+                 reason: str = "bad_request"):
+        super().__init__(message)
+        self.status = status
+        self.reason = reason
+
+
+_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout",
+    409: "Conflict", 429: "Too Many Requests",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+async def read_request(reader: asyncio.StreamReader) -> Optional[Request]:
+    """Parse one HTTP/1.1 request; None on clean EOF before a request
+    line (client connected and left)."""
+    try:
+        head = await reader.readuntil(b"\r\n\r\n")
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None
+        raise HttpError(400, "truncated request head")
+    except asyncio.LimitOverrunError:
+        raise HttpError(400, "request head too large")
+    if len(head) > MAX_HEADER_BYTES:
+        raise HttpError(400, "request head too large")
+    lines = head.decode("latin-1").split("\r\n")
+    parts = lines[0].split(" ")
+    if len(parts) != 3:
+        raise HttpError(400, f"malformed request line: {lines[0]!r}")
+    method, target, _version = parts
+    path, _, query = target.partition("?")
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        name, _, value = line.partition(":")
+        headers[name.strip().lower()] = value.strip()
+    length = int(headers.get("content-length", "0") or "0")
+    if length > MAX_BODY_BYTES:
+        raise HttpError(400, "request body too large")
+    body = await reader.readexactly(length) if length else b""
+    return Request(method.upper(), path, query, headers, body)
+
+
+def _head(status: int, content_type: str,
+          extra: Optional[dict[str, str]] = None,
+          length: Optional[int] = None) -> bytes:
+    lines = [f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}",
+             f"Content-Type: {content_type}",
+             "Connection: close"]
+    if length is not None:
+        lines.append(f"Content-Length: {length}")
+    for k, v in (extra or {}).items():
+        lines.append(f"{k}: {v}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+
+
+async def send_json(writer: asyncio.StreamWriter, status: int,
+                    payload: dict,
+                    extra_headers: Optional[dict[str, str]] = None
+                    ) -> None:
+    body = json.dumps(payload, indent=None).encode("utf-8")
+    writer.write(_head(status, "application/json", extra_headers,
+                       len(body)))
+    writer.write(body)
+    await writer.drain()
+
+
+async def send_text(writer: asyncio.StreamWriter, status: int,
+                    text: str, content_type: str = "text/plain"
+                    ) -> None:
+    body = text.encode("utf-8")
+    writer.write(_head(status, content_type, None, len(body)))
+    writer.write(body)
+    await writer.drain()
+
+
+class SseWriter:
+    """Server-Sent Events over one connection. Every event carries the
+    stream's cumulative event id (`id:` field), so whatever a client
+    last received doubles as its reconnect watermark."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._w = writer
+        self.opened = False
+
+    async def open(self) -> None:
+        self._w.write(_head(200, "text/event-stream", {
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        }))
+        await self._w.drain()
+        self.opened = True
+
+    async def event(self, data: Any,
+                    event_id: Optional[str] = None,
+                    tokens: int = 0) -> None:
+        chunk = ""
+        if event_id is not None:
+            chunk += f"id: {event_id}\n"
+        payload = data if isinstance(data, str) else json.dumps(
+            data, indent=None)
+        chunk += f"data: {payload}\n\n"
+        self._w.write(chunk.encode("utf-8"))
+        await self._w.drain()
+        if tokens:
+            # The conftest `gateway` guard's proof-of-streaming: token
+            # events written to a REAL socket, counted after drain.
+            _streams.note_tokens_streamed(tokens)
+
+    async def comment(self, text: str = "keepalive") -> None:
+        self._w.write(f": {text}\n\n".encode("utf-8"))
+        await self._w.drain()

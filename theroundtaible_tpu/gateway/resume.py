@@ -1,0 +1,110 @@
+"""Crash-consistent stream resume: the gateway's intent journal plus
+the reconnect ladder.
+
+The SessionJournal (engine/session_journal.py) makes COMMITTED turns
+durable; what it cannot answer after a kill -9 is "which HTTP streams
+were open, serving what". This module journals that intent: one
+fsynced JSONL record per admitted stream (stream id, session, knights,
+prompts, budget, the turn number it will commit as) — written BEFORE
+the first token, so the record on disk always covers every stream a
+client could hold an event id for.
+
+Reconnect ladder for `GET /v1/streams/<id>` with `Last-Event-ID`:
+
+1. **Live stream** (same process): attach to its in-memory history at
+   the client's watermark — tokens after the id flow, nothing repeats.
+2. **Committed turn** (post-restart, turn present in the session
+   journal): the round finished before the crash — serve the remaining
+   tokens straight from the journal record's `produced` ids and close.
+3. **Uncommitted turn** (post-restart, crash mid-round): re-submit the
+   recorded prompts greedily. `--resume` already replayed every
+   committed turn into KV, so the prefix cache makes the re-prefill
+   cheap and greedy decoding regenerates the IDENTICAL token stream;
+   the client's watermark skips everything it already saw.
+
+All three legs deliver exactly the tokens after the last-seen event:
+zero loss, zero duplication — the chaos acceptance (GATEWAY_r16.json)
+measures this end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+INTENT_FILE = "gateway-streams.jsonl"
+
+
+class StreamIntentJournal:
+    """Append-only fsynced record of admitted streams (torn-tail
+    tolerant, the SessionJournal WAL rule)."""
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.path = self.root / INTENT_FILE
+        self._lock = threading.Lock()
+
+    def record(self, stream_id: str, *, session: str,
+               knights: list[str], prompts: list[Any], turn: int,
+               max_new: int, deadline_s: Optional[float] = None,
+               kind: str = "native") -> Optional[dict]:
+        rec = {
+            "v": 1,
+            "stream": stream_id,
+            "session": session,
+            "knights": list(knights),
+            "prompts": list(prompts),
+            "turn": turn,
+            "max_new": max_new,
+            "deadline_s": deadline_s,
+            "kind": kind,
+        }
+        try:
+            with self._lock, open(self.path, "a",
+                                  encoding="utf-8") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            # Durability < availability (the journal rule): the stream
+            # serves; it just won't survive a crash.
+            return None
+        return rec
+
+    def load(self) -> dict[str, dict]:
+        """stream_id -> intent record, last-writer-wins, stopping at
+        the first torn line."""
+        out: dict[str, dict] = {}
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail from the crash
+                    if not isinstance(rec, dict) or "stream" not in rec:
+                        break
+                    out[rec["stream"]] = rec
+        except OSError:
+            return out
+        return out
+
+
+def committed_rows(journal, session: str,
+                   turn: int) -> Optional[list[dict]]:
+    """The journal record of `session`'s turn `turn`, if that round
+    committed before the crash (reconnect ladder leg 2). Returns the
+    record's rows ({"knight", "produced", ...}) or None."""
+    if journal is None:
+        return None
+    for rec in journal.turns(session):
+        if rec.get("turn") == turn:
+            return rec.get("rows", [])
+    return None

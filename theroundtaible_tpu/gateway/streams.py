@@ -1,0 +1,215 @@
+"""Per-stream state: committed-token history, event ids, bounded
+consumer buffers with drop-to-summary.
+
+The scheduler's streaming seam delivers COMMITTED tokens (eos-trimmed,
+segment-grain) on its loop thread; the gateway bridges each event onto
+the asyncio loop into one `StreamState`. The state keeps the full
+per-row committed history for the round — bounded by rows x max_new by
+construction — so any number of consumers (including a reconnecting
+one with a `Last-Event-ID` watermark) read exactly the tokens after
+their last-seen event: no loss, no duplication.
+
+Event-id scheme (crash-consistent): `"<turn>:<c0>,<c1>,..."` — the
+journal turn this stream commits as, plus the cumulative per-row
+committed-token counts AFTER the event. One id therefore encodes the
+whole multi-row watermark, so a single `Last-Event-ID` header resumes
+every knight's row of a discussion stream at once. Greedy decoding +
+journal replay regenerate identical token streams after a crash, so
+the counts stay aligned across process generations.
+
+Slow consumers: each connection drains through a BOUNDED event queue
+(ROUNDTABLE_GATEWAY_SSE_BUFFER). On overflow the oldest fine-grained
+events are dropped (counted: roundtable_gateway_dropped_events_total)
+and the consumer is handed one catch-up SUMMARY event computed from
+history-vs-watermark — content is never lost, only event granularity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Optional
+
+from ..utils import telemetry
+
+# --- test counters (conftest `gateway` marker guard) -----------------
+# A gateway-marked test that never streamed a token over a real socket
+# proves nothing about the serving path — the guard fails LOUD unless
+# this counter moved (the scheduler test-counter pattern).
+
+_test_tokens_streamed = 0
+_test_lock = threading.Lock()
+
+
+def reset_test_counters() -> None:
+    global _test_tokens_streamed
+    with _test_lock:
+        _test_tokens_streamed = 0
+
+
+def tokens_streamed() -> int:
+    return _test_tokens_streamed
+
+
+def note_tokens_streamed(n: int) -> None:
+    """Called by the SSE write path when token events hit a socket."""
+    global _test_tokens_streamed
+    with _test_lock:
+        _test_tokens_streamed += n
+
+
+# --- event ids -------------------------------------------------------
+
+def format_event_id(turn: int, counts: list[int]) -> str:
+    return f"{turn}:{','.join(str(c) for c in counts)}"
+
+
+def parse_event_id(eid: str, rows: int) -> Optional[tuple[int, list[int]]]:
+    """(turn, per-row counts) of a client's Last-Event-ID, or None when
+    it doesn't parse / doesn't match the stream's row count (a client
+    replaying a stale id against the wrong stream restarts from 0
+    rather than silently skipping tokens)."""
+    try:
+        turn_s, _, counts_s = eid.strip().partition(":")
+        turn = int(turn_s)
+        counts = [int(c) for c in counts_s.split(",")] if counts_s else []
+    except ValueError:
+        return None
+    if len(counts) != rows:
+        return None
+    if turn < 0 or any(c < 0 for c in counts):
+        return None
+    return turn, counts
+
+
+# --- stream state ----------------------------------------------------
+
+class StreamState:
+    """One admitted stream: the round's committed history plus the
+    fan-out to live SSE consumers. All mutation happens on the asyncio
+    loop (the scheduler thread bridges via call_soon_threadsafe)."""
+
+    def __init__(self, stream_id: str, session: str, knights: list[str],
+                 turn: int, *, buffer_cap: int = 512):
+        self.stream_id = stream_id
+        self.session = session
+        self.knights = knights
+        self.turn = turn
+        self.buffer_cap = max(buffer_cap, 8)
+        # Committed token history per row — the resume source of truth
+        # for in-process reconnects (post-crash reconnects read the
+        # session journal / regenerate instead).
+        self.history: list[list[int]] = [[] for _ in knights]
+        self.done = False
+        self.failed: Optional[dict] = None  # {"error", "kind"}
+        self.created = time.monotonic()
+        self._consumers: list["_Consumer"] = []
+
+    # -- producer side (bridged scheduler events) --
+
+    def on_commit_event(self, event: dict) -> None:
+        """Fold one scheduler stream event ({"type": "tokens"|"retired"
+        |"failed", ...}) into history and wake every consumer."""
+        kind = event.get("type")
+        if kind == "tokens":
+            row = event["row"]
+            if 0 <= row < len(self.history):
+                self.history[row].extend(event["tokens"])
+        elif kind == "retired":
+            self.done = True
+        elif kind == "failed":
+            self.done = True
+            self.failed = {"error": event.get("error", ""),
+                           "kind": event.get("kind", "unknown")}
+        for c in list(self._consumers):
+            c.wake(event)
+
+    def counts(self) -> list[int]:
+        return [len(h) for h in self.history]
+
+    def event_id(self) -> str:
+        return format_event_id(self.turn, self.counts())
+
+    # -- consumer side --
+
+    def attach(self, watermark: Optional[list[int]] = None) -> "_Consumer":
+        c = _Consumer(self, watermark or [0] * len(self.knights))
+        self._consumers.append(c)
+        return c
+
+    def detach(self, c: "_Consumer") -> None:
+        if c in self._consumers:
+            self._consumers.remove(c)
+
+
+class _Consumer:
+    """One SSE connection's view of a stream: a watermark into the
+    shared history plus a bounded wake queue. The queue bounds EVENT
+    backlog, not content — overflow drops granularity (summary
+    catch-up from history), never tokens."""
+
+    def __init__(self, state: StreamState, watermark: list[int]):
+        self.state = state
+        self.sent = list(watermark)
+        self._wakes: asyncio.Queue = asyncio.Queue(
+            maxsize=state.buffer_cap)
+        self.overflowed = False
+
+    def wake(self, event: dict) -> None:
+        try:
+            self._wakes.put_nowait(event)
+        except asyncio.QueueFull:
+            # Slow consumer: drop the fine-grained event (counted) —
+            # the next drain emits one summary catch-up from history.
+            self.overflowed = True
+            telemetry.inc("roundtable_gateway_dropped_events_total")
+
+    async def next_events(self, timeout_s: float = 15.0) -> list[dict]:
+        """Unsent committed content since this consumer's watermark,
+        as a list of emit-ready events (each tagged with the POST-event
+        cumulative id). Blocks until something new commits, the stream
+        finishes, or `timeout_s` passes (empty list = keepalive tick).
+
+        Coalescing rule: on overflow, everything pending collapses to
+        one summary event; otherwise each call emits per-row deltas at
+        whatever grain has accumulated — a fast consumer sees
+        segment-grain events, a slow one sees bigger batches."""
+        st = self.state
+        if not self._pending() and not st.done:
+            try:
+                await asyncio.wait_for(self._wakes.get(), timeout_s)
+                # Drain coalesced wakes — deltas come from history.
+                while not self._wakes.empty():
+                    self._wakes.get_nowait()
+            except asyncio.TimeoutError:
+                return []
+        out: list[dict] = []
+        was_summary = self.overflowed
+        self.overflowed = False
+        deltas: dict[int, list[int]] = {}
+        for i, h in enumerate(st.history):
+            if len(h) > self.sent[i]:
+                deltas[i] = h[self.sent[i]:]
+                self.sent[i] = len(h)
+        eid = format_event_id(st.turn, list(self.sent))
+        if was_summary and deltas:
+            out.append({"type": "summary", "id": eid,
+                        "rows": {i: d for i, d in deltas.items()}})
+        else:
+            for i, d in deltas.items():
+                out.append({"type": "tokens", "id": eid, "row": i,
+                            "knight": st.knights[i], "tokens": d})
+        if st.done and not self._pending():
+            if st.failed is not None:
+                out.append({"type": "failed", "id": eid, **st.failed})
+            else:
+                out.append({"type": "retired", "id": eid})
+        return out
+
+    def _pending(self) -> bool:
+        return any(len(h) > self.sent[i]
+                   for i, h in enumerate(self.state.history))
+
+    def finished(self) -> bool:
+        return self.state.done and not self._pending()

@@ -720,6 +720,7 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "failed": "roundtable_sched_failed_total",
         "rejected_draining": "roundtable_sched_rejected_draining_total",
         "rejected_other": "roundtable_sched_rejected_other_total",
+        "deadline_expired": "roundtable_sched_deadline_expired_total",
         "preemptions": "roundtable_sched_preemptions_total",
         "segments": "roundtable_sched_segments_total",
         "ragged_segments": "roundtable_sched_ragged_segments_total",
@@ -742,6 +743,10 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         # ISSUE 12: admission-gate + durable-journal provenance.
         "paused": "pause_admission/reopen_admission flight events "
                   "(gate reason string; None = open)",
+        # ISSUE 16: machine-readable admission state for the gateway's
+        # shed ladder (nested dict; the pause reason + queue depth).
+        "admission": "derived (paused reason + "
+                     "roundtable_sched_queue_depth gauge)",
         "journal_turns": "roundtable_journal_turns_total "
                          "(counter is fleet-wide; the describe key is "
                          "THIS scheduler's share)",
@@ -795,6 +800,25 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "stack_bytes": "roundtable_lora_stack_bytes gauge "
                        "(memory-ledger publish)",
         "share_suppressed": "derived (engine counter; lora_describe)",
+    },
+    # Gateway.describe() (ISSUE 16): the HTTP front door's admission /
+    # shed / stream provenance — counters move in lockstep with the
+    # registry series (AdmissionController._count is the one writer).
+    "gateway": {
+        "admitted": "roundtable_gateway_admitted_total{reason=...}",
+        "shed": "roundtable_gateway_shed_total{reason=...}",
+        "queued": "roundtable_gateway_queued_total{reason=...}",
+        "expired": "roundtable_gateway_expired_total{reason=...}",
+        "inflight": "roundtable_gateway_inflight_streams gauge "
+                    "(request-labeled; REMOVED per-stream at close)",
+        "draining": "roundtable_draining gauge (fleet drain state "
+                    "mirrored at the HTTP boundary)",
+        "resumed_streams": "roundtable_gateway_resumed_streams_total",
+        "dropped_events": "roundtable_gateway_dropped_events_total "
+                          "(slow-consumer drop-to-summary)",
+        "sessions": "derived (live stream table size)",
+        "host": "static config (bind address)",
+        "port": "static config (bind port)",
     },
 }
 
