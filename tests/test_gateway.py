@@ -263,15 +263,24 @@ class TestDiscussions:
         per_row = row_tokens(toks, 2)
         assert all(len(r) > 0 for r in per_row)
 
-        # ids: parseable, same turn, counts monotone non-decreasing,
-        # final id == the full per-row counts.
-        prev = [0, 0]
-        for eid, _ev in toks:
+        # ids: parseable, same turn, and EXACT per event — each id's
+        # counts equal precisely the tokens delivered up to and
+        # including that event (not the whole batch's post-state), so
+        # a client cut off anywhere holds a watermark that skips
+        # nothing on reconnect.
+        running = [0, 0]
+        for eid, ev in toks:
             parsed = parse_event_id(eid, 2)
             assert parsed is not None and parsed[0] == meta["turn"]
-            assert all(c >= p for c, p in zip(parsed[1], prev))
-            prev = parsed[1]
-        assert prev == [len(r) for r in per_row]
+            if ev["type"] == "tokens":
+                running[ev["row"]] += len(ev["tokens"])
+            else:  # summary
+                for i, d in ev["rows"].items():
+                    running[int(i)] += len(d["tokens"])
+            assert parsed[1] == running, (
+                f"event id {eid} counts tokens the client has not "
+                f"received yet (delivered so far: {running})")
+        assert running == [len(r) for r in per_row]
 
         # the stream retired -> its gauge series must be GONE.
         sid = meta["stream"]
@@ -389,6 +398,67 @@ class TestDiscussions:
         assert c.body_json()["reason"] == "unknown_stream"
         c.close()
 
+    @pytest.mark.gateway(allow_no_stream=True)
+    def test_restart_refuses_sampled_uncommitted(self, unit_engine,
+                                                 tmp_path):
+        """Reconnect ladder leg 3 only holds for GREEDY streams: an
+        intent recorded with temperature > 0 whose turn never committed
+        cannot regenerate byte-identically, so the reconnect is refused
+        (409 nondeterministic_stream) instead of splicing a different
+        token stream onto the client's watermark."""
+        from theroundtaible_tpu.gateway.resume import StreamIntentJournal
+        jdir = tmp_path / "sampled-intents"
+        rec = StreamIntentJournal(jdir).record(
+            "samp000000000001", session="s-sampled",
+            knights=["lancelot"], prompts=[PROMPT], turn=0, max_new=4,
+            temperature=0.8)
+        assert rec is not None and rec["temperature"] == 0.8
+        sched = SessionScheduler(
+            unit_engine, journal=SessionJournal(tmp_path / "empty-j"))
+        gws = Gateway(sched, port=0, intent_dir=str(jdir))
+        gws.start_in_thread()
+        try:
+            c = Conn(gws.port, "GET", "/v1/streams/samp000000000001")
+            assert c.status == 409
+            assert c.body_json()["reason"] == "nondeterministic_stream"
+            c.close()
+        finally:
+            gws.stop()
+            sched.close()
+
+    @pytest.mark.gateway(allow_no_stream=True)
+    def test_late_pump_failure_no_second_head(self, gw):
+        """A pump-path failure AFTER the SSE head went out must never
+        write a second HTTP status line onto the same socket — the
+        error arrives as a terminal `failed` SSE event mid-stream."""
+        gwx = Gateway(gw.sched, port=0)
+
+        def boom(_state, _ev):
+            raise RuntimeError("pump exploded")
+
+        gwx._native_payload = boom
+        gwx.start_in_thread()
+        c = None
+        try:
+            c = Conn(gwx.port, "POST", "/v1/discussions",
+                     body={"session": "late-fail", "max_new_tokens": 2,
+                           "turns": [{"knight": "lancelot",
+                                      "prompt": PROMPT}]})
+            assert c.status == 200  # the one and only response head
+            raw = c.f.read()
+            assert b"HTTP/1.1" not in raw, \
+                "second HTTP head written mid-SSE-stream"
+            datas = [json.loads(ln[6:].decode("utf-8"))
+                     for ln in raw.split(b"\n")
+                     if ln.startswith(b"data: ")]
+            assert any(d.get("type") == "failed"
+                       and d.get("kind") == "internal"
+                       for d in datas)
+        finally:
+            if c is not None:
+                c.close()
+            gwx.stop()
+
 
 # ---------------------------------------------------------------------
 # admission: shed ladder, drain, deadline propagation
@@ -479,6 +549,42 @@ class TestAdmission:
         assert telemetry.REGISTRY.counter_total(
             "roundtable_gateway_expired_total",
             reason="deadline_expired") == e0 + 1
+
+    def test_queued_counter_counts_queue_path(self, unit_engine):
+        """An admission that parks behind a NONEMPTY scheduler queue
+        is the queue path: Decision.queued rides into note_admitted and
+        moves roundtable_gateway_queued_total in lockstep."""
+
+        class _StubSched:
+            paused = None
+
+            def __init__(self, engine, depth):
+                self.engine = engine
+                self._depth = depth
+
+            def describe(self):
+                return {"admission": {"queued": self._depth}}
+
+        q0 = telemetry.REGISTRY.counter_total(
+            "roundtable_gateway_queued_total", reason="behind_queue")
+        adm = AdmissionController(_StubSched(unit_engine, 3),
+                                  max_inflight=8, max_queue_depth=16)
+        d = adm.decide(rows=1, inflight=1)
+        assert d.admit and d.queued
+        adm.note_admitted(queued=d.queued)
+        assert adm.admitted == 1 and adm.queued == 1
+        assert adm.describe()["queued"] == 1
+        assert telemetry.REGISTRY.counter_total(
+            "roundtable_gateway_queued_total",
+            reason="behind_queue") == q0 + 1
+
+        # Empty scheduler queue: admitted immediately, NOT queued.
+        adm2 = AdmissionController(_StubSched(unit_engine, 0),
+                                   max_inflight=8, max_queue_depth=16)
+        d2 = adm2.decide(rows=1, inflight=1)
+        assert d2.admit and not d2.queued
+        adm2.note_admitted(queued=d2.queued)
+        assert adm2.queued == 0
 
     def test_priority_scales_caps(self, gw):
         """Low-priority traffic sheds at half the configured caps;
@@ -605,6 +711,57 @@ class TestSeams:
         out = capsys.readouterr().out
         assert "Serving gateway" in out
         assert "Admitted" in out
+
+    def test_intent_record_roundtrips_adapters_temperature(
+            self, tmp_path):
+        """The intent record persists the full generation identity —
+        adapters + temperature included — so leg-3 resume replays the
+        SAME stream, not a base-model/greedy approximation of it."""
+        from theroundtaible_tpu.gateway.resume import StreamIntentJournal
+        j = StreamIntentJournal(tmp_path)
+        rec = j.record("r1", session="s", knights=["k"],
+                       prompts=["p"], turn=2, max_new=4,
+                       adapters=["persona-a"], temperature=0.5)
+        loaded = j.load()["r1"]
+        assert loaded == rec
+        assert loaded["adapters"] == ["persona-a"]
+        assert loaded["temperature"] == 0.5
+
+    def test_intent_journal_compacts(self, unit_engine, tmp_path):
+        """A long-lived gateway bounds the intent journal + cache:
+        past the cap, records whose turn committed in the session
+        journal compact away (newest half of the cap kept for leg-2
+        reconnects); uncommitted intents — a crash needs them for
+        leg-3 regeneration — always survive."""
+        j = SessionJournal(tmp_path)
+        sched = SessionScheduler(unit_engine, journal=j)
+        try:
+            sched.submit("compact-s", [("lancelot", PROMPT)],
+                         max_new_tokens=2, timeout_s=120)
+            gwc = Gateway(sched, port=0, intent_dir=str(tmp_path))
+            for i in range(12):  # committed (turn 0 is journaled)
+                sid = f"done{i:04d}"
+                gwc._intent_cache[sid] = gwc.intents.record(
+                    sid, session="compact-s", knights=["lancelot"],
+                    prompts=[PROMPT], turn=0, max_new=2)
+            # uncommitted (turn 9 never ran)
+            gwc._intent_cache["live0001"] = gwc.intents.record(
+                "live0001", session="compact-s", knights=["lancelot"],
+                prompts=[PROMPT], turn=9, max_new=2)
+            gwc.intent_cap = 8
+            gwc._compact_intents()
+            assert "live0001" in gwc._intent_cache
+            kept = [s for s in gwc._intent_cache
+                    if s.startswith("done")]
+            assert kept == [f"done{i:04d}" for i in range(8, 12)]
+            # disk and cache agree about who can still reconnect.
+            assert set(gwc.intents.load()) == set(gwc._intent_cache)
+            # below the cap again: a second pass is a no-op.
+            n = len(gwc._intent_cache)
+            gwc._compact_intents()
+            assert len(gwc._intent_cache) == n
+        finally:
+            sched.close()
 
     def test_event_id_roundtrip(self):
         assert parse_event_id(format_event_id(3, [5, 7]), 2) \

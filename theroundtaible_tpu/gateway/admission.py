@@ -22,7 +22,9 @@ caps — under pressure the cheap traffic goes first. Every shed carries
 deterministically instead of hammering a collapsing server.
 
 Counters move in lockstep with decisions (`_count` is the one writer):
-roundtable_gateway_{admitted,shed,queued,expired}_total{reason=...}.
+roundtable_gateway_{admitted,shed,queued,expired}_total{reason=...};
+`queued` is the subset of admissions that entered a NONEMPTY scheduler
+queue — admitted, but waiting behind in-flight rounds to start.
 """
 
 from __future__ import annotations
@@ -58,6 +60,10 @@ class Decision:
     reason: str                  # "ok" or the shed reason tag
     status: int = 200            # HTTP status for sheds
     retry_after_s: float = 0.0
+    # Admitted INTO a nonempty scheduler queue: the request parks
+    # behind in-flight rounds instead of starting now. Drives the
+    # queued counter (roundtable_gateway_queued_total).
+    queued: bool = False
 
 
 class AdmissionController:
@@ -149,6 +155,10 @@ class AdmissionController:
         adm = sched.describe()["admission"]
         if adm["queued"] >= max(int(self.max_queue_depth * scale), 1):
             return self._shed("queue_full", 429)
+        # Below the cap but behind queued work: the request admits but
+        # parks in the scheduler's FIFO — surfaced on the Decision so
+        # note_admitted() counts it under `queued`.
+        will_queue = adm["queued"] > 0
 
         # 5. KV page pressure: a paged pool within the headroom band
         # AND no host-RAM spill tier to evacuate into means the next
@@ -179,14 +189,18 @@ class AdmissionController:
             if p95 is not None and p95 > slo:
                 return self._shed("slo_p95", 429)
 
-        return Decision(True, "ok")
+        return Decision(True, "ok", queued=will_queue)
 
-    def note_admitted(self) -> None:
+    def note_admitted(self, queued: bool = False) -> None:
         """Counted by the gateway AFTER submit_async succeeds — the
         scheduler can still refuse between decide() and submit (a
         drain racing the request), and that lands under `shed`, so the
-        two counters never both claim one request."""
+        two counters never both claim one request. `queued` marks an
+        admission that parked behind a nonempty scheduler queue
+        (Decision.queued) — the queue path's own lockstep counter."""
         self._count("admitted", "ok")
+        if queued:
+            self._count("queued", "behind_queue")
 
     def note_shed(self, reason: str) -> None:
         """Submit-time refusals (scheduler raced the decision)."""

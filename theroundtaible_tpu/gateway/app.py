@@ -83,6 +83,12 @@ class Gateway:
         self.intents = StreamIntentJournal(root) if root else None
         self._intent_cache: dict[str, dict] = (
             self.intents.load() if self.intents else {})
+        # Compaction threshold for the intent journal + cache: above
+        # this many records, intents whose turn already committed in
+        # the session journal are compacted away (the newest half of
+        # the cap stays for leg-2 reconnects). Bounds a long-lived
+        # gateway's disk and memory (review fix).
+        self.intent_cap = 2 * _DONE_STREAM_CAP
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop_event: Optional[asyncio.Event] = None
@@ -205,16 +211,16 @@ class Gateway:
             }, {"Retry-After": f"{max(int(d.retry_after_s), 1)}"})
         except HttpError as e:
             try:
-                await send_json(writer, e.status,
-                                {"error": str(e), "reason": e.reason})
+                await self._send_error(writer, e.status, str(e),
+                                       e.reason)
             except (ConnectionError, RuntimeError):
                 pass
         except (ConnectionError, asyncio.IncompleteReadError):
             pass  # client went away mid-write — its stream state stays
         except Exception as e:  # noqa: BLE001 — one conn must not kill the server
             try:
-                await send_json(writer, 500, {
-                    "error": str(e)[:200], "reason": "internal"})
+                await self._send_error(writer, 500, str(e)[:200],
+                                       "internal")
             except Exception:  # noqa: BLE001
                 pass
         finally:
@@ -223,6 +229,19 @@ class Gateway:
                 await writer.wait_closed()
             except Exception:  # noqa: BLE001
                 pass
+
+    async def _send_error(self, writer: asyncio.StreamWriter,
+                          status: int, error: str, kind: str) -> None:
+        """Error the connection WITHOUT corrupting the protocol: once
+        an SSE head has been written (the pump path failed late), a
+        fresh HTTP status line would land mid-stream as malformed
+        bytes — emit a terminal `failed` SSE event instead."""
+        if getattr(writer, "_sse_opened", False):
+            await SseWriter(writer).event(
+                {"type": "failed", "error": error, "kind": kind})
+        else:
+            await send_json(writer, status,
+                            {"error": error, "reason": kind})
 
     async def _route(self, req: Request,
                      writer: asyncio.StreamWriter) -> None:
@@ -294,13 +313,14 @@ class Gateway:
                 stream_id, session=session,
                 knights=[k for k, _p in turns],
                 prompts=[p for _k, p in turns], turn=turn,
-                max_new=max_new, deadline_s=deadline_s, kind=kind)
+                max_new=max_new, deadline_s=deadline_s, kind=kind,
+                adapters=adapters, temperature=temperature)
             if rec is not None:
                 self._intent_cache[stream_id] = rec
         self._submit_state(state, turns, max_new=max_new,
                            deadline_s=deadline_s, adapters=adapters,
                            temperature=temperature)
-        self.admission.note_admitted()
+        self.admission.note_admitted(queued=dec.queued)
         return state
 
     def _submit_state(self, state: StreamState,
@@ -381,6 +401,31 @@ class Gateway:
         done = [sid for sid, st in self.streams.items() if st.done]
         while len(done) > _DONE_STREAM_CAP:
             self.streams.pop(done.pop(0), None)
+        self._compact_intents()
+
+    def _compact_intents(self) -> None:
+        """Bound the intent journal + cache. A record whose turn is
+        committed in the session journal is only ever needed again for
+        a leg-2 reconnect, so only the newest `intent_cap // 2` of
+        those are kept; uncommitted intents (a crash would need them
+        for leg-3 regeneration) always survive."""
+        if (self.intents is None or self.sched.journal is None
+                or len(self._intent_cache) <= self.intent_cap):
+            return
+        committed = [
+            sid for sid, rec in self._intent_cache.items()
+            if committed_rows(self.sched.journal, rec["session"],
+                              rec["turn"]) is not None]
+        keep_committed = max(self.intent_cap // 2, 1)
+        drop = set(committed[:-keep_committed])
+        if not drop:
+            return
+        keep = {sid: rec for sid, rec in self._intent_cache.items()
+                if sid not in drop}
+        # Cache evicts only if the on-disk journal rewrote: the two
+        # must never disagree about which streams can reconnect.
+        if self.intents.compact(keep):
+            self._intent_cache = keep
 
     # ------------------------------------------------------------------
     # POST /v1/chat/completions (OpenAI-compatible)
@@ -531,12 +576,22 @@ class Gateway:
         else:
             # Leg 3: crash mid-round — greedy re-generation over the
             # replayed KV produces the identical token stream; the
-            # client's watermark skips what it already saw.
+            # client's watermark skips what it already saw. A sampled
+            # stream (temperature > 0) cannot regenerate identically,
+            # so refuse rather than splice a different stream onto the
+            # client's watermark (silent corruption).
+            temperature = float(intent.get("temperature") or 0.0)
+            if temperature > 0.0:
+                raise HttpError(
+                    409, f"stream {stream_id!r} was sampled "
+                    "(temperature > 0) and its turn never committed — "
+                    "post-crash regeneration cannot be byte-identical; "
+                    "start a new request", "nondeterministic_stream")
             turns = list(zip(knights, intent["prompts"]))
             self._submit_state(state, turns,
                                max_new=int(intent["max_new"]),
                                deadline_s=intent.get("deadline_s"),
-                               adapters=None)
+                               adapters=intent.get("adapters"))
         return state
 
     # ------------------------------------------------------------------

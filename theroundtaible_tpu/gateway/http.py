@@ -139,6 +139,11 @@ class SseWriter:
         }))
         await self._w.drain()
         self.opened = True
+        # Marked on the connection itself so the gateway's error
+        # handlers (which never see this SseWriter) know a response
+        # head is already on the wire — a late failure must become a
+        # terminal SSE event, never a second HTTP head mid-stream.
+        self._w._sse_opened = True
 
     async def event(self, data: Any,
                     event_id: Optional[str] = None,

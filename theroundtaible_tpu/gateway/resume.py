@@ -17,10 +17,14 @@ Reconnect ladder for `GET /v1/streams/<id>` with `Last-Event-ID`:
    journal): the round finished before the crash — serve the remaining
    tokens straight from the journal record's `produced` ids and close.
 3. **Uncommitted turn** (post-restart, crash mid-round): re-submit the
-   recorded prompts greedily. `--resume` already replayed every
-   committed turn into KV, so the prefix cache makes the re-prefill
-   cheap and greedy decoding regenerates the IDENTICAL token stream;
-   the client's watermark skips everything it already saw.
+   recorded prompts — with the recorded adapters — greedily. `--resume`
+   already replayed every committed turn into KV, so the prefix cache
+   makes the re-prefill cheap and greedy decoding regenerates the
+   IDENTICAL token stream; the client's watermark skips everything it
+   already saw. A stream whose intent recorded temperature > 0 CANNOT
+   regenerate identically (sampling), so leg 3 refuses it with 409
+   `nondeterministic_stream` rather than splice a different stream
+   onto the client's watermark.
 
 All three legs deliver exactly the tokens after the last-seen event:
 zero loss, zero duplication — the chaos acceptance (GATEWAY_r16.json)
@@ -51,7 +55,14 @@ class StreamIntentJournal:
     def record(self, stream_id: str, *, session: str,
                knights: list[str], prompts: list[Any], turn: int,
                max_new: int, deadline_s: Optional[float] = None,
-               kind: str = "native") -> Optional[dict]:
+               kind: str = "native",
+               adapters: Optional[list] = None,
+               temperature: float = 0.0) -> Optional[dict]:
+        # adapters + temperature are part of the intent (review fix):
+        # leg-3 resume re-submits from this record, and replaying with
+        # different adapters — or regenerating a sampled stream at all
+        # — would splice a DIFFERENT token stream onto the client's
+        # watermark instead of the byte-identical continuation.
         rec = {
             "v": 1,
             "stream": stream_id,
@@ -62,6 +73,8 @@ class StreamIntentJournal:
             "max_new": max_new,
             "deadline_s": deadline_s,
             "kind": kind,
+            "adapters": list(adapters) if adapters is not None else None,
+            "temperature": temperature,
         }
         try:
             with self._lock, open(self.path, "a",
@@ -74,6 +87,30 @@ class StreamIntentJournal:
             # serves; it just won't survive a crash.
             return None
         return rec
+
+    def compact(self, records: dict[str, dict]) -> bool:
+        """Atomically rewrite the journal to exactly `records` (write
+        tmp, fsync, rename) — the unbounded-growth fix: the gateway
+        periodically drops records whose reconnect story the session
+        journal already covers. Returns False (journal unchanged) on
+        I/O failure, same durability-<-availability rule as record()."""
+        tmp = self.path.with_suffix(".compact.tmp")
+        try:
+            with self._lock:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for rec in records.values():
+                        f.write(json.dumps(rec, separators=(",", ":"))
+                                + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+        except OSError:
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            return False
+        return True
 
     def load(self) -> dict[str, dict]:
         """stream_id -> intent record, last-writer-wins, stopping at

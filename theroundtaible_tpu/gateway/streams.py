@@ -191,16 +191,29 @@ class _Consumer:
         for i, h in enumerate(st.history):
             if len(h) > self.sent[i]:
                 deltas[i] = h[self.sent[i]:]
-                self.sent[i] = len(h)
-        eid = format_event_id(st.turn, list(self.sent))
         if was_summary and deltas:
-            out.append({"type": "summary", "id": eid,
-                        "rows": {i: d for i, d in deltas.items()}})
-        else:
+            # One catch-up event carries every row, so its id advances
+            # all watermarks at once.
             for i, d in deltas.items():
-                out.append({"type": "tokens", "id": eid, "row": i,
-                            "knight": st.knights[i], "tokens": d})
+                self.sent[i] += len(d)
+            out.append({"type": "summary",
+                        "id": format_event_id(st.turn, list(self.sent)),
+                        "rows": dict(deltas)})
+        else:
+            # The watermark advances PER EVENT: a client cut off after
+            # the first event of a multi-row batch holds an id counting
+            # only the tokens it actually received — stamping the whole
+            # batch with the post-batch id would make its reconnect
+            # silently skip the later rows' tokens.
+            for i, d in deltas.items():
+                self.sent[i] += len(d)
+                out.append({"type": "tokens",
+                            "id": format_event_id(st.turn,
+                                                  list(self.sent)),
+                            "row": i, "knight": st.knights[i],
+                            "tokens": d})
         if st.done and not self._pending():
+            eid = format_event_id(st.turn, list(self.sent))
             if st.failed is not None:
                 out.append({"type": "failed", "id": eid, **st.failed})
             else:
