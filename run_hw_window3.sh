@@ -47,6 +47,19 @@ if ! env JAX_PLATFORMS=cpu python bench_gateway.py --smoke \
 fi
 echo "window3: gateway smoke clean $(stamp)" >> "$OUT.log"
 
+# Router preflight (ISSUE 17): a rolling restart of one replica in a
+# 2-replica CPU fleet under a live client — drain, migrate, rebuild,
+# re-admit with zero failed sessions and greedy parity — must pass
+# before any window time is spent; a fleet that cannot roll would
+# turn every planned restart on the real chips into an outage.
+if ! env JAX_PLATFORMS=cpu python bench_gateway.py --smoke \
+    --replicas 2 >> "$OUT.log" 2>&1; then
+  echo "window3: router smoke FAILED $(stamp) — fix the replica" \
+       "fleet before spending a window" >> "$OUT.log"
+  exit 1
+fi
+echo "window3: router smoke clean $(stamp)" >> "$OUT.log"
+
 while :; do
   python - <<'PY' 2>> "$OUT.log"
 import sys

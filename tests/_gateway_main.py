@@ -33,6 +33,10 @@ def main() -> int:
     ap.add_argument("--journal", required=True)
     ap.add_argument("--resume", default=None)
     ap.add_argument("--max-seq-len", type=int, default=512)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="build a router fleet (explicit --replicas 1 "
+                         "serves the N=1 router path; default: plain "
+                         "single-scheduler gateway)")
     args = ap.parse_args()
 
     from theroundtaible_tpu.engine.engine import InferenceEngine
@@ -41,17 +45,37 @@ def main() -> int:
     from theroundtaible_tpu.engine.session_journal import SessionJournal
     from theroundtaible_tpu.gateway import Gateway
 
-    cfg = get_model_config("tiny-gemma", max_seq_len=args.max_seq_len)
-    engine = InferenceEngine(cfg, num_slots=8)
-    sched = SessionScheduler(engine,
-                             journal=SessionJournal(args.journal))
+    router = None
+    if args.replicas is not None:
+        # Multi-replica fleet (ISSUE 17): paged KV + host offload so
+        # sessions can migrate between replicas; replica 0 wraps the
+        # seed engine, the rest clone from its rebuild recipe.
+        from theroundtaible_tpu.router import (SessionRouter,
+                                               build_replicas,
+                                               set_active_router)
+        engine = InferenceEngine.from_config({
+            "model": "tiny-gemma", "max_seq_len": args.max_seq_len,
+            "num_slots": 8, "kv_layout": "paged", "page_size": 16,
+            "kv_offload": True, "mesh": {"data": 1, "model": 1}})
+        journal = SessionJournal(args.journal)
+        reps = build_replicas(engine, args.replicas, journal=journal)
+        router = SessionRouter(reps, journal=journal)
+        set_active_router(router)
+        sched = reps[0].scheduler
+    else:
+        cfg = get_model_config("tiny-gemma",
+                               max_seq_len=args.max_seq_len)
+        engine = InferenceEngine(cfg, num_slots=8)
+        sched = SessionScheduler(engine,
+                                 journal=SessionJournal(args.journal))
     if args.resume:
         from theroundtaible_tpu.engine.recovery import resume_from_journal
         r = resume_from_journal(args.resume, scheduler=sched)
         print(f"RESUMED sessions={r['sessions']} turns={r['turns']}",
               flush=True)
 
-    gw = Gateway(sched, port=0, intent_dir=args.journal)
+    gw = Gateway(sched, port=0, intent_dir=args.journal,
+                 router=router)
     port = gw.start_in_thread()
     print(f"PORT={port}", flush=True)
     threading.Event().wait()  # serve until killed

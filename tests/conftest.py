@@ -413,6 +413,39 @@ def _gateway_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _router_guard(request):
+    """Tier-1 guard for @pytest.mark.router (ISSUE 17 satellite): a
+    test that CLAIMS multi-replica routing coverage must actually cross
+    a replica boundary — if no session's KV pages were adopted onto
+    another replica (migration) and no journal replay ran on a survivor
+    (failover) during the test, the evacuate → adopt → restore transfer
+    fabric silently never engaged (everything stayed on one engine) and
+    the test's fleet claims are vacuous; fail LOUD. Scoring/signals/
+    assignment unit tests (which legitimately never move KV) mark
+    allow_local=True. The guard also clears the process-wide active
+    router, so one test's fleet can never leak into another's
+    fleet_health()/status view."""
+    marker = request.node.get_closest_marker("router")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.router import core as router_core
+
+    router_core.set_active_router(None)
+    router_core.reset_test_counters()
+    yield
+    crossings = router_core.boundary_crossings()
+    router_core.set_active_router(None)
+    if marker.kwargs.get("allow_local"):
+        return
+    assert crossings > 0, (
+        "router-marked test never crossed a replica boundary: no "
+        "migration adopt and no failover replay ran — the evacuate/"
+        "adopt/restore fabric silently never engaged (mark "
+        "allow_local=True only for scoring/signals/assignment units)")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

@@ -77,6 +77,10 @@ def build_parser():
                         "cache makes it cheap), restoring every "
                         "session's KV at its last committed turn — "
                         "then serve the given topics (if any)")
+    v.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="Serve across N data-parallel engine replicas "
+                        "behind the session router (default 1 — "
+                        "byte-identical to single-engine serving)")
     v.add_argument("--read-code", action="store_true", default=None,
                    help="Read source code into context without asking")
     v.add_argument("--no-read-code", dest="read_code",
@@ -106,6 +110,14 @@ def build_parser():
                         "every session's KV at its last committed turn "
                         "so clients reconnect via Last-Event-ID with "
                         "no token loss or duplication")
+    g.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="Serve across N data-parallel engine replicas: "
+                        "the session router places cold sessions by "
+                        "live load score, keeps returning sessions on "
+                        "the replica holding their KV, migrates "
+                        "sessions across replicas over the host-RAM "
+                        "tier, and rolls replicas one at a time with "
+                        "zero lost sessions (default 1)")
 
     s = sub.add_parser("summon", help="Review the current git diff")
     s.add_argument("--read-code", action="store_true", default=None,
@@ -134,6 +146,11 @@ def build_parser():
                          "ledger: admitted/shed/expired counters by "
                          "reason, inflight streams, drop-to-summary "
                          "and resume counts")
+    st.add_argument("--fleet", action="store_true",
+                    help="Render the multi-replica serving view: "
+                         "per-replica liveness, session assignment, "
+                         "queue/row gauges, and the router's "
+                         "migration / failover / roll history")
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -218,7 +235,8 @@ def dispatch(args) -> int:
         return serve_command(args.topics, sessions=args.sessions,
                              read_code=args.read_code,
                              journal_dir=args.journal,
-                             resume_dir=args.resume_dir)
+                             resume_dir=args.resume_dir,
+                             replicas=args.replicas)
     if args.command == "summon":
         from .commands.summon import summon_command
         return summon_command(read_code=args.read_code)
@@ -226,7 +244,8 @@ def dispatch(args) -> int:
         from .commands.gateway_cmd import gateway_command
         return gateway_command(host=args.host, port=args.port,
                                journal_dir=args.journal,
-                               resume_dir=args.resume_dir)
+                               resume_dir=args.resume_dir,
+                               replicas=args.replicas)
     if args.command == "status":
         from .commands.status import status_command
         return status_command(
@@ -234,7 +253,8 @@ def dispatch(args) -> int:
             perf_view=getattr(args, "perf", False),
             kv_view=getattr(args, "kv", False),
             health_view=getattr(args, "health", False),
-            gateway_view=getattr(args, "gateway", False))
+            gateway_view=getattr(args, "gateway", False),
+            fleet_view=getattr(args, "fleet", False))
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

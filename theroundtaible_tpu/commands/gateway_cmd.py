@@ -42,10 +42,24 @@ def _build_scheduler(config, journal_dir: Optional[str]):
     return sched
 
 
+def _build_router(sched, replicas: int):
+    """N-replica fleet around the scheduler's engine (ISSUE 17): the
+    router owns session placement, migration, rolls, and failover;
+    admission reads fleet-wide signals through router.signals()."""
+    from ..router import SessionRouter, build_replicas, \
+        set_active_router
+    reps = build_replicas(sched.engine, replicas,
+                          journal=sched.journal)
+    router = SessionRouter(reps, journal=sched.journal)
+    set_active_router(router)
+    return router
+
+
 def gateway_command(host: Optional[str] = None,
                     port: Optional[int] = None,
                     journal_dir: Optional[str] = None,
                     resume_dir: Optional[str] = None,
+                    replicas: int = 1,
                     project_root: Optional[str] = None) -> int:
     project_root = project_root or os.getcwd()
     config = load_config(project_root)
@@ -72,14 +86,22 @@ def gateway_command(host: Optional[str] = None,
     else:
         sched = _build_scheduler(config, journal_dir)
 
-    gw = Gateway(sched, host=host, port=port, intent_dir=journal_dir)
+    router = _build_router(sched, replicas) if replicas > 1 else None
+    gw = Gateway(sched, host=host, port=port, intent_dir=journal_dir,
+                 router=router)
+    if router is not None:
+        print(style.dim(f"  serving across {replicas} replicas "
+                        f"({', '.join(r.name for r in router.replicas)})"))
     print(style.bold(f"\n  Gateway listening on "
                      f"http://{gw.host}:{gw.port}"))
     print(style.dim(
         "    POST /v1/chat/completions   (OpenAI-compatible, SSE)\n"
         "    POST /v1/discussions        (native multi-knight, SSE)\n"
         "    GET  /v1/streams/<id>       (Last-Event-ID reconnect)\n"
+        "    POST /v1/admin/roll         (rolling restart, fleets)\n"
         "    GET  /healthz · GET /metrics\n"))
     gw.run()
     gw.stop()
+    if router is not None:
+        router.close()
     return 0

@@ -49,10 +49,12 @@ def _dedupe_topics(topics: list[str]) -> list[str]:
 
 def _attach_schedulers(adapters: dict, session_id: str,
                        admit_hold_s: float,
-                       journal=None) -> tuple[list, list]:
+                       journal=None, router=None) -> tuple[list, list]:
     """Bind every tpu-llm adapter in this session's seat map to its
     session id and to the SHARED per-engine scheduler (scheduler_for:
-    one scheduler per resident engine, however many sessions share it).
+    one scheduler per resident engine, however many sessions share it)
+    — or, under a session router, to the scheduler of the REPLICA the
+    router placed this session on (affinity + load score; ISSUE 17).
     Returns (schedulers touched, schedulers CREATED here) — the caller
     must only close the latter: a scheduler that pre-existed this serve
     call belongs to someone else's sessions too, and closing it would
@@ -61,6 +63,15 @@ def _attach_schedulers(adapters: dict, session_id: str,
     for adapter in adapters.values():
         attach = getattr(adapter, "attach_scheduler", None)
         if attach is None:
+            continue
+        if router is not None:
+            # The router owns replica schedulers' lifecycles; serving
+            # goes through the scheduler, so the adapter's own engine
+            # handle is only a tokenizer/config source.
+            sched = router.scheduler_for(session_id)
+            attach(sched, session=session_id)
+            if sched not in scheds:
+                scheds.append(sched)
             continue
         try:
             engine = adapter._get_engine()
@@ -107,6 +118,7 @@ def serve_discussions(
     reporter_factory: Optional[Callable[[str], Any]] = None,
     close_schedulers: bool = True,
     journal_dir: Optional[str] = None,
+    replicas: int = 1,
 ) -> dict[str, Any]:
     """Run one discussion per topic, all concurrently, on shared engines.
 
@@ -125,6 +137,32 @@ def serve_discussions(
     if journal_dir is not None:
         from ..engine.session_journal import SessionJournal
         journal = SessionJournal(journal_dir)
+    router = None
+    if replicas > 1:
+        # N-replica fleet (ISSUE 17): one engine per replica behind a
+        # session router — sessions place by affinity/load and every
+        # scheduler shares the one journal. `--replicas 1` (and every
+        # caller that doesn't pass it) takes the classic path below,
+        # byte-identical to single-engine serving.
+        from ..router import SessionRouter, build_replicas, \
+            set_active_router
+        probe = initialize_adapters(config)
+        engine = None
+        for adapter in probe.values():
+            if hasattr(adapter, "attach_scheduler"):
+                try:
+                    engine = adapter._get_engine()
+                    break
+                except Exception:  # noqa: BLE001 — try the next seat
+                    continue
+        if engine is None:
+            raise ConfigError(
+                "--replicas needs at least one tpu-llm knight whose "
+                "engine can be built")
+        reps = build_replicas(engine, replicas, journal=journal,
+                              admit_hold_s=admit_hold_s)
+        router = SessionRouter(reps, journal=journal)
+        set_active_router(router)
     all_scheds: list = []
     owned_scheds: list = []
     # Session ids carry a per-CALL unique component: two concurrent
@@ -150,7 +188,7 @@ def serve_discussions(
             # when the report is built.
             scheds, owned = _attach_schedulers(
                 adapters, entry["session_id"], admit_hold_s,
-                journal=journal)
+                journal=journal, router=router)
             all_scheds.extend(scheds)
             owned_scheds.extend(owned)
             reporter = (reporter_factory(entry["session_id"])
@@ -180,11 +218,18 @@ def serve_discussions(
         "schedulers": [s.describe() for s in uniq],
         "wall_s": round(time.monotonic() - t0, 3),
     }
+    if router is not None:
+        report["router"] = router.describe()
     if close_schedulers:
         # Only schedulers CREATED by this call — a pre-existing one is
         # shared with sessions outside this call and must keep running.
         for s in {id(s): s for s in owned_scheds}.values():
             s.close()
+        if router is not None:
+            router.close()
+            for rep in router.replicas:
+                if getattr(rep, "owned_scheduler", False):
+                    rep.scheduler.close()
     return report
 
 
@@ -199,7 +244,8 @@ def serve_command(topics: list[str], sessions: Optional[int] = None,
                   read_code: Optional[bool] = None,
                   project_root: Optional[str] = None,
                   journal_dir: Optional[str] = None,
-                  resume_dir: Optional[str] = None) -> int:
+                  resume_dir: Optional[str] = None,
+                  replicas: int = 1) -> int:
     """CLI: `roundtable serve "topic" --sessions 4` (one topic fanned
     into K concurrent discussions), `roundtable serve "t1" "t2" "t3"`
     (one discussion each), `--journal DIR` for crash-durable turn
@@ -249,7 +295,8 @@ def serve_command(topics: list[str], sessions: Optional[int] = None,
                      "discussion(s) on the shared fleet...\n"))
     report = serve_discussions(topics, config, project_root,
                                read_source_code=bool(read_code),
-                               journal_dir=journal_dir)
+                               journal_dir=journal_dir,
+                               replicas=replicas)
 
     failed = 0
     for entry in report["sessions"]:
@@ -273,5 +320,12 @@ def serve_command(topics: list[str], sessions: Optional[int] = None,
             f"mean {sched['occupancy_mean']} over "
             f"{sched['segments']} segment(s), "
             f"queue peak {sched['queued_peak']}"))
+    if report.get("router"):
+        rt = report["router"]
+        print(style.dim(
+            f"  router: {len(rt['replicas'])} replica(s), "
+            f"{rt['sessions']} session(s) placed, "
+            f"{rt['migrations']} migration(s), "
+            f"{rt['failovers']} failover(s)"))
     print(style.dim(f"  total wall: {report['wall_s']:.1f}s\n"))
     return 1 if failed else 0

@@ -41,7 +41,8 @@ def status_command(project_root: Optional[str] = None,
                    perf_view: bool = False,
                    kv_view: bool = False,
                    health_view: bool = False,
-                   gateway_view: bool = False) -> int:
+                   gateway_view: bool = False,
+                   fleet_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     if health_view:
         # Fleet health needs no session dir — it reads the live
@@ -50,6 +51,9 @@ def status_command(project_root: Optional[str] = None,
     if gateway_view:
         # Gateway ledger is live-registry state too — no session dir.
         return gateway_status()
+    if fleet_view:
+        # Multi-replica serving view — live router + registry state.
+        return fleet_status()
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
@@ -292,6 +296,59 @@ def gateway_status() -> int:
             "\n  No gateway series in this process. Run `roundtable "
             "gateway` (or drive a Gateway in-process) to populate the "
             "admission/shed ledger.\n"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --fleet` (ISSUE 17) ---
+
+
+def fleet_status() -> int:
+    """`roundtable status --fleet` — the multi-replica serving view:
+    per-replica liveness, session assignment and queue/row gauges from
+    the live router (when this process serves one), plus every
+    replica-labeled registry series — so an operator sees WHERE the
+    sessions live, which replica is rolling/dead, and the router's
+    migration / failover / roll history. Live-process state like
+    --health: a fresh CLI process reports no fleet."""
+    from ..router import active_router
+    from ..utils import telemetry
+
+    print(style.bold("\n  Multi-replica serving"))
+    router = active_router()
+    if router is not None:
+        d = router.describe()
+        print(style.dim(
+            f"    replicas={len(d['replicas'])}  "
+            f"sessions={d['sessions']}  "
+            f"migrations={d['migrations']}  "
+            f"failovers={d['failovers']}  rolls={d['rolls']}"
+            + (f"  rolling={','.join(d['rolling'])}"
+               if d["rolling"] else "")
+            + (f"  retired={','.join(d['retired'])}"
+               if d["retired"] else "")))
+        for name, rep in sorted(d["replicas"].items()):
+            state = (style.red(f"DEAD: {rep['dead']}") if rep["dead"]
+                     else style.yellow(f"paused:{rep['paused']}")
+                     if rep["paused"] else style.green("live"))
+            print(f"    {name} [{rep['engine']}]: {state}")
+            print(style.dim(
+                f"      sessions={rep['sessions']}  "
+                f"queued={rep['queued']}  "
+                f"active_rows={rep['active_rows']}"))
+
+    series = telemetry.REGISTRY.snapshot_compact()
+    labeled = {k: v for k, v in series.items()
+               if "replica=" in k}
+    if labeled:
+        print(style.bold("\n  Replica-labeled series:"))
+        for k in sorted(labeled):
+            print(style.dim(f"    {k} {labeled[k]:g}"))
+    if router is None and not labeled:
+        print(style.dim(
+            "\n  No replica fleet in this process. Serve with "
+            "`roundtable gateway --replicas N` (or `serve --replicas "
+            "N`) to route sessions across N engine replicas.\n"))
     print("")
     return 0
 

@@ -325,6 +325,10 @@ def fleet_health() -> dict[str, Any]:
         # engines and WHY, per-engine restart budgets. Cheap: reads the
         # process singleton's host-side state, never constructs it.
         "supervisor": _supervisor_rollup(),
+        # ISSUE 17: the session router's fleet view when one is active
+        # (multi-replica serving) — per-replica liveness + assignment
+        # counts, migration/failover/roll history. None without one.
+        "router": _router_rollup(),
     }
 
 
@@ -340,6 +344,12 @@ def _perf_rollup() -> dict[str, Any]:
 def _supervisor_rollup() -> dict[str, Any]:
     from .supervisor import supervisor_snapshot
     return supervisor_snapshot()
+
+
+def _router_rollup() -> Optional[dict[str, Any]]:
+    from ..router.core import active_router
+    r = active_router()
+    return r.describe() if r is not None else None
 
 
 def drain(timeout_s: float = 30.0, flush_kv: bool = True) -> dict[str, Any]:

@@ -541,7 +541,8 @@ class HostOffloadTier:
             manifest["host_bytes"] += b
         return manifest
 
-    def adopt(self, other: "HostOffloadTier") -> list[str]:
+    def adopt(self, other: "HostOffloadTier",
+              sessions: Optional[list[str]] = None) -> list[str]:
         """Graft another tier's spill records onto THIS tier (the
         supervisor's engine rebuild: the dead engine's evacuated
         sessions become the fresh engine's restorable sessions).
@@ -550,9 +551,17 @@ class HostOffloadTier:
         tier has never seen, and restoring it would alias unrelated
         content. Such records are refused (left on `other`, named in
         no list) rather than corrupting the new pool. Returns the
-        adopted session names."""
+        adopted session names.
+
+        `sessions` selects a subset (ISSUE 17: cross-replica migration
+        moves ONE session's record between two live engines' tiers —
+        adopting everything would steal the source replica's other
+        spilled sessions); None keeps the supervisor's adopt-all shape."""
+        targets = None if sessions is None else set(sessions)
         adopted: list[str] = []
         for session, rec in list(other._spilled.items()):
+            if targets is not None and session not in targets:
+                continue
             if not rec.fully_host_resident():
                 continue
             if session in self._spilled:

@@ -382,6 +382,13 @@ class SessionScheduler:
         # LOCKSTEP (_bump), so describe() and the registry can never
         # disagree — the single-source-of-truth migration.
         self._tname = getattr(engine.cfg, "name", "engine")
+        # Replica identity (ISSUE 17): set by the session router when
+        # this scheduler serves as one replica of a data-parallel
+        # fleet. N replicas of one model share `_tname` (same config),
+        # so every registry series this scheduler writes additionally
+        # carries `replica=` once set — and the router removes the
+        # labeled series when the replica retires (RT-GAUGE-LEAK).
+        self.replica: Optional[str] = None
         # Attaching a scheduler ADDS compile surface (pipelined-segment
         # carries, pinned-row joins) to an engine whose warmup() may
         # already have declared steady state — reopen the warmup phase
@@ -607,13 +614,27 @@ class SessionScheduler:
     # observability
     # ------------------------------------------------------------------
 
+    def _series_labels(self) -> dict[str, str]:
+        """Labels for this scheduler's registry series: engine-keyed as
+        always, plus `replica=` when the router named this scheduler a
+        fleet replica (N replicas share one engine config name)."""
+        if self.replica is not None:
+            return {"engine": self._tname, "replica": self.replica}
+        return {"engine": self._tname}
+
+    def set_replica(self, name: Optional[str]) -> None:
+        """Name this scheduler's fleet replica (ISSUE 17). The router
+        calls this once at fleet build; passing None detaches (used by
+        retire, after the labeled series were removed)."""
+        self.replica = name
+
     def _bump(self, counter: str, n: int = 1) -> None:
         """Increment a decision counter AND its registry series in one
         place — no counter can move without the registry seeing it
         (the drift test pins describe()'s keys to these series)."""
         setattr(self, counter, getattr(self, counter) + n)
         telemetry.inc(f"roundtable_sched_{counter}_total", n,
-                      engine=self._tname)
+                      **self._series_labels())
 
     def _event(self, kind: str, **fields) -> None:
         e = {"event": kind, "at": round(time.monotonic(), 3)}
@@ -627,9 +648,9 @@ class SessionScheduler:
                                     **{k: v for k, v in fields.items()
                                        if k not in ("kind", "at")})
         telemetry.set_gauge("roundtable_sched_queue_depth",
-                            len(self._queue), engine=self._tname)
+                            len(self._queue), **self._series_labels())
         telemetry.set_gauge("roundtable_sched_active_rows",
-                            len(self._active), engine=self._tname)
+                            len(self._active), **self._series_labels())
 
     def describe(self) -> dict[str, Any]:
         """Scheduler provenance for engine.describe() / bench records —
@@ -1643,7 +1664,11 @@ class SessionScheduler:
                     r.produced = [tok]
                     r.last = tok
                     r.valid = r.pos
-                    r.done = (tok == eos)
+                    # The join token counts against the row's budget: a
+                    # max_new_tokens=1 row (journal replay) is DONE here
+                    # — leaving it live would hand the spec segment a
+                    # zero-room row next tick.
+                    r.done = (tok == eos) or len(r.produced) >= r.max_new
                     if (req is not None and req.first_token_at is None
                             and all(not rr.pending for rr in req.rows)):
                         req.first_token_at = now
@@ -2054,7 +2079,8 @@ class SessionScheduler:
             if len(emit) > room:
                 emit = emit[:room]
             r.produced.extend(emit)
-            r.last = emit[-1]
+            if emit:
+                r.last = emit[-1]
             r.valid += len(emit)
             r.done = (r.last == eos) or len(r.produced) >= r.max_new
             if r.adapter_slot:
@@ -2772,7 +2798,8 @@ class SessionScheduler:
                     "adapter": adapter,
                 })
             rec = self._journal.record_turn(req.session, rows,
-                                            engine=self._tname)
+                                            engine=self._tname,
+                                            replica=self.replica)
             if rec is not None:
                 self.journal_turns += 1
             elif not self._journal._suspended:

@@ -224,6 +224,17 @@ class SessionJournal:
         recs = self.turns(session)
         return recs[-1]["turn"] if recs else None
 
+    def last_replica(self, session: str) -> Optional[str]:
+        """The replica that committed this session's most recent turn
+        (the `replica=` meta the scheduler stamps when it serves a
+        fleet replica — ISSUE 17 routing affinity). None for sessions
+        served single-engine or never journaled."""
+        for rec in reversed(self.turns(session)):
+            rep = rec.get("replica")
+            if rep is not None:
+                return rep
+        return None
+
     def describe(self) -> dict:
         return {
             "root": str(self.root),

@@ -97,6 +97,33 @@ def supervision_enabled() -> bool:
         "0", "false", "off")
 
 
+# Death notifications (ISSUE 17): the router subscribes so an engine
+# that exhausts its restart budget triggers failover of its sessions
+# onto surviving replicas. Module-level on purpose — the singleton is
+# swapped freely by tests (set_supervisor(None)), and a subscription
+# must survive the swap. Callbacks receive (engine, reason, kind) and
+# must never raise into the restart path.
+_death_callbacks: list = []
+
+
+def on_engine_dead(cb) -> None:
+    if cb not in _death_callbacks:
+        _death_callbacks.append(cb)
+
+
+def remove_death_callback(cb) -> None:
+    if cb in _death_callbacks:
+        _death_callbacks.remove(cb)
+
+
+def _notify_dead(engine, reason: str, kind: str) -> None:
+    for cb in list(_death_callbacks):
+        try:
+            cb(engine, reason, kind)
+        except Exception:  # noqa: BLE001 — containment must not re-crash
+            pass
+
+
 def engine_key(engine) -> str:
     """Stable identity for supervision state: the engine-cache key when
     the engine came through get_engine (the rebuilt instance inherits
@@ -592,11 +619,24 @@ class EngineSupervisor:
         if sched is not None:
             stale |= {r.session for r in list(sched._active_reqs)}
         self._drop_session_gauges(engine, stale)
-        telemetry.set_gauge("roundtable_engine_dead", 1.0,
-                            engine=st.name)
+        # Replica-labeled when the engine serves as a router replica
+        # (ISSUE 17): `roundtable_engine_dead{replica=}` — the router
+        # removes the series when the replica is retired, so the
+        # registry never keeps one dead series per replica ever rolled.
+        rname = getattr(engine, "_replica_name", None)
+        if rname is not None:
+            telemetry.set_gauge("roundtable_engine_dead", 1.0,
+                                engine=st.name, replica=rname)
+        else:
+            telemetry.set_gauge("roundtable_engine_dead", 1.0,
+                                engine=st.name)
         telemetry.recorder().record(
             "supervisor_engine_dead", engine=st.name,
             reason=st.dead_reason)
+        # Failure containment (ISSUE 17): tell subscribers (the session
+        # router) AFTER the dead state is fully published — the router
+        # migrates this engine's journaled sessions to survivors.
+        _notify_dead(engine, st.dead_reason or "", st.dead_kind)
 
     @staticmethod
     def _drop_session_gauges(engine, sessions) -> None:

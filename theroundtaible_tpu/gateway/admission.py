@@ -15,6 +15,11 @@ Every decision derives from state the serving stack already publishes
 | adapter residency | LoraStore.can_admit (lora.py)           | adapters_busy (429) |
 | p95 turn latency  | gateway's own recent-TTFT window        | slo_p95 (429)  |
 
+The serving-stack signals arrive through a provider (`source=`):
+`SchedulerSignals` reads one scheduler/engine (the default — and the
+exact pre-ISSUE-17 behavior), the router's `FleetSignals` reads the
+whole replica fleet and only sheds when NO replica can serve.
+
 Priority classes: "high" requests bypass the soft signals (p95) and
 shed only at hard caps; "low" requests shed at half the inflight/queue
 caps — under pressure the cheap traffic goes first. Every shed carries
@@ -66,20 +71,63 @@ class Decision:
     queued: bool = False
 
 
+class SchedulerSignals:
+    """The single-engine admission signal provider: every signal reads
+    ONE scheduler/engine, exactly as the gateway did before ISSUE 17.
+    The router's FleetSignals implements the same protocol over N
+    replicas — single-engine serving is just the N=1 case."""
+
+    def __init__(self, scheduler):
+        self.sched = scheduler
+
+    def drain_state(self) -> Optional[str]:
+        paused = self.sched.paused
+        if deadlines.DRAINING or paused is not None:
+            return "draining" if (deadlines.DRAINING
+                                  or paused == "fleet.drain") \
+                else f"paused:{paused}"
+        return None
+
+    def dead_reason(self) -> Optional[str]:
+        from ..engine.supervisor import engine_dead_reason
+        return engine_dead_reason(self.sched.engine)
+
+    def queue_depth(self) -> int:
+        return self.sched.describe()["admission"]["queued"]
+
+    def kv_pressure(self, headroom: float) -> bool:
+        engine = self.sched.engine
+        if getattr(engine, "kv_layout", None) != "paged":
+            return False
+        kv = engine.kv
+        floor = int(kv.usable_pages() * headroom)
+        return (kv.free_pages() <= floor
+                and getattr(engine, "kv_offload", None) is None)
+
+    def adapters_busy(self, adapters) -> bool:
+        store = getattr(self.sched.engine, "lora", None)
+        return (store is not None
+                and not store.can_admit(adapters))
+
+
 class AdmissionController:
     """Derives one Decision per request from the live signals above.
 
-    Stateless against the scheduler (reads describe()/engine state);
-    its own state is the shed/admit accounting and a bounded window of
-    recent TTFT samples for the p95 SLO signal."""
+    Stateless against the signal source (reads its provider methods —
+    `SchedulerSignals` for one engine, the router's `FleetSignals` for
+    a fleet); its own state is the shed/admit accounting and a bounded
+    window of recent TTFT samples for the p95 SLO signal."""
 
     def __init__(self, scheduler, *,
+                 source=None,
                  max_inflight: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
                  page_headroom: Optional[float] = None,
                  p95_slo_s: Optional[float] = None,
                  retry_after_s: Optional[float] = None):
         self.sched = scheduler
+        self.source = source if source is not None \
+            else SchedulerSignals(scheduler)
         self.max_inflight = max_inflight if max_inflight is not None \
             else _env_int("ROUNDTABLE_GATEWAY_MAX_INFLIGHT", 32)
         self.max_queue_depth = max_queue_depth \
@@ -99,10 +147,15 @@ class AdmissionController:
 
     # -- accounting (single writer for counters + registry) --
 
-    def _count(self, outcome: str, reason: str) -> None:
+    def _count(self, outcome: str, reason: str,
+               replica: Optional[str] = None) -> None:
         setattr(self, outcome, getattr(self, outcome) + 1)
-        telemetry.inc(f"roundtable_gateway_{outcome}_total",
-                      reason=reason)
+        if replica is not None:
+            telemetry.inc(f"roundtable_gateway_{outcome}_total",
+                          reason=reason, replica=replica)
+        else:
+            telemetry.inc(f"roundtable_gateway_{outcome}_total",
+                          reason=reason)
 
     def note_ttft(self, seconds: float) -> None:
         self._ttfts.append(seconds)
@@ -122,22 +175,21 @@ class AdmissionController:
                deadline_s: Optional[float] = None,
                priority: str = "normal",
                adapters: Optional[list] = None) -> Decision:
-        sched = self.sched
+        src = self.source
         scale = _PRIORITY_SCALE.get(priority, 1.0)
 
         # 1. Drain / pause: finish in-flight, refuse new (503 — the
         # gate reopens; clients retry the same pod after Retry-After).
-        paused = sched.paused
-        if deadlines.DRAINING or paused is not None:
-            reason = "draining" if (deadlines.DRAINING
-                                    or paused == "fleet.drain") \
-                else f"paused:{paused}"
-            return self._shed(reason, 503)
+        # Fleet sources only report this when EVERY live replica is
+        # closed — one rolling replica never 503s the front door.
+        drain = src.drain_state()
+        if drain is not None:
+            return self._shed(drain, 503)
 
         # 2. Dead engine: the supervisor exhausted its restart budget —
-        # nothing this pod serves can succeed (503, longer backoff).
-        from ..engine.supervisor import engine_dead_reason
-        if engine_dead_reason(sched.engine) is not None:
+        # (fleet: on EVERY replica) nothing this pod serves can
+        # succeed (503, longer backoff).
+        if src.dead_reason() is not None:
             return self._shed("engine_dead", 503,
                               retry_after=4 * self.retry_after_s)
 
@@ -152,33 +204,25 @@ class AdmissionController:
         # half the cap so paid/interactive traffic keeps headroom.
         if inflight >= max(int(self.max_inflight * scale), 1):
             return self._shed("inflight_cap", 429)
-        adm = sched.describe()["admission"]
-        if adm["queued"] >= max(int(self.max_queue_depth * scale), 1):
+        depth = src.queue_depth()
+        if depth >= max(int(self.max_queue_depth * scale), 1):
             return self._shed("queue_full", 429)
         # Below the cap but behind queued work: the request admits but
         # parks in the scheduler's FIFO — surfaced on the Decision so
         # note_admitted() counts it under `queued`.
-        will_queue = adm["queued"] > 0
+        will_queue = depth > 0
 
         # 5. KV page pressure: a paged pool within the headroom band
         # AND no host-RAM spill tier to evacuate into means the next
         # admission trades page faults for collapse — shed instead.
-        engine = sched.engine
-        if getattr(engine, "kv_layout", None) == "paged":
-            kv = engine.kv
-            free = kv.free_pages()
-            floor = int(kv.usable_pages() * self.page_headroom)
-            if (free <= floor
-                    and getattr(engine, "kv_offload", None) is None):
-                return self._shed("kv_pressure", 429)
+        if src.kv_pressure(self.page_headroom):
+            return self._shed("kv_pressure", 429)
 
         # 6. Adapter residency: every LoRA store slot referenced by
         # live rows — retirement frees refs; back off rather than park
         # in the scheduler queue behind an unknown-duration round.
-        store = getattr(engine, "lora", None)
-        if (store is not None and adapters
-                and any(a is not None for a in adapters)
-                and not store.can_admit(adapters)):
+        if (adapters and any(a is not None for a in adapters)
+                and src.adapters_busy(adapters)):
             return self._shed("adapters_busy", 429)
 
         # 7. Soft SLO: the gateway's own p95 TTFT window over target —
@@ -191,20 +235,24 @@ class AdmissionController:
 
         return Decision(True, "ok", queued=will_queue)
 
-    def note_admitted(self, queued: bool = False) -> None:
+    def note_admitted(self, queued: bool = False,
+                      replica: Optional[str] = None) -> None:
         """Counted by the gateway AFTER submit_async succeeds — the
         scheduler can still refuse between decide() and submit (a
         drain racing the request), and that lands under `shed`, so the
         two counters never both claim one request. `queued` marks an
         admission that parked behind a nonempty scheduler queue
-        (Decision.queued) — the queue path's own lockstep counter."""
-        self._count("admitted", "ok")
+        (Decision.queued) — the queue path's own lockstep counter.
+        `replica` labels the series when a router placed the stream
+        (single-engine output stays byte-identical)."""
+        self._count("admitted", "ok", replica=replica)
         if queued:
-            self._count("queued", "behind_queue")
+            self._count("queued", "behind_queue", replica=replica)
 
-    def note_shed(self, reason: str) -> None:
+    def note_shed(self, reason: str,
+                  replica: Optional[str] = None) -> None:
         """Submit-time refusals (scheduler raced the decision)."""
-        self._count("shed", reason)
+        self._count("shed", reason, replica=replica)
 
     def _shed(self, reason: str, status: int,
               retry_after: Optional[float] = None) -> Decision:

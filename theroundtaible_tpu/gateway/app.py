@@ -7,6 +7,7 @@ Endpoints:
 | /v1/chat/completions      | POST   | OpenAI-compatible; stream=true → SSE chunks + [DONE] |
 | /v1/discussions           | POST   | native multi-knight round → SSE token events |
 | /v1/streams/<id>          | GET    | reconnect a stream (Last-Event-ID watermark) |
+| /v1/admin/roll            | POST   | rolling restart (router fleets) |
 | /healthz                  | GET    | liveness + drain state          |
 | /metrics                  | GET    | Prometheus exposition snapshot  |
 
@@ -48,6 +49,13 @@ from .streams import StreamState, format_event_id, parse_event_id
 
 _DONE_STREAM_CAP = 256   # completed streams kept for reconnects
 
+# Failure kinds where a reconnect should FAIL OVER instead of replaying
+# the failure: the stream died with its replica, not with its request —
+# under a router, restore it (journal leg 2 / greedy-regen leg 3) on a
+# surviving replica rather than handing the corpse back to the client.
+_FAILOVER_KINDS = {"device_lost", "engine_dead", "restarting",
+                   "data_loss"}
+
 
 class _Shed(Exception):
     def __init__(self, decision: Decision):
@@ -56,18 +64,24 @@ class _Shed(Exception):
 
 
 class Gateway:
-    """One gateway over one scheduler (one pod, one engine)."""
+    """One gateway over one scheduler — or, with `router=`, over a
+    SessionRouter's replica fleet (the scheduler argument stays the
+    primary: its tokenizer and shared journal serve every replica)."""
 
     def __init__(self, scheduler, *, host: Optional[str] = None,
                  port: Optional[int] = None,
                  intent_dir: Optional[str] = None,
-                 admission: Optional[AdmissionController] = None):
+                 admission: Optional[AdmissionController] = None,
+                 router=None):
         self.sched = scheduler
+        self.router = router
         self.host = host or os.environ.get(
             "ROUNDTABLE_GATEWAY_HOST", "127.0.0.1")
         self.port = port if port is not None \
             else _env_int("ROUNDTABLE_GATEWAY_PORT", 8080)
-        self.admission = admission or AdmissionController(scheduler)
+        self.admission = admission or AdmissionController(
+            scheduler,
+            source=router.signals() if router is not None else None)
         self.default_deadline_s = _env_float(
             "ROUNDTABLE_GATEWAY_DEFAULT_DEADLINE_S", 120.0)
         self.sse_buffer = _env_int("ROUNDTABLE_GATEWAY_SSE_BUFFER", 512)
@@ -164,7 +178,8 @@ class Gateway:
         for sid, st in list(self.streams.items()):
             if not st.done:
                 telemetry.REGISTRY.remove_gauge(
-                    "roundtable_gateway_inflight_streams", request=sid)
+                    "roundtable_gateway_inflight_streams",
+                    **self._stream_labels(st))
 
     # ------------------------------------------------------------------
     # observability
@@ -174,14 +189,13 @@ class Gateway:
         """Keys ⊆ SURFACE_BINDINGS["gateway"] (drift-tested like the
         scheduler's describe)."""
         adm = self.admission
-        return {
+        out = {
             "admitted": adm.admitted,
             "shed": adm.shed,
             "queued": adm.queued,
             "expired": adm.expired,
             "inflight": self._inflight(),
-            "draining": bool(deadlines.DRAINING
-                             or self.sched.paused is not None),
+            "draining": self._draining(),
             "resumed_streams": self.resumed_streams,
             "dropped_events": int(telemetry.REGISTRY.counter_total(
                 "roundtable_gateway_dropped_events_total")),
@@ -189,9 +203,38 @@ class Gateway:
             "host": self.host,
             "port": self.port,
         }
+        if self.router is not None:
+            out["replicas"] = self.router.describe()
+        return out
 
     def _inflight(self) -> int:
         return sum(1 for s in self.streams.values() if not s.done)
+
+    def _draining(self) -> bool:
+        """Fleet-aware drain state: under a router, the front door only
+        reports draining when NO replica is open (one rolling replica
+        keeps /healthz green and admission flowing to its peers)."""
+        if self.router is not None:
+            return bool(self.admission.source.drain_state())
+        return bool(deadlines.DRAINING
+                    or self.sched.paused is not None)
+
+    def _sched_for(self, session: str, adapters: Optional[list] = None
+                   ) -> tuple[Any, Optional[str]]:
+        """(scheduler, replica-name) that serves this session: the
+        router's affinity/load placement, or the one scheduler with no
+        replica label in the N=1 case."""
+        if self.router is not None:
+            rep = self.router.replica_for(session, adapters)
+            return rep.scheduler, rep.name
+        return self.sched, None
+
+    def _stream_labels(self, state: StreamState) -> dict[str, str]:
+        labels = {"request": state.stream_id}
+        replica = getattr(state, "replica", None)
+        if replica is not None:
+            labels["replica"] = replica
+        return labels
 
     # ------------------------------------------------------------------
     # connection handling
@@ -247,13 +290,18 @@ class Gateway:
                      writer: asyncio.StreamWriter) -> None:
         path = req.path.rstrip("/") or "/"
         if path == "/healthz" and req.method == "GET":
-            await send_json(writer, 200, {
+            health = {
                 "ok": True,
-                "draining": bool(deadlines.DRAINING
-                                 or self.sched.paused is not None),
+                "draining": self._draining(),
                 "paused": self.sched.paused,
                 "inflight": self._inflight(),
-            })
+            }
+            if self.router is not None:
+                health["replicas"] = {
+                    name: {"dead": d["dead"], "paused": d["paused"]}
+                    for name, d in
+                    self.router.describe()["replicas"].items()}
+            await send_json(writer, 200, health)
             return
         if path == "/metrics" and req.method == "GET":
             await send_text(writer, 200,
@@ -270,8 +318,31 @@ class Gateway:
             await self._reconnect(req, writer,
                                   path[len("/v1/streams/"):])
             return
+        if path == "/v1/admin/roll" and req.method == "POST":
+            await self._admin_roll(req, writer)
+            return
         raise HttpError(404, f"no route for {req.method} {req.path}",
                         "not_found")
+
+    async def _admin_roll(self, req: Request,
+                          writer: asyncio.StreamWriter) -> None:
+        """Rolling restart over the fleet (or one named replica) —
+        runs off the event loop; in-flight streams keep pumping and
+        any stream crossing the roll reconnects through the resume
+        ladder."""
+        if self.router is None:
+            raise HttpError(400, "no router attached: single-engine "
+                            "gateway cannot roll", "no_router")
+        target = None
+        if req.body:
+            try:
+                target = req.json().get("replica")
+            except (ValueError, json.JSONDecodeError) as e:
+                raise HttpError(400, f"bad JSON body: {e}", "bad_json")
+        loop = asyncio.get_running_loop()
+        reports = await loop.run_in_executor(
+            None, lambda: self.router.roll(target))
+        await send_json(writer, 200, {"rolled": reports})
 
     # ------------------------------------------------------------------
     # admission + submit (the shared front half of both POST routes)
@@ -320,7 +391,8 @@ class Gateway:
         self._submit_state(state, turns, max_new=max_new,
                            deadline_s=deadline_s, adapters=adapters,
                            temperature=temperature)
-        self.admission.note_admitted(queued=dec.queued)
+        self.admission.note_admitted(
+            queued=dec.queued, replica=getattr(state, "replica", None))
         return state
 
     def _submit_state(self, state: StreamState,
@@ -328,7 +400,8 @@ class Gateway:
                       deadline_s: Optional[float],
                       adapters: Optional[list],
                       temperature: float = 0.0) -> None:
-        """The scheduler half: submit with the streaming seam bridged
+        """The scheduler half: pick the serving replica (router) or the
+        one scheduler (N=1), submit with the streaming seam bridged
         onto the asyncio loop, classify every refusal into the shed
         taxonomy, and publish the inflight gauge."""
         loop = self._loop
@@ -343,12 +416,20 @@ class Gateway:
             except RuntimeError:
                 pass
 
+        try:
+            sched, replica = self._sched_for(state.session, adapters)
+        except Exception as e:  # noqa: BLE001 — NoLiveReplica et al.
+            self.admission.note_shed("engine_dead")
+            raise _Shed(Decision(False, "engine_dead", 503,
+                                 4 * self.admission.retry_after_s)) \
+                from e
+        state.replica = replica
         sampling = [SamplingParams(temperature=temperature,
                                    max_new_tokens=max_new)
                     for _ in turns]
         timeout_s = deadline_s if deadline_s else 600.0
         try:
-            self.sched.submit_async(
+            sched.submit_async(
                 state.session, turns, max_new_tokens=max_new,
                 timeout_s=timeout_s, sampling_per_turn=sampling,
                 budget=make_budget(deadline_s),
@@ -358,29 +439,29 @@ class Gateway:
             self.admission._count("expired", "deadline_expired")
             raise HttpError(408, str(e), "deadline_expired")
         except deadlines.DrainingError as e:
-            self.admission.note_shed("draining")
+            self.admission.note_shed("draining", replica=replica)
             raise _Shed(Decision(False, "draining", 503,
                                  self.admission.retry_after_s)) from e
         except SchedulerRefused as e:
             reason = e.reason or "refused"
-            self.admission.note_shed(reason)
+            self.admission.note_shed(reason, replica=replica)
             status = 503 if reason in ("fleet.drain", "quiesce") else 429
             raise _Shed(Decision(False, reason, status,
                                  self.admission.retry_after_s)) from e
         except SchedulerClosed as e:
-            self.admission.note_shed("closed")
+            self.admission.note_shed("closed", replica=replica)
             raise _Shed(Decision(False, "closed", 503,
                                  self.admission.retry_after_s)) from e
         except Exception as e:  # noqa: BLE001 — classify dead engines etc.
             from ..core.errors import classify_error
             kind = classify_error(e)
-            self.admission.note_shed(kind)
+            self.admission.note_shed(kind, replica=replica)
             raise _Shed(Decision(False, kind, 503,
                                  4 * self.admission.retry_after_s)) \
                 from e
         self.streams[state.stream_id] = state
         telemetry.set_gauge("roundtable_gateway_inflight_streams", 1,
-                            request=state.stream_id)
+                            **self._stream_labels(state))
 
     def _on_stream_event(self, state: StreamState, event: dict) -> None:
         """Asyncio-loop side of the scheduler's on_commit bridge."""
@@ -394,7 +475,7 @@ class Gateway:
             # keep one series per stream ever served (RT-GAUGE-LEAK).
             telemetry.REGISTRY.remove_gauge(
                 "roundtable_gateway_inflight_streams",
-                request=state.stream_id)
+                **self._stream_labels(state))
             self._evict_done_streams()
 
     def _evict_done_streams(self) -> None:
@@ -538,6 +619,16 @@ class Gateway:
                          writer: asyncio.StreamWriter,
                          stream_id: str) -> None:
         state = self.streams.get(stream_id)
+        if (state is not None and state.failed is not None
+                and self.router is not None
+                and state.failed.get("kind") in _FAILOVER_KINDS):
+            # The stream died WITH its replica, not with its request:
+            # drop the corpse and restore on a survivor — the router's
+            # failover already re-established the session's KV there,
+            # so leg 2/3 of the ladder resumes byte-identically and the
+            # client's Last-Event-ID skips what it already saw.
+            self.streams.pop(stream_id, None)
+            state = None
         if state is None:
             state = self._restore_stream(stream_id)
         watermark = [0] * len(state.knights)

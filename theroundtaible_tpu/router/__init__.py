@@ -1,0 +1,22 @@
+"""Session-routing tier: one gateway front door, N data-parallel
+engine replicas (ISSUE 17).
+
+The router owns the session→replica map and the three fleet
+operations built on it — cold-session placement by live load score,
+cross-replica KV migration over the host-RAM tier, and zero-loss
+rolling restarts — while `FleetSignals` feeds the gateway's admission
+controller fleet-wide backpressure instead of one engine's.
+"""
+
+from .core import (  # noqa: F401
+    NoLiveReplica,
+    Replica,
+    SessionRouter,
+    active_router,
+    boundary_crossings,
+    build_replicas,
+    note_boundary_crossing,
+    reset_test_counters,
+    set_active_router,
+)
+from .signals import FleetSignals  # noqa: F401

@@ -259,6 +259,7 @@ REGISTRY = MetricsRegistry()
 # Module-level shorthands (call sites read better; one shared registry).
 inc = REGISTRY.inc
 set_gauge = REGISTRY.set_gauge
+remove_gauge = REGISTRY.remove_gauge
 observe = REGISTRY.observe
 counter_total = REGISTRY.counter_total
 
@@ -712,6 +713,14 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
                       "roundtable_sessions_lost_total / "
                       "roundtable_engine_dead gauge "
                       "(engine/supervisor snapshot)",
+        # ISSUE 17: the session router's fleet view (None without a
+        # router) — assignment counts + migration/failover/roll
+        # counters, replica-labeled, dropped at retire.
+        "router": "roundtable_router_sessions{replica=...} gauge / "
+                  "roundtable_router_migrations_total / "
+                  "roundtable_router_failovers_total / "
+                  "roundtable_router_rolls_total "
+                  "(router/core SessionRouter.describe)",
     },
     "scheduler_describe": {
         "admitted": "roundtable_sched_admitted_total",
@@ -819,6 +828,13 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "sessions": "derived (live stream table size)",
         "host": "static config (bind address)",
         "port": "static config (bind port)",
+        # ISSUE 17: router fleets only — per-replica roll-up; the
+        # underlying series carry a `replica=` label and are REMOVED
+        # when SessionRouter.retire drops the replica.
+        "replicas": "roundtable_router_sessions{replica=...} gauge / "
+                    "roundtable_router_migrations_total / "
+                    "roundtable_router_failovers_total / "
+                    "roundtable_router_rolls_total{replica=...}",
     },
 }
 
