@@ -60,6 +60,19 @@ if ! env JAX_PLATFORMS=cpu python bench_gateway.py --smoke \
 fi
 echo "window3: router smoke clean $(stamp)" >> "$OUT.log"
 
+# Loadgen preflight (ISSUE 19): a tiny open-loop Poisson sweep on CPU
+# (~30 s) must reach a shed point and fit a knee before any window
+# time is spent — a harness that cannot find the capacity frontier on
+# CPU would waste the chips measuring nothing; the on-chip sweep later
+# reuses this exact path with real rates.
+if ! env JAX_PLATFORMS=cpu python bench_load.py --smoke \
+    >> "$OUT.log" 2>&1; then
+  echo "window3: loadgen smoke FAILED $(stamp) — fix the offered-load" \
+       "harness before spending a window" >> "$OUT.log"
+  exit 1
+fi
+echo "window3: loadgen smoke clean $(stamp)" >> "$OUT.log"
+
 while :; do
   python - <<'PY' 2>> "$OUT.log"
 import sys

@@ -446,6 +446,34 @@ def _router_guard(request):
 
 
 @pytest.fixture(autouse=True)
+def _loadgen_guard(request):
+    """Tier-1 guard for @pytest.mark.loadgen (ISSUE 19 satellite): a
+    test that CLAIMS offered-load harness coverage must actually OFFER
+    load — if the driver never held >= 2 concurrent open-loop sessions
+    in flight during the test, the harness silently served closed-loop
+    (or one-at-a-time), arrivals waited on completions, and the test's
+    open-loop capacity claims are vacuous; fail LOUD. Arrival/workload/
+    capacity-math unit tests (which never drive a scheduler) mark
+    allow_closed=True."""
+    marker = request.node.get_closest_marker("loadgen")
+    if marker is None:
+        yield
+        return
+    from theroundtaible_tpu.loadgen import driver as lg_driver
+
+    lg_driver.reset_test_counters()
+    yield
+    if marker.kwargs.get("allow_closed"):
+        return
+    assert lg_driver.open_loop_peak() >= 2, (
+        "loadgen-marked test never drove >= 2 concurrent OPEN-LOOP "
+        f"sessions (peak {lg_driver.open_loop_peak()}): arrivals "
+        "silently waited on completions — closed-loop in disguise "
+        "(mark allow_closed=True only for arrival/workload/"
+        "capacity-math units)")
+
+
+@pytest.fixture(autouse=True)
 def _telemetry_guard(request):
     """Tier-1 guard for @pytest.mark.telemetry (ISSUE 5 satellite): a
     test that CLAIMS span-tracing coverage runs with telemetry armed,

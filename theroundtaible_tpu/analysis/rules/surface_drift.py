@@ -25,6 +25,10 @@ _SURFACES = (
     ("fleet_health", "engine/fleet.py", ("function", "fleet_health")),
     ("scheduler_describe", "engine/scheduler.py",
      ("method", "SessionScheduler", "describe")),
+    # ISSUE 19: the capacity view's machine shape — frontier record
+    # joined with live gateway series.
+    ("capacity_status", "commands/status.py",
+     ("function", "capacity_surface")),
 )
 
 

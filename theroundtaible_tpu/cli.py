@@ -125,6 +125,26 @@ def build_parser():
     s.add_argument("--no-read-code", dest="read_code", action="store_false",
                    help="Skip reading source code without asking")
 
+    lg = sub.add_parser(
+        "loadgen",
+        help="Offered-load capacity sweep: open-loop arrivals ramped "
+             "to the shed point, knee fit, and derived admission "
+             "thresholds (writes CAPACITY_r19.json in full mode)")
+    lg.add_argument("--smoke", action="store_true",
+                    help="Tiny ~30s sweep, no artifact")
+    lg.add_argument("--seed", type=int, default=7)
+    lg.add_argument("--arrival", default="poisson",
+                    choices=["poisson", "diurnal", "mmpp"],
+                    help="Arrival process for the sweep")
+    lg.add_argument("--duration", type=float, default=None,
+                    help="Seconds per sweep point")
+    lg.add_argument("--rates", default=None, metavar="R,R,...",
+                    help="Comma-separated offered rates "
+                         "(default: geometric ramp)")
+    lg.add_argument("--out", default=None,
+                    help="Capacity-record path "
+                         "(default ./CAPACITY_r19.json)")
+
     st = sub.add_parser("status", help="Show the latest session")
     st.add_argument("--telemetry", action="store_true",
                     help="Render the session's telemetry view: registry "
@@ -146,6 +166,12 @@ def build_parser():
                          "ledger: admitted/shed/expired counters by "
                          "reason, inflight streams, drop-to-summary "
                          "and resume counts")
+    st.add_argument("--capacity", action="store_true",
+                    help="Render the measured capacity frontier "
+                         "(latest CAPACITY_r19.json or "
+                         "ROUNDTABLE_GATEWAY_CAPACITY_FILE) against "
+                         "the live gateway gauges: predicted vs "
+                         "measured, knee, derived thresholds")
     st.add_argument("--fleet", action="store_true",
                     help="Render the multi-replica serving view: "
                          "per-replica liveness, session assignment, "
@@ -254,7 +280,14 @@ def dispatch(args) -> int:
             kv_view=getattr(args, "kv", False),
             health_view=getattr(args, "health", False),
             gateway_view=getattr(args, "gateway", False),
-            fleet_view=getattr(args, "fleet", False))
+            fleet_view=getattr(args, "fleet", False),
+            capacity_view=getattr(args, "capacity", False))
+    if args.command == "loadgen":
+        from .commands.loadgen_cmd import loadgen_command
+        return loadgen_command(smoke=args.smoke, seed=args.seed,
+                               arrival=args.arrival,
+                               duration_s=args.duration,
+                               rates=args.rates, out=args.out)
     if args.command == "list":
         from .commands.list_cmd import list_command
         return list_command()

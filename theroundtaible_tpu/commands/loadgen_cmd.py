@@ -1,0 +1,74 @@
+"""`roundtable loadgen` — offered-load capacity sweep (ISSUE 19).
+
+Thin CLI wrapper over loadgen.bench.run_capacity: builds the tiny
+in-process stack (engine + scheduler + admission + gateway), ramps an
+open-loop arrival process to the shed point, fits the knee, derives
+admission thresholds, and (full mode) writes the CAPACITY_r19.json
+record that ROUNDTABLE_GATEWAY_CAPACITY_FILE feeds back into
+gateway/admission.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from ..utils.ui import style
+
+
+def loadgen_command(smoke: bool = False,
+                    seed: int = 7,
+                    arrival: str = "poisson",
+                    duration_s: Optional[float] = None,
+                    rates: Optional[str] = None,
+                    out: Optional[str] = None) -> int:
+    from ..loadgen.bench import run_capacity
+
+    t0 = time.monotonic()
+    rate_list = ([float(r) for r in rates.split(",") if r.strip()]
+                 if rates else None)
+    print(style.bold("\n  Capacity sweep "
+                     f"({'smoke' if smoke else 'full'}, "
+                     f"{arrival} arrivals, seed {seed})"))
+    record = run_capacity(
+        smoke=smoke, seed=seed, arrival=arrival, rates=rate_list,
+        duration_s=duration_s,
+        log=lambda m: print(style.dim(f"  {m}"), file=sys.stderr))
+    record["detail"]["wall_s"] = round(time.monotonic() - t0, 1)
+
+    frontier = record["detail"]["frontier"]
+    knee = frontier["knee"]
+    th = frontier["derived_thresholds"]
+    print(style.bold("\n  Frontier:"))
+    print(style.dim("    offered_rps  admitted  shed_rate  ttft_p95_s"
+                    "  accepted_tok_s"))
+    for p in frontier["points"]:
+        p95 = p.get("ttft_p95_s")
+        print(style.dim(
+            f"    {p['offered_rps']:>11.2f}  {p['admitted']:>8.0f}"
+            f"  {p['shed_rate']:>9.3f}"
+            f"  {p95 if p95 is None else f'{p95:.3f}':>10}"
+            f"  {p['accepted_tok_s']:>14.1f}"))
+    print(style.bold(
+        f"\n  Knee: {knee['rate']:.2f} sessions/s ({knee['reason']})"))
+    print(style.dim(
+        f"  Derived thresholds: max_inflight={th['max_inflight']} "
+        f"max_queue_depth={th['max_queue_depth']} "
+        f"p95_slo_s={th['p95_slo_s']:.2f}"))
+
+    meets = record["detail"]["acceptance"]["meets"]
+    if smoke:
+        print(style.dim("\n  (smoke mode: no artifact written)\n"))
+        return 0 if meets else 1
+    path = out or os.path.join(os.getcwd(), "CAPACITY_r19.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(style.dim(f"\n  wrote {path}"))
+    print(style.dim(
+        "  feed it back: ROUNDTABLE_GATEWAY_CAPACITY_FILE="
+        f"{path} roundtable gateway\n"))
+    return 0 if meets else 1

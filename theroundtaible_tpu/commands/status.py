@@ -42,7 +42,8 @@ def status_command(project_root: Optional[str] = None,
                    kv_view: bool = False,
                    health_view: bool = False,
                    gateway_view: bool = False,
-                   fleet_view: bool = False) -> int:
+                   fleet_view: bool = False,
+                   capacity_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     if health_view:
         # Fleet health needs no session dir — it reads the live
@@ -54,6 +55,9 @@ def status_command(project_root: Optional[str] = None,
     if fleet_view:
         # Multi-replica serving view — live router + registry state.
         return fleet_status()
+    if capacity_view:
+        # Capacity frontier: file-based record vs live gateway gauges.
+        return capacity_status(project_root)
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
@@ -349,6 +353,152 @@ def fleet_status() -> int:
             "\n  No replica fleet in this process. Serve with "
             "`roundtable gateway --replicas N` (or `serve --replicas "
             "N`) to route sessions across N engine replicas.\n"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --capacity` (ISSUE 19) ---
+
+
+def _find_capacity_record(project_root: str):
+    """(path, frontier) of the capacity record to render:
+    ROUNDTABLE_GATEWAY_CAPACITY_FILE when set, else the newest
+    CAPACITY_r19.json under the project root. (None, None) when there
+    is nothing loadable — an unreadable record prints WHY."""
+    from ..gateway.admission import CAPACITY_FILE_ENV
+    from ..loadgen.capacity import load_record
+
+    candidates = []
+    envp = os.environ.get(CAPACITY_FILE_ENV)
+    if envp:
+        candidates.append(envp)
+    local = Path(project_root) / "CAPACITY_r19.json"
+    if local.exists():
+        candidates.append(str(local))
+    for path in candidates:
+        try:
+            return path, load_record(path)
+        except ValueError as e:
+            print(style.red(f"  unreadable capacity record: {e}"))
+    return None, None
+
+
+def capacity_surface(frontier, record_path, series) -> dict:
+    """The capacity view's machine shape: the measured frontier record
+    next to the LIVE gateway ledger, so predicted-vs-measured and
+    configured-vs-derived drift is one lookup. Keys are bound in
+    telemetry.SURFACE_BINDINGS["capacity_status"] (RT-SURFACE-DRIFT)."""
+    knee = frontier.get("knee", {})
+    predicted = frontier.get("predicted") or {}
+    gap = frontier.get("gap") or {}
+    live_inflight = sum(
+        1 for k in series
+        if k.split("{", 1)[0] == "roundtable_gateway_inflight_streams")
+    shed = sum(v for k, v in series.items()
+               if k.split("{", 1)[0] == "roundtable_gateway_shed_total")
+    admitted = sum(
+        v for k, v in series.items()
+        if k.split("{", 1)[0] == "roundtable_gateway_admitted_total")
+    record_errors = sum(
+        v for k, v in series.items()
+        if k.split("{", 1)[0]
+        == "roundtable_gateway_capacity_record_errors_total")
+    return {
+        "record_path": record_path,
+        "knee_rate": knee.get("rate"),
+        "knee_ttft_p95_s": knee.get("ttft_p95_s"),
+        "measured_tok_s": knee.get("accepted_tok_s"),
+        "predicted_tok_s": predicted.get("decode_ceiling_tps"),
+        "gap_frac": gap.get("gap_frac"),
+        "derived_thresholds": dict(
+            frontier.get("derived_thresholds", {})),
+        "points": len(frontier.get("points", [])),
+        "live_inflight": live_inflight,
+        "live_admitted": admitted,
+        "live_shed": shed,
+        "record_errors": record_errors,
+    }
+
+
+def capacity_status(project_root: str) -> int:
+    """`roundtable status --capacity` — the measured capacity frontier
+    (latest CAPACITY_r19.json / ROUNDTABLE_GATEWAY_CAPACITY_FILE)
+    rendered against the live gateway gauges: per-rate frontier table,
+    the perfmodel predicted curve vs the measured knee, the derived
+    admission thresholds, and this process's admission ledger so an
+    operator sees at a glance whether live load sits inside the
+    measured envelope."""
+    from ..utils import telemetry
+
+    print(style.bold("\n  Capacity frontier"))
+    path, frontier = _find_capacity_record(project_root)
+    series = telemetry.REGISTRY.snapshot_compact()
+    if frontier is None:
+        print(style.dim(
+            "\n  No capacity record found. Sweep one with `roundtable "
+            "loadgen` (or `python bench_load.py`) — it writes "
+            "CAPACITY_r19.json and ROUNDTABLE_GATEWAY_CAPACITY_FILE "
+            "feeds it back into admission.\n"))
+        return 0
+    surf = capacity_surface(frontier, path, series)
+    print(style.dim(f"    record: {path}"))
+    if frontier.get("chip"):
+        ch = frontier["chip"]
+        print(style.dim(f"    chip: {ch.get('name')} "
+                        f"({ch.get('source', '?')}), "
+                        f"n_devices={frontier.get('n_devices', 1)}"))
+
+    print(style.bold("\n  Frontier (measured):"))
+    print(style.dim("    offered_rps  admitted  shed_rate  ttft_p95_s"
+                    "  accepted_tok_s  sessions/chip"))
+    for p in frontier.get("points", []):
+        p95 = p.get("ttft_p95_s")
+        print(style.dim(
+            f"    {p['offered_rps']:>11.2f}  {p['admitted']:>8.0f}"
+            f"  {p['shed_rate']:>9.3f}"
+            f"  {p95 if p95 is None else f'{p95:.3f}':>10}"
+            f"  {p['accepted_tok_s']:>14.1f}"
+            f"  {p['sessions_per_chip']:>13.2f}"))
+    knee = frontier.get("knee", {})
+    rate = surf["knee_rate"]
+    print(style.bold(
+        f"\n  Knee: {f'{rate:.2f}' if rate is not None else '?'} "
+        "sessions/s"))
+    print(style.dim(f"    {knee.get('reason', '')}"))
+
+    if surf["predicted_tok_s"] is not None:
+        meas = surf["measured_tok_s"] or 0.0
+        gapf = surf["gap_frac"]
+        print(style.bold("\n  Predicted vs measured:"))
+        print(style.dim(
+            f"    roofline decode ceiling: "
+            f"{surf['predicted_tok_s']:.1f} tok/s"))
+        print(style.dim(f"    measured at knee:        {meas:.1f} tok/s"
+                        + (f"  (gap {gapf * 100:.1f}%)"
+                           if gapf is not None else "")))
+        for name, frac in (frontier.get("gap", {})
+                           .get("overheads", {}).items()):
+            if isinstance(frac, (int, float)):
+                print(style.dim(f"      {name:<24} {frac * 100:6.1f}%"))
+
+    th = surf["derived_thresholds"]
+    if th:
+        print(style.bold("\n  Derived admission thresholds:"))
+        print(style.dim(
+            f"    max_inflight={th.get('max_inflight')}  "
+            f"max_queue_depth={th.get('max_queue_depth')}  "
+            f"p95_slo_s={th.get('p95_slo_s')}"))
+
+    print(style.bold("\n  Live gateway (this process):"))
+    print(style.dim(
+        f"    inflight_streams={surf['live_inflight']:g}  "
+        f"admitted={surf['live_admitted']:g}  "
+        f"shed={surf['live_shed']:g}  "
+        f"record_errors={surf['record_errors']:g}"))
+    if not surf["live_admitted"] and not surf["live_inflight"]:
+        print(style.dim(
+            "    (idle — run the gateway in-process to compare live "
+            "load against the frontier)"))
     print("")
     return 0
 

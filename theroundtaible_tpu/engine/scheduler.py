@@ -975,17 +975,29 @@ class SessionScheduler:
         escape 60s later through the waiter's anti-wedge bound."""
         now = time.monotonic()
         expired: list[_Request] = []
+        abandoned: list[_Request] = []
         with self._cv:
             keep: deque[_Request] = deque()
             for req in self._queue:
                 if req.abandoned:
-                    continue  # waiter already gone: drop silently
+                    # A blocking waiter is simply gone — drop silently.
+                    # A STREAMING submitter (on_commit) still needs the
+                    # terminal event: without it the gateway's stream
+                    # state never finishes and its inflight gauge
+                    # leaks (ISSUE 19 abandonment regression).
+                    if req.on_commit is not None:
+                        abandoned.append(req)
+                    continue
                 if ((req.budget is not None and req.budget.expired)
                         or now - req.enqueued > req.timeout_s):
                     expired.append(req)
                 else:
                     keep.append(req)
             self._queue = keep
+        for req in abandoned:
+            self._fail_request(req, TimeoutError(
+                f"session {req.session!r} abandoned by its waiter "
+                "while queued"))
         for req in expired:
             self._fail_request(req, TimeoutError(
                 f"session {req.session!r} timed out in the admission "

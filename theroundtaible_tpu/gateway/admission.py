@@ -35,9 +35,10 @@ queue — admitted, but waiting behind in-flight rounds to start.
 from __future__ import annotations
 
 import os
+import sys
 import time
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Any, Optional
 
 from ..engine import deadlines
 from ..utils import telemetry
@@ -57,6 +58,97 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, ""))
     except ValueError:
         return default
+
+
+# --- derived thresholds (ISSUE 19) -----------------------------------
+
+CAPACITY_FILE_ENV = "ROUNDTABLE_GATEWAY_CAPACITY_FILE"
+
+# field -> (env var, parse, built-in default). Precedence per FIELD:
+# explicit ctor arg > env var > capacity record > built-in default.
+_FIELD_ENVS: dict[str, tuple] = {
+    "max_inflight": ("ROUNDTABLE_GATEWAY_MAX_INFLIGHT", int, 32),
+    "max_queue_depth": ("ROUNDTABLE_GATEWAY_MAX_QUEUE_DEPTH", int, 16),
+    "page_headroom": ("ROUNDTABLE_GATEWAY_PAGE_HEADROOM", float, 0.05),
+    "p95_slo_s": ("ROUNDTABLE_GATEWAY_P95_SLO_S", float, 0.0),
+    "retry_after_s": ("ROUNDTABLE_GATEWAY_RETRY_AFTER_S", float, 2.0),
+}
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    """The admission caps with their provenance. `resolve()` layers
+    env var > measured capacity record (CAPACITY_FILE_ENV) > built-in
+    default — a malformed record degrades LOUDLY to defaults (stderr
+    + roundtable_gateway_capacity_record_errors_total) and never
+    crashes admission."""
+
+    max_inflight: int = 32
+    max_queue_depth: int = 16
+    page_headroom: float = 0.05
+    p95_slo_s: float = 0.0
+    retry_after_s: float = 2.0
+    source: str = "default"      # default | capacity_record
+    record_path: Optional[str] = None
+    env_overrides: tuple = field(default_factory=tuple)
+
+    @classmethod
+    def from_capacity_record(cls, record: Any, *,
+                             path: Optional[str] = None
+                             ) -> "Thresholds":
+        """Thresholds DERIVED from a measured capacity frontier
+        (loadgen sweep record, bare or bench-wrapped). Raises
+        ValueError on a malformed record — resolve() turns that into
+        the loud-degrade path."""
+        from ..loadgen.capacity import extract_thresholds
+        th = extract_thresholds(record)
+        return cls(max_inflight=int(th["max_inflight"]),
+                   max_queue_depth=int(th["max_queue_depth"]),
+                   p95_slo_s=float(th["p95_slo_s"]),
+                   source="capacity_record", record_path=path)
+
+    @classmethod
+    def resolve(cls) -> "Thresholds":
+        base = cls()
+        path = os.environ.get(CAPACITY_FILE_ENV)
+        if path:
+            try:
+                from ..loadgen.capacity import load_record
+                base = cls.from_capacity_record(load_record(path),
+                                                path=path)
+            except ValueError as e:
+                telemetry.inc("roundtable_gateway_capacity_record_"
+                              "errors_total")
+                print(f"[gateway] ignoring {CAPACITY_FILE_ENV}="
+                      f"{path!r}: {e} — falling back to built-in "
+                      "admission defaults", file=sys.stderr)
+        overrides: dict[str, Any] = {}
+        for fname, (env, parse, _default) in _FIELD_ENVS.items():
+            if env not in os.environ:
+                continue
+            try:
+                overrides[fname] = parse(os.environ[env])
+            except ValueError:
+                # Matches the historical _env_* behavior: an unparsable
+                # env value falls through to the layer below.
+                continue
+        if not overrides:
+            return base
+        return cls(**{**{f: getattr(base, f) for f in _FIELD_ENVS},
+                      **overrides},
+                   source=base.source, record_path=base.record_path,
+                   env_overrides=tuple(sorted(overrides)))
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "max_inflight": self.max_inflight,
+            "max_queue_depth": self.max_queue_depth,
+            "page_headroom": self.page_headroom,
+            "p95_slo_s": self.p95_slo_s,
+            "source": self.source,
+            "record_path": self.record_path,
+            "env_overrides": list(self.env_overrides),
+        }
 
 
 @dataclass(frozen=True)
@@ -124,21 +216,27 @@ class AdmissionController:
                  max_queue_depth: Optional[int] = None,
                  page_headroom: Optional[float] = None,
                  p95_slo_s: Optional[float] = None,
-                 retry_after_s: Optional[float] = None):
+                 retry_after_s: Optional[float] = None,
+                 thresholds: Optional[Thresholds] = None):
         self.sched = scheduler
         self.source = source if source is not None \
             else SchedulerSignals(scheduler)
+        # Defaults layer through Thresholds.resolve(): env var >
+        # measured capacity record (ROUNDTABLE_GATEWAY_CAPACITY_FILE)
+        # > built-in. Explicit ctor args still win over everything.
+        th = thresholds if thresholds is not None \
+            else Thresholds.resolve()
+        self.thresholds = th
         self.max_inflight = max_inflight if max_inflight is not None \
-            else _env_int("ROUNDTABLE_GATEWAY_MAX_INFLIGHT", 32)
+            else th.max_inflight
         self.max_queue_depth = max_queue_depth \
-            if max_queue_depth is not None \
-            else _env_int("ROUNDTABLE_GATEWAY_MAX_QUEUE_DEPTH", 16)
+            if max_queue_depth is not None else th.max_queue_depth
         self.page_headroom = page_headroom if page_headroom is not None \
-            else _env_float("ROUNDTABLE_GATEWAY_PAGE_HEADROOM", 0.05)
+            else th.page_headroom
         self.p95_slo_s = p95_slo_s if p95_slo_s is not None \
-            else _env_float("ROUNDTABLE_GATEWAY_P95_SLO_S", 0.0)
+            else th.p95_slo_s
         self.retry_after_s = retry_after_s if retry_after_s is not None \
-            else _env_float("ROUNDTABLE_GATEWAY_RETRY_AFTER_S", 2.0)
+            else th.retry_after_s
         self._ttfts: list[float] = []   # bounded window, newest last
         self.admitted = 0
         self.shed = 0
@@ -273,6 +371,8 @@ class AdmissionController:
                 "max_queue_depth": self.max_queue_depth,
                 "page_headroom": self.page_headroom,
                 "p95_slo_s": self.p95_slo_s,
+                "source": self.thresholds.source,
+                "record_path": self.thresholds.record_path,
             },
         }
 

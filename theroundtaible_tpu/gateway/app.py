@@ -87,6 +87,13 @@ class Gateway:
         self.sse_buffer = _env_int("ROUNDTABLE_GATEWAY_SSE_BUFFER", 512)
         self.keepalive_s = _env_float(
             "ROUNDTABLE_GATEWAY_KEEPALIVE_S", 15.0)
+        # Abandonment linger (ISSUE 19): a stream whose LAST consumer
+        # disconnected gets this long for a reconnect before its
+        # scheduler round is abandoned (adapters/KV/gauges released).
+        # Long enough for the Last-Event-ID resume ladder, short
+        # enough that walked-away clients stop burning capacity.
+        self.abandon_s = _env_float(
+            "ROUNDTABLE_GATEWAY_ABANDON_S", 30.0)
         self.streams: dict[str, StreamState] = {}
         self.resumed_streams = 0
         # Stream-intent journal: rides in the session journal's
@@ -429,7 +436,7 @@ class Gateway:
                     for _ in turns]
         timeout_s = deadline_s if deadline_s else 600.0
         try:
-            sched.submit_async(
+            req = sched.submit_async(
                 state.session, turns, max_new_tokens=max_new,
                 timeout_s=timeout_s, sampling_per_turn=sampling,
                 budget=make_budget(deadline_s),
@@ -459,6 +466,12 @@ class Gateway:
             raise _Shed(Decision(False, kind, 503,
                                  4 * self.admission.retry_after_s)) \
                 from e
+        # Keep the request handle: abandonment (client disconnected,
+        # nobody reconnected within abandon_s) flips req.abandoned and
+        # the scheduler's health check releases the round's LoRA refs,
+        # KV rows and gauges — without it a walked-away client's round
+        # would burn capacity to completion.
+        state.request = req
         self.streams[state.stream_id] = state
         telemetry.set_gauge("roundtable_gateway_inflight_streams", 1,
                             **self._stream_labels(state))
@@ -477,6 +490,25 @@ class Gateway:
                 "roundtable_gateway_inflight_streams",
                 **self._stream_labels(state))
             self._evict_done_streams()
+
+    def _release_consumer(self, state: StreamState, consumer) -> None:
+        """Detach a pump's consumer; when that was the LAST one on a
+        live stream, start the abandonment clock — a reconnect within
+        `abandon_s` cancels it, otherwise the round is abandoned and
+        the scheduler releases everything it held (ISSUE 19)."""
+        state.detach(consumer)
+        if state.done or state.attached() or self._loop is None:
+            return
+        self._loop.call_later(self.abandon_s, self._reap_orphan, state)
+
+    def _reap_orphan(self, state: StreamState) -> None:
+        if state.done or state.attached():
+            return  # finished or reconnected — not abandoned
+        req = getattr(state, "request", None)
+        if req is None:
+            return
+        req.abandoned = True
+        telemetry.inc("roundtable_gateway_abandoned_streams_total")
 
     def _evict_done_streams(self) -> None:
         done = [sid for sid, st in self.streams.items() if st.done]
@@ -544,7 +576,7 @@ class Gateway:
             try:
                 failed = await self._await_done(consumer, deadline_s)
             finally:
-                state.detach(consumer)
+                self._release_consumer(state, consumer)
             if failed is not None:
                 raise HttpError(500, failed.get("error", "failed"),
                                 failed.get("kind", "unknown"))
@@ -725,7 +757,7 @@ class Gateway:
                 if terminal:
                     break
         finally:
-            state.detach(consumer)
+            self._release_consumer(state, consumer)
 
     def _native_payload(self, state: StreamState,
                         ev: dict) -> tuple[dict, int]:
@@ -789,4 +821,4 @@ class Gateway:
                 if terminal:
                     break
         finally:
-            state.detach(consumer)
+            self._release_consumer(state, consumer)

@@ -105,6 +105,10 @@ class StreamState:
         self.failed: Optional[dict] = None  # {"error", "kind"}
         self.created = time.monotonic()
         self._consumers: list["_Consumer"] = []
+        # The scheduler's _Request handle (set by the gateway after
+        # submit): the abandonment seam — flipping request.abandoned
+        # makes the scheduler release the round's holds (ISSUE 19).
+        self.request = None
 
     # -- producer side (bridged scheduler events) --
 
@@ -141,6 +145,9 @@ class StreamState:
     def detach(self, c: "_Consumer") -> None:
         if c in self._consumers:
             self._consumers.remove(c)
+
+    def attached(self) -> int:
+        return len(self._consumers)
 
 
 class _Consumer:

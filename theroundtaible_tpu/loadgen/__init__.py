@@ -1,0 +1,36 @@
+"""Offered-load traffic harness + capacity model (ISSUE 19).
+
+One load-model module that both the benches and the admission
+controller consume:
+
+- `arrivals`  — seeded OPEN-LOOP arrival processes (Poisson, diurnal,
+  MMPP burst) plus a closed-loop comparison arm. Open loop is the
+  evidence standard: arrivals keep coming whether or not the server
+  keeps up, so the shed point is a property of the server, not of the
+  client's politeness.
+- `workload`  — seeded session mixes: heavy-tailed prompt/turn
+  lengths, persona churn over more adapters than the LoraStore holds,
+  priority/deadline mixes, mid-stream abandonment draws.
+- `driver`    — two ways to offer the traffic: in-process over
+  `SessionScheduler.submit_async` and over-the-wire against the
+  gateway SSE endpoints (single replica or a router fleet), plus
+  chaos arms over the PR-12 fault points.
+- `sweep`     — ramp offered load to the refusal/shed point, one
+  frontier point per arrival rate.
+- `capacity`  — the frontier record schema, the knee fit, and the
+  DERIVED admission thresholds that `gateway/admission.py` loads via
+  ROUNDTABLE_GATEWAY_CAPACITY_FILE.
+- `bench`     — the orchestration shared by `bench_load.py` and the
+  `roundtable loadgen` command (emits CAPACITY_r19.json).
+"""
+
+from .arrivals import (ArrivalProcess, ClosedLoopArrivals,  # noqa: F401
+                       DiurnalArrivals, MMPPArrivals, PoissonArrivals,
+                       make_arrivals)
+from .capacity import (CAPACITY_SCHEMA_ID, build_record,  # noqa: F401
+                       derive_thresholds, fit_knee, load_record,
+                       validate_record)
+from .driver import (GatewayDriver, InProcessDriver,  # noqa: F401
+                     open_loop_peak, reset_test_counters, summarize)
+from .sweep import ramp_rates, run_point, run_sweep  # noqa: F401
+from .workload import SessionSpec, WorkloadMix  # noqa: F401

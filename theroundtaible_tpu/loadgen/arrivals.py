@@ -1,0 +1,201 @@
+"""Seeded arrival processes: WHEN sessions show up.
+
+Every process emits a deterministic schedule of relative offsets
+(seconds from sweep start, sorted ascending) from its own
+`random.Random` seeded by a string key — re-running the same seed and
+parameters reproduces the identical schedule byte-for-byte, which is
+what makes a capacity record re-runnable evidence rather than an
+anecdote.
+
+Open loop vs closed loop: an open-loop process decides arrival times
+WITHOUT looking at the server — when the server falls behind, traffic
+piles up and the shed machinery is exercised honestly. The closed-loop
+arm (K clients, next request only after the last finished) is kept
+strictly as a comparison arm: it self-throttles at exactly the
+saturation point and therefore can never find it.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+# A runaway rate x duration must not OOM the harness building a list.
+_MAX_ARRIVALS = 200_000
+
+
+class ArrivalProcess:
+    """One arrival process: `schedule()` maps (rate, duration) to the
+    session start offsets."""
+
+    kind = "base"
+    open_loop = True
+
+    def schedule(self, *, rate_rps: float,
+                 duration_s: float) -> list[float]:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "open_loop": self.open_loop}
+
+
+def _check(rate_rps: float, duration_s: float) -> None:
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    if rate_rps * duration_s > _MAX_ARRIVALS:
+        raise ValueError(
+            f"schedule of ~{rate_rps * duration_s:.0f} arrivals exceeds "
+            f"the {_MAX_ARRIVALS} harness bound — shorten the point or "
+            "lower the rate")
+
+
+class PoissonArrivals(ArrivalProcess):
+    """Memoryless arrivals at constant rate λ — the canonical
+    open-loop baseline (exponential inter-arrival gaps)."""
+
+    kind = "poisson"
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    def schedule(self, *, rate_rps: float,
+                 duration_s: float) -> list[float]:
+        _check(rate_rps, duration_s)
+        rng = random.Random(f"arrivals:poisson:{self.seed}")
+        out: list[float] = []
+        t = rng.expovariate(rate_rps)
+        while t < duration_s and len(out) < _MAX_ARRIVALS:
+            out.append(t)
+            t += rng.expovariate(rate_rps)
+        return out
+
+    def describe(self) -> dict:
+        return {**super().describe(), "seed": self.seed}
+
+
+class DiurnalArrivals(ArrivalProcess):
+    """Poisson thinned against a sinusoidal rate profile
+    λ(t) = rate x (1 + depth x sin(2πt/period)) — the compressed
+    day/night cycle. Mean rate stays `rate_rps`; the peak runs
+    (1 + depth) x above it."""
+
+    kind = "diurnal"
+
+    def __init__(self, seed: int = 0, *, period_s: float = 60.0,
+                 depth: float = 0.5):
+        if not 0.0 <= depth < 1.0:
+            raise ValueError(f"depth must be in [0, 1), got {depth}")
+        self.seed = int(seed)
+        self.period_s = float(period_s)
+        self.depth = float(depth)
+
+    def schedule(self, *, rate_rps: float,
+                 duration_s: float) -> list[float]:
+        _check(rate_rps, duration_s)
+        rng = random.Random(f"arrivals:diurnal:{self.seed}")
+        peak = rate_rps * (1.0 + self.depth)
+        out: list[float] = []
+        t = rng.expovariate(peak)
+        while t < duration_s and len(out) < _MAX_ARRIVALS:
+            lam = rate_rps * (1.0 + self.depth * math.sin(
+                2.0 * math.pi * t / self.period_s))
+            if rng.random() < lam / peak:
+                out.append(t)
+            t += rng.expovariate(peak)
+        return out
+
+    def describe(self) -> dict:
+        return {**super().describe(), "seed": self.seed,
+                "period_s": self.period_s, "depth": self.depth}
+
+
+class MMPPArrivals(ArrivalProcess):
+    """Two-state Markov-modulated Poisson (bursty): a quiet state and a
+    burst state at `burst_mult` x the quiet rate, with exponential
+    dwell times. Rates are normalized so the MEAN offered rate is still
+    `rate_rps` — sweeps stay comparable across processes."""
+
+    kind = "mmpp"
+
+    def __init__(self, seed: int = 0, *, burst_mult: float = 4.0,
+                 dwell_s: float = 5.0):
+        if burst_mult < 1.0:
+            raise ValueError(
+                f"burst_mult must be >= 1, got {burst_mult}")
+        self.seed = int(seed)
+        self.burst_mult = float(burst_mult)
+        self.dwell_s = float(dwell_s)
+
+    def schedule(self, *, rate_rps: float,
+                 duration_s: float) -> list[float]:
+        _check(rate_rps, duration_s)
+        rng = random.Random(f"arrivals:mmpp:{self.seed}")
+        # Equal expected dwell in each state: mean = (quiet+burst)/2.
+        quiet = 2.0 * rate_rps / (1.0 + self.burst_mult)
+        rates = (quiet, quiet * self.burst_mult)
+        out: list[float] = []
+        t, state = 0.0, 0
+        flip = rng.expovariate(1.0 / self.dwell_s)
+        while t < duration_s and len(out) < _MAX_ARRIVALS:
+            gap = rng.expovariate(rates[state])
+            if t + gap >= flip:
+                t = flip
+                state = 1 - state
+                flip = t + rng.expovariate(1.0 / self.dwell_s)
+                continue
+            t += gap
+            if t < duration_s:
+                out.append(t)
+        return out
+
+    def describe(self) -> dict:
+        return {**super().describe(), "seed": self.seed,
+                "burst_mult": self.burst_mult, "dwell_s": self.dwell_s}
+
+
+class ClosedLoopArrivals(ArrivalProcess):
+    """The comparison arm: K concurrent clients, each submitting its
+    next session only after the previous one finished. The schedule is
+    just the initial batch — drivers keep K in flight from there.
+    Deliberately NOT acceptable capacity evidence (see BENCH_NOTES)."""
+
+    kind = "closed"
+    open_loop = False
+
+    def __init__(self, concurrency: int = 2):
+        if concurrency < 1:
+            raise ValueError(
+                f"concurrency must be >= 1, got {concurrency}")
+        self.concurrency = int(concurrency)
+
+    def schedule(self, *, rate_rps: float = 1.0,
+                 duration_s: float = 1.0) -> list[float]:
+        return [0.0] * self.concurrency
+
+    def describe(self) -> dict:
+        return {**super().describe(), "concurrency": self.concurrency}
+
+
+_KINDS = {
+    "poisson": PoissonArrivals,
+    "diurnal": DiurnalArrivals,
+    "mmpp": MMPPArrivals,
+    "closed": ClosedLoopArrivals,
+}
+
+
+def make_arrivals(kind: str, seed: Optional[int] = None,
+                  **params) -> ArrivalProcess:
+    """Factory over the registered processes ("poisson", "diurnal",
+    "mmpp", "closed")."""
+    cls = _KINDS.get(kind)
+    if cls is None:
+        raise ValueError(
+            f"unknown arrival process {kind!r} "
+            f"(have: {', '.join(sorted(_KINDS))})")
+    if kind == "closed":
+        return cls(**params)
+    return cls(seed or 0, **params)

@@ -1,0 +1,233 @@
+"""Capacity frontier record: schema, knee fit, derived thresholds.
+
+The frontier record is the durable artifact of a sweep — the thing
+BENCH_NOTES calls re-runnable evidence and the thing
+`gateway/admission.py` loads through ROUNDTABLE_GATEWAY_CAPACITY_FILE
+(`Thresholds.from_capacity_record`). Hand-rolled validation (no
+jsonschema dependency): `validate_record` returns a list of problems,
+empty means valid.
+
+Threshold derivation rules (documented in ARCHITECTURE "Load &
+capacity"; every rule anchors to the measured knee):
+
+- `p95_slo_s`      = knee p95 TTFT x `slo_margin` — the soft-shed SLO
+  sits above what the server PROVABLY does at its best operating
+  point, so it trips on regression, not on normal service.
+- `max_inflight`   = peak concurrent sessions at the knee x
+  `inflight_margin` — beyond measured peak concurrency the extra
+  admissions only queue.
+- `max_queue_depth`= Little's-law backlog at the knee
+  (knee rate x knee p95 TTFT) x `queue_margin`, floor 2 — a queue
+  deeper than the knee can drain within one SLO window is pure added
+  latency.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Optional
+
+CAPACITY_SCHEMA_ID = "roundtable.capacity_frontier.v1"
+
+# Per-point keys the schema requires; the ttft percentiles may be null
+# (a fully-shed point has no admitted sessions to time).
+_POINT_NUM_KEYS = ("offered_rps", "duration_s", "arrivals", "admitted",
+                   "shed", "shed_rate", "accepted_tok_s",
+                   "peak_concurrent_sessions", "sessions_per_chip")
+_POINT_NULLABLE_KEYS = ("ttft_p50_s", "ttft_p95_s", "ttft_p99_s")
+_THRESHOLD_KEYS = ("max_inflight", "max_queue_depth", "p95_slo_s")
+
+
+# --- knee fit --------------------------------------------------------
+
+def fit_knee(points: list[dict], *, max_shed_rate: float = 0.05,
+             ttft_slo_factor: float = 3.0) -> dict[str, Any]:
+    """The knee: the highest offered rate the server absorbed — shed
+    rate within `max_shed_rate` and p95 TTFT within
+    `ttft_slo_factor` x the lightest point's p95. Past it, added
+    offered load only buys shed + latency.
+
+    Monotone in offered load by construction: each point's goodness
+    depends only on itself and the FIRST point's baseline, so
+    extending a sweep with higher-rate points never moves the knee
+    DOWN — the property the tier-1 sweep test pins.
+    """
+    if not points:
+        raise ValueError("fit_knee needs at least one point")
+    ordered = sorted(range(len(points)),
+                     key=lambda i: points[i]["offered_rps"])
+    base_p95 = points[ordered[0]].get("ttft_p95_s")
+    knee_i = ordered[0]
+    reason = "lightest point (nothing else within limits)"
+    for i in ordered:
+        pt = points[i]
+        if pt["shed_rate"] > max_shed_rate:
+            continue
+        p95 = pt.get("ttft_p95_s")
+        if (base_p95 is not None and p95 is not None
+                and p95 > ttft_slo_factor * max(base_p95, 1e-6)):
+            continue
+        if pt["offered_rps"] >= points[knee_i]["offered_rps"]:
+            knee_i = i
+            reason = (f"highest rate with shed<={max_shed_rate:g} "
+                      f"and p95<={ttft_slo_factor:g}x baseline")
+    knee = points[knee_i]
+    return {
+        "index": knee_i,
+        "rate": knee["offered_rps"],
+        "accepted_tok_s": knee["accepted_tok_s"],
+        "ttft_p95_s": knee.get("ttft_p95_s"),
+        "peak_concurrent_sessions": knee["peak_concurrent_sessions"],
+        "max_shed_rate": max_shed_rate,
+        "ttft_slo_factor": ttft_slo_factor,
+        "reason": reason,
+    }
+
+
+def derive_thresholds(points: list[dict], knee: dict, *,
+                      slo_margin: float = 1.5,
+                      inflight_margin: float = 1.25,
+                      queue_margin: float = 2.0) -> dict[str, Any]:
+    """Admission thresholds from the measured knee (rules in the
+    module docstring / ARCHITECTURE)."""
+    p95 = knee.get("ttft_p95_s")
+    peak = max(int(knee.get("peak_concurrent_sessions", 1)), 1)
+    backlog = (knee["rate"] * p95) if p95 else 0.0
+    return {
+        "max_inflight": max(math.ceil(peak * inflight_margin), 1),
+        "max_queue_depth": max(math.ceil(backlog * queue_margin), 2),
+        "p95_slo_s": round(p95 * slo_margin, 4) if p95 else 0.0,
+        "rules": {
+            "slo_margin": slo_margin,
+            "inflight_margin": inflight_margin,
+            "queue_margin": queue_margin,
+        },
+    }
+
+
+# --- record build / validate -----------------------------------------
+
+def build_record(*, points: list[dict], arrival: dict, workload: dict,
+                 seed: int, predicted: Optional[dict] = None,
+                 gap: Optional[dict] = None,
+                 chaos: Optional[dict] = None,
+                 chip: Optional[dict] = None,
+                 n_devices: int = 1,
+                 knee_params: Optional[dict] = None) -> dict[str, Any]:
+    """Assemble the full frontier record (fits the knee and derives
+    thresholds on the way)."""
+    knee = fit_knee(points, **(knee_params or {}))
+    record = {
+        "schema": CAPACITY_SCHEMA_ID,
+        "seed": int(seed),
+        "n_devices": int(n_devices),
+        "arrival": arrival,
+        "workload": workload,
+        "points": points,
+        "knee": knee,
+        "derived_thresholds": derive_thresholds(points, knee),
+    }
+    if predicted is not None:
+        record["predicted"] = predicted
+    if gap is not None:
+        record["gap"] = gap
+    if chaos is not None:
+        record["chaos"] = chaos
+    if chip is not None:
+        record["chip"] = chip
+    errors = validate_record(record)
+    if errors:  # a bug in this module, not in the caller's data
+        raise AssertionError(
+            "built an invalid capacity record: " + "; ".join(errors))
+    return record
+
+
+def validate_record(rec: Any) -> list[str]:
+    """Problems with a frontier record ([] = valid). Never raises —
+    admission's loud-degrade path depends on getting WORDS back, not
+    a traceback."""
+    errs: list[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not a dict"]
+    if rec.get("schema") != CAPACITY_SCHEMA_ID:
+        errs.append(f"schema is {rec.get('schema')!r}, "
+                    f"expected {CAPACITY_SCHEMA_ID!r}")
+    points = rec.get("points")
+    if not isinstance(points, list) or not points:
+        errs.append("points must be a non-empty list")
+        points = []
+    prev_rate = 0.0
+    for i, pt in enumerate(points):
+        if not isinstance(pt, dict):
+            errs.append(f"points[{i}] is not a dict")
+            continue
+        for k in _POINT_NUM_KEYS:
+            v = pt.get(k)
+            if not isinstance(v, (int, float)) \
+                    or isinstance(v, bool):
+                errs.append(f"points[{i}].{k} missing or non-numeric")
+        for k in _POINT_NULLABLE_KEYS:
+            v = pt.get(k, "absent")
+            if v == "absent" or (v is not None and not
+                                 isinstance(v, (int, float))):
+                errs.append(f"points[{i}].{k} missing or non-numeric")
+        rate = pt.get("offered_rps")
+        if isinstance(rate, (int, float)):
+            if rate <= 0:
+                errs.append(f"points[{i}].offered_rps must be > 0")
+            if rate < prev_rate:
+                errs.append("points must be sorted by offered_rps "
+                            f"(points[{i}] goes backwards)")
+            prev_rate = rate
+    knee = rec.get("knee")
+    if not isinstance(knee, dict) or "rate" not in knee:
+        errs.append("knee must be a dict with a fitted rate")
+    th = rec.get("derived_thresholds")
+    if not isinstance(th, dict):
+        errs.append("derived_thresholds must be a dict")
+    else:
+        for k in _THRESHOLD_KEYS:
+            v = th.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                errs.append(
+                    f"derived_thresholds.{k} missing or invalid")
+    return errs
+
+
+def extract_thresholds(rec: Any) -> dict[str, Any]:
+    """The derived thresholds out of a frontier record — accepts the
+    bare record or a bench wrapper carrying it under
+    detail.frontier (the CAPACITY_r19.json shape). Raises ValueError
+    with every problem spelled out when the record is malformed."""
+    if isinstance(rec, dict) and "schema" not in rec:
+        inner = rec.get("detail", {})
+        if isinstance(inner, dict) and \
+                isinstance(inner.get("frontier"), dict):
+            rec = inner["frontier"]
+    errors = validate_record(rec)
+    if errors:
+        raise ValueError("malformed capacity record: "
+                         + "; ".join(errors))
+    return dict(rec["derived_thresholds"])
+
+
+def load_record(path: str) -> dict[str, Any]:
+    """Read + validate a frontier record from disk (bare or bench-
+    wrapped). Raises ValueError (unreadable / bad JSON / malformed) —
+    callers choose whether that is fatal."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+    except OSError as e:
+        raise ValueError(f"cannot read capacity record {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"capacity record {path!r} is not JSON: {e}")
+    extract_thresholds(rec)  # full validation
+    if isinstance(rec, dict) and "schema" not in rec:
+        inner = rec.get("detail", {})
+        if isinstance(inner, dict) and \
+                isinstance(inner.get("frontier"), dict):
+            return inner["frontier"]
+    return rec

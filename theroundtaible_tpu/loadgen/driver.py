@@ -1,0 +1,617 @@
+"""Traffic drivers: offer a schedule of SessionSpecs to the serving
+stack and record what happened to each one.
+
+Two drivers, one record shape:
+
+- `InProcessDriver` — straight into `SessionScheduler.submit_async`
+  (optionally consulting an `AdmissionController` first, so sweeps
+  exercise the same decision ladder the gateway runs). Abandonment
+  uses the scheduler's own seam: `request.abandoned = True`.
+- `GatewayDriver` — over the wire against the gateway's SSE
+  endpoints (`POST /v1/discussions`, reconnects via
+  `GET /v1/streams/<id>` + Last-Event-ID), single replica or a
+  router fleet alike. Abandonment closes the socket mid-stream —
+  the real client-disconnect path.
+
+Chaos arms: `arm_chaos()` wires the PR-12 fault points
+(`device_lost`, `engine_wedged`, ...) for in-process runs; over-the-
+wire children inherit them via `chaos_env()` → ROUNDTABLE_FAULTS.
+
+Per-session record keys (every driver emits the same dict):
+  index, session, outcome ∈ {completed, shed, failed, abandoned},
+  shed_reason, error_kind, ttft_s, tokens, reconnects, offset_s,
+  wall_s.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from .workload import SessionSpec
+
+# --- test counters (conftest `loadgen` marker guard) -----------------
+# A loadgen-marked test that never held >= 2 open-loop sessions in
+# flight at once proved nothing about offered load — the guard fails
+# LOUD unless this peak moved (the scheduler test-counter pattern).
+
+_test_lock = threading.Lock()
+_open_loop_now = 0
+_open_loop_peak = 0
+
+
+def reset_test_counters() -> None:
+    global _open_loop_now, _open_loop_peak
+    with _test_lock:
+        _open_loop_now = 0
+        _open_loop_peak = 0
+
+
+def open_loop_peak() -> int:
+    return _open_loop_peak
+
+
+def _note_start(open_loop: bool) -> None:
+    global _open_loop_now, _open_loop_peak
+    if not open_loop:
+        return
+    with _test_lock:
+        _open_loop_now += 1
+        _open_loop_peak = max(_open_loop_peak, _open_loop_now)
+
+
+def _note_done(open_loop: bool) -> None:
+    global _open_loop_now
+    if not open_loop:
+        return
+    with _test_lock:
+        _open_loop_now = max(_open_loop_now - 1, 0)
+
+
+# --- chaos arms ------------------------------------------------------
+
+def arm_chaos(point: str = "device_lost", count: int = 1,
+              delay_s: float = 0.0) -> None:
+    """Arm a PR-12 fault point in THIS process (in-process driver /
+    in-process gateway runs)."""
+    from ..engine import faults
+    if point not in faults.POINTS:
+        raise ValueError(f"unknown fault point {point!r}")
+    faults.arm(point, count=count, delay_s=delay_s)
+
+
+def chaos_env(point: str = "device_lost", count: int = 1,
+              delay_s: float = 0.0) -> dict[str, str]:
+    """The env var that arms the same fault in a CHILD gateway process
+    (faults parse ROUNDTABLE_FAULTS at import)."""
+    spec = f"{point}:{count}"
+    if delay_s:
+        spec += f"@{delay_s}"
+    return {"ROUNDTABLE_FAULTS": spec}
+
+
+# --- aggregation -----------------------------------------------------
+
+def _percentile(ordered: list[float], q: float) -> Optional[float]:
+    if not ordered:
+        return None
+    return ordered[min(int(len(ordered) * q), len(ordered) - 1)]
+
+
+def summarize(records: list[dict], *, offered_rps: float,
+              duration_s: float, n_devices: int = 1) -> dict[str, Any]:
+    """Fold per-session records into one capacity-frontier point."""
+    done = [r for r in records if r is not None]
+    admitted = [r for r in done if r["outcome"] != "shed"]
+    completed = [r for r in done if r["outcome"] == "completed"]
+    failed = [r for r in done if r["outcome"] == "failed"]
+    abandoned = [r for r in done if r["outcome"] == "abandoned"]
+    shed = [r for r in done if r["outcome"] == "shed"]
+    ttfts = sorted(r["ttft_s"] for r in admitted
+                   if r.get("ttft_s") is not None)
+    tokens = sum(r.get("tokens", 0) for r in admitted)
+    peak = _peak_concurrency(admitted)
+    return {
+        "offered_rps": offered_rps,
+        "duration_s": round(duration_s, 3),
+        "arrivals": len(done),
+        "admitted": len(admitted),
+        "completed": len(completed),
+        "failed": len(failed),
+        "abandoned": len(abandoned),
+        "shed": len(shed),
+        "shed_rate": round(len(shed) / max(len(done), 1), 4),
+        "shed_reasons": _reason_counts(shed),
+        "ttft_p50_s": _percentile(ttfts, 0.50),
+        "ttft_p95_s": _percentile(ttfts, 0.95),
+        "ttft_p99_s": _percentile(ttfts, 0.99),
+        "accepted_tokens": tokens,
+        "accepted_tok_s": round(tokens / max(duration_s, 1e-9), 3),
+        "peak_concurrent_sessions": peak,
+        "sessions_per_chip": round(peak / max(n_devices, 1), 3),
+    }
+
+
+def _reason_counts(shed: list[dict]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for r in shed:
+        reason = r.get("shed_reason") or "unknown"
+        out[reason] = out.get(reason, 0) + 1
+    return out
+
+
+def _peak_concurrency(records: list[dict]) -> int:
+    """Max sessions simultaneously in flight, from (start, end) offsets."""
+    marks = []
+    for r in records:
+        start = r.get("offset_s", 0.0)
+        marks.append((start, 1))
+        marks.append((start + r.get("wall_s", 0.0), -1))
+    peak = cur = 0
+    for _, d in sorted(marks):
+        cur += d
+        peak = max(peak, cur)
+    return peak
+
+
+def _new_record(spec: SessionSpec, offset_s: float) -> dict:
+    return {"index": spec.index, "session": spec.session,
+            "outcome": "failed", "shed_reason": None,
+            "error_kind": None, "ttft_s": None, "tokens": 0,
+            "reconnects": 0, "offset_s": round(offset_s, 4),
+            "wall_s": 0.0}
+
+
+# --- in-process driver -----------------------------------------------
+
+class InProcessDriver:
+    """Offers traffic straight into one SessionScheduler. With
+    `admission=`, each arrival first runs the gateway's decision
+    ladder (`AdmissionController.decide`) — shed sessions never reach
+    the scheduler, exactly like the HTTP front door."""
+
+    def __init__(self, scheduler, *, admission=None):
+        self.sched = scheduler
+        self.admission = admission
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def run(self, specs: list[SessionSpec], offsets: list[float], *,
+            open_loop: bool = True,
+            timeout_s: float = 120.0) -> list[dict]:
+        if open_loop:
+            return self._run_open(specs, offsets, timeout_s)
+        return self._run_closed(specs, len(offsets), timeout_s)
+
+    # -- open loop: dispatch on the schedule, never wait --
+
+    def _run_open(self, specs, offsets, timeout_s) -> list[dict]:
+        records: list[Optional[dict]] = [None] * len(specs)
+        waiters: list[threading.Thread] = []
+        t0 = time.monotonic()
+        for i, (spec, off) in enumerate(zip(specs, offsets)):
+            delay = t0 + off - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            records[i] = rec = _new_record(spec, off)
+            w = self._offer(spec, rec, timeout_s)
+            if w is not None:
+                waiters.append(w)
+        bound = time.monotonic() + timeout_s
+        for w in waiters:
+            w.join(max(bound - time.monotonic(), 0.1))
+        return [r for r in records if r is not None]
+
+    # -- closed loop (comparison arm): K clients, submit-wait-repeat --
+
+    def _run_closed(self, specs, concurrency, timeout_s) -> list[dict]:
+        records: list[Optional[dict]] = [None] * len(specs)
+        cursor = {"i": 0}
+        lock = threading.Lock()
+        t0 = time.monotonic()
+
+        def client() -> None:
+            while True:
+                with lock:
+                    i = cursor["i"]
+                    if i >= len(specs):
+                        return
+                    cursor["i"] = i + 1
+                spec = specs[i]
+                records[i] = rec = _new_record(
+                    spec, time.monotonic() - t0)
+                w = self._offer(spec, rec, timeout_s, open_loop=False)
+                if w is not None:
+                    w.join(timeout_s)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s)
+        return [r for r in records if r is not None]
+
+    def _offer(self, spec: SessionSpec, rec: dict,
+               timeout_s: float,
+               open_loop: bool = True) -> Optional[threading.Thread]:
+        start = time.monotonic()
+        if self.admission is not None:
+            with self._inflight_lock:
+                inflight = self._inflight
+            dec = self.admission.decide(
+                rows=spec.rows(), inflight=inflight,
+                deadline_s=spec.deadline_s, priority=spec.priority,
+                adapters=spec.adapters_per_turn)
+            if not dec.admit:
+                rec["outcome"] = "shed"
+                rec["shed_reason"] = dec.reason
+                rec["wall_s"] = round(time.monotonic() - start, 4)
+                return None
+        state = {"tokens": 0, "req": None}
+
+        def on_commit(event: dict) -> None:
+            if event.get("type") == "tokens":
+                if rec["ttft_s"] is None:
+                    rec["ttft_s"] = round(
+                        time.monotonic() - start, 4)
+                state["tokens"] += len(event.get("tokens", ()))
+                rec["tokens"] = state["tokens"]
+                req = state["req"]
+                if (req is not None
+                        and spec.abandon_after_tokens is not None
+                        and state["tokens"]
+                        >= spec.abandon_after_tokens):
+                    # The client walked away: the scheduler's health
+                    # check fails the round and releases its holds.
+                    req.abandoned = True
+
+        try:
+            req = self.sched.submit_async(
+                spec.session, list(spec.turns),
+                max_new_tokens=spec.max_new_tokens,
+                timeout_s=min(timeout_s, spec.deadline_s or timeout_s),
+                adapters_per_turn=spec.adapters_per_turn,
+                on_commit=on_commit)
+        except Exception as e:  # noqa: BLE001 — refusals are sheds
+            from ..core.errors import classify_error
+            rec["outcome"] = "shed"
+            rec["shed_reason"] = getattr(e, "reason", None) \
+                or classify_error(e)
+            rec["wall_s"] = round(time.monotonic() - start, 4)
+            return None
+        state["req"] = req
+        if self.admission is not None:
+            self.admission.note_admitted()
+        with self._inflight_lock:
+            self._inflight += 1
+        _note_start(open_loop)
+
+        def waiter() -> None:
+            try:
+                req.event.wait(timeout_s)
+                rec["wall_s"] = round(time.monotonic() - start, 4)
+                if spec.abandon_after_tokens is not None \
+                        and req.abandoned:
+                    rec["outcome"] = "abandoned"
+                elif req.error is not None:
+                    rec["outcome"] = "failed"
+                    rec["error_kind"] = type(req.error).__name__
+                elif req.event.is_set():
+                    rec["outcome"] = "completed"
+                else:
+                    rec["error_kind"] = "driver_timeout"
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                _note_done(open_loop)
+
+        w = threading.Thread(target=waiter, daemon=True)
+        w.start()
+        return w
+
+
+# --- over-the-wire driver --------------------------------------------
+
+class _Conn:
+    """Minimal raw-socket HTTP/1.1 + SSE client (stdlib only; the
+    gateway speaks unframed SSE after the response head)."""
+
+    def __init__(self, port: int, method: str, path: str, *,
+                 host: str = "127.0.0.1", body: Optional[dict] = None,
+                 headers: Optional[dict] = None, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        payload = (json.dumps(body).encode("utf-8")
+                   if body is not None else b"")
+        head = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+                "Accept: text/event-stream"]
+        for k, v in (headers or {}).items():
+            head.append(f"{k}: {v}")
+        if payload:
+            head.append("Content-Type: application/json")
+            head.append(f"Content-Length: {len(payload)}")
+        raw = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+        self.sock.sendall(raw + payload)
+        self.fp = self.sock.makefile("rb")
+        status_line = self.fp.readline().decode("latin-1", "replace")
+        parts = status_line.split(None, 2)
+        self.status = int(parts[1]) if len(parts) >= 2 else 0
+        self.headers: dict[str, str] = {}
+        while True:
+            line = self.fp.readline().decode("latin-1", "replace")
+            if line in ("\r\n", "\n", ""):
+                break
+            k, _, v = line.partition(":")
+            self.headers[k.strip().lower()] = v.strip()
+
+    def body_json(self) -> dict:
+        n = int(self.headers.get("content-length", "0") or 0)
+        raw = self.fp.read(n) if n else b""
+        try:
+            return json.loads(raw.decode("utf-8", "replace") or "{}")
+        except json.JSONDecodeError:
+            return {}
+
+    def events(self):
+        """Yield (event_id, payload_dict) per SSE event until EOF."""
+        eid, data = None, []
+        while True:
+            raw = self.fp.readline()
+            if not raw:
+                return
+            line = raw.decode("utf-8", "replace").rstrip("\r\n")
+            if line.startswith("id:"):
+                eid = line[3:].strip()
+            elif line.startswith("data:"):
+                data.append(line[5:].strip())
+            elif line == "" and data:
+                joined = "\n".join(data)
+                eid_out, data = eid, []
+                if joined == "[DONE]":
+                    yield eid_out, {"type": "done"}
+                    continue
+                try:
+                    yield eid_out, json.loads(joined)
+                except json.JSONDecodeError:
+                    continue
+
+    def close(self) -> None:
+        try:
+            self.fp.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# Failure kinds a well-behaved client retries: the engine comes back
+# (supervisor restart) and greedy + journal replay regenerate the
+# round byte-identically on a fresh POST.
+RETRYABLE_KINDS = ("device_lost", "engine_dead", "restarting",
+                   "data_loss", "engine_wedged")
+
+
+class GatewayDriver:
+    """Offers traffic over the wire against a live gateway (single
+    replica or router fleet — the driver only sees the front door).
+    Failed streams walk the client retry ladder: a dropped socket
+    reconnects GET /v1/streams/<id> with the Last-Event-ID watermark
+    (up to `max_reconnects`); a stream FAILED with a retryable kind
+    (device_lost, engine restarting, ...) re-POSTs the same session
+    once the engine is back (up to `max_reposts`) — on one engine
+    there is no surviving replica to fail over to, so the failed
+    round must be resubmitted, and greedy decoding + the session
+    journal make the regenerated round exact. A chaos arm counts a
+    session LOST only when the whole ladder fails."""
+
+    def __init__(self, port: int, *, host: str = "127.0.0.1",
+                 max_reconnects: int = 8, max_reposts: int = 8):
+        self.port = port
+        self.host = host
+        self.max_reconnects = max_reconnects
+        self.max_reposts = max_reposts
+
+    def run(self, specs: list[SessionSpec], offsets: list[float], *,
+            open_loop: bool = True,
+            timeout_s: float = 120.0) -> list[dict]:
+        records: list[Optional[dict]] = [None] * len(specs)
+        if open_loop:
+            threads = []
+            t0 = time.monotonic()
+            for i, (spec, off) in enumerate(zip(specs, offsets)):
+                delay = t0 + off - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                records[i] = rec = _new_record(spec, off)
+                t = threading.Thread(
+                    target=self._client, args=(spec, rec, timeout_s,
+                                               open_loop),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+            bound = time.monotonic() + timeout_s
+            for t in threads:
+                t.join(max(bound - time.monotonic(), 0.1))
+            return [r for r in records if r is not None]
+        # Closed-loop comparison arm.
+        cursor = {"i": 0}
+        lock = threading.Lock()
+        t0 = time.monotonic()
+
+        def client_loop() -> None:
+            while True:
+                with lock:
+                    i = cursor["i"]
+                    if i >= len(specs):
+                        return
+                    cursor["i"] = i + 1
+                records[i] = rec = _new_record(
+                    specs[i], time.monotonic() - t0)
+                self._client(specs[i], rec, timeout_s, False)
+
+        threads = [threading.Thread(target=client_loop, daemon=True)
+                   for _ in range(len(offsets))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s)
+        return [r for r in records if r is not None]
+
+    # -- one session over the wire --
+
+    def _body(self, spec: SessionSpec) -> dict:
+        body: dict[str, Any] = {
+            "session": spec.session,
+            "turns": [{"knight": k, "prompt": p}
+                      for k, p in spec.turns],
+            "max_new_tokens": spec.max_new_tokens,
+            "priority": spec.priority,
+            "temperature": spec.temperature,
+        }
+        if spec.adapters_per_turn is not None:
+            body["adapters"] = spec.adapters_per_turn
+        if spec.deadline_s is not None:
+            body["deadline_s"] = spec.deadline_s
+        return body
+
+    def _client(self, spec: SessionSpec, rec: dict, timeout_s: float,
+                open_loop: bool) -> None:
+        start = time.monotonic()
+        _note_start(open_loop)
+        try:
+            self._drive(spec, rec, start, timeout_s)
+        except Exception as e:  # noqa: BLE001 — record, don't crash the run
+            rec["outcome"] = "failed"
+            rec["error_kind"] = type(e).__name__
+        finally:
+            rec["wall_s"] = round(time.monotonic() - start, 4)
+            _note_done(open_loop)
+
+    def _drive(self, spec: SessionSpec, rec: dict, start: float,
+               timeout_s: float) -> None:
+        reposts = 0
+        while True:
+            retry_kind = self._serve_once(
+                spec, rec, start, timeout_s, first=(reposts == 0))
+            if retry_kind is None:
+                return
+            reposts += 1
+            if (reposts > self.max_reposts
+                    or time.monotonic() - start > timeout_s):
+                rec["outcome"] = "failed"
+                rec["error_kind"] = retry_kind
+                return
+            # The regenerated round streams from token zero — don't
+            # double-count what the dead stream already delivered.
+            rec["tokens"] = 0
+            rec["reconnects"] += 1
+            time.sleep(min(0.5 * reposts, 2.0))
+
+    def _serve_once(self, spec: SessionSpec, rec: dict, start: float,
+                    timeout_s: float, *,
+                    first: bool) -> Optional[str]:
+        """POST + stream + GET-resume ladder. Returns None when `rec`
+        is final, or a retryable failure kind when the caller should
+        re-POST the session (engine restarting / round failed with a
+        recoverable kind)."""
+        try:
+            conn = _Conn(self.port, "POST", "/v1/discussions",
+                         host=self.host, body=self._body(spec),
+                         timeout=timeout_s)
+        except OSError:
+            return "restarting"  # front door down mid-restart
+        if conn.status != 200:
+            err = conn.body_json()
+            conn.close()
+            reason = err.get("reason") or f"http_{conn.status}"
+            if not first and reason in RETRYABLE_KINDS:
+                # An admitted session mid-retry that hits the
+                # restarting engine's refusal is NOT shed — keep
+                # knocking until the repost budget runs out.
+                return reason
+            rec["outcome"] = "shed"
+            rec["shed_reason"] = reason
+            return None
+        stream_id, last_id = None, None
+        tokens = 0
+        attempts = 0
+        while True:
+            terminal = None
+            try:
+                for eid, ev in conn.events():
+                    if eid:
+                        last_id = eid
+                    kind = ev.get("type")
+                    if kind == "stream":
+                        stream_id = ev.get("stream")
+                    elif kind == "tokens":
+                        if rec["ttft_s"] is None:
+                            rec["ttft_s"] = round(
+                                time.monotonic() - start, 4)
+                        tokens += len(ev.get("tokens", ()))
+                    elif kind == "summary":
+                        tokens += sum(
+                            len(r.get("tokens", ()))
+                            for r in ev.get("rows", {}).values())
+                    elif kind in ("retired", "failed", "done"):
+                        terminal = (kind, ev)
+                    rec["tokens"] = tokens
+                    if (spec.abandon_after_tokens is not None
+                            and tokens >= spec.abandon_after_tokens):
+                        # Mid-stream client disconnect: just drop the
+                        # socket — the gateway must clean up.
+                        conn.close()
+                        rec["outcome"] = "abandoned"
+                        return None
+                    if terminal is not None:
+                        break
+            finally:
+                conn.close()
+            if terminal is not None and terminal[0] != "failed":
+                rec["outcome"] = "completed"
+                return None
+            if terminal is not None:
+                # Terminal FAILED: reconnecting would only replay the
+                # same failed state — re-POST if the kind is one the
+                # engine recovers from, else the session is done.
+                fail_kind = terminal[1].get("kind", "unknown")
+                if fail_kind in RETRYABLE_KINDS:
+                    return fail_kind
+                rec["outcome"] = "failed"
+                rec["error_kind"] = fail_kind
+                return None
+            # Socket died without a terminal (gateway restart / pump
+            # crash): walk the resume ladder from our watermark until
+            # a reconnect serves 200 or the attempt budget runs out.
+            reconnected = False
+            while not reconnected:
+                attempts += 1
+                if stream_id is None or attempts > self.max_reconnects:
+                    rec["outcome"] = "failed"
+                    rec["error_kind"] = "disconnected"
+                    return None
+                if time.monotonic() - start > timeout_s:
+                    rec["outcome"] = "failed"
+                    rec["error_kind"] = "driver_timeout"
+                    return None
+                time.sleep(min(0.25 * attempts, 1.0))
+                rec["reconnects"] += 1
+                headers = ({"Last-Event-ID": last_id}
+                           if last_id else None)
+                try:
+                    conn = _Conn(self.port, "GET",
+                                 f"/v1/streams/{stream_id}",
+                                 host=self.host, headers=headers,
+                                 timeout=timeout_s)
+                except OSError:
+                    continue
+                if conn.status != 200:
+                    conn.close()
+                    continue
+                reconnected = True

@@ -1,0 +1,95 @@
+"""Sweep controller: ramp offered load to the refusal/shed point.
+
+One `run_point` per arrival rate — schedule from the arrival process,
+sessions from the workload mix, offered through a driver, folded into
+one frontier point (driver.summarize). `run_sweep` walks a rate ramp
+and stops once the server visibly sheds (`stop_shed_rate`), so every
+sweep records both sides of the knee without burning wall clock past
+the collapse.
+
+Determinism: the per-point workload seed is derived from (sweep seed,
+point index) — re-running the same sweep offers byte-identical traffic
+at every point, while distinct points never reuse session names (a
+reused name would look like the same session's next turn to the
+journal/affinity machinery and corrupt the measurement).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from .arrivals import ArrivalProcess
+from .driver import summarize
+from .workload import WorkloadMix
+
+# Session-name / draw-stream separation between sweep points.
+_POINT_SEED_STRIDE = 7919
+
+
+def ramp_rates(start: float, factor: float, n: int) -> list[float]:
+    """Geometric offered-load ramp: start, start*factor, ..."""
+    if start <= 0 or factor <= 1.0 or n < 1:
+        raise ValueError(
+            f"need start > 0, factor > 1, n >= 1 "
+            f"(got {start}, {factor}, {n})")
+    out, r = [], start
+    for _ in range(n):
+        out.append(round(r, 6))
+        r *= factor
+    return out
+
+
+def point_seed(seed: int, index: int) -> int:
+    return int(seed) + _POINT_SEED_STRIDE * int(index)
+
+
+def run_point(driver, process: ArrivalProcess, mix: WorkloadMix, *,
+              rate_rps: float, duration_s: float, seed: int,
+              point_index: int = 0, timeout_s: Optional[float] = None,
+              n_devices: int = 1) -> dict[str, Any]:
+    """One frontier point: offer `rate_rps` for `duration_s` and
+    summarize what came back."""
+    schedule = process.schedule(rate_rps=rate_rps,
+                                duration_s=duration_s)
+    pseed = point_seed(seed, point_index)
+    specs = [mix.draw(pseed, i) for i in range(len(schedule))]
+    t0 = time.monotonic()
+    records = driver.run(specs, schedule,
+                         open_loop=process.open_loop,
+                         timeout_s=timeout_s or (duration_s * 4 + 30))
+    wall = time.monotonic() - t0
+    point = summarize(records, offered_rps=rate_rps,
+                      duration_s=duration_s, n_devices=n_devices)
+    point["wall_s"] = round(wall, 3)
+    point["seed"] = pseed
+    return point
+
+
+def run_sweep(driver, process: ArrivalProcess, mix: WorkloadMix,
+              rates: list[float], *, duration_s: float, seed: int,
+              stop_shed_rate: float = 0.5, min_points: int = 4,
+              settle_s: float = 0.5, timeout_s: Optional[float] = None,
+              n_devices: int = 1,
+              log=None) -> list[dict[str, Any]]:
+    """Walk the ramp; stop early once the shed point is on record
+    (shed_rate >= stop_shed_rate) AND at least `min_points` points
+    were measured — the frontier needs both the flat region and the
+    collapse."""
+    points: list[dict[str, Any]] = []
+    for i, rate in enumerate(rates):
+        pt = run_point(driver, process, mix, rate_rps=rate,
+                       duration_s=duration_s, seed=seed,
+                       point_index=i, timeout_s=timeout_s,
+                       n_devices=n_devices)
+        points.append(pt)
+        if log is not None:
+            log(f"point {i}: {rate:g}/s -> admitted={pt['admitted']} "
+                f"shed={pt['shed']} ({pt['shed_rate']:.0%}) "
+                f"p95={pt['ttft_p95_s']} tok/s={pt['accepted_tok_s']}")
+        if (pt["shed_rate"] >= stop_shed_rate
+                and len(points) >= min_points):
+            break
+        if settle_s > 0:
+            time.sleep(settle_s)
+    return points

@@ -1,0 +1,173 @@
+"""Seeded workload mixes: WHAT each arriving session asks for.
+
+`WorkloadMix.draw(seed, index)` is a pure function of (mix parameters,
+seed, index) — per-index RNG streams mean draw i is identical whether
+the harness generates 10 sessions or 10,000, and identical across
+runs: the determinism contract capacity records depend on.
+
+The knobs map one-to-one onto the capacity-limiting axes the serving
+stack exposes:
+
+- heavy-tailed prompt/turn lengths (bounded Pareto) — KV pressure and
+  ragged prefill;
+- persona churn cycling MORE adapters than the LoraStore holds —
+  eviction/residency pressure (the `adapters_busy` shed signal);
+- priority + deadline mixes — the admission ladder's scaled caps and
+  deadline propagation;
+- mid-stream abandonment — clients that disconnect after a few tokens
+  (the RT-GAUGE-LEAK regression surface).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_KNIGHTS = ("galahad", "percival", "lancelot")
+
+_WORDS = ("the knights debate the session store design at the "
+          "roundtable while the grail quest waits siege banners "
+          "lances shields crowns castles heralds squires stewards "
+          "falcons ramparts scrolls oaths feasts tourneys").split()
+
+
+@dataclass(frozen=True)
+class SessionSpec:
+    """One drawn session: everything a driver needs to offer it."""
+
+    index: int
+    session: str
+    turns: list  # [(knight, prompt), ...]
+    max_new_tokens: int
+    adapters_per_turn: Optional[list] = None
+    priority: str = "normal"
+    deadline_s: Optional[float] = None
+    # Client disconnects after reading this many tokens (None = stays).
+    abandon_after_tokens: Optional[int] = None
+    temperature: float = 0.0
+
+    def rows(self) -> int:
+        return len(self.turns)
+
+
+def _pareto_int(rng: random.Random, lo: int, hi: int,
+                tail: float) -> int:
+    """Bounded Pareto draw in [lo, hi] — small values common, the tail
+    reaches hi (heavy-tailed lengths are the realistic shape; uniform
+    draws understate both KV pressure and batching raggedness)."""
+    u = rng.random()
+    n = int(lo * (1.0 - u) ** (-1.0 / tail))
+    return max(lo, min(hi, n))
+
+
+@dataclass
+class WorkloadMix:
+    """Parameterized session mix. All draws route through the per-index
+    seeded RNG in `draw` — the mix object itself holds no state."""
+
+    knights: tuple = _KNIGHTS
+    max_new_tokens: int = 8
+    # Turn count: bounded Pareto in [1, max_turns].
+    max_turns: int = 2
+    turn_tail: float = 1.6
+    # Prompt length in words: bounded Pareto in prompt_words.
+    prompt_words: tuple = (4, 32)
+    prompt_tail: float = 1.3
+    # Persona churn: with probability persona_churn, a turn carries an
+    # adapter cycled from persona_pool. A pool LARGER than the
+    # LoraStore's max_adapters is what forces eviction under load.
+    persona_pool: tuple = ()
+    persona_churn: float = 0.0
+    # Priority class weights.
+    priority_mix: dict = field(default_factory=lambda: {
+        "high": 0.1, "normal": 0.8, "low": 0.1})
+    # Fraction of sessions carrying a client deadline, drawn uniformly
+    # from deadline_range_s.
+    deadline_frac: float = 0.0
+    deadline_range_s: tuple = (10.0, 60.0)
+    # Fraction of clients that abandon mid-stream, after reading
+    # uniform(abandon_after) tokens.
+    abandon_frac: float = 0.0
+    abandon_after: tuple = (1, 4)
+
+    def draw(self, seed: int, index: int) -> SessionSpec:
+        rng = random.Random(f"workload:{seed}:{index}")
+        n_turns = _pareto_int(rng, 1, self.max_turns, self.turn_tail)
+        turns = []
+        adapters: list = []
+        for t in range(n_turns):
+            knight = self.knights[(index + t) % len(self.knights)]
+            n_words = _pareto_int(rng, self.prompt_words[0],
+                                  self.prompt_words[1],
+                                  self.prompt_tail)
+            words = [_WORDS[rng.randrange(len(_WORDS))]
+                     for _ in range(n_words)]
+            turns.append((knight, " ".join(words)))
+            if (self.persona_pool
+                    and rng.random() < self.persona_churn):
+                adapters.append(self.persona_pool[
+                    (index + t) % len(self.persona_pool)])
+            else:
+                adapters.append(None)
+        priority = self._draw_priority(rng)
+        deadline = None
+        if rng.random() < self.deadline_frac:
+            deadline = rng.uniform(*self.deadline_range_s)
+        abandon = None
+        if rng.random() < self.abandon_frac:
+            abandon = rng.randint(*self.abandon_after)
+        return SessionSpec(
+            index=index, session=f"lg{seed}-{index}", turns=turns,
+            max_new_tokens=self.max_new_tokens,
+            adapters_per_turn=(adapters if any(a is not None
+                                               for a in adapters)
+                               else None),
+            priority=priority, deadline_s=deadline,
+            abandon_after_tokens=abandon)
+
+    def draw_many(self, seed: int, n: int) -> list[SessionSpec]:
+        return [self.draw(seed, i) for i in range(n)]
+
+    def _draw_priority(self, rng: random.Random) -> str:
+        total = sum(self.priority_mix.values()) or 1.0
+        u = rng.random() * total
+        acc = 0.0
+        for name, w in sorted(self.priority_mix.items()):
+            acc += w
+            if u < acc:
+                return name
+        return "normal"
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "knights": list(self.knights),
+            "max_new_tokens": self.max_new_tokens,
+            "max_turns": self.max_turns,
+            "prompt_words": list(self.prompt_words),
+            "persona_pool": list(self.persona_pool),
+            "persona_churn": self.persona_churn,
+            "priority_mix": dict(self.priority_mix),
+            "deadline_frac": self.deadline_frac,
+            "abandon_frac": self.abandon_frac,
+        }
+
+
+def default_persona_pool(n: int = 5) -> tuple:
+    """Adapter ids for churn mixes — sized past the default LoraStore
+    capacity so residency pressure actually evicts."""
+    return tuple(f"persona{i:02d}" for i in range(n))
+
+
+def register_personas(engine, pool) -> int:
+    """Register seed-initialized personas on the engine's LoraStore
+    (no-op without one). Returns how many were registered."""
+    store = getattr(engine, "lora", None)
+    if store is None:
+        return 0
+    count = 0
+    for i, adapter in enumerate(pool):
+        if not store.resolvable(adapter):
+            store.register(adapter, {"seed": 100 + i})
+            count += 1
+    return count

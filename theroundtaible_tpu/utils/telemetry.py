@@ -836,6 +836,36 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
                     "roundtable_router_failovers_total / "
                     "roundtable_router_rolls_total{replica=...}",
     },
+    # `roundtable status --capacity` (ISSUE 19): the measured
+    # capacity frontier (CAPACITY_r19.json / the record behind
+    # ROUNDTABLE_GATEWAY_CAPACITY_FILE) joined with the live gateway
+    # ledger — commands/status.py capacity_surface() is the one
+    # builder of this shape.
+    "capacity_status": {
+        "record_path": "static (which frontier record was loaded)",
+        "knee_rate": "frontier record knee.rate (file-based; the "
+                     "sweep that produced it ran the registry live)",
+        "knee_ttft_p95_s": "frontier record knee.ttft_p95_s",
+        "measured_tok_s": "frontier record knee.accepted_tok_s",
+        "predicted_tok_s": "frontier record predicted."
+                           "decode_ceiling_tps (perfmodel roofline; "
+                           "roundtable_decode_ceiling_tps gauge when "
+                           "serving live)",
+        "gap_frac": "frontier record gap.gap_frac (span_overheads "
+                    "attribution rides gap.overheads)",
+        "derived_thresholds": "frontier record derived_thresholds "
+                              "(what admission loads through "
+                              "ROUNDTABLE_GATEWAY_CAPACITY_FILE)",
+        "points": "len(frontier record points)",
+        "live_inflight": "roundtable_gateway_inflight_streams gauge "
+                         "(series count)",
+        "live_admitted": "roundtable_gateway_admitted_total"
+                         "{reason=...} sum",
+        "live_shed": "roundtable_gateway_shed_total{reason=...} sum",
+        "record_errors":
+            "roundtable_gateway_capacity_record_errors_total "
+            "(malformed-record loud-degrade counter)",
+    },
 }
 
 
