@@ -25,6 +25,17 @@ rolling restart of replica r0 under open-loop multi-turn client load
 across the roll) plus the aggregate-tok/s scaling point at 1 and 2
 replicas — written to ROUTER_r17.json. `--smoke --replicas 2` shrinks
 it to one client and skips the scaling sweep for the CPU preflight.
+
+`--trace` (ISSUE 20) switches to the end-to-end tracing acceptance —
+TRACE_r20.json: a chaos run (device_lost cross-replica failover, then
+kill -9 + `--resume`, under concurrent streams) where every client
+request stitches to ONE on-disk trace across both process generations
+with per-leg stage sums within 5% of the leg wall and zero orphan
+legs; an open-loop loadgen sweep whose per-session records join to
+retained server-side traces with per-stage p95 attribution; and the
+SLO burn monitor staying quiet on a under-SLO baseline while firing
+exactly once on an induced breach. `--trace --smoke` shrinks it to
+one stream + one sweep point for the run_hw_window3.sh preflight.
 """
 
 from __future__ import annotations
@@ -581,6 +592,339 @@ def main_router(args) -> int:
     return 0 if meets else 1
 
 
+# --- (d) end-to-end tracing (ISSUE 20) -------------------------------
+
+
+def _leg_gap_ok(leg, frac=0.05, floor=0.02):
+    """The acceptance invariant: a leg's stage sum telescopes to its
+    wall — within 5% (or a small absolute floor for sub-second legs)."""
+    return abs(leg.get("stage_gap_s", 0.0)) <= max(
+        frac * leg.get("wall_s", 0.0), floor)
+
+
+def _trace_env(tdir):
+    return {"ROUNDTABLE_TRACE_DIR": tdir,
+            "ROUNDTABLE_TRACE_SAMPLE": "1",
+            "ROUNDTABLE_TELEMETRY": "1"}
+
+
+def run_trace_chaos(workdir, n_streams, max_new):
+    """One trace per client request across the full recovery ladder:
+    leg 1 dies with its replica (device_lost), leg 2 is the failover
+    restore on the survivor (replica_crossed), kill -9 lands between
+    legs, and leg 3 is the post-`--resume` committed replay in a NEW
+    process. Every leg is tail-retained or head-sampled at 1.0, so the
+    on-disk trace file stitches all generations."""
+    from theroundtaible_tpu.utils import tracing
+
+    jdir = os.path.join(workdir, "trace-journal")
+    tdir = os.path.join(workdir, "trace-retained")
+    env = dict(_trace_env(tdir), ROUNDTABLE_FAULTS="device_lost:1")
+    proc, port = spawn_gateway(jdir, replicas=2, extra_env=env)
+
+    clients = [{"session": f"tr{i}", "trace": None, "stream": None,
+                "tokens": 0, "failed_leg": False, "last_id": None,
+                "walls_s": []} for i in range(n_streams)]
+    try:
+        conns = []
+        t_open = time.monotonic()
+        for i, cl in enumerate(clients):
+            c = Conn(port, "POST", "/v1/discussions",
+                     body={"session": cl["session"],
+                           "max_new_tokens": max_new,
+                           "turns": [{"knight": "lancelot",
+                                      "prompt": PROMPTS[
+                                          i % len(PROMPTS)]}]})
+            assert c.status == 200
+            conns.append(c)
+        # Leg 1: read each stream to its terminal. The armed
+        # device_lost kills whichever replica dispatches next, so its
+        # streams terminate `failed` (their legs finish `interrupted`,
+        # flagged, WRITTEN); survivor streams retire clean.
+        for cl, c in zip(clients, conns):
+            it = c.events()
+            meta = json.loads(next(it)[1])
+            cl["trace"], cl["stream"] = meta["trace"], meta["stream"]
+            assert cl["trace"], "metadata event carries no trace id"
+            for eid, data in it:
+                ev = json.loads(data)
+                if ev["type"] in ("tokens", "summary"):
+                    cl["tokens"] += len(flat_tokens([(eid, ev)]))
+                    cl["last_id"] = eid
+                elif ev["type"] == "failed":
+                    cl["failed_leg"] = True
+                    break
+                elif ev["type"] == "retired":
+                    break
+            c.close()
+            cl["walls_s"].append(round(time.monotonic() - t_open, 3))
+        # Leg 2: failed clients reconnect INSIDE the same process —
+        # the router failover restores them on the survivor, which is
+        # the guaranteed replica_crossed leg. Read to retirement so
+        # the leg record flushes before the SIGKILL.
+        for cl in clients:
+            if not cl["failed_leg"]:
+                continue
+            t0, deadline = time.monotonic(), time.monotonic() + 90
+            done = False
+            while not done and time.monotonic() < deadline:
+                hdrs = ({"Last-Event-ID": cl["last_id"]}
+                        if cl["last_id"] else None)
+                try:
+                    meta2, toks2, term2 = read_stream(
+                        port, f"/v1/streams/{cl['stream']}",
+                        method="GET", headers=hdrs)
+                except (AssertionError, OSError):
+                    time.sleep(0.5)   # failover still settling
+                    continue
+                assert meta2["trace"] == cl["trace"], \
+                    "failover leg minted a NEW trace id"
+                cl["tokens"] += len(flat_tokens(toks2))
+                if toks2:
+                    cl["last_id"] = toks2[-1][0]
+                done = term2 is not None and term2["type"] == "retired"
+            assert done, f"{cl['session']} never recovered in leg 2"
+            cl["walls_s"].append(round(time.monotonic() - t0, 3))
+    finally:
+        proc.kill()   # SIGKILL between legs: kill -9 crossing
+        proc.wait(30)
+
+    # Leg 3: a NEW process resumes the journal; every client
+    # reconnects and replays its committed turn under the SAME trace.
+    proc2, port2 = spawn_gateway(jdir, resume=jdir, replicas=2,
+                                 extra_env=_trace_env(tdir))
+    try:
+        for cl in clients:
+            t0 = time.monotonic()
+            meta3, toks3, term3 = read_stream(
+                port2, f"/v1/streams/{cl['stream']}", method="GET")
+            assert term3 and term3["type"] == "retired", \
+                f"{cl['session']}: post-restart replay did not retire"
+            assert meta3["trace"] == cl["trace"], \
+                "post-restart leg minted a NEW trace id"
+            replayed = len(flat_tokens(toks3))
+            assert replayed >= cl["tokens"], \
+                f"{cl['session']}: replay lost tokens"
+            cl["walls_s"].append(round(time.monotonic() - t0, 3))
+    finally:
+        proc2.kill()
+        proc2.wait(30)
+
+    # Judge the retained traces.
+    traces = tracing.load_traces(tdir)
+    want = {cl["trace"] for cl in clients}
+    orphans = sorted(set(traces) - want)
+    stitched, gap_violations, crossed = [], [], 0
+    max_gap_frac = 0.0
+    for cl in clients:
+        legs = traces.get(cl["trace"], [])
+        for leg in legs:
+            if not _leg_gap_ok(leg):
+                gap_violations.append(
+                    {"trace": cl["trace"],
+                     "gap_s": leg.get("stage_gap_s"),
+                     "wall_s": leg.get("wall_s")})
+            if leg.get("wall_s", 0.0) > 0:
+                max_gap_frac = max(
+                    max_gap_frac, abs(leg.get("stage_gap_s", 0.0))
+                    / leg["wall_s"])
+        s = tracing.stitch(legs)
+        if "replica_crossed" in s["flags"]:
+            crossed += 1
+        stitched.append({
+            "session": cl["session"], "trace": cl["trace"],
+            "legs": s["legs"], "pids": len(s["pids"]),
+            "outcome": s["outcome"], "flags": s["flags"],
+            "wall_s": s["wall_s"], "stage_sum_s": s["stage_sum_s"],
+            "ttft_s": s["ttft_s"], "stages": s["stages"],
+            "client_leg_walls_s": cl["walls_s"],
+        })
+    # Structural orphan check: every retained trace roots in a
+    # `request` leg; later legs are `resume` joins, never new roots.
+    malformed = [
+        tid for tid, legs in traces.items()
+        if legs[0].get("kind") != "request"
+        or any(leg.get("kind") not in ("request", "resume")
+               for leg in legs)]
+    one_per_client = (
+        len(want) == n_streams
+        and all(s["legs"] >= 2 and s["pids"] >= 2 for s in stitched))
+    return {
+        "streams": n_streams,
+        "max_new_tokens": max_new,
+        "stitched": stitched,
+        "one_stitched_trace_per_client": one_per_client,
+        "replicas_crossed": crossed,
+        "stage_gap_violations": gap_violations,
+        "max_leg_gap_frac": round(max_gap_frac, 4),
+        "orphan_traces": orphans,
+        "malformed_traces": malformed,
+        "zero_orphans": not orphans and not malformed,
+        "stage_sum_within_5pct": not gap_violations,
+    }
+
+
+def run_trace_sweep(workdir, smoke):
+    """Open-loop loadgen sweep against a traced child gateway: every
+    per-session client record carries the trace id from the SSE
+    events, and joins to a server-side retained leg — the per-stage
+    p95 table attributes the sweep's TTFT tail to named stages."""
+    from theroundtaible_tpu.loadgen.arrivals import make_arrivals
+    from theroundtaible_tpu.loadgen.driver import GatewayDriver
+    from theroundtaible_tpu.loadgen.sweep import run_point
+    from theroundtaible_tpu.loadgen.workload import WorkloadMix
+    from theroundtaible_tpu.utils import tracing
+
+    jdir = os.path.join(workdir, "sweep-journal")
+    tdir = os.path.join(workdir, "sweep-retained")
+    proc, port = spawn_gateway(
+        jdir, extra_env=dict(_trace_env(tdir),
+                             ROUNDTABLE_GATEWAY_MAX_INFLIGHT="4"))
+    rates = [2.0, 6.0] if smoke else [2.0, 6.0, 12.0]
+    duration_s = 2.0 if smoke else 5.0
+    points = []
+    try:
+        mix = WorkloadMix(max_new_tokens=4, max_turns=1,
+                          prompt_words=(3, 12))
+        process = make_arrivals("poisson", 7)
+        driver = GatewayDriver(port)
+        for i, rate in enumerate(rates):
+            p = run_point(driver, process, mix, rate_rps=rate,
+                          duration_s=duration_s, seed=7,
+                          point_index=i + 1, n_devices=1)
+            points.append({
+                "offered_rps": p["offered_rps"],
+                "admitted": p["admitted"], "shed": p["shed"],
+                "ttft_p95_s": p.get("ttft_p95_s"),
+                "exemplar_traces": p.get("exemplar_traces", []),
+            })
+    finally:
+        proc.kill()
+        proc.wait(30)
+
+    legs = [leg for l in tracing.load_traces(tdir).values()
+            for leg in l]
+
+    def p95(vals):
+        if not vals:
+            return None
+        v = sorted(vals)
+        return round(v[min(int(len(v) * 0.95), len(v) - 1)], 6)
+
+    from theroundtaible_tpu.utils.tracing import STAGES
+    stage_p95 = {
+        s: p95([leg["stages"][s] for leg in legs
+                if s in leg.get("stages", {})])
+        for s in STAGES}
+    exemplars = [t for p in points for t in p["exemplar_traces"]]
+    joined = [t for t in exemplars
+              if t in {leg["trace_id"] for leg in legs}]
+    return {
+        "points": points,
+        "retained_legs": len(legs),
+        "stage_p95_s": {k: v for k, v in stage_p95.items()
+                        if v is not None},
+        "stage_gap_p95_s": p95([abs(leg.get("stage_gap_s", 0.0))
+                                for leg in legs]),
+        "exemplars_joined": f"{len(joined)}/{len(exemplars)}",
+        "exemplars_join_retained": (bool(exemplars)
+                                    and len(joined) == len(exemplars)),
+    }
+
+
+def run_burn_probe(workdir):
+    """The SLO burn monitor's two-sided acceptance in-process: quiet
+    on an under-SLO baseline, exactly one flight dump on an induced
+    sustained breach (multiwindow rule + per-window cooldown)."""
+    os.environ["ROUNDTABLE_TELEMETRY_DIR"] = os.path.join(workdir,
+                                                          "dumps")
+    from theroundtaible_tpu.utils import tracing
+
+    baseline = tracing.SloBurnMonitor(0.5, error_budget=0.05,
+                                      fast_window_s=60,
+                                      slow_window_s=600)
+    for _ in range(32):
+        baseline.note_ttft(0.01)
+    induced = tracing.SloBurnMonitor(0.001, error_budget=0.05,
+                                     fast_window_s=60,
+                                     slow_window_s=600)
+    for _ in range(32):
+        induced.note_ttft(0.4, trace_id="bench-induced")
+    return {
+        "baseline_breaches": baseline.breaches,
+        "induced_breaches": induced.breaches,
+        "induced_dump": os.path.basename(induced.last_dump_path),
+        "induced_burn": induced.burn_rates(),
+        "quiet_on_baseline": baseline.breaches == 0,
+        "fires_once_on_breach": (induced.breaches == 1
+                                 and bool(induced.last_dump_path)),
+    }
+
+
+def main_trace(args) -> int:
+    """--trace mode: TRACE_r20.json (ISSUE 20 acceptance)."""
+    import tempfile
+    n_streams = 1 if args.smoke else 3
+    max_new = 8 if args.smoke else 24
+
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="trbench-") as workdir:
+        chaos = run_trace_chaos(workdir, n_streams, max_new)
+        sweep = run_trace_sweep(workdir, args.smoke)
+        burn = run_burn_probe(workdir)
+
+    meets = (chaos["one_stitched_trace_per_client"]
+             and chaos["stage_sum_within_5pct"]
+             and chaos["zero_orphans"]
+             and chaos["replicas_crossed"] >= 1
+             and sweep["exemplars_join_retained"]
+             and burn["quiet_on_baseline"]
+             and burn["fires_once_on_breach"])
+    if not args.smoke:
+        lint = subprocess.run(
+            [sys.executable, "-m", "theroundtaible_tpu", "lint"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True)
+        meets = meets and lint.returncode == 0
+    record = {
+        "metric": "request_tracing",
+        "value": chaos["max_leg_gap_frac"],
+        "unit": "max_leg_stage_gap_frac",
+        "detail": {
+            "chaos": chaos,
+            "loadgen_sweep": sweep,
+            "slo_burn": burn,
+            "lint_exit": None if args.smoke else lint.returncode,
+            "acceptance": {
+                "criterion": "device_lost failover + kill -9 + "
+                             "--resume under concurrent streams: one "
+                             "stitched on-disk trace per client "
+                             "request across process generations, "
+                             "per-leg stage sum within 5% of the leg "
+                             "wall, zero orphan legs, >=1 "
+                             "replica_crossed leg; loadgen exemplar "
+                             "traces join retained server legs with "
+                             "per-stage p95 attribution; burn monitor "
+                             "quiet on baseline, fires once on an "
+                             "induced breach",
+                "meets": meets,
+            },
+            "cpu_wall_caveat": True,
+            "platform": "cpu",
+            "wall_s": round(time.monotonic() - t0, 1),
+        },
+    }
+    print(json.dumps(record, indent=1))
+    if args.smoke:
+        return 0 if meets else 1
+    out = args.out or os.path.join(REPO, "TRACE_r20.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out}", file=sys.stderr)
+    return 0 if meets else 1
+
+
 # --- driver ----------------------------------------------------------
 
 
@@ -591,9 +935,15 @@ def main() -> int:
     ap.add_argument("--replicas", type=int, default=1,
                     help=">1 switches to the router acceptance "
                          "(rolling restart + scaling, ROUTER_r17.json)")
+    ap.add_argument("--trace", action="store_true",
+                    help="end-to-end tracing acceptance "
+                         "(chaos stitch + sweep attribution + burn "
+                         "monitor, TRACE_r20.json)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
+    if args.trace:
+        return main_trace(args)
     if args.replicas > 1:
         return main_router(args)
     args.out = args.out or os.path.join(REPO, "GATEWAY_r16.json")

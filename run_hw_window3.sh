@@ -73,6 +73,20 @@ if ! env JAX_PLATFORMS=cpu python bench_load.py --smoke \
 fi
 echo "window3: loadgen smoke clean $(stamp)" >> "$OUT.log"
 
+# Tracing preflight (ISSUE 20): one client request must stitch to ONE
+# on-disk trace across a cross-replica failover and a kill -9 +
+# --resume restart, with per-leg stage sums telescoping to the leg
+# wall and the SLO burn monitor firing only on an induced breach —
+# broken trace propagation would leave the on-chip windows with
+# unattributable TTFT tails.
+if ! env JAX_PLATFORMS=cpu python bench_gateway.py --trace --smoke \
+    >> "$OUT.log" 2>&1; then
+  echo "window3: tracing smoke FAILED $(stamp) — fix trace" \
+       "propagation before spending a window" >> "$OUT.log"
+  exit 1
+fi
+echo "window3: tracing smoke clean $(stamp)" >> "$OUT.log"
+
 while :; do
   python - <<'PY' 2>> "$OUT.log"
 import sys

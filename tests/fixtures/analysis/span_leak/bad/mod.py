@@ -1,0 +1,18 @@
+"""Seeded RT-SPAN-LEAK violations: spans started, never ended."""
+from somewhere import telemetry
+
+
+def discarded(session):
+    telemetry.start_span("turn", session=session)  # result dropped
+
+
+def bound_but_never_ended(session):
+    sp = telemetry.start_span("turn", session=session)
+    sp.set_attr("session", session)  # attrs set, span never ended
+    return session
+
+
+class Holder:
+    def begin(self):
+        # stored on an attribute nothing in this file ever ends
+        self.span = telemetry.start_span("request")

@@ -1,0 +1,40 @@
+"""Clean twin: every start_span reaches .end() or a with-block."""
+from somewhere import telemetry
+
+
+def context_managed(session):
+    with telemetry.start_span("turn", session=session):
+        pass
+
+
+def chained():
+    telemetry.start_span("turn").end()
+
+
+def ended_in_function(session):
+    sp = telemetry.start_span("turn", session=session)
+    try:
+        return session
+    finally:
+        sp.end()
+
+
+def with_bound_name():
+    sp = telemetry.start_span("turn")
+    with sp:
+        pass
+
+
+def ownership_transferred():
+    return telemetry.start_span("request")
+
+
+class Holder:
+    """The scheduler/RequestTrace pattern: start on an attribute in
+    one method, end it in another."""
+
+    def begin(self):
+        self.span = telemetry.start_span("request")
+
+    def finish(self):
+        self.span.end("ok")

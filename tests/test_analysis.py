@@ -66,6 +66,7 @@ CASES = [
     ("RT-MARKER-REG", "marker_reg"),
     ("RT-ENV-DOC", "env_doc"),
     ("RT-SURFACE-DRIFT", "surface_drift"),
+    ("RT-SPAN-LEAK", "span_leak"),
 ]
 
 

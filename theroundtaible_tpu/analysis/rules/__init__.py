@@ -16,6 +16,7 @@ from .gauges import GaugeLeakRule
 from .locking import LockBumpRule
 from .markers import MarkerRegRule
 from .shapes import ShapeValueRule
+from .spans import SpanLeakRule
 from .surface_drift import SurfaceDriftRule
 
 ALL_RULES: tuple[type[Rule], ...] = (
@@ -26,6 +27,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     MarkerRegRule,
     EnvDocRule,
     SurfaceDriftRule,
+    SpanLeakRule,
 )
 
 RULES_BY_ID = {cls.id: cls for cls in ALL_RULES}

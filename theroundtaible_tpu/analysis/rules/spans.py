@@ -1,0 +1,117 @@
+"""RT-SPAN-LEAK — every `telemetry.start_span(...)` needs a reachable
+`.end()` (the gauge-leak lesson applied to spans, ISSUE 20).
+
+`start_span` is the explicit-lifecycle half of the span API: unlike
+`with telemetry.span(...)`, nothing ends it when the holder forgets.
+An unended span never emits its record (the duration the critical-path
+analyzer attributes), never lands in the flight ring, and leaks its
+thread-stack entry if it was entered — the trace it belongs to shows a
+hole exactly where the interesting latency went.
+
+The static check mirrors RT-GAUGE-LEAK's shape: a `start_span` call is
+fine when its result provably reaches an `.end()` or a with-block —
+
+- used as a context manager:   `with telemetry.start_span(...):`
+- directly returned:            ownership transfers to the caller
+  (the `telemetry.span()` wrapper itself does this)
+- chained:                      `telemetry.start_span(...).end()`
+- bound to a local name `x`:    some `x.end(...)` / `with x` /
+  `return x` exists in the same enclosing function
+- bound to an attribute `o.a`:  some `<anything>.a.end(...)` exists in
+  the same FILE (the scheduler starts `req.tele` at submit and ends it
+  in `_retire_finished` / `_fail_request`; `RequestTrace` starts
+  `self.span` in __init__ and ends it in `finish()`)
+
+Anything else — discarded result, name that is never ended — is a
+finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..astlint import Finding, ProjectIndex, Rule, call_name
+
+
+def _enclosing_fn(index: ProjectIndex, rel: str,
+                  node: ast.AST) -> Optional[ast.AST]:
+    fns = index.enclosing(
+        rel, node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return fns[0] if fns else index.tree(rel)
+
+
+def _name_ended(scope: ast.AST, name: str) -> bool:
+    """Does `name` reach an end within `scope`: `name.end(...)`,
+    `with name ...`, or `return name` (ownership transfer)?"""
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name):
+            return True
+        if isinstance(node, ast.withitem):
+            ctx = node.context_expr
+            if isinstance(ctx, ast.Name) and ctx.id == name:
+                return True
+        if (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == name):
+            return True
+    return False
+
+
+def _attr_ended(tree: ast.Module, attr: str) -> bool:
+    """Does any `<expr>.{attr}.end(...)` exist in the file?"""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == attr):
+            return True
+    return False
+
+
+class SpanLeakRule(Rule):
+    id = "RT-SPAN-LEAK"
+    severity = "error"
+    description = ("telemetry.start_span(...) whose span never "
+                   "reaches .end() or a with-block")
+
+    def run(self, index: ProjectIndex) -> list[Finding]:
+        out: list[Finding] = []
+        for rel in index.files():
+            tree = index.tree(rel)
+            parents = index.parents(rel)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node) == "start_span"):
+                    continue
+                parent = parents.get(node)
+                if isinstance(parent, (ast.withitem, ast.Return)):
+                    continue
+                if (isinstance(parent, ast.Attribute)
+                        and parent.attr == "end"):
+                    continue  # start_span(...).end()
+                if isinstance(parent, ast.Assign) \
+                        and len(parent.targets) == 1:
+                    target = parent.targets[0]
+                    if isinstance(target, ast.Name) and _name_ended(
+                            _enclosing_fn(index, rel, node), target.id):
+                        continue
+                    if isinstance(target, ast.Attribute) \
+                            and _attr_ended(tree, target.attr):
+                        continue
+                out.append(self.finding(
+                    rel, node.lineno,
+                    "start_span(...) result never reaches .end() or a "
+                    "with-block on any visible path — the span never "
+                    "emits its record and the trace it belongs to "
+                    "shows a hole where the latency went (the gauge-"
+                    "leak lesson applied to spans, ISSUE 20); context-"
+                    "manage it, end the bound name in this function, "
+                    "or end the attribute it is stored on somewhere "
+                    "in this file"))
+        return out

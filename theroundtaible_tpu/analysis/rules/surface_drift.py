@@ -29,6 +29,10 @@ _SURFACES = (
     # joined with live gateway series.
     ("capacity_status", "commands/status.py",
      ("function", "capacity_surface")),
+    # ISSUE 20: the SLO burn-rate view — capacity-record baseline
+    # joined with the live burn gauges and trace retention.
+    ("slo_status", "commands/status.py",
+     ("function", "slo_surface")),
 )
 
 

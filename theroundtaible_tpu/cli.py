@@ -177,6 +177,29 @@ def build_parser():
                          "per-replica liveness, session assignment, "
                          "queue/row gauges, and the router's "
                          "migration / failover / roll history")
+    st.add_argument("--slo", action="store_true",
+                    help="Render the SLO burn-rate view: the p95 TTFT "
+                         "SLO from the capacity record, live fast/slow "
+                         "burn-rate gauges against the error budget, "
+                         "breach + flight-dump counters, and trace "
+                         "retention")
+
+    tr = sub.add_parser(
+        "trace",
+        help="Inspect retained request traces: per-request critical-"
+             "path waterfalls (admission → queue → placement → prefill "
+             "→ first flush → decode) stitched across reconnects, "
+             "gateway restarts and replica failovers")
+    tr.add_argument("action", choices=["list", "show", "stages"],
+                    help="list = every retained trace; show <id> = one "
+                         "stitched trace's per-leg waterfall; stages = "
+                         "the aggregate critical-path table")
+    tr.add_argument("trace_id", nargs="?", default=None,
+                    help="Trace id (or unique prefix) for `show`")
+    tr.add_argument("--dir", dest="trace_dir", default=None,
+                    help="Trace directory (default ROUNDTABLE_TRACE_DIR "
+                         "or <telemetry dumps>/traces)")
+
     sub.add_parser("list", help="List all sessions")
     sub.add_parser("chronicle", help="Show the decision chronicle")
     sub.add_parser("decrees", help="Show the King's Decree Log")
@@ -281,7 +304,12 @@ def dispatch(args) -> int:
             health_view=getattr(args, "health", False),
             gateway_view=getattr(args, "gateway", False),
             fleet_view=getattr(args, "fleet", False),
-            capacity_view=getattr(args, "capacity", False))
+            capacity_view=getattr(args, "capacity", False),
+            slo_view=getattr(args, "slo", False))
+    if args.command == "trace":
+        from .commands.trace_cmd import trace_command
+        return trace_command(args.action, trace_id=args.trace_id,
+                             trace_dir=args.trace_dir)
     if args.command == "loadgen":
         from .commands.loadgen_cmd import loadgen_command
         return loadgen_command(smoke=args.smoke, seed=args.seed,

@@ -43,7 +43,8 @@ def status_command(project_root: Optional[str] = None,
                    health_view: bool = False,
                    gateway_view: bool = False,
                    fleet_view: bool = False,
-                   capacity_view: bool = False) -> int:
+                   capacity_view: bool = False,
+                   slo_view: bool = False) -> int:
     project_root = project_root or os.getcwd()
     if health_view:
         # Fleet health needs no session dir — it reads the live
@@ -58,6 +59,10 @@ def status_command(project_root: Optional[str] = None,
     if capacity_view:
         # Capacity frontier: file-based record vs live gateway gauges.
         return capacity_status(project_root)
+    if slo_view:
+        # SLO burn-rate view: capacity-record baseline vs live burn
+        # gauges + trace retention (ISSUE 20).
+        return slo_status(project_root)
     session = find_latest_session(project_root)
     if session is None:
         print(style.dim("\n  No sessions yet. "
@@ -295,11 +300,121 @@ def gateway_status() -> int:
         for ln in lines:
             print(style.dim(ln))
 
+    # ISSUE 20: the TTFT stage split — the former one-lump TTFT
+    # decomposed into the critical-path stages the tracer attributes,
+    # aggregated over this process's recent traces.
+    from ..utils import tracing
+    recent = [r for r in tracing.store().recent()
+              if r.get("stages")]
+    if recent:
+        any_out = True
+        agg: dict[str, list[float]] = {}
+        for r in recent:
+            for stage, dur in r["stages"].items():
+                agg.setdefault(stage, []).append(dur)
+        print(style.bold(
+            f"\n  TTFT stage split ({len(recent)} recent traces):"))
+        print(style.dim("    stage            n      mean_s       p95_s"))
+        for stage in tracing.STAGES:
+            vals = sorted(agg.get(stage, ()))
+            if not vals:
+                continue
+            p95 = vals[min(int(len(vals) * 0.95), len(vals) - 1)]
+            print(style.dim(
+                f"    {stage:<14}{len(vals):>4}"
+                f"{sum(vals) / len(vals):>12.4f}{p95:>12.4f}"))
+
     if not any_out:
         print(style.dim(
             "\n  No gateway series in this process. Run `roundtable "
             "gateway` (or drive a Gateway in-process) to populate the "
             "admission/shed ledger.\n"))
+    print("")
+    return 0
+
+
+# --- `roundtable status --slo` (ISSUE 20) ---
+
+
+def slo_surface(frontier, record_path, series) -> dict:
+    """The SLO view's machine shape: the capacity record's p95 SLO
+    baseline joined with the live burn-rate gauges and trace
+    retention. Keys are bound in telemetry.SURFACE_BINDINGS
+    ["slo_status"] (RT-SURFACE-DRIFT)."""
+    from ..utils import tracing
+
+    th = (frontier or {}).get("derived_thresholds", {})
+    p95 = float(th.get("p95_slo_s") or 0.0)
+    mon = tracing.SloBurnMonitor(
+        p95_slo_s=p95,
+        source="capacity_record" if frontier else "default")
+
+    def gauge(name: str, **labels) -> float:
+        total = 0.0
+        for key, val in series.items():
+            if key.split("{", 1)[0] != name:
+                continue
+            lb = _labels(key)
+            if any(lb.get(k) != v for k, v in labels.items()):
+                continue
+            total += val
+        return total
+
+    return {
+        "armed": mon.armed,
+        "p95_slo_s": p95,
+        "source": mon.source,
+        "record_path": record_path,
+        "error_budget": mon.error_budget,
+        "threshold": mon.threshold,
+        "burn_fast": gauge("roundtable_slo_burn_rate", window="fast"),
+        "burn_slow": gauge("roundtable_slo_burn_rate", window="slow"),
+        "breaches": gauge("roundtable_slo_breaches_total"),
+        "slo_dumps": gauge("roundtable_flight_dumps_total",
+                           trigger="slo_burn"),
+        "traces_retained": gauge("roundtable_traces_retained_total"),
+    }
+
+
+def slo_status(project_root: str) -> int:
+    """`roundtable status --slo` — the SLO burn-rate view (ISSUE 20):
+    the p95 TTFT SLO from the capacity frontier record, the live
+    fast/slow burn-rate gauges against the error budget, breach /
+    flight-dump counters, and trace retention. Live-process gauges
+    like --gateway: a fresh CLI process shows the armed baseline with
+    zero burn."""
+    from ..utils import telemetry, tracing
+
+    print(style.bold("\n  SLO burn rate"))
+    path, frontier = _find_capacity_record(project_root)
+    series = telemetry.REGISTRY.snapshot_compact()
+    surf = slo_surface(frontier, path, series)
+
+    armed = ("armed" if surf["armed"]
+             else "DISARMED (no p95 SLO — sweep a capacity record)")
+    print(style.dim(
+        f"    {armed}  p95_slo_s={surf['p95_slo_s']:g}  "
+        f"source={surf['source']}"))
+    if surf["record_path"]:
+        print(style.dim(f"    record: {surf['record_path']}"))
+    print(style.bold("\n  Burn (bad-fraction / error budget):"))
+    print(style.dim(
+        f"    fast={surf['burn_fast']:g}  slow={surf['burn_slow']:g}  "
+        f"budget={surf['error_budget']:g}  "
+        f"fires at >{surf['threshold']:g} on BOTH windows"))
+    print(style.bold("\n  Incidents:"))
+    print(style.dim(
+        f"    breaches={surf['breaches']:g}  "
+        f"slo_burn flight dumps={surf['slo_dumps']:g}  "
+        f"traces retained={surf['traces_retained']:g}"))
+    recent = [r for r in tracing.store().recent()
+              if "slo_violation" in r.get("flags", ())]
+    if recent:
+        print(style.bold("\n  Recent SLO-violating traces:"))
+        for r in recent[-5:]:
+            print(style.dim(
+                f"    {r['trace_id']}  ttft={r.get('ttft_s', 0):g}s  "
+                f"{r.get('session', '')}"))
     print("")
     return 0
 

@@ -1,0 +1,158 @@
+"""`roundtable trace` — inspect retained request traces (ISSUE 20).
+
+Three views over the tail-retained trace files the serving stack
+appends under `tracing.trace_dir()` (one JSONL file per trace id, one
+row per finished leg — a kill -9'd gateway's resume leg lands in the
+SAME file, so a trace stitches across process generations):
+
+- `trace list`           — every retained trace, newest last: outcome,
+                           wall, TTFT, flags, leg/pid counts.
+- `trace show <id>`      — one stitched trace: per-leg waterfall with
+                           the critical-path stages as proportional
+                           bars, flags, and the stage-sum-vs-wall gap.
+- `trace stages`         — the aggregate critical-path table across
+                           every retained trace: per-stage n / mean /
+                           p95 / share of total attributed time — the
+                           "where does TTFT go" answer.
+
+File-based like `status --capacity`: works from a fresh CLI process
+against whatever directory the serving process retained into
+(ROUNDTABLE_TRACE_DIR or <telemetry dumps>/traces).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import tracing
+from ..utils.ui import style
+
+_BAR_WIDTH = 32
+
+
+def trace_command(action: str, trace_id: Optional[str] = None,
+                  trace_dir: Optional[str] = None) -> int:
+    traces = tracing.load_traces(trace_dir)
+    where = trace_dir or tracing.trace_dir()
+    if action == "list":
+        return _list(traces, where)
+    if action == "stages":
+        return _stages(traces, where)
+    if action == "show":
+        if not trace_id:
+            print(style.red("  trace show needs a trace id "
+                            "(see `roundtable trace list`)"))
+            return 1
+        return _show(traces, trace_id, where)
+    print(style.red(f"  unknown trace action {action!r}"))
+    return 1
+
+
+def _empty(where) -> int:
+    print(style.dim(
+        f"\n  No retained traces under {where}. Serve with "
+        "ROUNDTABLE_TELEMETRY=1 (head-sampling via "
+        "ROUNDTABLE_TRACE_SAMPLE; shed/failed/hung/SLO-violating "
+        "traces are always retained).\n"))
+    return 0
+
+
+def _list(traces: dict[str, list[dict]], where) -> int:
+    if not traces:
+        return _empty(where)
+    print(style.bold(f"\n  Retained traces ({len(traces)}) — {where}"))
+    print(style.dim(
+        "    trace             outcome        wall_s   ttft_s  legs"
+        "  flags"))
+    stitched = sorted(
+        ((tracing.stitch(legs), legs) for legs in traces.values()),
+        key=lambda pair: pair[1][0].get("start", 0.0))
+    for s, legs in stitched:
+        ttft = s.get("ttft_s")
+        flags = ",".join(s["flags"]) or "-"
+        line = (f"    {s['trace_id']:<16}  {s['outcome']:<12} "
+                f"{s['wall_s']:>8.3f} "
+                f"{ttft if ttft is None else f'{ttft:8.3f}':>8}"
+                f"  {len(legs):>4}  {flags}")
+        print(style.red(line) if "failed" in s["outcome"]
+              or "hung" in s["flags"] else style.dim(line))
+    print("")
+    return 0
+
+
+def _show(traces: dict[str, list[dict]], trace_id: str, where) -> int:
+    legs = traces.get(trace_id)
+    if legs is None:
+        # Prefix match — ids are long; operators paste the head.
+        hits = [t for t in traces if t.startswith(trace_id)]
+        if len(hits) == 1:
+            trace_id, legs = hits[0], traces[hits[0]]
+    if legs is None:
+        print(style.red(f"  no retained trace {trace_id!r} under "
+                        f"{where} (try `roundtable trace list`)"))
+        return 1
+    s = tracing.stitch(legs)
+    print(style.bold(f"\n  Trace {trace_id}"))
+    print(style.dim(
+        f"    session={s.get('session', '')}  outcome={s['outcome']}  "
+        f"legs={len(legs)}  pids={','.join(str(p) for p in s['pids'])}"
+        + (f"  flags={','.join(s['flags'])}" if s["flags"] else "")))
+    gap = s["wall_s"] - s["stage_sum_s"]
+    print(style.dim(
+        f"    wall={s['wall_s']:.3f}s  stage_sum={s['stage_sum_s']:.3f}s"
+        f"  gap={gap:.3f}s"
+        + (f"  ttft={s['ttft_s']:.3f}s"
+           if s.get("ttft_s") is not None else "")))
+    for i, leg in enumerate(legs):
+        _waterfall(i, leg)
+    print("")
+    return 0
+
+
+def _waterfall(i: int, leg: dict) -> None:
+    stages = leg.get("stages", {})
+    total = sum(stages.values()) or 1e-9
+    print(style.bold(
+        f"\n    leg {i} [{leg.get('kind', '?')}] pid={leg.get('pid')}"
+        f"  outcome={leg.get('outcome')}  wall={leg.get('wall_s', 0):g}s"
+        + (f"  reconnects={leg['reconnects']}"
+           if leg.get("reconnects") else "")))
+    offset = 0.0
+    for stage in tracing.STAGES:
+        dur = stages.get(stage)
+        if dur is None:
+            continue
+        # Proportional waterfall: indent = time before this stage,
+        # bar = this stage's share of the leg's attributed time.
+        lead = int(_BAR_WIDTH * offset / total)
+        width = max(int(_BAR_WIDTH * dur / total), 1)
+        print(style.dim(
+            f"      {stage:<14} {dur:>9.4f}s  "
+            + " " * lead + "█" * width))
+        offset += dur
+
+
+def _stages(traces: dict[str, list[dict]], where) -> int:
+    if not traces:
+        return _empty(where)
+    agg: dict[str, list[float]] = {}
+    for legs in traces.values():
+        for leg in legs:
+            for stage, dur in leg.get("stages", {}).items():
+                agg.setdefault(stage, []).append(dur)
+    grand = sum(sum(v) for v in agg.values()) or 1e-9
+    print(style.bold(
+        f"\n  Critical path across {len(traces)} traces — {where}"))
+    print(style.dim(
+        "    stage            n       mean_s        p95_s   share"))
+    for stage in tracing.STAGES:
+        vals = sorted(agg.get(stage, ()))
+        if not vals:
+            continue
+        p95 = vals[min(int(len(vals) * 0.95), len(vals) - 1)]
+        share = sum(vals) / grand
+        print(style.dim(
+            f"    {stage:<14}{len(vals):>5}{sum(vals) / len(vals):>13.4f}"
+            f"{p95:>13.4f}{share * 100:>7.1f}%"))
+    print("")
+    return 0

@@ -2678,9 +2678,15 @@ class SessionScheduler:
             if len(ids) <= r.streamed:
                 continue
             new = ids[r.streamed:]
+            # queue_wait_s rides every tokens event (ISSUE 20): the
+            # gateway's critical-path trace carves the scheduler queue
+            # wait out of its submit→first-token lump, so the TTFT
+            # waterfall separates "waiting for a slot" from prefill.
             self._stream_notify(req, {
                 "type": "tokens", "row": i, "knight": req.turns[i][0],
-                "tokens": new, "done": r.done})
+                "tokens": new, "done": r.done,
+                "queue_wait_s": round(
+                    (req.admitted_at or req.enqueued) - req.enqueued, 3)})
             if req.on_commit is None:
                 return  # callback died mid-flush
             r.streamed = len(ids)

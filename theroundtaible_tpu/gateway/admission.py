@@ -41,7 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ..engine import deadlines
-from ..utils import telemetry
+from ..utils import telemetry, tracing
 
 _PRIORITY_SCALE = {"high": 1.0, "normal": 1.0, "low": 0.5}
 
@@ -242,6 +242,12 @@ class AdmissionController:
         self.shed = 0
         self.expired = 0
         self.queued = 0
+        # SLO burn-rate monitor (ISSUE 20): every TTFT sample and shed
+        # this controller sees also feeds the multiwindow burn rate
+        # against the capacity-record SLO — the PR-19 frontier becomes
+        # a live alerting baseline instead of a one-shot bench artifact.
+        self.slo = tracing.SloBurnMonitor(p95_slo_s=self.p95_slo_s,
+                                          source=th.source)
 
     # -- accounting (single writer for counters + registry) --
 
@@ -254,11 +260,22 @@ class AdmissionController:
         else:
             telemetry.inc(f"roundtable_gateway_{outcome}_total",
                           reason=reason)
+        if outcome == "shed":
+            # Sheds are budget-burning events regardless of the SLO
+            # being armed — both burn windows see them.
+            self.slo.note_shed()
 
-    def note_ttft(self, seconds: float) -> None:
+    def note_ttft(self, seconds: float, trace_id: str = "") -> None:
+        """One writer for every TTFT surface: the p95 shed window, the
+        roundtable_gateway_ttft_seconds histogram (with a trace-id
+        exemplar so a bad bucket links to a concrete trace), and the
+        SLO burn monitor."""
         self._ttfts.append(seconds)
         if len(self._ttfts) > 256:
             del self._ttfts[:-256]
+        telemetry.observe("roundtable_gateway_ttft_seconds", seconds,
+                          exemplar=trace_id or None)
+        self.slo.note_ttft(seconds, trace_id)
 
     def p95_ttft(self) -> Optional[float]:
         if len(self._ttfts) < 8:
@@ -273,6 +290,29 @@ class AdmissionController:
                deadline_s: Optional[float] = None,
                priority: str = "normal",
                adapters: Optional[list] = None) -> Decision:
+        """The decision ladder, wrapped in an `admission` span (armed
+        telemetry only) recording the signal that decided — the trace
+        waterfall's first stage. Callers put the request trace on the
+        thread stack (telemetry.attached) so the span parents to it."""
+        if not telemetry.ACTIVE:
+            return self._decide(rows=rows, inflight=inflight,
+                                deadline_s=deadline_s,
+                                priority=priority, adapters=adapters)
+        with telemetry.span("admission", rows=rows, inflight=inflight,
+                            priority=priority) as sp:
+            dec = self._decide(rows=rows, inflight=inflight,
+                               deadline_s=deadline_s,
+                               priority=priority, adapters=adapters)
+            sp.set_attr("admit", dec.admit)
+            sp.set_attr("signal", dec.reason)
+            if not dec.admit:
+                sp.set_attr("status", dec.status)
+            return dec
+
+    def _decide(self, *, rows: int, inflight: int,
+                deadline_s: Optional[float] = None,
+                priority: str = "normal",
+                adapters: Optional[list] = None) -> Decision:
         src = self.source
         scale = _PRIORITY_SCALE.get(priority, 1.0)
 
@@ -374,6 +414,7 @@ class AdmissionController:
                 "source": self.thresholds.source,
                 "record_path": self.thresholds.record_path,
             },
+            "slo": self.slo.describe(),
         }
 
 

@@ -39,7 +39,7 @@ from ..engine import deadlines
 from ..engine.sampling import SamplingParams
 from ..engine.scheduler import DeadlineExpired, SchedulerClosed, \
     SchedulerRefused
-from ..utils import telemetry
+from ..utils import telemetry, tracing
 from .admission import AdmissionController, Decision, _env_float, \
     _env_int, make_budget
 from .http import HttpError, Request, SseWriter, read_request, \
@@ -58,9 +58,13 @@ _FAILOVER_KINDS = {"device_lost", "engine_dead", "restarting",
 
 
 class _Shed(Exception):
-    def __init__(self, decision: Decision):
+    def __init__(self, decision: Decision, trace_id: str = ""):
         super().__init__(decision.reason)
         self.decision = decision
+        # Echoed on the shed payload (ISSUE 20): a shed request still
+        # has a trace — tail retention keeps it, and the client can
+        # quote the id.
+        self.trace_id = trace_id
 
 
 class Gateway:
@@ -187,6 +191,11 @@ class Gateway:
                 telemetry.REGISTRY.remove_gauge(
                     "roundtable_gateway_inflight_streams",
                     **self._stream_labels(st))
+                if st.trace is not None:
+                    # A leg cut off by shutdown is an anomaly worth
+                    # keeping: flag → tail retention.
+                    st.trace.flag("interrupted")
+                    st.trace.finish("interrupted")
 
     # ------------------------------------------------------------------
     # observability
@@ -209,6 +218,11 @@ class Gateway:
             "sessions": len(self.streams),
             "host": self.host,
             "port": self.port,
+            "slo": adm.slo.describe(),
+            "tracing": {
+                "retained": tracing.store().retained,
+                "sample_rate": tracing.sample_rate(),
+            },
         }
         if self.router is not None:
             out["replicas"] = self.router.describe()
@@ -255,14 +269,21 @@ class Gateway:
                 await self._route(req, writer)
         except _Shed as s:
             d = s.decision
-            await send_json(writer, d.status, {
+            payload = {
                 "error": f"request shed: {d.reason}",
                 "reason": d.reason,
-            }, {"Retry-After": f"{max(int(d.retry_after_s), 1)}"})
+            }
+            headers = {"Retry-After": f"{max(int(d.retry_after_s), 1)}"}
+            if s.trace_id:
+                payload["trace"] = s.trace_id
+                headers["Traceparent"] = tracing.format_traceparent(
+                    s.trace_id)
+            await send_json(writer, d.status, payload, headers)
         except HttpError as e:
             try:
                 await self._send_error(writer, e.status, str(e),
-                                       e.reason)
+                                       e.reason,
+                                       getattr(e, "trace_id", ""))
             except (ConnectionError, RuntimeError):
                 pass
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -281,17 +302,27 @@ class Gateway:
                 pass
 
     async def _send_error(self, writer: asyncio.StreamWriter,
-                          status: int, error: str, kind: str) -> None:
+                          status: int, error: str, kind: str,
+                          trace_id: str = "") -> None:
         """Error the connection WITHOUT corrupting the protocol: once
         an SSE head has been written (the pump path failed late), a
         fresh HTTP status line would land mid-stream as malformed
-        bytes — emit a terminal `failed` SSE event instead."""
+        bytes — emit a terminal `failed` SSE event instead. The trace
+        id (when the failure happened after one existed) rides every
+        error payload so a failure always names its trace."""
         if getattr(writer, "_sse_opened", False):
-            await SseWriter(writer).event(
-                {"type": "failed", "error": error, "kind": kind})
+            payload = {"type": "failed", "error": error, "kind": kind}
+            if trace_id:
+                payload["trace"] = trace_id
+            await SseWriter(writer).event(payload)
         else:
-            await send_json(writer, status,
-                            {"error": error, "reason": kind})
+            payload = {"error": error, "reason": kind}
+            headers = None
+            if trace_id:
+                payload["trace"] = trace_id
+                headers = {"Traceparent": tracing.format_traceparent(
+                    trace_id)}
+            await send_json(writer, status, payload, headers)
 
     async def _route(self, req: Request,
                      writer: asyncio.StreamWriter) -> None:
@@ -373,34 +404,69 @@ class Gateway:
                        deadline_s: Optional[float], priority: str,
                        adapters: Optional[list], kind: str,
                        temperature: float = 0.0,
-                       record_intent: bool = True) -> StreamState:
-        dec = self.admission.decide(
-            rows=len(turns), inflight=self._inflight(),
-            deadline_s=deadline_s, priority=priority, adapters=adapters)
-        if not dec.admit:
-            raise _Shed(dec)
-        stream_id = uuid.uuid4().hex[:16]
-        journal = self.sched.journal
-        last = journal.last_turn(session) if journal is not None else None
-        turn = 0 if last is None else last + 1
-        state = StreamState(stream_id, session,
-                            [k for k, _p in turns], turn,
-                            buffer_cap=self.sse_buffer)
-        if record_intent and self.intents is not None:
-            rec = self.intents.record(
-                stream_id, session=session,
-                knights=[k for k, _p in turns],
-                prompts=[p for _k, p in turns], turn=turn,
-                max_new=max_new, deadline_s=deadline_s, kind=kind,
-                adapters=adapters, temperature=temperature)
-            if rec is not None:
-                self._intent_cache[stream_id] = rec
-        self._submit_state(state, turns, max_new=max_new,
-                           deadline_s=deadline_s, adapters=adapters,
-                           temperature=temperature)
-        self.admission.note_admitted(
-            queued=dec.queued, replica=getattr(state, "replica", None))
-        return state
+                       record_intent: bool = True,
+                       traceparent: Optional[str] = None) -> StreamState:
+        # One trace per client request (ISSUE 20): join the client's
+        # traceparent when one parses, mint a root otherwise. The
+        # RequestTrace is the critical-path clock; its span (armed
+        # telemetry) is the parent everything downstream hangs off.
+        tp = tracing.parse_traceparent(traceparent)
+        trace = tracing.RequestTrace(
+            tp[0] if tp else None,
+            parent_span_id=tp[1] if tp else "",
+            kind="request", session=session, endpoint=kind,
+            priority=priority, rows=len(turns))
+        try:
+            with telemetry.attached(trace.context()):
+                dec = self.admission.decide(
+                    rows=len(turns), inflight=self._inflight(),
+                    deadline_s=deadline_s, priority=priority,
+                    adapters=adapters)
+            if not dec.admit:
+                raise _Shed(dec)
+            trace.stage("admission")
+            stream_id = uuid.uuid4().hex[:16]
+            trace.stream_id = stream_id
+            if trace.span is not None:
+                trace.span.set_attr("stream", stream_id)
+            journal = self.sched.journal
+            last = journal.last_turn(session) \
+                if journal is not None else None
+            turn = 0 if last is None else last + 1
+            state = StreamState(stream_id, session,
+                                [k for k, _p in turns], turn,
+                                buffer_cap=self.sse_buffer)
+            state.trace = trace
+            if record_intent and self.intents is not None:
+                rec = self.intents.record(
+                    stream_id, session=session,
+                    knights=[k for k, _p in turns],
+                    prompts=[p for _k, p in turns], turn=turn,
+                    max_new=max_new, deadline_s=deadline_s, kind=kind,
+                    adapters=adapters, temperature=temperature,
+                    trace=trace.trace_id)
+                if rec is not None:
+                    self._intent_cache[stream_id] = rec
+            self._submit_state(state, turns, max_new=max_new,
+                               deadline_s=deadline_s, adapters=adapters,
+                               temperature=temperature)
+            trace.stage("placement")
+            trace.replica = getattr(state, "replica", None)
+            self.admission.note_admitted(
+                queued=dec.queued,
+                replica=getattr(state, "replica", None))
+            return state
+        except _Shed as s:
+            trace.flag("shed")
+            trace.finish(f"shed:{s.decision.reason}",
+                         tail_stage="admission")
+            s.trace_id = trace.trace_id
+            raise
+        except HttpError as e:
+            trace.flag("failed")
+            trace.finish(f"error:{e.reason}", tail_stage="admission")
+            e.trace_id = trace.trace_id
+            raise
 
     def _submit_state(self, state: StreamState,
                       turns: list[tuple[str, Any]], *, max_new: int,
@@ -423,49 +489,60 @@ class Gateway:
             except RuntimeError:
                 pass
 
-        try:
-            sched, replica = self._sched_for(state.session, adapters)
-        except Exception as e:  # noqa: BLE001 — NoLiveReplica et al.
-            self.admission.note_shed("engine_dead")
-            raise _Shed(Decision(False, "engine_dead", 503,
-                                 4 * self.admission.retry_after_s)) \
-                from e
-        state.replica = replica
-        sampling = [SamplingParams(temperature=temperature,
-                                   max_new_tokens=max_new)
-                    for _ in turns]
-        timeout_s = deadline_s if deadline_s else 600.0
-        try:
-            req = sched.submit_async(
-                state.session, turns, max_new_tokens=max_new,
-                timeout_s=timeout_s, sampling_per_turn=sampling,
-                budget=make_budget(deadline_s),
-                adapters_per_turn=adapters, on_commit=on_commit,
-                queue_when_paused=False)
-        except DeadlineExpired as e:
-            self.admission._count("expired", "deadline_expired")
-            raise HttpError(408, str(e), "deadline_expired")
-        except deadlines.DrainingError as e:
-            self.admission.note_shed("draining", replica=replica)
-            raise _Shed(Decision(False, "draining", 503,
-                                 self.admission.retry_after_s)) from e
-        except SchedulerRefused as e:
-            reason = e.reason or "refused"
-            self.admission.note_shed(reason, replica=replica)
-            status = 503 if reason in ("fleet.drain", "quiesce") else 429
-            raise _Shed(Decision(False, reason, status,
-                                 self.admission.retry_after_s)) from e
-        except SchedulerClosed as e:
-            self.admission.note_shed("closed", replica=replica)
-            raise _Shed(Decision(False, "closed", 503,
-                                 self.admission.retry_after_s)) from e
-        except Exception as e:  # noqa: BLE001 — classify dead engines etc.
-            from ..core.errors import classify_error
-            kind = classify_error(e)
-            self.admission.note_shed(kind, replica=replica)
-            raise _Shed(Decision(False, kind, 503,
-                                 4 * self.admission.retry_after_s)) \
-                from e
+        # Placement + submit run under the request trace's context
+        # (ISSUE 20): the router's placement span and the scheduler's
+        # tele_ctx capture (engine/scheduler.py submit) both read the
+        # thread-local stack, so the whole engine-side span tree joins
+        # this trace with zero signature changes.
+        ctx = state.trace.context() if state.trace is not None else None
+        with telemetry.attached(ctx):
+            try:
+                sched, replica = self._sched_for(state.session, adapters)
+            except Exception as e:  # noqa: BLE001 — NoLiveReplica et al.
+                self.admission.note_shed("engine_dead")
+                raise _Shed(Decision(False, "engine_dead", 503,
+                                     4 * self.admission.retry_after_s)) \
+                    from e
+            state.replica = replica
+            sampling = [SamplingParams(temperature=temperature,
+                                       max_new_tokens=max_new)
+                        for _ in turns]
+            timeout_s = deadline_s if deadline_s else 600.0
+            try:
+                req = sched.submit_async(
+                    state.session, turns, max_new_tokens=max_new,
+                    timeout_s=timeout_s, sampling_per_turn=sampling,
+                    budget=make_budget(deadline_s),
+                    adapters_per_turn=adapters, on_commit=on_commit,
+                    queue_when_paused=False)
+            except DeadlineExpired as e:
+                self.admission._count("expired", "deadline_expired")
+                raise HttpError(408, str(e), "deadline_expired")
+            except deadlines.DrainingError as e:
+                self.admission.note_shed("draining", replica=replica)
+                raise _Shed(Decision(False, "draining", 503,
+                                     self.admission.retry_after_s)) \
+                    from e
+            except SchedulerRefused as e:
+                reason = e.reason or "refused"
+                self.admission.note_shed(reason, replica=replica)
+                status = 503 if reason in ("fleet.drain", "quiesce") \
+                    else 429
+                raise _Shed(Decision(False, reason, status,
+                                     self.admission.retry_after_s)) \
+                    from e
+            except SchedulerClosed as e:
+                self.admission.note_shed("closed", replica=replica)
+                raise _Shed(Decision(False, "closed", 503,
+                                     self.admission.retry_after_s)) \
+                    from e
+            except Exception as e:  # noqa: BLE001 — classify dead engines etc.
+                from ..core.errors import classify_error
+                kind = classify_error(e)
+                self.admission.note_shed(kind, replica=replica)
+                raise _Shed(Decision(False, kind, 503,
+                                     4 * self.admission.retry_after_s)) \
+                    from e
         # Keep the request handle: abandonment (client disconnected,
         # nobody reconnected within abandon_s) flips req.abandoned and
         # the scheduler's health check releases the round's LoRA refs,
@@ -479,10 +556,40 @@ class Gateway:
     def _on_stream_event(self, state: StreamState, event: dict) -> None:
         """Asyncio-loop side of the scheduler's on_commit bridge."""
         first = not any(state.history) and event.get("type") == "tokens"
+        trace = state.trace
+        if first and trace is not None:
+            # Everything since placement was the submit→first-token
+            # lump; the scheduler reports its share of that lump spent
+            # queued (queue_wait_s on the event), which is carved out
+            # so the waterfall separates waiting from prefill.
+            trace.stage("prefill")
+            trace.carve("prefill", "queue_wait",
+                        event.get("queue_wait_s"))
         state.on_commit_event(event)
         if first:
-            self.admission.note_ttft(time.monotonic() - state.created)
+            if trace is not None:
+                # TTFT = the stage sum through first_flush — the SAME
+                # number the trace waterfall shows, so the admission
+                # SLO signal and the trace can never disagree (the old
+                # code lumped time.monotonic() - state.created).
+                trace.stage("first_flush")
+                ttft = trace.ttft()
+                slo = self.admission.p95_slo_s
+                if slo and ttft > slo:
+                    trace.flag("slo_violation")
+                self.admission.note_ttft(ttft,
+                                         trace_id=trace.trace_id)
+            else:
+                self.admission.note_ttft(
+                    time.monotonic() - state.created)
         if state.done:
+            if trace is not None:
+                if state.failed is not None:
+                    trace.flag("failed")
+                    trace.finish(
+                        f"failed:{state.failed.get('kind', 'unknown')}")
+                else:
+                    trace.finish("ok")
             # Stream finished (retired or failed): its per-request
             # gauge series dies NOW — a long-lived gateway must not
             # keep one series per stream ever served (RT-GAUGE-LEAK).
@@ -568,19 +675,26 @@ class Gateway:
         state = self._submit_stream(
             session=session, turns=[(knight, prompt)], max_new=max_new,
             deadline_s=deadline_s, priority=priority, adapters=None,
-            kind="chat", temperature=temperature)
+            kind="chat", temperature=temperature,
+            traceparent=req.header("traceparent"))
         consumer = state.attach()
         if body.get("stream"):
             await self._pump_chat(writer, state, consumer)
         else:
+            trace_id = state.trace.trace_id \
+                if state.trace is not None else ""
             try:
                 failed = await self._await_done(consumer, deadline_s)
             finally:
                 self._release_consumer(state, consumer)
             if failed is not None:
-                raise HttpError(500, failed.get("error", "failed"),
+                err = HttpError(500, failed.get("error", "failed"),
                                 failed.get("kind", "unknown"))
+                err.trace_id = trace_id
+                raise err
             text = self._decode(state.history[0])
+            headers = {"Traceparent": tracing.format_traceparent(
+                trace_id)} if trace_id else None
             await send_json(writer, 200, {
                 "id": f"chatcmpl-{state.stream_id}",
                 "object": "chat.completion",
@@ -591,7 +705,7 @@ class Gateway:
                                          "content": text},
                              "finish_reason": "stop"}],
                 "usage": {"completion_tokens": len(state.history[0])},
-            })
+            }, headers)
 
     async def _await_done(self, consumer,
                           deadline_s: Optional[float]) -> Optional[dict]:
@@ -600,8 +714,14 @@ class Gateway:
         bound = time.monotonic() + (deadline_s or 600.0) + 60.0
         while not consumer.finished():
             if time.monotonic() > bound:
-                raise HttpError(500, "stream never finished",
+                tr = consumer.state.trace
+                err = HttpError(500, "stream never finished",
                                 "gateway_wedged")
+                if tr is not None:
+                    tr.flag("hung")
+                    tr.finish("hung")
+                    err.trace_id = tr.trace_id
+                raise err
             for ev in await consumer.next_events(self.keepalive_s):
                 if ev["type"] == "failed":
                     return {"error": ev.get("error", ""),
@@ -639,7 +759,8 @@ class Gateway:
             session=session, turns=turns, max_new=max_new,
             deadline_s=deadline_s, priority=priority,
             adapters=adapters, kind="native",
-            temperature=float(body.get("temperature") or 0.0))
+            temperature=float(body.get("temperature") or 0.0),
+            traceparent=req.header("traceparent"))
         consumer = state.attach()
         await self._pump_native(writer, state, consumer)
 
@@ -651,6 +772,7 @@ class Gateway:
                          writer: asyncio.StreamWriter,
                          stream_id: str) -> None:
         state = self.streams.get(stream_id)
+        crossed = False
         if (state is not None and state.failed is not None
                 and self.router is not None
                 and state.failed.get("kind") in _FAILOVER_KINDS):
@@ -661,8 +783,18 @@ class Gateway:
             # client's Last-Event-ID skips what it already saw.
             self.streams.pop(stream_id, None)
             state = None
+            crossed = True
         if state is None:
-            state = self._restore_stream(stream_id)
+            state = self._restore_stream(stream_id, crossed=crossed)
+        elif state.trace is not None:
+            # Live-stream rejoin (ladder leg 1): same trace, counted,
+            # marked with a follow-on `resume` span so the waterfall
+            # shows the reconnect without starting a new leg clock.
+            state.trace.reconnects += 1
+            with telemetry.span("resume", parent=state.trace.context(),
+                                stream=stream_id,
+                                session=state.session, live=True):
+                pass
         watermark = [0] * len(state.knights)
         leid = req.header("last-event-id")
         if leid:
@@ -674,28 +806,42 @@ class Gateway:
         telemetry.inc("roundtable_gateway_resumed_streams_total")
         await self._pump_native(writer, state, consumer)
 
-    def _restore_stream(self, stream_id: str) -> StreamState:
+    def _restore_stream(self, stream_id: str,
+                        crossed: bool = False) -> StreamState:
         """Post-restart reconnect: rebuild the stream from the intent
         journal — from the committed turn when the round finished
-        before the crash, by greedy re-generation otherwise."""
+        before the crash, by greedy re-generation otherwise. The
+        restore leg REJOINS the original trace (the intent record
+        carries its id), so one client request stays one stitched
+        trace across kill -9 and failover; `crossed` marks a leg that
+        moved replicas (always tail-retained)."""
         intent = self._intent_cache.get(stream_id)
         if intent is None:
             raise HttpError(404, f"unknown stream {stream_id!r}",
                             "unknown_stream")
         session = intent["session"]
         knights = intent["knights"]
+        trace = tracing.RequestTrace(
+            intent.get("trace") or None, kind="resume",
+            stream=stream_id, session=session,
+            endpoint=str(intent.get("kind", "native")))
+        if crossed:
+            trace.flag("replica_crossed")
         state = StreamState(stream_id, session, knights,
                             intent["turn"], buffer_cap=self.sse_buffer)
+        state.trace = trace
         rows = committed_rows(self.sched.journal, session,
                               intent["turn"])
         if rows is not None:
             # Leg 2: the round committed before the crash — serve
-            # straight from the durable record, no recompute.
+            # straight from the durable record, no recompute. The leg
+            # is pure replay: its whole (tiny) wall is resume_replay.
             for i, row in enumerate(rows[:len(knights)]):
                 state.history[i] = [int(t) for t in
                                     row.get("produced", [])]
             state.done = True
             self.streams[stream_id] = state
+            trace.finish("ok", tail_stage="resume_replay")
         else:
             # Leg 3: crash mid-round — greedy re-generation over the
             # replayed KV produces the identical token stream; the
@@ -705,16 +851,41 @@ class Gateway:
             # client's watermark (silent corruption).
             temperature = float(intent.get("temperature") or 0.0)
             if temperature > 0.0:
-                raise HttpError(
+                err = HttpError(
                     409, f"stream {stream_id!r} was sampled "
                     "(temperature > 0) and its turn never committed — "
                     "post-crash regeneration cannot be byte-identical; "
                     "start a new request", "nondeterministic_stream")
+                trace.flag("failed")
+                trace.finish("nondeterministic_stream",
+                             tail_stage="resume_replay")
+                err.trace_id = trace.trace_id
+                raise err
             turns = list(zip(knights, intent["prompts"]))
-            self._submit_state(state, turns,
-                               max_new=int(intent["max_new"]),
-                               deadline_s=intent.get("deadline_s"),
-                               adapters=intent.get("adapters"))
+            # Restore bookkeeping up to here is the resume_replay
+            # stage; the re-submit itself is placement, and the regen
+            # prefill/decode land in the usual stages via the event
+            # bridge — the resume leg gets a full waterfall.
+            trace.stage("resume_replay")
+            try:
+                self._submit_state(state, turns,
+                                   max_new=int(intent["max_new"]),
+                                   deadline_s=intent.get("deadline_s"),
+                                   adapters=intent.get("adapters"))
+            except _Shed as s:
+                trace.flag("shed")
+                trace.finish(f"shed:{s.decision.reason}",
+                             tail_stage="resume_replay")
+                s.trace_id = trace.trace_id
+                raise
+            except HttpError as e:
+                trace.flag("failed")
+                trace.finish(f"error:{e.reason}",
+                             tail_stage="resume_replay")
+                e.trace_id = trace.trace_id
+                raise
+            trace.stage("placement")
+            trace.replica = getattr(state, "replica", None)
         return state
 
     # ------------------------------------------------------------------
@@ -729,15 +900,22 @@ class Gateway:
 
     async def _pump_native(self, writer: asyncio.StreamWriter,
                            state: StreamState, consumer) -> None:
+        tid = state.trace.trace_id if state.trace is not None else ""
         sse = SseWriter(writer)
-        await sse.open()
+        await sse.open({"Traceparent": tracing.format_traceparent(tid)}
+                       if tid else None)
         # Metadata first: the stream id IS the reconnect handle
         # (GET /v1/streams/<id>) — a client that only ever saw this
-        # event can still resume from zero after a crash.
+        # event can still resume from zero after a crash. The trace id
+        # rides it (and every payload below) so any single event a
+        # client holds names the trace to quote in a report.
+        meta = {"type": "stream", "stream": state.stream_id,
+                "session": state.session, "turn": state.turn,
+                "knights": state.knights}
+        if tid:
+            meta["trace"] = tid
         await sse.event(
-            {"type": "stream", "stream": state.stream_id,
-             "session": state.session, "turn": state.turn,
-             "knights": state.knights},
+            meta,
             event_id=format_event_id(state.turn, list(consumer.sent)))
         try:
             while True:
@@ -750,6 +928,8 @@ class Gateway:
                 terminal = False
                 for ev in events:
                     payload, ntok = self._native_payload(state, ev)
+                    if tid:
+                        payload["trace"] = tid
                     await sse.event(payload, event_id=ev["id"],
                                     tokens=ntok)
                     terminal = terminal or ev["type"] in ("retired",
@@ -781,16 +961,21 @@ class Gateway:
 
     async def _pump_chat(self, writer: asyncio.StreamWriter,
                          state: StreamState, consumer) -> None:
+        tid = state.trace.trace_id if state.trace is not None else ""
         sse = SseWriter(writer)
-        await sse.open()
+        await sse.open({"Traceparent": tracing.format_traceparent(tid)}
+                       if tid else None)
         cid = f"chatcmpl-{state.stream_id}"
         model = state.knights[0]
 
         def chunk(delta: dict, finish: Optional[str] = None) -> dict:
-            return {"id": cid, "object": "chat.completion.chunk",
-                    "created": int(time.time()), "model": model,
-                    "choices": [{"index": 0, "delta": delta,
-                                 "finish_reason": finish}]}
+            out = {"id": cid, "object": "chat.completion.chunk",
+                   "created": int(time.time()), "model": model,
+                   "choices": [{"index": 0, "delta": delta,
+                                "finish_reason": finish}]}
+            if tid:
+                out["trace"] = tid
+            return out
 
         try:
             while True:

@@ -132,11 +132,17 @@ class SseWriter:
         self._w = writer
         self.opened = False
 
-    async def open(self) -> None:
-        self._w.write(_head(200, "text/event-stream", {
+    async def open(self, extra_headers: Optional[dict[str, str]] = None
+                   ) -> None:
+        # extra_headers: the gateway echoes the request's traceparent
+        # here (ISSUE 20) so clients can join server traces without
+        # parsing the SSE body.
+        headers = {
             "Cache-Control": "no-cache",
             "X-Accel-Buffering": "no",
-        }))
+        }
+        headers.update(extra_headers or {})
+        self._w.write(_head(200, "text/event-stream", headers))
         await self._w.drain()
         self.opened = True
         # Marked on the connection itself so the gateway's error

@@ -57,12 +57,16 @@ class StreamIntentJournal:
                max_new: int, deadline_s: Optional[float] = None,
                kind: str = "native",
                adapters: Optional[list] = None,
-               temperature: float = 0.0) -> Optional[dict]:
+               temperature: float = 0.0,
+               trace: Optional[str] = None) -> Optional[dict]:
         # adapters + temperature are part of the intent (review fix):
         # leg-3 resume re-submits from this record, and replaying with
         # different adapters — or regenerating a sampled stream at all
         # — would splice a DIFFERENT token stream onto the client's
         # watermark instead of the byte-identical continuation.
+        # `trace` (ISSUE 20) is the request's trace id: a post-crash
+        # reconnect's restore leg rejoins the ORIGINAL trace, so one
+        # client request stays one stitched trace across kill -9.
         rec = {
             "v": 1,
             "stream": stream_id,
@@ -75,6 +79,7 @@ class StreamIntentJournal:
             "kind": kind,
             "adapters": list(adapters) if adapters is not None else None,
             "temperature": temperature,
+            "trace": trace,
         }
         try:
             with self._lock, open(self.path, "a",

@@ -109,6 +109,10 @@ class StreamState:
         # submit): the abandonment seam — flipping request.abandoned
         # makes the scheduler release the round's holds (ISSUE 19).
         self.request = None
+        # The request's RequestTrace (utils/tracing, ISSUE 20): one per
+        # serving leg; its trace id is echoed on every SSE payload and
+        # survives reconnects/restarts via the intent journal.
+        self.trace = None
 
     # -- producer side (bridged scheduler events) --
 

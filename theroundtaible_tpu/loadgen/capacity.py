@@ -82,6 +82,10 @@ def fit_knee(points: list[dict], *, max_shed_rate: float = 0.05,
         "max_shed_rate": max_shed_rate,
         "ttft_slo_factor": ttft_slo_factor,
         "reason": reason,
+        # Trace ids of the knee point's slowest sessions (ISSUE 20):
+        # the p95 behind the derived SLO is inspectable via
+        # `roundtable trace show <id>` instead of being a bare number.
+        "exemplar_traces": list(knee.get("exemplar_traces") or ()),
     }
 
 

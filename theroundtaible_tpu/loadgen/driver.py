@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..utils import telemetry, tracing
 from .workload import SessionSpec
 
 # --- test counters (conftest `loadgen` marker guard) -----------------
@@ -114,6 +115,14 @@ def summarize(records: list[dict], *, offered_rps: float,
                    if r.get("ttft_s") is not None)
     tokens = sum(r.get("tokens", 0) for r in admitted)
     peak = _peak_concurrency(admitted)
+    # Exemplar trace ids for the point's slowest sessions: the knee
+    # finder copies these onto the knee, so a capacity regression
+    # links straight to retained traces (ISSUE 20).
+    slowest = sorted((r for r in admitted
+                      if r.get("ttft_s") is not None
+                      and r.get("trace")),
+                     key=lambda r: r["ttft_s"], reverse=True)
+    exemplars = [r["trace"] for r in slowest[:3]]
     return {
         "offered_rps": offered_rps,
         "duration_s": round(duration_s, 3),
@@ -132,6 +141,7 @@ def summarize(records: list[dict], *, offered_rps: float,
         "accepted_tok_s": round(tokens / max(duration_s, 1e-9), 3),
         "peak_concurrent_sessions": peak,
         "sessions_per_chip": round(peak / max(n_devices, 1), 3),
+        "exemplar_traces": exemplars,
     }
 
 
@@ -158,11 +168,13 @@ def _peak_concurrency(records: list[dict]) -> int:
 
 
 def _new_record(spec: SessionSpec, offset_s: float) -> dict:
+    # `trace` (ISSUE 20): every per-session record names its trace id,
+    # so a capacity regression joins directly to retained traces.
     return {"index": spec.index, "session": spec.session,
             "outcome": "failed", "shed_reason": None,
             "error_kind": None, "ttft_s": None, "tokens": 0,
             "reconnects": 0, "offset_s": round(offset_s, 4),
-            "wall_s": 0.0}
+            "wall_s": 0.0, "trace": None}
 
 
 # --- in-process driver -----------------------------------------------
@@ -239,25 +251,45 @@ class InProcessDriver:
                timeout_s: float,
                open_loop: bool = True) -> Optional[threading.Thread]:
         start = time.monotonic()
+        trace = tracing.RequestTrace(
+            kind="request", session=spec.session, endpoint="loadgen",
+            priority=spec.priority, rows=spec.rows())
+        rec["trace"] = trace.trace_id
         if self.admission is not None:
             with self._inflight_lock:
                 inflight = self._inflight
-            dec = self.admission.decide(
-                rows=spec.rows(), inflight=inflight,
-                deadline_s=spec.deadline_s, priority=spec.priority,
-                adapters=spec.adapters_per_turn)
+            with telemetry.attached(trace.context()):
+                dec = self.admission.decide(
+                    rows=spec.rows(), inflight=inflight,
+                    deadline_s=spec.deadline_s, priority=spec.priority,
+                    adapters=spec.adapters_per_turn)
             if not dec.admit:
                 rec["outcome"] = "shed"
                 rec["shed_reason"] = dec.reason
                 rec["wall_s"] = round(time.monotonic() - start, 4)
+                trace.flag("shed")
+                trace.finish(f"shed:{dec.reason}",
+                             tail_stage="admission")
                 return None
+        trace.stage("admission")
         state = {"tokens": 0, "req": None}
 
         def on_commit(event: dict) -> None:
             if event.get("type") == "tokens":
                 if rec["ttft_s"] is None:
+                    trace.stage("prefill")
+                    trace.carve("prefill", "queue_wait",
+                                event.get("queue_wait_s"))
+                    trace.stage("first_flush")
                     rec["ttft_s"] = round(
                         time.monotonic() - start, 4)
+                    if self.admission is not None:
+                        # Burn monitor only — note_ttft() would also
+                        # feed the p95 shed window and shift sweep
+                        # knees, so the decision ladder stays blind
+                        # to driver-side TTFTs.
+                        self.admission.slo.note_ttft(
+                            trace.ttft(), trace.trace_id)
                 state["tokens"] += len(event.get("tokens", ()))
                 rec["tokens"] = state["tokens"]
                 req = state["req"]
@@ -270,20 +302,26 @@ class InProcessDriver:
                     req.abandoned = True
 
         try:
-            req = self.sched.submit_async(
-                spec.session, list(spec.turns),
-                max_new_tokens=spec.max_new_tokens,
-                timeout_s=min(timeout_s, spec.deadline_s or timeout_s),
-                adapters_per_turn=spec.adapters_per_turn,
-                on_commit=on_commit)
+            with telemetry.attached(trace.context()):
+                req = self.sched.submit_async(
+                    spec.session, list(spec.turns),
+                    max_new_tokens=spec.max_new_tokens,
+                    timeout_s=min(timeout_s,
+                                  spec.deadline_s or timeout_s),
+                    adapters_per_turn=spec.adapters_per_turn,
+                    on_commit=on_commit)
         except Exception as e:  # noqa: BLE001 — refusals are sheds
             from ..core.errors import classify_error
             rec["outcome"] = "shed"
             rec["shed_reason"] = getattr(e, "reason", None) \
                 or classify_error(e)
             rec["wall_s"] = round(time.monotonic() - start, 4)
+            trace.flag("shed")
+            trace.finish(f"shed:{rec['shed_reason']}",
+                         tail_stage="admission")
             return None
         state["req"] = req
+        trace.stage("placement")
         if self.admission is not None:
             self.admission.note_admitted()
         with self._inflight_lock:
@@ -297,13 +335,19 @@ class InProcessDriver:
                 if spec.abandon_after_tokens is not None \
                         and req.abandoned:
                     rec["outcome"] = "abandoned"
+                    trace.finish("abandoned")
                 elif req.error is not None:
                     rec["outcome"] = "failed"
                     rec["error_kind"] = type(req.error).__name__
+                    trace.flag("failed")
+                    trace.finish(f"failed:{rec['error_kind']}")
                 elif req.event.is_set():
                     rec["outcome"] = "completed"
+                    trace.finish("ok")
                 else:
                     rec["error_kind"] = "driver_timeout"
+                    trace.flag("hung")
+                    trace.finish("hung")
             finally:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -530,6 +574,7 @@ class GatewayDriver:
             err = conn.body_json()
             conn.close()
             reason = err.get("reason") or f"http_{conn.status}"
+            rec["trace"] = err.get("trace") or rec.get("trace")
             if not first and reason in RETRYABLE_KINDS:
                 # An admitted session mid-retry that hits the
                 # restarting engine's refusal is NOT shed — keep
@@ -550,6 +595,8 @@ class GatewayDriver:
                     kind = ev.get("type")
                     if kind == "stream":
                         stream_id = ev.get("stream")
+                        rec["trace"] = (ev.get("trace")
+                                        or rec.get("trace"))
                     elif kind == "tokens":
                         if rec["ttft_s"] is None:
                             rec["ttft_s"] = round(

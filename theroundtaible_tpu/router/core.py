@@ -236,7 +236,21 @@ class SessionRouter:
                     adapters: Optional[list] = None) -> Replica:
         """Sticky per-session assignment with journal affinity for
         sessions from before this process, load-scored placement for
-        cold ones. Raises NoLiveReplica when nothing can serve."""
+        cold ones. Raises NoLiveReplica when nothing can serve.
+
+        Armed telemetry wraps the lookup in a `placement` span
+        (ISSUE 20): the gateway calls this under the request trace's
+        context, so the span lands in the request's waterfall naming
+        the replica that won."""
+        if not telemetry.ACTIVE:
+            return self._place(session, adapters)
+        with telemetry.span("placement", session=session) as sp:
+            rep = self._place(session, adapters)
+            sp.set_attr("replica", rep.name)
+            return rep
+
+    def _place(self, session: str,
+               adapters: Optional[list] = None) -> Replica:
         with self._lock:
             name = self._assign.get(session)
             if name is not None and name not in self._retired:
@@ -297,7 +311,8 @@ class SessionRouter:
         dispatch. Byte-identical — quantized pages move at stored
         width. Falls back to journal replay when either side has no
         host tier. Raises if the session is mid-turn on the source."""
-        with self._op_lock:
+        with self._op_lock, telemetry.span("migration",
+                                           session=session):
             with self._lock:
                 src_name = self._assign.get(session)
             src = self._replica(src_name) if src_name else None
@@ -392,7 +407,7 @@ class SessionRouter:
 
     def _roll_one(self, name: str) -> dict:
         rep = self._replica(name)
-        with self._op_lock:
+        with self._op_lock, telemetry.span("roll", replica=name):
             report: dict[str, Any] = {"replica": name, "op": "roll"}
             rep.scheduler.pause_admission("router.roll")
             with self._lock:
@@ -498,31 +513,36 @@ class SessionRouter:
 
     def _failover_session(self, session: str, dead: Replica,
                           dst: Replica) -> None:
-        adopted: list[str] = []
-        if dead.tier is not None and dst.tier is not None:
-            try:
-                # NEVER spill from a dead engine — only records that
-                # were already fully host-resident cross here.
-                adopted = dst.tier.adopt(dead.tier, sessions=[session])
-            except Exception:  # noqa: BLE001 — fall back to replay
-                adopted = []
-        if session not in adopted:
-            if self.journal is None:
-                raise RuntimeError(
-                    f"session {session!r} lost with {dead.name!r}: "
-                    "no host-resident KV and no journal to replay")
-            replay_turns(self.journal, session, dst.scheduler.submit)
-        with self._lock:
-            self._assign[session] = dst.name
-            self._publish_sessions(dst.name)
-            self._publish_sessions(dead.name)
-        self.failovers += 1
-        telemetry.inc("roundtable_router_failovers_total",
-                      replica=dead.name)
-        note_boundary_crossing()
-        telemetry.recorder().record(
-            "router_failover", session=session, src=dead.name,
-            dst=dst.name, via="adopt" if adopted else "replay")
+        with telemetry.span("failover", session=session,
+                            src=dead.name, dst=dst.name) as sp:
+            adopted: list[str] = []
+            if dead.tier is not None and dst.tier is not None:
+                try:
+                    # NEVER spill from a dead engine — only records
+                    # that were already fully host-resident cross here.
+                    adopted = dst.tier.adopt(dead.tier,
+                                             sessions=[session])
+                except Exception:  # noqa: BLE001 — fall back to replay
+                    adopted = []
+            if session not in adopted:
+                if self.journal is None:
+                    raise RuntimeError(
+                        f"session {session!r} lost with {dead.name!r}: "
+                        "no host-resident KV and no journal to replay")
+                replay_turns(self.journal, session,
+                             dst.scheduler.submit)
+            with self._lock:
+                self._assign[session] = dst.name
+                self._publish_sessions(dst.name)
+                self._publish_sessions(dead.name)
+            self.failovers += 1
+            telemetry.inc("roundtable_router_failovers_total",
+                          replica=dead.name)
+            note_boundary_crossing()
+            sp.set_attr("via", "adopt" if adopted else "replay")
+            telemetry.recorder().record(
+                "router_failover", session=session, src=dead.name,
+                dst=dst.name, via="adopt" if adopted else "replay")
 
     # --- retirement (RT-GAUGE-LEAK: series die with the replica) ---
 

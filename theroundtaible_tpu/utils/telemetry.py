@@ -68,9 +68,12 @@ _PROFILING = False
 
 # The span rungs, outermost first — the Budget tree (deadlines.RUNGS)
 # plus the two sub-turn seams budgets don't name ("segment" sits between
-# decode and dispatch; "profile" is maybe_profile's root).
-TRACE_RUNGS = ("profile", "discussion", "round", "turn", "prefill",
-               "decode", "segment", "dispatch")
+# decode and dispatch; "profile" is maybe_profile's root). ISSUE 20
+# adds the serving layer above the engine tree: "request" roots a
+# gateway stream leg, "resume" roots a reconnect/restore leg joined to
+# the original trace id (utils/tracing.py).
+TRACE_RUNGS = ("profile", "request", "resume", "discussion", "round",
+               "turn", "prefill", "decode", "segment", "dispatch")
 
 _INF = float("inf")
 
@@ -143,7 +146,14 @@ class MetricsRegistry:
         with self._lock:
             self._gauges.pop(key, None)
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(self, name: str, value: float,
+                exemplar: Optional[str] = None, **labels) -> None:
+        """One histogram sample. `exemplar` (ISSUE 20) attaches a
+        trace id to the bucket the sample lands in — last writer wins
+        per bucket — so a bad p95/p99 bucket links to a CONCRETE trace
+        instead of an anonymous count. Exemplars ride snapshot() and
+        the exposition's bucket lines (OpenMetrics `# {...}` syntax,
+        which the metrics.prom overlay parser already skips)."""
         key = (name, _label_key(labels))
         with self._lock:
             h = self._hists.get(key)
@@ -154,11 +164,19 @@ class MetricsRegistry:
             for i, b in enumerate(HIST_BUCKETS):
                 if value <= b:
                     h["counts"][i] += 1
+                    bucket = i
                     break
             else:
                 h["counts"][-1] += 1
+                bucket = len(HIST_BUCKETS)
             h["sum"] += value
             h["count"] += 1
+            if exemplar:
+                ex = h.get("exemplars")
+                if ex is None:
+                    ex = h["exemplars"] = {}
+                ex[bucket] = {"trace_id": str(exemplar),
+                              "value": round(float(value), 6)}
 
     # --- reads ---
 
@@ -175,6 +193,17 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get((name, _label_key(labels)))
 
+    def exemplars(self, name: str, **labels) -> dict[int, dict]:
+        """bucket index → {"trace_id", "value"} for one histogram
+        series (the trace-exemplar read side: `roundtable trace`
+        links a slow bucket to its retained trace)."""
+        with self._lock:
+            h = self._hists.get((name, _label_key(labels)))
+            if h is None:
+                return {}
+            return {int(k): dict(v)
+                    for k, v in h.get("exemplars", {}).items()}
+
     def snapshot(self) -> dict[str, Any]:
         """Full structured snapshot (flight dumps, tests)."""
 
@@ -190,7 +219,13 @@ class MetricsRegistry:
                 "counters": flat(self._counters),
                 "gauges": flat(self._gauges),
                 "histograms": {
-                    key: {"sum": round(h["sum"], 6), "count": h["count"]}
+                    key: {
+                        "sum": round(h["sum"], 6), "count": h["count"],
+                        **({"exemplars": {
+                            str(b): dict(e)
+                            for b, e in h["exemplars"].items()}}
+                           if h.get("exemplars") else {}),
+                    }
                     for key, h in flat(self._hists).items()},
             }
 
@@ -233,16 +268,27 @@ class MetricsRegistry:
             if name not in seen:
                 lines.append(f"# TYPE {name} histogram")
                 seen.add(name)
+            def ex_suffix(bucket: int) -> str:
+                # OpenMetrics exemplar on the bucket line; the
+                # metrics.prom overlay parser skips _bucket lines, so
+                # this never perturbs `status --perf/--kv` series.
+                e = h.get("exemplars", {}).get(bucket)
+                if not e:
+                    return ""
+                return (f' # {{trace_id="{e["trace_id"]}"}} '
+                        f'{e["value"]:g}')
+
             cum = 0
             for i, b in enumerate(HIST_BUCKETS):
                 cum += h["counts"][i]
                 le = (("le", f"{b:g}"),)
                 lines.append(
-                    f"{name}_bucket{fmt_labels(lkey + le)} {cum}")
+                    f"{name}_bucket{fmt_labels(lkey + le)} {cum}"
+                    f"{ex_suffix(i)}")
             cum += h["counts"][-1]
             lines.append(
                 f'{name}_bucket{fmt_labels(lkey + (("le", "+Inf"),))} '
-                f"{cum}")
+                f"{cum}{ex_suffix(len(HIST_BUCKETS))}")
             lines.append(f"{name}_sum{fmt_labels(lkey)} {h['sum']:g}")
             lines.append(f"{name}_count{fmt_labels(lkey)} {h['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
@@ -835,6 +881,16 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
                     "roundtable_router_migrations_total / "
                     "roundtable_router_failovers_total / "
                     "roundtable_router_rolls_total{replica=...}",
+        # ISSUE 20: the SLO burn-rate monitor's live state — the gauge
+        # moves in lockstep with SloBurnMonitor._note (one writer).
+        "slo": "roundtable_slo_burn_rate{window=fast|slow} gauge / "
+               "roundtable_slo_breaches_total "
+               "(utils/tracing SloBurnMonitor.describe)",
+        # ISSUE 20: end-to-end tracing provenance — retained-trace
+        # counter plus the TTFT stage decomposition the traces carry.
+        "tracing": "roundtable_traces_retained_total{outcome=...} / "
+                   "roundtable_gateway_ttft_seconds histogram "
+                   "(trace-id exemplars; utils/tracing store)",
     },
     # `roundtable status --capacity` (ISSUE 19): the measured
     # capacity frontier (CAPACITY_r19.json / the record behind
@@ -865,6 +921,28 @@ SURFACE_BINDINGS: dict[str, dict[str, str]] = {
         "record_errors":
             "roundtable_gateway_capacity_record_errors_total "
             "(malformed-record loud-degrade counter)",
+    },
+    # `roundtable status --slo` (ISSUE 20): the burn-rate monitor's
+    # machine shape — capacity-record SLO baseline joined with the
+    # live burn gauges; commands/status.py slo_surface() is the one
+    # builder (statically drift-bound like capacity_status).
+    "slo_status": {
+        "armed": "derived (p95_slo_s > 0)",
+        "p95_slo_s": "capacity record derived_thresholds.p95_slo_s "
+                     "(the admission SLO baseline)",
+        "source": "static (default | capacity_record)",
+        "record_path": "static (which frontier record was loaded)",
+        "error_budget": "static config "
+                        "(ROUNDTABLE_SLO_ERROR_BUDGET)",
+        "threshold": "static config "
+                     "(ROUNDTABLE_SLO_BURN_THRESHOLD)",
+        "burn_fast": "roundtable_slo_burn_rate{window=fast} gauge",
+        "burn_slow": "roundtable_slo_burn_rate{window=slow} gauge",
+        "breaches": "roundtable_slo_breaches_total",
+        "slo_dumps": "roundtable_flight_dumps_total"
+                     "{trigger=slo_burn}",
+        "traces_retained": "roundtable_traces_retained_total"
+                           "{outcome=...} sum",
     },
 }
 

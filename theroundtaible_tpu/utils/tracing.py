@@ -1,0 +1,630 @@
+"""End-to-end request tracing (ISSUE 20): trace-context propagation,
+critical-path TTFT attribution, tail-based retention, SLO burn rate.
+
+The PR-5 span tracer stops at the orchestrator/engine boundary — the
+serving layers above it (gateway, admission, router, loadgen) emit
+counters but no per-request causality. This module is the glue that
+threads ONE trace id from the HTTP header down to the dispatch spans
+and back out on every SSE event:
+
+- **Trace context** — a W3C-`traceparent`-style header parsed/minted
+  at the gateway (`parse_traceparent`/`format_traceparent`). The
+  16-hex trace ids the span tracer already mints ride zero-padded in
+  the 32-hex header field, so external ids and internal ids join
+  without a second id space.
+- **RequestTrace** — the per-request critical-path clock. Contiguous
+  `stage()` marks decompose TTFT and turn latency into the named,
+  non-overlapping stages in `STAGES`; the stage sum equals the leg
+  wall by construction, and `finish()` records both so the invariant
+  is checkable, not assumed. TTFT histograms gain trace-id exemplars
+  (telemetry.observe(..., exemplar=)) so a bad bucket links to a
+  concrete trace.
+- **Tail-based retention** — ordinary traces head-sample at
+  ROUNDTABLE_TRACE_SAMPLE (deterministic on the trace id, so every
+  leg of one trace samples the same way); traces that shed, failed,
+  hung, crossed a replica, or violated the SLO are ALWAYS retained.
+  Retained legs append JSONL to one file per trace id under
+  ROUNDTABLE_TRACE_DIR — append-mode, so the legs of a trace that
+  crossed a kill -9 stitch on disk across process generations.
+- **SloBurnMonitor** — the PR-19 capacity frontier as a live alerting
+  baseline: fast/slow windows of per-request good/bad events against
+  the record's p95 SLO, `roundtable_slo_burn_rate{window=}` gauges,
+  and a `slo_burn` flight dump when both windows burn hot.
+
+Always-on by design (trace ids, stage clocks, retention, burn rate
+are event-rate bookkeeping); SPANS still gate on telemetry.ACTIVE —
+armed, every gateway leg opens a real span the scheduler's turn span
+parents under, which is what the `tracing` test marker asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+from . import telemetry
+
+# The critical-path stages, in serving order. Non-overlapping and
+# collectively exhaustive per leg: every stage() mark attributes the
+# time since the previous mark, so the sum telescopes to the leg wall.
+STAGES = ("admission", "queue_wait", "placement", "prefill",
+          "first_flush", "decode_stream", "resume_replay")
+
+# Serving-layer span rungs (extends telemetry.TRACE_RUNGS, which names
+# the engine-side tree): "request" roots a gateway leg, "resume" roots
+# a reconnect/restore leg joined to the original trace.
+SERVING_RUNGS = ("request", "resume")
+
+# Engine-side rungs whose presence under a serving-rung trace proves a
+# CROSS-LAYER trace (the conftest `tracing` marker guard's criterion).
+ENGINE_RUNGS = ("turn", "prefill", "decode", "segment", "dispatch")
+
+_TRACEPARENT = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+# ---------------------------------------------------------------------------
+# trace context (the W3C-style header)
+# ---------------------------------------------------------------------------
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[tuple[str, str]]:
+    """(trace_id, parent_span_id) from a `traceparent` header, or None
+    when absent/malformed (the gateway then mints a fresh root). The
+    internal id space is 16-hex trace / 12-hex span (the PR-5 tracer's
+    widths); a full-width external id keeps its LOW bytes, which is
+    also exactly what round-trips through format_traceparent."""
+    if not header:
+        return None
+    m = _TRACEPARENT.match(header.strip().lower())
+    if m is None or m.group(1) == "ff":
+        return None
+    trace, span = m.group(2), m.group(3)
+    if set(trace) == {"0"} or set(span) == {"0"}:
+        return None
+    return trace[-16:], span[-12:]
+
+
+def format_traceparent(trace_id: str, span_id: str = "") -> str:
+    """The echo header: internal ids zero-padded to W3C widths."""
+    t = (trace_id or mint_trace_id())[-32:].rjust(32, "0")
+    s = (span_id or "0" * 12)[-16:].rjust(16, "0")
+    return f"00-{t}-{s}-01"
+
+
+# ---------------------------------------------------------------------------
+# head sampling + env knobs
+# ---------------------------------------------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def sample_rate() -> float:
+    return max(0.0, min(1.0, _env_float("ROUNDTABLE_TRACE_SAMPLE",
+                                        1.0)))
+
+
+def head_sampled(trace_id: str) -> bool:
+    """Deterministic head-sampling decision: a hash of the trace id,
+    not a coin flip, so every leg of one trace (including post-crash
+    resume legs in a NEW process) decides identically."""
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        frac = int(trace_id[-8:], 16) / float(0xFFFFFFFF)
+    except ValueError:
+        return True
+    return frac < rate
+
+
+def trace_dir() -> str:
+    """Where retained traces land: ROUNDTABLE_TRACE_DIR, else a
+    `traces/` subdir of the flight-dump dir (one knob usually moves
+    both — the bench sets ROUNDTABLE_TELEMETRY_DIR for the child)."""
+    configured = os.environ.get("ROUNDTABLE_TRACE_DIR")
+    if configured:
+        return configured
+    return os.path.join(telemetry.dump_dir(), "traces")
+
+
+def _keep() -> int:
+    return max(int(_env_float("ROUNDTABLE_TRACE_KEEP", 256)), 8)
+
+
+# ---------------------------------------------------------------------------
+# the per-request critical-path clock
+# ---------------------------------------------------------------------------
+
+class RequestTrace:
+    """One serving LEG of a client request: the initial admission+
+    stream, or a resume/restore leg joined to the same trace id after
+    a reconnect, kill -9, or failover.
+
+    Usage (the gateway's shape):
+
+        trace = RequestTrace(trace_id, stream=..., session=...)
+        ... admission decision ...
+        trace.stage("admission")
+        ... placement + submit ...
+        trace.stage("placement")
+        ... first committed tokens arrive ...
+        trace.stage("prefill")
+        trace.carve("prefill", "queue_wait", reported_queue_wait_s)
+        ... first event handed to consumers ...
+        trace.stage("first_flush")        # trace.ttft() is now final
+        ... stream retires ...
+        trace.finish("ok")                # rest lands in decode_stream
+
+    `stage(name)` attributes everything since the previous mark to
+    `name` (accumulating — a stage may be marked more than once);
+    `carve()` re-attributes an externally measured share of one stage
+    to another (the scheduler reports queue_wait_s; the gateway only
+    observes the submit→first-token lump). The stage sum therefore
+    telescopes to the leg wall by construction, and finish() records
+    both plus their gap so the invariant is CHECKED downstream
+    (bench --trace, tests), never assumed."""
+
+    __slots__ = ("trace_id", "parent_span_id", "kind", "stream_id",
+                 "session", "attrs", "stages", "flags", "outcome",
+                 "span", "t0", "_last", "_wall0", "_finished",
+                 "replica", "reconnects")
+
+    def __init__(self, trace_id: Optional[str] = None, *,
+                 parent_span_id: str = "", kind: str = "request",
+                 stream: str = "", session: str = "",
+                 **attrs) -> None:
+        self.trace_id = trace_id or mint_trace_id()
+        self.parent_span_id = parent_span_id
+        self.kind = kind            # "request" | "resume"
+        self.stream_id = stream
+        self.session = session
+        self.attrs = dict(attrs)
+        self.stages: dict[str, float] = {}
+        self.flags: list[str] = []
+        self.outcome = ""
+        self.replica: Optional[str] = None
+        self.reconnects = 0
+        self.t0 = time.monotonic()
+        self._last = self.t0
+        self._wall0 = time.time()
+        self._finished = False
+        # A REAL span only when telemetry is armed: the scheduler's
+        # turn span parents under it (tele_ctx captured inside
+        # telemetry.attached(trace.context())), which is the
+        # cross-layer link the `tracing` marker guard asserts.
+        self.span = None
+        if telemetry.ACTIVE:
+            self.span = telemetry.start_span(
+                kind, parent={"trace_id": self.trace_id,
+                              "span_id": parent_span_id},
+                stream=stream, session=session, **attrs)
+
+    # -- stage marks --
+
+    def stage(self, name: str) -> float:
+        """Attribute the time since the previous mark to `name`;
+        returns the increment."""
+        now = time.monotonic()
+        dt = max(now - self._last, 0.0)
+        self._last = now
+        self.stages[name] = self.stages.get(name, 0.0) + dt
+        return dt
+
+    def carve(self, src: str, dst: str,
+              seconds: Optional[float]) -> None:
+        """Move an externally measured `seconds` share of stage `src`
+        into stage `dst` (clamped — the split can never create time
+        the lump didn't contain, so the stage sum stays telescoped)."""
+        if not seconds or seconds <= 0.0:
+            return
+        have = self.stages.get(src, 0.0)
+        moved = min(float(seconds), have)
+        if moved <= 0.0:
+            return
+        self.stages[src] = have - moved
+        self.stages[dst] = self.stages.get(dst, 0.0) + moved
+
+    def flag(self, reason: str) -> None:
+        """Mark a tail-retention trigger (shed/failed/hung/
+        replica_crossed/slo_violation/...): flagged traces are always
+        retained regardless of the head-sampling rate."""
+        if reason not in self.flags:
+            self.flags.append(reason)
+
+    def ttft(self) -> float:
+        """TTFT as the STAGE SUM up through first_flush — the same
+        number the waterfall shows, so the admission SLO signal and
+        the trace can never disagree (the app.py:484 lump fix)."""
+        return sum(self.stages.get(s, 0.0) for s in
+                   ("resume_replay", "admission", "queue_wait",
+                    "placement", "prefill", "first_flush"))
+
+    def context(self) -> dict:
+        """A telemetry.attached()-compatible parent context: spans
+        opened under it (the scheduler's turn span) join this trace."""
+        span_id = self.span.span_id if self.span is not None else ""
+        return {"trace_id": self.trace_id, "span_id": span_id,
+                "rung": self.kind, "sink": None}
+
+    # -- completion --
+
+    def finish(self, outcome: str = "ok",
+               tail_stage: str = "decode_stream") -> dict:
+        """Close the leg: attribute the remaining time to `tail_stage`,
+        compute wall vs stage sum, end the span, hand the record to
+        the store (head-sample or tail-retain), and return it.
+        Idempotent — double-finish returns the first record."""
+        if self._finished:
+            return self._record
+        self._finished = True
+        self.stage(tail_stage)
+        self.outcome = outcome or "ok"
+        wall = time.monotonic() - self.t0
+        stage_sum = sum(self.stages.values())
+        record = {
+            "trace_id": self.trace_id,
+            "kind": self.kind,
+            "stream": self.stream_id,
+            "session": self.session,
+            "outcome": self.outcome,
+            "start": round(self._wall0, 6),
+            "wall_s": round(wall, 6),
+            "stage_sum_s": round(stage_sum, 6),
+            "stage_gap_s": round(wall - stage_sum, 6),
+            "ttft_s": round(self.ttft(), 6),
+            "stages": {k: round(v, 6)
+                       for k, v in self.stages.items() if v > 0.0},
+            "flags": list(self.flags),
+            "reconnects": self.reconnects,
+            "pid": os.getpid(),
+        }
+        if self.replica is not None:
+            record["replica"] = self.replica
+        if self.attrs:
+            record["attrs"] = {k: v for k, v in self.attrs.items()
+                               if isinstance(v, (str, int, float,
+                                                 bool))}
+        if self.span is not None:
+            for name, secs in record["stages"].items():
+                self.span.set_attr(f"stage_{name}_s", round(secs, 6))
+            self.span.set_attr("outcome", self.outcome)
+            self.span.end("ok" if outcome == "ok"
+                          else f"error:{outcome}")
+            record["span_id"] = self.span.span_id
+            self.span = None
+        self._record = record
+        store().note(record)
+        return record
+
+    # finish() stashes its record here for idempotence; a slot can't
+    # default, so read through a property with a safe fallback.
+    @property
+    def _record(self) -> dict:
+        return self.attrs.get("_final_record", {})
+
+    @_record.setter
+    def _record(self, value: dict) -> None:
+        self.attrs["_final_record"] = value
+
+
+# ---------------------------------------------------------------------------
+# retention store
+# ---------------------------------------------------------------------------
+
+class TraceStore:
+    """Finished legs: a bounded in-process ring (the `roundtable trace`
+    CLI's live view) plus the on-disk retained set — one JSONL file
+    per trace id, append-mode, so the legs of one trace written by
+    DIFFERENT process generations (kill -9 + --resume) stitch on disk
+    without any coordination."""
+
+    def __init__(self) -> None:
+        self._ring: deque[dict] = deque(maxlen=_keep())
+        self._lock = threading.Lock()
+        self.retained = 0
+
+    def note(self, record: dict) -> None:
+        with self._lock:
+            self._ring.append(record)
+        if self._should_retain(record):
+            self._write(record)
+
+    def _should_retain(self, record: dict) -> bool:
+        if record.get("flags"):
+            return True        # tail-based: anomalies always survive
+        return head_sampled(record.get("trace_id", ""))
+
+    def _write(self, record: dict) -> None:
+        try:
+            d = trace_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"trace-{record.get('trace_id', 'unknown')}.jsonl")
+            is_new = not os.path.exists(path)
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record, default=str) + "\n")
+            with self._lock:
+                self.retained += 1
+            telemetry.inc("roundtable_traces_retained_total",
+                          outcome=record.get("outcome", "ok"))
+            if is_new:
+                _prune_traces(d)
+        except OSError:
+            pass  # retention must never kill serving
+
+    def recent(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            out = list(self._ring)
+        return out[-n:]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.retained = 0
+
+
+def _prune_traces(d: str) -> None:
+    """Cap the retained-trace dir at ROUNDTABLE_TRACE_KEEP files
+    (oldest-mtime first) — the flight-dump pruning rule applied to
+    traces, so a long overload can't fill the disk with sheds."""
+    keep = _keep()
+    try:
+        files = sorted(
+            (p for p in os.listdir(d)
+             if p.startswith("trace-") and p.endswith(".jsonl")),
+            key=lambda p: os.path.getmtime(os.path.join(d, p)))
+        for p in files[:-keep]:
+            os.unlink(os.path.join(d, p))
+    except OSError:
+        pass
+
+
+_store: Optional[TraceStore] = None
+_store_lock = threading.Lock()
+
+
+def store() -> TraceStore:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = TraceStore()
+        return _store
+
+
+def load_traces(directory: Optional[str] = None
+                ) -> dict[str, list[dict]]:
+    """trace_id → legs (start-ordered) from the retained-trace dir —
+    the `roundtable trace` CLI's and bench --trace's read side. Torn
+    tails (a leg mid-write at kill -9) are skipped, not fatal."""
+    d = directory or trace_dir()
+    out: dict[str, list[dict]] = {}
+    try:
+        names = [p for p in os.listdir(d)
+                 if p.startswith("trace-") and p.endswith(".jsonl")]
+    except OSError:
+        return out
+    for name in names:
+        legs = []
+        try:
+            with open(os.path.join(d, name), encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    if isinstance(rec, dict) and rec.get("trace_id"):
+                        legs.append(rec)
+        except OSError:
+            continue
+        if legs:
+            legs.sort(key=lambda r: r.get("start", 0.0))
+            out[legs[0]["trace_id"]] = legs
+    return out
+
+
+def stitch(legs: list[dict]) -> dict:
+    """One client request's stitched view across its legs: aggregate
+    stages, total wall vs stage sum, flags union. The chaos
+    acceptance (TRACE_r20.json) checks the stitched stage sum against
+    client-measured wall."""
+    stages: dict[str, float] = {}
+    flags: list[str] = []
+    wall = stage_sum = 0.0
+    for leg in legs:
+        for k, v in leg.get("stages", {}).items():
+            stages[k] = stages.get(k, 0.0) + float(v)
+        for fl in leg.get("flags", []):
+            if fl not in flags:
+                flags.append(fl)
+        wall += float(leg.get("wall_s", 0.0))
+        stage_sum += float(leg.get("stage_sum_s", 0.0))
+    first = legs[0] if legs else {}
+    return {
+        "trace_id": first.get("trace_id", ""),
+        "session": first.get("session", ""),
+        "legs": len(legs),
+        "pids": sorted({leg.get("pid") for leg in legs
+                        if leg.get("pid") is not None}),
+        "outcome": legs[-1].get("outcome", "") if legs else "",
+        "wall_s": round(wall, 6),
+        "stage_sum_s": round(stage_sum, 6),
+        "stages": {k: round(v, 6) for k, v in sorted(stages.items())},
+        "flags": flags,
+        "ttft_s": first.get("ttft_s"),
+    }
+
+
+def cross_layer_count(spans: list[dict]) -> int:
+    """How many traces in `spans` (flight-ring span records) link a
+    serving-layer root (rung "request"/"resume") to an engine-side
+    span (turn/segment/dispatch/...) — the `tracing` marker guard's
+    proof that propagation crossed the gateway→scheduler seam."""
+    serving = {s.get("trace_id") for s in spans
+               if s.get("rung") in SERVING_RUNGS}
+    engine = {s.get("trace_id") for s in spans
+              if s.get("rung") in ENGINE_RUNGS}
+    return len(serving & engine)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor
+# ---------------------------------------------------------------------------
+
+class SloBurnMonitor:
+    """The PR-19 capacity frontier as a live alerting baseline.
+
+    Each finished request lands as one good/bad event (bad = shed, or
+    TTFT over the record's p95 SLO). Two sliding windows — fast
+    (ROUNDTABLE_SLO_FAST_WINDOW_S, 60 s) and slow
+    (ROUNDTABLE_SLO_SLOW_WINDOW_S, 600 s) — each compute
+
+        burn = bad_fraction / error_budget
+
+    (error budget = ROUNDTABLE_SLO_ERROR_BUDGET, default 0.05 — the
+    shed-rate bound the knee fit used). Burn 1.0 = consuming budget
+    exactly as fast as the frontier allows; the classic multiwindow
+    rule fires only when BOTH windows exceed
+    ROUNDTABLE_SLO_BURN_THRESHOLD (fast = it's happening now, slow =
+    it's not a blip), which publishes roundtable_slo_burn_rate{window=}
+    gauges continuously and ships one `slo_burn` flight dump per fast
+    window (cooldown — a sustained breach must not dump in a loop)."""
+
+    MIN_SAMPLES = 8
+
+    def __init__(self, p95_slo_s: float = 0.0, *,
+                 error_budget: Optional[float] = None,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None,
+                 source: str = "default") -> None:
+        self.p95_slo_s = float(p95_slo_s or 0.0)
+        self.error_budget = max(
+            error_budget if error_budget is not None
+            else _env_float("ROUNDTABLE_SLO_ERROR_BUDGET", 0.05),
+            1e-6)
+        self.fast_window_s = (
+            fast_window_s if fast_window_s is not None
+            else _env_float("ROUNDTABLE_SLO_FAST_WINDOW_S", 60.0))
+        self.slow_window_s = (
+            slow_window_s if slow_window_s is not None
+            else _env_float("ROUNDTABLE_SLO_SLOW_WINDOW_S", 600.0))
+        self.threshold = _env_float("ROUNDTABLE_SLO_BURN_THRESHOLD",
+                                    1.0)
+        self.source = source
+        self._events: deque[tuple[float, bool]] = deque(maxlen=4096)
+        self._lock = threading.Lock()
+        self.breaches = 0
+        self._last_dump_at = 0.0
+        self.last_dump_path = ""
+
+    @property
+    def armed(self) -> bool:
+        """No SLO baseline → nothing to burn against; the monitor
+        idles (gauges 0, never fires)."""
+        return self.p95_slo_s > 0.0
+
+    # -- event intake (one per finished admission decision) --
+
+    def note_ttft(self, ttft_s: float,
+                  trace_id: str = "") -> None:
+        bad = self.armed and ttft_s > self.p95_slo_s
+        self._note(bad, trace_id=trace_id if bad else "")
+
+    def note_shed(self) -> None:
+        self._note(True)
+
+    def _note(self, bad: bool, trace_id: str = "") -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, bad))
+        if not self.armed:
+            return
+        fast = self._burn(now, self.fast_window_s)
+        slow = self._burn(now, self.slow_window_s)
+        telemetry.set_gauge("roundtable_slo_burn_rate", round(fast, 4),
+                            window="fast")
+        telemetry.set_gauge("roundtable_slo_burn_rate", round(slow, 4),
+                            window="slow")
+        if (fast > self.threshold and slow > self.threshold
+                and self._count(now, self.fast_window_s)
+                >= self.MIN_SAMPLES):
+            self._fire(now, fast, slow, trace_id)
+
+    def _count(self, now: float, window_s: float) -> int:
+        with self._lock:
+            return sum(1 for t, _bad in self._events
+                       if now - t <= window_s)
+
+    def _burn(self, now: float, window_s: float) -> float:
+        with self._lock:
+            total = bad = 0
+            for t, is_bad in self._events:
+                if now - t <= window_s:
+                    total += 1
+                    bad += is_bad
+        if total == 0:
+            return 0.0
+        return (bad / total) / self.error_budget
+
+    def _fire(self, now: float, fast: float, slow: float,
+              trace_id: str) -> None:
+        with self._lock:
+            if now - self._last_dump_at < self.fast_window_s:
+                return
+            self._last_dump_at = now
+            self.breaches += 1
+        telemetry.inc("roundtable_slo_breaches_total")
+        extra = {"burn_fast": round(fast, 4),
+                 "burn_slow": round(slow, 4),
+                 "p95_slo_s": self.p95_slo_s,
+                 "error_budget": self.error_budget,
+                 "threshold": self.threshold}
+        if trace_id:
+            extra["exemplar_trace_id"] = trace_id
+        self.last_dump_path = telemetry.flight_dump("slo_burn",
+                                                    extra=extra)
+
+    # -- reads --
+
+    def burn_rates(self) -> dict[str, float]:
+        now = time.monotonic()
+        return {"fast": round(self._burn(now, self.fast_window_s), 4),
+                "slow": round(self._burn(now, self.slow_window_s), 4)}
+
+    def describe(self) -> dict[str, Any]:
+        rates = self.burn_rates()
+        now = time.monotonic()
+        return {
+            "armed": self.armed,
+            "p95_slo_s": self.p95_slo_s,
+            "source": self.source,
+            "error_budget": self.error_budget,
+            "threshold": self.threshold,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "burn_fast": rates["fast"],
+            "burn_slow": rates["slow"],
+            "samples_fast": self._count(now, self.fast_window_s),
+            "samples_slow": self._count(now, self.slow_window_s),
+            "breaches": self.breaches,
+            "last_dump": self.last_dump_path,
+        }
